@@ -1,0 +1,30 @@
+"""fluidframework-tpu — a TPU-native real-time collaboration framework.
+
+A ground-up, TPU-first re-design of the capabilities of Fluid Framework
+(reference: volser/FluidFramework): conflict-resolving distributed data
+structures (merge-tree sequence, map, directory, matrix, tree, cell, counter,
+consensus collections), a total-order sequencing service with a durable op log,
+summarization/checkpointing, and reconnect/resubmit resilience.
+
+The architectural inversion vs. the reference: the per-document hot loops —
+the sequencer's ticket state machine (reference: server/routerlicious/packages/
+lambdas/src/deli/lambda.ts:236) and the DDS ``processCore`` merge bodies
+(reference: packages/dds/*/src) — are pure functions over fixed-shape arrays,
+vectorized with ``jax.vmap`` across a batch axis of thousands of documents and
+sharded with ``jax.sharding``/``shard_map`` across a TPU mesh. The client and
+service layers are thin, idiomatic Python/C++ hosts around those kernels.
+
+Layering (mirrors SURVEY.md §1, machine-checked by tests/test_layering.py):
+
+    protocol/   layer 0-1: wire protocol, quorum state machine
+    ops/        batched JAX/XLA/Pallas kernels (sequencer, map, merge-tree,
+                matrix, tree) + their scalar oracles
+    dds/        distributed data structures (client merge engines)
+    runtime/    container runtime, data stores, delta manager, pending state
+    drivers/    document service drivers (local, replay)
+    server/     ordering service: lambdas, orderer, op log, local server
+    parallel/   device mesh, sharding specs, collective layout
+    utils/      telemetry, tracing, config
+"""
+
+__version__ = "0.1.0"

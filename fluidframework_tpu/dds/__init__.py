@@ -1,0 +1,5 @@
+"""Distributed data structures — the client-side merge engines.
+
+Reference parity: packages/dds/* (merge-tree, sequence, map, directory,
+matrix, cell, counter, ordered-collection, register-collection, tree).
+"""

@@ -1,0 +1,8 @@
+"""Batched JAX/XLA/Pallas kernels — the device-side hot paths.
+
+Each kernel is a pure function ``(state_arrays, op_arrays) -> (state', out)``
+over fixed-shape int32 arrays, ``vmap``-ed over a leading documents axis and
+sharded across the TPU mesh (see :mod:`fluidframework_tpu.parallel`). Every
+kernel ships with a scalar Python oracle in the same module family used for
+differential convergence testing (SURVEY.md §4.2's farms model).
+"""

@@ -1,0 +1,6 @@
+"""Device mesh + sharding layout for multi-chip scale-out.
+
+The workload's data-parallel axis is *documents* (SURVEY.md §2.9): kernels are
+per-document independent, so docs shard across chips over ICI with no
+collectives on the merge path; metrics/load-balance use psum/all_gather.
+"""

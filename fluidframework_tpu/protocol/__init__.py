@@ -1,0 +1,37 @@
+"""Layer 0/1: protocol definitions + the deterministic quorum state machine.
+
+Reference parity: server/routerlicious/packages/protocol-definitions/src/
+protocol.ts (wire messages) and protocol-base/src/{quorum.ts,protocol.ts}
+(quorum + protocol op handler).
+"""
+
+from .messages import (
+    MessageType,
+    NackErrorType,
+    DocumentMessage,
+    SequencedDocumentMessage,
+    NackMessage,
+    Trace,
+    ClientDetail,
+    ScopeType,
+    SignalMessage,
+)
+from .quorum import Quorum, PendingProposal, CommittedProposal, QuorumClient
+from .handler import ProtocolOpHandler
+
+__all__ = [
+    "MessageType",
+    "NackErrorType",
+    "DocumentMessage",
+    "SequencedDocumentMessage",
+    "NackMessage",
+    "Trace",
+    "ClientDetail",
+    "ScopeType",
+    "SignalMessage",
+    "Quorum",
+    "PendingProposal",
+    "CommittedProposal",
+    "QuorumClient",
+    "ProtocolOpHandler",
+]

@@ -1,0 +1,5 @@
+"""Ordering service: sequencer host, lambda pipeline, op log, local server.
+
+Reference parity: server/routerlicious/packages/* (deli, scriptorium,
+broadcaster, scribe, lambdas-driver, memory-orderer, local-server).
+"""

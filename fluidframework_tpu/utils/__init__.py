@@ -1,0 +1,4 @@
+"""Base utilities: telemetry, tracing, events, heaps, config.
+
+Reference parity: common/lib/common-utils, packages/utils/telemetry-utils.
+"""

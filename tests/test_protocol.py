@@ -1,0 +1,137 @@
+"""Protocol layer tests: quorum propose/accept/commit, membership, handler.
+
+Oracle behavior from reference protocol-base/src/quorum.ts:262-333 and
+protocol.ts:47 (see SURVEY.md §2.6).
+"""
+
+from fluidframework_tpu.protocol import (
+    ClientDetail,
+    MessageType,
+    ProtocolOpHandler,
+    Quorum,
+    SequencedDocumentMessage,
+)
+
+
+def seq_msg(seq, msn, mtype=MessageType.NOOP, client_id="c1", contents=None,
+            data=None, ref_seq=0, client_seq=0):
+    return SequencedDocumentMessage(
+        client_id=client_id,
+        sequence_number=seq,
+        minimum_sequence_number=msn,
+        client_sequence_number=client_seq,
+        reference_sequence_number=ref_seq,
+        type=mtype,
+        contents=contents,
+        data=data,
+    )
+
+
+class TestQuorum:
+    def test_proposal_accepted_when_msn_advances(self):
+        q = Quorum()
+        approved = []
+        q.on_approve_proposal.append(lambda s, k, v, a: approved.append((s, k, v, a)))
+        q.add_proposal("code", "pkg@1", sequence_number=5, local=False)
+        assert not q.has("code")
+        # MSN below proposal seq: still pending.
+        q.update_minimum_sequence_number(seq_msg(6, 4))
+        assert not q.has("code")
+        # MSN reaches proposal seq: accepted.
+        immediate = q.update_minimum_sequence_number(seq_msg(7, 5))
+        assert immediate is True
+        assert q.get("code") == "pkg@1"
+        assert approved == [(5, "code", "pkg@1", 7)]
+        committed = q.get_committed("code")
+        assert committed.approval_sequence_number == 7
+        assert committed.commit_sequence_number == -1
+        # MSN passes approval seq: committed.
+        q.update_minimum_sequence_number(seq_msg(9, 8))
+        assert q.get_committed("code").commit_sequence_number == 9
+
+    def test_rejected_proposal_never_becomes_value(self):
+        q = Quorum()
+        rejected = []
+        q.on_reject_proposal.append(lambda s, k, v, r: rejected.append((s, k, r)))
+        q.add_proposal("code", "pkg@1", sequence_number=3, local=True)
+        assert q.reject_proposal("c2", 3)
+        q.update_minimum_sequence_number(seq_msg(5, 3))
+        assert not q.has("code")
+        assert rejected == [(3, "code", ["c2"])]
+        # Rejection after settlement is a no-op.
+        assert not q.reject_proposal("c3", 3)
+
+    def test_msn_never_regresses_settlement(self):
+        q = Quorum()
+        q.add_proposal("k", 1, sequence_number=2, local=False)
+        q.update_minimum_sequence_number(seq_msg(4, 3))
+        assert q.get("k") == 1
+        # Stale MSN (<= current) is ignored.
+        assert q.update_minimum_sequence_number(seq_msg(5, 2)) is False
+
+    def test_later_proposal_wins_key(self):
+        q = Quorum()
+        q.add_proposal("k", "old", sequence_number=2, local=False)
+        q.add_proposal("k", "new", sequence_number=3, local=False)
+        q.update_minimum_sequence_number(seq_msg(5, 4))
+        assert q.get("k") == "new"
+
+    def test_snapshot_roundtrip(self):
+        q = Quorum()
+        q.add_member("c1", __import__(
+            "fluidframework_tpu.protocol.quorum", fromlist=["QuorumClient"]
+        ).QuorumClient(detail=ClientDetail(client_id="c1"), sequence_number=1))
+        q.add_proposal("k", {"x": 1}, sequence_number=4, local=False)
+        q.update_minimum_sequence_number(seq_msg(6, 5))
+        q2 = Quorum.load(q.snapshot())
+        assert q2.get("k") == {"x": 1}
+        assert "c1" in q2.get_members()
+        assert q2.snapshot() == q.snapshot()
+
+
+class TestProtocolOpHandler:
+    def test_join_leave_propose_flow(self):
+        h = ProtocolOpHandler()
+        h.process_message(
+            seq_msg(1, 0, MessageType.CLIENT_JOIN, client_id=None,
+                    data=ClientDetail(client_id="c1")),
+            local=False,
+        )
+        assert "c1" in h.quorum.get_members()
+        h.process_message(
+            seq_msg(2, 1, MessageType.PROPOSE,
+                    contents={"key": "code", "value": "app@1"}),
+            local=False,
+        )
+        # A noop that advances MSN past the proposal accepts it.
+        out = h.process_message(seq_msg(3, 2), local=False)
+        assert out["immediate_noop"] is True
+        assert h.quorum.get("code") == "app@1"
+        h.process_message(
+            seq_msg(4, 3, MessageType.CLIENT_LEAVE, client_id=None, data="c1"),
+            local=False,
+        )
+        assert "c1" not in h.quorum.get_members()
+        assert h.sequence_number == 4
+        assert h.minimum_sequence_number == 3
+
+    def test_gap_detection(self):
+        h = ProtocolOpHandler()
+        h.process_message(seq_msg(1, 0), local=False)
+        try:
+            h.process_message(seq_msg(3, 0), local=False)
+        except AssertionError:
+            pass
+        else:  # pragma: no cover
+            raise AssertionError("expected gap assertion")
+
+    def test_snapshot_roundtrip(self):
+        h = ProtocolOpHandler()
+        h.process_message(
+            seq_msg(1, 0, MessageType.CLIENT_JOIN, client_id=None,
+                    data=ClientDetail(client_id="c1")),
+            local=False,
+        )
+        h2 = ProtocolOpHandler.load(h.snapshot())
+        assert h2.sequence_number == 1
+        assert "c1" in h2.quorum.get_members()
