@@ -1,0 +1,290 @@
+"""Batched total-order sequencer kernel ("deli-kernel").
+
+The reference sequencer is a single-threaded per-document ticket loop
+(server/routerlicious/packages/lambdas/src/deli/lambda.ts:236-470) scaled by
+Kafka partitioning across documents. Here the same state machine is a pure,
+branch-free function over int32 arrays: ``lax.scan`` walks the ops of one
+tick in order (sequencing is inherently sequential *within* a document) and
+``jax.vmap`` batches thousands of documents — the workload's true data-
+parallel axis (SURVEY.md §2.9) — onto the TPU's vector unit. Sharding the
+document axis across a mesh needs no collectives on this path.
+
+Client identity is a host-assigned *slot index* (< ``num_slots``); the CPU
+front-door owns the string-id ↔ slot mapping (see server.session). All
+semantics (dup/gap NACKs, MSN, join/leave dedupe, no-op consolidation) are
+differentially tested against the scalar oracle
+:class:`fluidframework_tpu.server.sequencer.DocumentSequencer`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocol.messages import MessageType
+from . import opcodes as oc
+
+I32 = jnp.int32
+
+
+class SequencerState(NamedTuple):
+    """Per-document sequencer state. Leading axis = documents (B)."""
+
+    seq: jax.Array            # i32[B] current sequence number
+    msn: jax.Array            # i32[B] minimum sequence number
+    last_sent_msn: jax.Array  # i32[B] msn of last immediately-sent message
+    nack_future: jax.Array    # bool[B] control-driven reject-all state
+    active: jax.Array         # bool[B, C] slot occupied
+    cseq: jax.Array           # i32[B, C] last clientSequenceNumber per client
+    cref: jax.Array           # i32[B, C] referenceSequenceNumber per client
+    clu: jax.Array            # i32[B, C] last-update timestamp (ms)
+    csum: jax.Array           # bool[B, C] summarize scope
+    cnack: jax.Array          # bool[B, C] client marked nacked
+
+
+class OpBatch(NamedTuple):
+    """One tick of raw ops, padded to K per document. Axes [B, K]."""
+
+    valid: jax.Array         # bool — padding mask
+    kind: jax.Array          # i32 MessageType opcode
+    slot: jax.Array          # i32 submitting client slot; -1 = system message
+    target: jax.Array        # i32 join/leave subject slot (else ignored)
+    client_seq: jax.Array    # i32
+    ref_seq: jax.Array       # i32 (-1 = direct/REST op)
+    timestamp: jax.Array     # i32 ms
+    has_contents: jax.Array  # bool (no-op consolidation heuristic)
+    can_summarize: jax.Array  # bool (join detail)
+    is_nack_future: jax.Array  # bool (control payload)
+
+
+class TicketBatch(NamedTuple):
+    """Sequencing outcome per op. Axes [B, K]."""
+
+    kind: jax.Array       # i32 oc.OUT_*
+    seq: jax.Array        # i32 assigned seq (sequenced) / current seq (nack) / -1
+    msn: jax.Array        # i32
+    send: jax.Array       # i32 oc.SEND_*
+    nack_code: jax.Array  # i32 oc.NACK_*
+
+
+def init_state(num_docs: int, num_slots: int = 16) -> SequencerState:
+    b, c = num_docs, num_slots
+    return SequencerState(
+        seq=jnp.zeros((b,), I32),
+        msn=jnp.zeros((b,), I32),
+        last_sent_msn=jnp.zeros((b,), I32),
+        nack_future=jnp.zeros((b,), jnp.bool_),
+        active=jnp.zeros((b, c), jnp.bool_),
+        cseq=jnp.zeros((b, c), I32),
+        cref=jnp.zeros((b, c), I32),
+        clu=jnp.zeros((b, c), I32),
+        csum=jnp.zeros((b, c), jnp.bool_),
+        cnack=jnp.zeros((b, c), jnp.bool_),
+    )
+
+
+def _ticket_step(s: SequencerState, op: OpBatch):
+    """One op through one document's state machine. All fields scalar/[C]."""
+    num_slots = s.active.shape[0]
+    is_client = op.slot >= 0
+    slot = jnp.clip(op.slot, 0, num_slots - 1)
+    target = jnp.clip(op.target, 0, num_slots - 1)
+
+    exists = is_client & s.active[slot]
+    expected = s.cseq[slot] + 1
+    gap = exists & (op.client_seq > expected)
+    dup = exists & (op.client_seq < expected)
+
+    is_join = op.kind == int(MessageType.CLIENT_JOIN)
+    is_leave = op.kind == int(MessageType.CLIENT_LEAVE)
+    join_dup = (~is_client) & is_join & s.active[target]
+    leave_dup = (~is_client) & is_leave & ~s.active[target]
+
+    nonexistent = is_client & ~gap & ~dup & (~s.active[slot] | s.cnack[slot])
+    refseq_nack = (
+        is_client & ~gap & ~dup & ~nonexistent
+        & (op.ref_seq != -1) & (op.ref_seq < s.msn)
+    )
+    summarize_nack = (
+        is_client & ~gap & ~dup & ~nonexistent & ~refseq_nack
+        & (op.kind == int(MessageType.SUMMARIZE)) & ~s.csum[slot]
+    )
+
+    nack_future = s.nack_future
+    nacked = op.valid & (
+        nack_future | gap | nonexistent | refseq_nack | summarize_nack
+    )
+    ignored = op.valid & ~nack_future & (dup | join_dup | leave_dup)
+    sequenced = op.valid & ~nacked & ~ignored
+
+    nack_code = jnp.select(
+        [nack_future, gap, nonexistent, refseq_nack, summarize_nack],
+        [
+            I32(oc.NACK_FUTURE),
+            I32(oc.NACK_GAP),
+            I32(oc.NACK_NONEXISTENT_CLIENT),
+            I32(oc.NACK_REFSEQ_BELOW_MSN),
+            I32(oc.NACK_NO_SUMMARY_SCOPE),
+        ],
+        default=I32(oc.NACK_NONE),
+    )
+
+    # Side effect of a refseq NACK: client is marked nacked at refSeq=MSN
+    # (deli lambda.ts:305-312 upsert with nack=true).
+    do_refseq_mark = op.valid & ~nack_future & refseq_nack
+    lanes = jnp.arange(num_slots)
+    onehot_slot = (lanes == slot) & is_client
+    mark = onehot_slot & do_refseq_mark
+    cseq = jnp.where(mark, op.client_seq, s.cseq)
+    cref = jnp.where(mark, s.msn, s.cref)
+    clu = jnp.where(mark, op.timestamp, s.clu)
+    cnack = jnp.where(mark, True, s.cnack)
+
+    # Membership changes. NOTE: a duplicate join is dropped from the stream
+    # but STILL upserts the client entry (clientSeq=0, refSeq=msn) — the
+    # reference's upsertClient mutates before deli's early return
+    # (clientSeqManager.ts:79-88, deli lambda.ts:277-287). A duplicate leave
+    # has no side effect.
+    onehot_target = lanes == target
+    do_join = op.valid & ~nack_future & is_join & ~is_client
+    do_leave = sequenced & is_leave & ~is_client
+    join_mask = onehot_target & do_join
+    active = jnp.where(join_mask, True, jnp.where(onehot_target & do_leave, False, s.active))
+    cseq = jnp.where(join_mask, 0, cseq)
+    cref = jnp.where(join_mask, s.msn, cref)
+    clu = jnp.where(join_mask, op.timestamp, clu)
+    # Scopes are set only at first join; a dup-join upsert leaves them as-is
+    # (upsertClient updates seq numbers but not scopes for existing clients).
+    fresh_join_mask = join_mask & ~s.active[target]
+    csum = jnp.where(fresh_join_mask, op.can_summarize, s.csum)
+    cnack = jnp.where(join_mask, False, cnack)
+
+    # Sequence-number rev (step 5).
+    is_noop = op.kind == int(MessageType.NOOP)
+    is_noclient = op.kind == int(MessageType.NO_CLIENT)
+    is_control = op.kind == int(MessageType.CONTROL)
+    rev1 = sequenced & jnp.where(
+        is_client, ~is_noop, ~(is_noop | is_noclient | is_control)
+    )
+    seq1 = s.seq + rev1.astype(I32)
+
+    # Client upsert on the sequenced path.
+    ref_eff = jnp.where(is_client & (op.ref_seq == -1), seq1, op.ref_seq)
+    up = onehot_slot & (sequenced & is_client)
+    cseq = jnp.where(up, op.client_seq, cseq)
+    cref = jnp.where(up, ref_eff, cref)
+    clu = jnp.where(up, op.timestamp, clu)
+    cnack = jnp.where(up, False, cnack)
+
+    # MSN (step 6).
+    min_ref = jnp.min(jnp.where(active, cref, oc.INT32_MAX))
+    no_clients = ~jnp.any(active)
+    msn1 = jnp.where(no_clients, seq1, min_ref)
+
+    # No-op consolidation heuristics (step 7).
+    stale = msn1 <= s.last_sent_msn
+    client_noop = sequenced & is_noop & is_client
+    server_noop = sequenced & is_noop & ~is_client
+    noclient = sequenced & is_noclient & ~is_client
+    control = sequenced & is_control & ~is_client
+
+    send = jnp.full((), oc.SEND_IMMEDIATE, I32)
+    send = jnp.where(client_noop & (~op.has_contents | stale), oc.SEND_LATER, send)
+    send = jnp.where(server_noop & stale, oc.SEND_NEVER, send)
+    send = jnp.where(noclient & ~no_clients, oc.SEND_NEVER, send)
+    send = jnp.where(control, oc.SEND_NEVER, send)
+
+    rev2 = (
+        (client_noop & op.has_contents & ~stale)
+        | (server_noop & ~stale)
+        | (noclient & no_clients)
+    )
+    seq2 = seq1 + rev2.astype(I32)
+    msn2 = jnp.where(noclient & no_clients, seq2, msn1)
+    nack_future_next = s.nack_future | (control & op.is_nack_future)
+
+    applied = sequenced
+    touched = applied | do_refseq_mark | do_join
+    state = SequencerState(
+        seq=jnp.where(applied, seq2, s.seq),
+        msn=jnp.where(applied, msn2, s.msn),
+        last_sent_msn=jnp.where(
+            applied & (send == oc.SEND_IMMEDIATE), msn2, s.last_sent_msn
+        ),
+        nack_future=jnp.where(op.valid, nack_future_next, s.nack_future),
+        active=jnp.where(touched, active, s.active),
+        cseq=jnp.where(touched, cseq, s.cseq),
+        cref=jnp.where(touched, cref, s.cref),
+        clu=jnp.where(touched, clu, s.clu),
+        csum=jnp.where(touched, csum, s.csum),
+        cnack=jnp.where(touched, cnack, s.cnack),
+    )
+
+    out = TicketBatch(
+        kind=jnp.where(
+            nacked,
+            I32(oc.OUT_NACK),
+            jnp.where(sequenced, I32(oc.OUT_SEQUENCED), I32(oc.OUT_IGNORED)),
+        ),
+        seq=jnp.where(nacked, s.seq, jnp.where(sequenced, seq2, I32(-1))),
+        msn=jnp.where(nacked, s.msn, jnp.where(sequenced, msn2, I32(-1))),
+        send=jnp.where(sequenced, send, I32(oc.SEND_IMMEDIATE)),
+        nack_code=jnp.where(nacked, nack_code, I32(oc.NACK_NONE)),
+    )
+    return state, out
+
+
+def _process_doc(state: SequencerState, ops: OpBatch):
+    """scan the K ops of one document through the state machine."""
+    return jax.lax.scan(_ticket_step, state, ops)
+
+
+@jax.jit
+def process_batch(state: SequencerState, ops: OpBatch):
+    """Sequence one tick of ops for every document.
+
+    state: fields [B, ...]; ops: fields [B, K] → (state', TicketBatch[B, K]).
+    """
+    return jax.vmap(_process_doc)(state, ops)
+
+
+def find_idle(state: SequencerState, now: int, timeout_ms: int) -> jax.Array:
+    """bool[B, C] mask of evictable idle clients. The host crafts leave ops
+    for these (deli checkIdleClients piggybacks leaves via alfred)."""
+    return state.active & ((now - state.clu) > timeout_ms)
+
+
+# -- host-side encode helpers -------------------------------------------------
+
+
+def make_op_batch(ops_per_doc: list[list[dict]], num_docs: int, k: int) -> OpBatch:
+    """Encode python op dicts (see fields of OpBatch) into padded arrays."""
+    def zeros(dtype):
+        return np.zeros((num_docs, k), dtype)
+
+    out = dict(
+        valid=zeros(np.bool_), kind=zeros(np.int32), slot=zeros(np.int32),
+        target=zeros(np.int32), client_seq=zeros(np.int32),
+        ref_seq=zeros(np.int32), timestamp=zeros(np.int32),
+        has_contents=zeros(np.bool_), can_summarize=zeros(np.bool_),
+        is_nack_future=zeros(np.bool_),
+    )
+    out["slot"][:] = -1
+    for d, doc_ops in enumerate(ops_per_doc):
+        assert len(doc_ops) <= k, f"tick overflow: {len(doc_ops)} > {k}"
+        for i, op in enumerate(doc_ops):
+            out["valid"][d, i] = True
+            out["kind"][d, i] = int(op["kind"])
+            out["slot"][d, i] = op.get("slot", -1)
+            out["target"][d, i] = op.get("target", 0)
+            out["client_seq"][d, i] = op.get("client_seq", 0)
+            out["ref_seq"][d, i] = op.get("ref_seq", 0)
+            out["timestamp"][d, i] = op.get("timestamp", 0)
+            out["has_contents"][d, i] = op.get("has_contents", False)
+            out["can_summarize"][d, i] = op.get("can_summarize", True)
+            out["is_nack_future"][d, i] = op.get("is_nack_future", False)
+    return OpBatch(**{name: jnp.asarray(v) for name, v in out.items()})

@@ -178,6 +178,7 @@ class Quorum:
     def snapshot(self) -> dict:
         """JSON-serializable state (summary parity: protocol-base snapshot)."""
         return {
+            "msn": self._msn,
             "members": [
                 [cid, {"seq": m.sequence_number, "detail": {
                     "client_id": m.detail.client_id,
@@ -218,9 +219,15 @@ class Quorum:
                 rejections=set(p["rejections"]),
             )
         for k, c in snapshot.get("values", []):
-            quorum._values[k] = CommittedProposal(
+            committed = CommittedProposal(
                 key=c["key"], value=c["value"], sequence_number=c["seq"],
                 approval_sequence_number=c["approval_seq"],
                 commit_sequence_number=c["commit_seq"],
             )
+            quorum._values[k] = committed
+            # Approved-but-not-committed values still await their commit seq;
+            # without this a restored replica diverges from a live one.
+            if committed.commit_sequence_number == -1:
+                quorum._pending_commit[k] = committed
+        quorum._msn = snapshot.get("msn")
         return quorum

@@ -7,13 +7,20 @@ multi-chip path via __graft_entry__.dryrun_multichip).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session has a TPU attached: tests validate
+# semantics + sharding on a virtual 8-device host mesh; bench.py uses the
+# real chip. NOTE the JAX_PLATFORMS env var alone does NOT stick here (the
+# environment pins JAX_PLATFORMS=axon and the plugin wins) — the config
+# update below is what takes effect, and it must run before first device use.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -9,6 +9,7 @@ from fluidframework_tpu.protocol import (
     MessageType,
     ProtocolOpHandler,
     Quorum,
+    QuorumClient,
     SequencedDocumentMessage,
 )
 
@@ -76,11 +77,23 @@ class TestQuorum:
         q.update_minimum_sequence_number(seq_msg(5, 4))
         assert q.get("k") == "new"
 
+    def test_snapshot_preserves_pending_commit(self):
+        # A value approved but not yet committed must still get its commit
+        # seq after a snapshot/load, identically to a live replica.
+        live = Quorum()
+        live.add_proposal("k", "v", sequence_number=1, local=False)
+        live.update_minimum_sequence_number(seq_msg(2, 1))  # approved at 2
+        restored = Quorum.load(live.snapshot())
+        for q in (live, restored):
+            q.update_minimum_sequence_number(seq_msg(3, 2))  # commits at 3
+        assert live.snapshot() == restored.snapshot()
+        assert restored.get_committed("k").commit_sequence_number == 3
+
     def test_snapshot_roundtrip(self):
         q = Quorum()
-        q.add_member("c1", __import__(
-            "fluidframework_tpu.protocol.quorum", fromlist=["QuorumClient"]
-        ).QuorumClient(detail=ClientDetail(client_id="c1"), sequence_number=1))
+        q.add_member(
+            "c1", QuorumClient(detail=ClientDetail(client_id="c1"), sequence_number=1)
+        )
         q.add_proposal("k", {"x": 1}, sequence_number=4, local=False)
         q.update_minimum_sequence_number(seq_msg(6, 5))
         q2 = Quorum.load(q.snapshot())

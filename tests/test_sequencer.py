@@ -1,0 +1,279 @@
+"""Sequencer tests: scalar oracle semantics + batched-kernel differential fuzz.
+
+The fuzz harness mirrors the reference's farm-test philosophy (SURVEY.md §4.2):
+random raw-op streams — joins, leaves, ops, dups, gaps, noops, stale refseqs —
+through the scalar DocumentSequencer and the batched JAX kernel, asserting
+identical tickets and identical end state.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import opcodes as oc
+from fluidframework_tpu.ops import sequencer as seqk
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.server.sequencer import DocumentSequencer, RawOperation
+
+
+def join(cid, ts=0, can_summarize=True):
+    return RawOperation(client_id=None, type=MessageType.CLIENT_JOIN, data=cid,
+                        timestamp=ts, can_summarize=can_summarize)
+
+
+def leave(cid, ts=0):
+    return RawOperation(client_id=None, type=MessageType.CLIENT_LEAVE, data=cid,
+                        timestamp=ts)
+
+
+def op(cid, cseq, rseq, mtype=MessageType.OPERATION, ts=0, contents="x"):
+    return RawOperation(client_id=cid, type=mtype, client_seq=cseq,
+                        ref_seq=rseq, timestamp=ts, contents=contents)
+
+
+class TestScalarSequencer:
+    def test_join_op_leave_flow(self):
+        s = DocumentSequencer()
+        t1 = s.ticket(join("a"))
+        assert (t1.kind, t1.seq) == (oc.OUT_SEQUENCED, 1)
+        # Client joined with ref_seq = msn(0): msn stays 0.
+        assert t1.msn == 0
+        t2 = s.ticket(op("a", 1, 1))
+        assert (t2.seq, t2.msn) == (2, 1)
+        t3 = s.ticket(leave("a"))
+        # No clients left: msn jumps to seq.
+        assert (t3.seq, t3.msn) == (3, 3)
+
+    def test_duplicate_is_dropped_gap_is_nacked(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        s.ticket(op("a", 1, 1))
+        assert s.ticket(op("a", 1, 1)).kind == oc.OUT_IGNORED
+        t = s.ticket(op("a", 5, 1))
+        assert (t.kind, t.nack_code) == (oc.OUT_NACK, oc.NACK_GAP)
+        # Client can continue at the expected number.
+        assert s.ticket(op("a", 2, 1)).kind == oc.OUT_SEQUENCED
+
+    def test_nonexistent_client_nacked(self):
+        s = DocumentSequencer()
+        t = s.ticket(op("ghost", 1, 0))
+        assert (t.kind, t.nack_code) == (oc.OUT_NACK, oc.NACK_NONEXISTENT_CLIENT)
+
+    def test_refseq_below_msn_nacks_and_marks_client(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        s.ticket(join("b"))
+        s.ticket(op("a", 1, 2))
+        s.ticket(op("b", 1, 3))  # msn = min(2,3) = 2
+        assert s.minimum_sequence_number == 2
+        t = s.ticket(op("a", 2, 1))  # refseq 1 < msn 2
+        assert (t.kind, t.nack_code) == (oc.OUT_NACK, oc.NACK_REFSEQ_BELOW_MSN)
+        # Marked client now nacks everything until rejoin.
+        t2 = s.ticket(op("a", 3, 4))
+        assert (t2.kind, t2.nack_code) == (oc.OUT_NACK, oc.NACK_NONEXISTENT_CLIENT)
+
+    def test_summarize_scope(self):
+        s = DocumentSequencer()
+        s.ticket(join("a", can_summarize=False))
+        t = s.ticket(op("a", 1, 1, mtype=MessageType.SUMMARIZE))
+        assert (t.kind, t.nack_code) == (oc.OUT_NACK, oc.NACK_NO_SUMMARY_SCOPE)
+
+    def test_noop_consolidation(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        s.ticket(op("a", 1, 1))  # seq=2, msn=1, sent → last_sent_msn=1
+        # Null-contents noop: never revs, delayed.
+        t = s.ticket(op("a", 2, 2, mtype=MessageType.NOOP, contents=None))
+        assert (t.kind, t.send, t.seq) == (oc.OUT_SEQUENCED, oc.SEND_LATER, 2)
+        # Contentful noop advancing msn: revs + sends.
+        t2 = s.ticket(op("a", 3, 2, mtype=MessageType.NOOP, contents="mark"))
+        assert (t2.send, t2.seq, t2.msn) == (oc.SEND_IMMEDIATE, 3, 2)
+        # Same msn again: delayed, no rev.
+        t3 = s.ticket(op("a", 4, 2, mtype=MessageType.NOOP, contents="mark"))
+        assert (t3.send, t3.seq) == (oc.SEND_LATER, 3)
+
+    def test_duplicate_join_and_leave_dropped(self):
+        s = DocumentSequencer()
+        assert s.ticket(join("a")).kind == oc.OUT_SEQUENCED
+        assert s.ticket(join("a")).kind == oc.OUT_IGNORED
+        assert s.ticket(leave("a")).kind == oc.OUT_SEQUENCED
+        assert s.ticket(leave("a")).kind == oc.OUT_IGNORED
+
+    def test_checkpoint_restore(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        s.ticket(op("a", 1, 1))
+        cp = s.checkpoint(log_offset=41)
+        s2 = DocumentSequencer.restore(cp)
+        # Same continuation from both.
+        ta, tb = s.ticket(op("a", 2, 2)), s2.ticket(op("a", 2, 2))
+        assert (ta.seq, ta.msn) == (tb.seq, tb.msn)
+        assert s.checkpoint().clients == s2.checkpoint().clients
+
+    def test_checkpoint_preserves_nack_future(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        s.ticket(RawOperation(client_id=None, type=MessageType.CONTROL,
+                              contents={"type": "nackFuture"}))
+        s2 = DocumentSequencer.restore(s.checkpoint())
+        t = s2.ticket(op("a", 1, 1))
+        assert (t.kind, t.nack_code) == (oc.OUT_NACK, oc.NACK_FUTURE)
+
+    def test_idle_client_detection(self):
+        s = DocumentSequencer(client_timeout_ms=100)
+        s.ticket(join("a", ts=0))
+        s.ticket(join("b", ts=0))
+        s.ticket(op("b", 1, 1, ts=500))
+        assert s.get_idle_client(now=500) == "a"
+        # After the host injects the leave, nobody is idle.
+        s.ticket(leave("a", ts=500))
+        assert s.get_idle_client(now=500) is None
+
+
+# -- differential fuzz: scalar vs batched kernel ------------------------------
+
+
+def random_stream(rng: random.Random, n_ops: int, n_clients: int):
+    """Raw op stream over slot-named clients 's0..'; includes every edge."""
+    ops = []
+    # Track plausible client state to generate a mix of valid + invalid ops.
+    next_cseq = {}
+    joined = set()
+    seq_guess = 0
+    for i in range(n_ops):
+        r = rng.random()
+        cid = f"s{rng.randrange(n_clients)}"
+        ts = i
+        if r < 0.08:
+            ops.append(join(cid, ts=ts, can_summarize=rng.random() < 0.7))
+            if cid not in joined:
+                joined.add(cid)
+                next_cseq[cid] = 1
+        elif r < 0.12 and joined:
+            target = rng.choice(sorted(joined)) if rng.random() < 0.8 else cid
+            ops.append(leave(target, ts=ts))
+            joined.discard(target)
+        elif r < 0.17:
+            # Duplicate or gap clientSeq.
+            cseq = next_cseq.get(cid, 1)
+            delta = rng.choice([-2, -1, 2, 5])
+            ops.append(op(cid, max(cseq + delta, 0), rng.randrange(seq_guess + 1), ts=ts))
+        elif r < 0.25:
+            # Noop (null or contentful).
+            cseq = next_cseq.get(cid, 1)
+            contents = None if rng.random() < 0.5 else "probe"
+            ops.append(op(cid, cseq, rng.randrange(seq_guess + 1),
+                          mtype=MessageType.NOOP, ts=ts, contents=contents))
+            if cid in joined:
+                next_cseq[cid] = cseq + 1
+        elif r < 0.30:
+            # Summarize attempt.
+            cseq = next_cseq.get(cid, 1)
+            ops.append(op(cid, cseq, rng.randrange(seq_guess + 1),
+                          mtype=MessageType.SUMMARIZE, ts=ts))
+            if cid in joined:
+                next_cseq[cid] = cseq + 1
+        else:
+            # Normal op; refseq sometimes stale, sometimes -1 (REST).
+            cseq = next_cseq.get(cid, 1)
+            if rng.random() < 0.05:
+                rseq = -1
+            else:
+                rseq = rng.randrange(max(seq_guess, 1))
+            ops.append(op(cid, cseq, rseq, ts=ts))
+            if cid in joined:
+                next_cseq[cid] = cseq + 1
+                seq_guess += 1
+    return ops
+
+
+def encode_for_kernel(stream, n_clients):
+    """Map the scalar stream to kernel slot encoding (slot i = client 's{i}')."""
+    enc = []
+    for o in stream:
+        if o.client_id is None and o.type in (MessageType.CLIENT_JOIN,
+                                              MessageType.CLIENT_LEAVE):
+            enc.append(dict(kind=int(o.type), slot=-1, target=int(o.data[1:]),
+                            timestamp=o.timestamp,
+                            can_summarize=o.can_summarize))
+        else:
+            enc.append(dict(kind=int(o.type), slot=int(o.client_id[1:]),
+                            client_seq=o.client_seq, ref_seq=o.ref_seq,
+                            timestamp=o.timestamp,
+                            has_contents=o.contents is not None))
+    return enc
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_kernel_matches_scalar_fuzz(seed):
+    rng = random.Random(seed)
+    n_clients = 6
+    n_docs = 4
+    k = 32
+    n_ticks = 6
+
+    scalars = [DocumentSequencer() for _ in range(n_docs)]
+    state = seqk.init_state(n_docs, num_slots=n_clients)
+
+    for _tick in range(n_ticks):
+        streams = [random_stream(rng, rng.randrange(k + 1), n_clients)
+                   for _ in range(n_docs)]
+        # Scalar pass.
+        expected = [[s.ticket(o) for o in stream]
+                    for s, stream in zip(scalars, streams)]
+        # Kernel pass.
+        ops = seqk.make_op_batch(
+            [encode_for_kernel(st, n_clients) for st in streams], n_docs, k)
+        state, out = seqk.process_batch(state, ops)
+        out = {f: np.asarray(getattr(out, f)) for f in out._fields}
+
+        for d, tickets in enumerate(expected):
+            for i, t in enumerate(tickets):
+                got = {f: out[f][d, i] for f in out}
+                want_send = t.send if t.kind == oc.OUT_SEQUENCED else oc.SEND_IMMEDIATE
+                assert got["kind"] == t.kind, (seed, d, i, t, got)
+                if t.kind != oc.OUT_IGNORED:
+                    assert got["seq"] == t.seq, (seed, d, i, t, got)
+                    assert got["msn"] == t.msn, (seed, d, i, t, got)
+                assert got["send"] == want_send, (seed, d, i, t, got)
+                assert got["nack_code"] == t.nack_code, (seed, d, i, t, got)
+
+        # End-state equivalence per tick.
+        for d, s in enumerate(scalars):
+            assert int(state.seq[d]) == s.sequence_number
+            assert int(state.msn[d]) == s.minimum_sequence_number
+            assert int(state.last_sent_msn[d]) == s.last_sent_msn
+            for c in range(n_clients):
+                cid = f"s{c}"
+                active = bool(state.active[d, c])
+                assert active == (cid in s.clients), (seed, d, cid)
+                if active:
+                    e = s.clients[cid]
+                    assert int(state.cseq[d, c]) == e.client_seq
+                    assert int(state.cref[d, c]) == e.ref_seq
+                    assert bool(state.cnack[d, c]) == e.nack
+
+
+def test_kernel_nack_future_control():
+    state = seqk.init_state(1, num_slots=2)
+    ops = seqk.make_op_batch([[
+        dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=0),
+        dict(kind=int(MessageType.CONTROL), slot=-1, is_nack_future=True),
+        dict(kind=int(MessageType.OPERATION), slot=0, client_seq=1, ref_seq=1),
+    ]], 1, 4)
+    state, out = seqk.process_batch(state, ops)
+    assert int(out.kind[0, 2]) == oc.OUT_NACK
+    assert int(out.nack_code[0, 2]) == oc.NACK_FUTURE
+
+
+def test_find_idle():
+    state = seqk.init_state(2, num_slots=3)
+    ops = seqk.make_op_batch(
+        [[dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=0, timestamp=0),
+          dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=1, timestamp=900)],
+         []], 2, 2)
+    state, _ = seqk.process_batch(state, ops)
+    idle = np.asarray(seqk.find_idle(state, now=1000, timeout_ms=500))
+    assert idle[0].tolist() == [True, False, False]
+    assert idle[1].tolist() == [False, False, False]
