@@ -1,0 +1,127 @@
+"""Batched SharedMap apply kernel — LWW key-value merge across documents.
+
+Reference parity: the sequenced-op apply path of SharedMap
+(packages/dds/map/src/mapKernel.ts:510 tryProcessMessage and its
+set/delete/clear handlers). On a *converged* replica the totally-ordered
+stream reduces to last-writer-wins per key with clear barriers — which is
+associative, so one tick of K ops needs NO sequential scan:
+
+  1. find the last CLEAR in the tick (ops before it are dead),
+  2. scatter-max the op index per key slot (winner = last key-op),
+  3. gather winner kind/value; untouched slots survive unless cleared.
+
+This runs entirely on the VPU as masked gathers/scatters, ``vmap``-ed over
+the document axis. Keys and values are interned to int32 ids host-side
+(per-document key→slot assignment is the host's job; see server.session).
+
+Client-side *pending local op* conflict resolution (pendingKeys shadowing,
+clear-except-pending) is inherently per-replica and lives in
+:class:`fluidframework_tpu.dds.map.MapData`; the differential tests assert
+the two converge byte-identically once all ops are acked.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+
+# Map op kinds (device encoding of {"set","delete","clear"}).
+MAP_SET = 0
+MAP_DELETE = 1
+MAP_CLEAR = 2
+
+
+class MapState(NamedTuple):
+    """Materialized map state per document. Axes [B, S] (S = key slots)."""
+
+    present: jax.Array   # bool[B, S]
+    value: jax.Array     # i32[B, S] interned value id
+    vseq: jax.Array      # i32[B, S] seq that set the current value
+    cleared_seq: jax.Array  # i32[B] seq of the last applied clear (-1 none)
+
+
+class MapOpBatch(NamedTuple):
+    """One tick of sequenced map ops, padded to K per document. Axes [B, K]."""
+
+    valid: jax.Array  # bool
+    kind: jax.Array   # i32 MAP_*
+    slot: jax.Array   # i32 key slot (ignored for clear)
+    value: jax.Array  # i32 interned value id (set only)
+    seq: jax.Array    # i32 sequence number (strictly increasing along K)
+
+
+def init_state(num_docs: int, num_slots: int) -> MapState:
+    b, s = num_docs, num_slots
+    return MapState(
+        present=jnp.zeros((b, s), jnp.bool_),
+        value=jnp.zeros((b, s), I32),
+        vseq=jnp.full((b, s), -1, I32),
+        cleared_seq=jnp.full((b,), -1, I32),
+    )
+
+
+def _apply_doc(state: MapState, ops: MapOpBatch) -> MapState:
+    """Apply one document's tick. state fields [S], ops fields [K]."""
+    num_slots = state.present.shape[0]
+    k = ops.valid.shape[0]
+    idxs = jnp.arange(k, dtype=I32)
+
+    is_clear = ops.valid & (ops.kind == MAP_CLEAR)
+    last_clear = jnp.max(jnp.where(is_clear, idxs, I32(-1)))
+
+    # Key ops that survive the clear barrier.
+    live = ops.valid & (ops.kind != MAP_CLEAR) & (idxs > last_clear)
+    safe_slot = jnp.clip(ops.slot, 0, num_slots - 1)
+    winner = jnp.full((num_slots,), -1, I32).at[safe_slot].max(
+        jnp.where(live, idxs, I32(-1))
+    )
+    has_winner = winner >= 0
+    widx = jnp.maximum(winner, 0)
+    w_is_set = ops.kind[widx] == MAP_SET
+    w_value = ops.value[widx]
+    w_seq = ops.seq[widx]
+
+    cleared = last_clear >= 0
+    present = jnp.where(
+        has_winner, w_is_set, jnp.where(cleared, False, state.present)
+    )
+    value = jnp.where(has_winner & w_is_set, w_value, state.value)
+    vseq = jnp.where(
+        has_winner, w_seq, jnp.where(cleared, I32(-1), state.vseq)
+    )
+    cleared_seq = jnp.where(cleared, ops.seq[jnp.maximum(last_clear, 0)],
+                            state.cleared_seq)
+    return MapState(present=present, value=value, vseq=vseq,
+                    cleared_seq=cleared_seq)
+
+
+@jax.jit
+def apply_tick(state: MapState, ops: MapOpBatch) -> MapState:
+    """Apply one tick of sequenced map ops for every document."""
+    return jax.vmap(_apply_doc)(state, ops)
+
+
+def make_map_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
+                      k: int) -> MapOpBatch:
+    """Encode python op dicts {kind, slot, value, seq} into padded arrays."""
+    valid = np.zeros((num_docs, k), np.bool_)
+    kind = np.zeros((num_docs, k), np.int32)
+    slot = np.zeros((num_docs, k), np.int32)
+    value = np.zeros((num_docs, k), np.int32)
+    seq = np.zeros((num_docs, k), np.int32)
+    for d, doc_ops in enumerate(ops_per_doc):
+        assert len(doc_ops) <= k, f"tick overflow: {len(doc_ops)} > {k}"
+        for i, op in enumerate(doc_ops):
+            valid[d, i] = True
+            kind[d, i] = op["kind"]
+            slot[d, i] = op.get("slot", 0)
+            value[d, i] = op.get("value", 0)
+            seq[d, i] = op["seq"]
+    return MapOpBatch(valid=jnp.asarray(valid), kind=jnp.asarray(kind),
+                      slot=jnp.asarray(slot), value=jnp.asarray(value),
+                      seq=jnp.asarray(seq))

@@ -29,3 +29,4 @@ NACK_REFSEQ_BELOW_MSN = 2  # referenceSequenceNumber < MSN (code 400)
 NACK_NONEXISTENT_CLIENT = 3  # unknown or nacked client (code 400)
 NACK_NO_SUMMARY_SCOPE = 4    # summarize without permission (code 403)
 NACK_FUTURE = 5         # service is draining/rejecting all (control-driven)
+NACK_INVALID_TYPE = 6   # client submitted a service-only message type

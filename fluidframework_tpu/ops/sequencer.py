@@ -44,6 +44,7 @@ class SequencerState(NamedTuple):
     clu: jax.Array            # i32[B, C] last-update timestamp (ms)
     csum: jax.Array           # bool[B, C] summarize scope
     cnack: jax.Array          # bool[B, C] client marked nacked
+    cevict: jax.Array         # bool[B, C] client may be idle-evicted
 
 
 class OpBatch(NamedTuple):
@@ -58,6 +59,7 @@ class OpBatch(NamedTuple):
     timestamp: jax.Array     # i32 ms
     has_contents: jax.Array  # bool (no-op consolidation heuristic)
     can_summarize: jax.Array  # bool (join detail)
+    can_evict: jax.Array     # bool (join detail; False pins e.g. summarizers)
     is_nack_future: jax.Array  # bool (control payload)
 
 
@@ -84,6 +86,7 @@ def init_state(num_docs: int, num_slots: int = 16) -> SequencerState:
         clu=jnp.zeros((b, c), I32),
         csum=jnp.zeros((b, c), jnp.bool_),
         cnack=jnp.zeros((b, c), jnp.bool_),
+        cevict=jnp.ones((b, c), jnp.bool_),
     )
 
 
@@ -104,28 +107,44 @@ def _ticket_step(s: SequencerState, op: OpBatch):
     join_dup = (~is_client) & is_join & s.active[target]
     leave_dup = (~is_client) & is_leave & ~s.active[target]
 
-    nonexistent = is_client & ~gap & ~dup & (~s.active[slot] | s.cnack[slot])
+    # Service-only types from a client are invalid (scalar _SERVICE_ONLY_TYPES).
+    service_only = (
+        (op.kind == int(MessageType.CLIENT_JOIN))
+        | (op.kind == int(MessageType.CLIENT_LEAVE))
+        | (op.kind == int(MessageType.NO_CLIENT))
+        | (op.kind == int(MessageType.CONTROL))
+        | (op.kind == int(MessageType.SUMMARY_ACK))
+        | (op.kind == int(MessageType.SUMMARY_NACK))
+    )
+    invalid_type = is_client & ~gap & ~dup & service_only
+    nonexistent = (
+        is_client & ~gap & ~dup & ~invalid_type
+        & (~s.active[slot] | s.cnack[slot])
+    )
     refseq_nack = (
-        is_client & ~gap & ~dup & ~nonexistent
+        is_client & ~gap & ~dup & ~invalid_type & ~nonexistent
         & (op.ref_seq != -1) & (op.ref_seq < s.msn)
     )
     summarize_nack = (
-        is_client & ~gap & ~dup & ~nonexistent & ~refseq_nack
+        is_client & ~gap & ~dup & ~invalid_type & ~nonexistent & ~refseq_nack
         & (op.kind == int(MessageType.SUMMARIZE)) & ~s.csum[slot]
     )
 
     nack_future = s.nack_future
     nacked = op.valid & (
-        nack_future | gap | nonexistent | refseq_nack | summarize_nack
+        nack_future | gap | invalid_type | nonexistent | refseq_nack
+        | summarize_nack
     )
     ignored = op.valid & ~nack_future & (dup | join_dup | leave_dup)
     sequenced = op.valid & ~nacked & ~ignored
 
     nack_code = jnp.select(
-        [nack_future, gap, nonexistent, refseq_nack, summarize_nack],
+        [nack_future, gap, invalid_type, nonexistent, refseq_nack,
+         summarize_nack],
         [
             I32(oc.NACK_FUTURE),
             I32(oc.NACK_GAP),
+            I32(oc.NACK_INVALID_TYPE),
             I32(oc.NACK_NONEXISTENT_CLIENT),
             I32(oc.NACK_REFSEQ_BELOW_MSN),
             I32(oc.NACK_NO_SUMMARY_SCOPE),
@@ -161,6 +180,7 @@ def _ticket_step(s: SequencerState, op: OpBatch):
     # (upsertClient updates seq numbers but not scopes for existing clients).
     fresh_join_mask = join_mask & ~s.active[target]
     csum = jnp.where(fresh_join_mask, op.can_summarize, s.csum)
+    cevict = jnp.where(fresh_join_mask, op.can_evict, s.cevict)
     cnack = jnp.where(join_mask, False, cnack)
 
     # Sequence-number rev (step 5).
@@ -222,6 +242,7 @@ def _ticket_step(s: SequencerState, op: OpBatch):
         clu=jnp.where(touched, clu, s.clu),
         csum=jnp.where(touched, csum, s.csum),
         cnack=jnp.where(touched, cnack, s.cnack),
+        cevict=jnp.where(touched, cevict, s.cevict),
     )
 
     out = TicketBatch(
@@ -254,8 +275,11 @@ def process_batch(state: SequencerState, ops: OpBatch):
 
 def find_idle(state: SequencerState, now: int, timeout_ms: int) -> jax.Array:
     """bool[B, C] mask of evictable idle clients. The host crafts leave ops
-    for these (deli checkIdleClients piggybacks leaves via alfred)."""
-    return state.active & ((now - state.clu) > timeout_ms)
+    for these (deli checkIdleClients piggybacks leaves via alfred).
+    ``now`` uses the same clock as op timestamps: int32 milliseconds since
+    service start (NOT epoch ms — see make_op_batch)."""
+    assert 0 <= now < 2**31, "timestamps are i32 ms since service start"
+    return state.active & state.cevict & ((now - state.clu) > timeout_ms)
 
 
 # -- host-side encode helpers -------------------------------------------------
@@ -271,20 +295,26 @@ def make_op_batch(ops_per_doc: list[list[dict]], num_docs: int, k: int) -> OpBat
         target=zeros(np.int32), client_seq=zeros(np.int32),
         ref_seq=zeros(np.int32), timestamp=zeros(np.int32),
         has_contents=zeros(np.bool_), can_summarize=zeros(np.bool_),
-        is_nack_future=zeros(np.bool_),
+        can_evict=zeros(np.bool_), is_nack_future=zeros(np.bool_),
     )
     out["slot"][:] = -1
     for d, doc_ops in enumerate(ops_per_doc):
         assert len(doc_ops) <= k, f"tick overflow: {len(doc_ops)} > {k}"
         for i, op in enumerate(doc_ops):
+            ts = op.get("timestamp", 0)
+            # Timestamps are milliseconds SINCE SERVICE START, not epoch ms:
+            # they live in int32 on device (epoch ms overflows).
+            assert 0 <= ts < 2**31, (
+                f"timestamp {ts} out of i32 range — rebase to service start")
             out["valid"][d, i] = True
             out["kind"][d, i] = int(op["kind"])
             out["slot"][d, i] = op.get("slot", -1)
             out["target"][d, i] = op.get("target", 0)
             out["client_seq"][d, i] = op.get("client_seq", 0)
             out["ref_seq"][d, i] = op.get("ref_seq", 0)
-            out["timestamp"][d, i] = op.get("timestamp", 0)
+            out["timestamp"][d, i] = ts
             out["has_contents"][d, i] = op.get("has_contents", False)
             out["can_summarize"][d, i] = op.get("can_summarize", True)
+            out["can_evict"][d, i] = op.get("can_evict", True)
             out["is_nack_future"][d, i] = op.get("is_nack_future", False)
     return OpBatch(**{name: jnp.asarray(v) for name, v in out.items()})

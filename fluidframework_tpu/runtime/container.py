@@ -1,0 +1,190 @@
+"""Container — a client's connection to one collaborative document.
+
+Reference parity: packages/loader/container-loader/src/container.ts
+(``Container``: load:277/1115, processRemoteMessage:1700, connection state)
+with the DeltaManager inbound/outbound queues (deltaManager.ts:147,197-199)
+collapsed into one class — transport is a driver-provided delta connection;
+storage is a driver-provided snapshot/delta reader.
+
+The container owns the protocol handler (quorum) and the ContainerRuntime;
+protocol messages route to the former, OPERATION envelopes to the latter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..drivers.base import DocumentService
+from ..protocol.handler import ProtocolOpHandler
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+)
+from .container_runtime import ContainerRuntime
+from .delta_queue import DeltaQueue
+
+
+class Container:
+    def __init__(self, document_service: DocumentService,
+                 registry=None) -> None:
+        self._service = document_service
+        self.protocol = ProtocolOpHandler()
+        self.runtime = ContainerRuntime(self, registry)
+        self.client_id: str | None = None
+        self.attached = False
+        self._connection: Any = None
+        self.client_seq = 0
+        self.last_processed_seq = 0
+        self.inbound: DeltaQueue[SequencedDocumentMessage] = DeltaQueue(
+            self._process_remote_message)
+        self.on_connected: list[Callable[[str], None]] = []
+        self.on_disconnected: list[Callable[[], None]] = []
+        # Service rejections of our ops (never silent — tests assert empty).
+        self.nacks: list[Any] = []
+        self.on_nack: list[Callable[[Any], None]] = []
+
+    # -- load -----------------------------------------------------------------
+
+    @classmethod
+    def load(cls, document_service: DocumentService, registry=None
+             ) -> "Container":
+        """Open an existing document: snapshot + trailing deltas + connect."""
+        container = cls(document_service, registry)
+        snapshot = document_service.storage.get_latest_snapshot()
+        if snapshot is not None:
+            container.protocol = ProtocolOpHandler.load(snapshot["protocol"])
+            container.runtime.load(snapshot["runtime"])
+            container.last_processed_seq = snapshot["sequence_number"]
+        container.attached = True
+        container.connect()
+        return container
+
+    @classmethod
+    def create_detached(cls, document_service: DocumentService, registry=None
+                        ) -> "Container":
+        """Create a new (empty) document; call attach() to go live. Edits made
+        while detached apply locally and ship via the attach-time snapshot."""
+        return cls(document_service, registry)
+
+    def attach(self) -> None:
+        """Publish the detached state as the document's base snapshot and go
+        live (container.ts attach: detached → attached lifecycle)."""
+        assert not self.attached, "already attached"
+        self._service.storage.upload_snapshot(self.summarize())
+        self.attached = True
+        self.connect()
+
+    # -- connection state machine --------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._connection is not None
+
+    def connect(self) -> None:
+        assert self._connection is None, "already connected"
+        # Catch up on deltas missed while away BEFORE the live stream starts;
+        # both land in the paused inbound queue in seq order (the reference's
+        # fetchMissingDeltas + early-op queueing, deltaManager.ts:1298-1360).
+        for message in self._service.delta_storage.get_deltas(
+                self.last_processed_seq):
+            self.inbound.push(message)
+        connection = self._service.connect(self._on_incoming,
+                                           on_nack=self._on_nack)
+        self._connection = connection
+        self.client_id = connection.client_id
+        self.client_seq = 0
+        self.inbound.resume()
+        for cb in self.on_connected:
+            cb(connection.client_id)
+
+    def disconnect(self) -> None:
+        if self._connection is None:
+            return
+        self._connection.close()
+        self._connection = None
+        self.client_id = None
+        self.inbound.pause()
+        for cb in self.on_disconnected:
+            cb()
+
+    def reconnect(self) -> None:
+        """Drop + re-establish the connection, replaying pending local ops
+        (deltaManager.ts:566-692 + containerRuntime replayPendingStates)."""
+        self.disconnect()
+        self.connect()
+        self.runtime.replay_pending()
+
+    # -- outbound -------------------------------------------------------------
+
+    def allocate_client_seq(self) -> int | None:
+        """Claim the next clientSequenceNumber, or None when disconnected.
+        Callers record pending state against it BEFORE send_message — the
+        ack may arrive re-entrantly during the send (in-proc server)."""
+        if self._connection is None:
+            return None
+        self.client_seq += 1
+        return self.client_seq
+
+    def send_message(self, mtype: MessageType, contents: Any,
+                     client_seq: int) -> None:
+        self._connection.submit([DocumentMessage(
+            client_sequence_number=client_seq,
+            reference_sequence_number=self.last_processed_seq,
+            type=mtype,
+            contents=contents,
+        )])
+
+    def submit_message(self, mtype: MessageType, contents: Any) -> int | None:
+        """Stamp + send a message with no pending tracking (protocol msgs).
+        Returns clientSequenceNumber, or None when not connected."""
+        client_seq = self.allocate_client_seq()
+        if client_seq is not None:
+            self.send_message(mtype, contents, client_seq)
+        return client_seq
+
+    def propose(self, key: str, value: Any) -> None:
+        self.submit_message(MessageType.PROPOSE, {"key": key, "value": value})
+
+    # -- inbound --------------------------------------------------------------
+
+    def _on_incoming(self, messages: list[SequencedDocumentMessage]) -> None:
+        for message in messages:
+            self.inbound.push(message)
+
+    def _on_nack(self, nack: Any) -> None:
+        self.nacks.append(nack)
+        for cb in self.on_nack:
+            cb(nack)
+
+    def _process_remote_message(self, message: SequencedDocumentMessage) -> None:
+        local = (
+            self.client_id is not None and message.client_id == self.client_id
+        )
+        if message.sequence_number <= self.last_processed_seq:
+            return  # duplicate during catch-up overlap
+        assert message.sequence_number == self.last_processed_seq + 1, (
+            f"sequence gap: got {message.sequence_number}, "
+            f"expected {self.last_processed_seq + 1}"
+        )
+        self.last_processed_seq = message.sequence_number
+        result = self.protocol.process_message(message, local)
+        if message.type == MessageType.OPERATION:
+            self.runtime.process(message, local)
+        if result["immediate_noop"] and self.connected:
+            # Expedite proposal commit (quorum.ts:326): a contentful noop revs
+            # and carries our advanced refSeq to the sequencer.
+            self.submit_message(MessageType.NOOP, "")
+
+    # -- summary --------------------------------------------------------------
+
+    def summarize(self) -> dict:
+        """Full summary of protocol + runtime state at the current seq."""
+        return {
+            "sequence_number": self.last_processed_seq,
+            "protocol": self.protocol.snapshot(),
+            "runtime": self.runtime.summarize(),
+        }
+
+    def close(self) -> None:
+        self.disconnect()

@@ -1,0 +1,99 @@
+"""ContainerRuntime — container-level op router, batching, pending replay.
+
+Reference parity: packages/runtime/container-runtime/src/containerRuntime.ts
+(``ContainerRuntime``: process:1042 routing {address: dataStoreId} envelopes,
+submit:1589, reSubmit:1722, replayPendingStates:989-1027) and
+dataStores.ts:274.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import TYPE_CHECKING, Any
+
+from ..dds.shared_object import ChannelRegistry, default_registry
+from ..protocol.messages import MessageType, SequencedDocumentMessage
+from .datastore import DataStoreRuntime
+from .pending_state import PendingStateManager
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .container import Container
+
+
+class ContainerRuntime:
+    def __init__(self, container: "Container",
+                 registry: ChannelRegistry | None = None) -> None:
+        self.container = container
+        self.registry = registry if registry is not None else default_registry()
+        self.datastores: dict[str, DataStoreRuntime] = {}
+        self.pending = PendingStateManager()
+
+    # -- data store lifecycle -------------------------------------------------
+
+    def create_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        if datastore_id in self.datastores:
+            raise ValueError(f"datastore {datastore_id!r} already exists")
+        datastore = DataStoreRuntime(datastore_id, self, self.registry)
+        self.datastores[datastore_id] = datastore
+        return datastore
+
+    def get_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        return self.datastores[datastore_id]
+
+    # -- outbound -------------------------------------------------------------
+
+    def submit_datastore_op(self, datastore_id: str, contents: dict,
+                            local_op_metadata: Any) -> None:
+        if not self.container.attached:
+            return  # detached edits ship via the attach-time snapshot
+        envelope = {"address": datastore_id, "contents": contents}
+        # Pending is recorded BEFORE the send: the in-proc server acks
+        # re-entrantly. client_seq None = disconnected: the op stays pending
+        # (never sent) and is replayed on reconnect (pendingStateManager.ts:56).
+        client_seq = self.container.allocate_client_seq()
+        self.pending.on_submit(client_seq, envelope, local_op_metadata)
+        if client_seq is not None:
+            self.container.send_message(
+                MessageType.OPERATION, envelope, client_seq)
+
+    # -- inbound --------------------------------------------------------------
+
+    def process(self, message: SequencedDocumentMessage, local: bool) -> None:
+        assert message.type == MessageType.OPERATION
+        local_op_metadata = None
+        if local:
+            local_op_metadata = self.pending.process_own_message(
+                message.client_sequence_number)
+        envelope = message.contents
+        datastore = self.datastores[envelope["address"]]
+        datastore.process(
+            replace(message, contents=envelope["contents"]),
+            local,
+            local_op_metadata,
+        )
+
+    # -- reconnect ------------------------------------------------------------
+
+    def replay_pending(self) -> None:
+        """Resubmit every unacked op through the owning channel so it can
+        regenerate/restamp (containerRuntime.ts replayPendingStates)."""
+        for item in self.pending.drain_for_replay():
+            envelope = item.contents
+            datastore = self.datastores[envelope["address"]]
+            datastore.resubmit(envelope["contents"], item.local_op_metadata)
+
+    # -- summary --------------------------------------------------------------
+
+    def summarize(self) -> dict:
+        return {
+            "datastores": {
+                datastore_id: datastore.summarize()
+                for datastore_id, datastore in sorted(self.datastores.items())
+            }
+        }
+
+    def load(self, snapshot: dict) -> None:
+        for datastore_id, datastore_snapshot in snapshot["datastores"].items():
+            datastore = DataStoreRuntime(datastore_id, self, self.registry)
+            self.datastores[datastore_id] = datastore
+            datastore.load(datastore_snapshot)

@@ -1,0 +1,96 @@
+"""DataStoreRuntime — hosts named channels (DDS instances).
+
+Reference parity: packages/runtime/datastore/src/dataStoreRuntime.ts:98
+(``FluidDataStoreRuntime``: createChannel:370, process:499 routing the
+envelope {address: channelId, contents} to the channel, channel summaries)
+and channelDeltaConnection.ts:39.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import TYPE_CHECKING, Any
+
+from ..dds.shared_object import ChannelRegistry, SharedObject
+from ..protocol.messages import SequencedDocumentMessage
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .container_runtime import ContainerRuntime
+
+
+class ChannelDeltaConnection:
+    """The submit/process pipe between one channel and its data store."""
+
+    def __init__(self, datastore: "DataStoreRuntime", channel_id: str) -> None:
+        self._datastore = datastore
+        self._channel_id = channel_id
+
+    def submit(self, contents: Any, local_op_metadata: Any) -> None:
+        self._datastore.submit_channel_op(
+            self._channel_id, contents, local_op_metadata)
+
+
+class DataStoreRuntime:
+    def __init__(self, datastore_id: str, parent: "ContainerRuntime",
+                 registry: ChannelRegistry) -> None:
+        self.id = datastore_id
+        self.parent = parent
+        self.registry = registry
+        self.channels: dict[str, SharedObject] = {}
+
+    # -- channel lifecycle ----------------------------------------------------
+
+    def create_channel(self, channel_id: str, channel_type: str) -> SharedObject:
+        if channel_id in self.channels:
+            raise ValueError(f"channel {channel_id!r} already exists")
+        channel = self.registry.get(channel_type).create(self, channel_id)
+        self._bind(channel)
+        return channel
+
+    def get_channel(self, channel_id: str) -> SharedObject:
+        return self.channels[channel_id]
+
+    def _bind(self, channel: SharedObject) -> None:
+        self.channels[channel.id] = channel
+        channel.bind_connection(ChannelDeltaConnection(self, channel.id))
+
+    # -- op plumbing ---------------------------------------------------------
+
+    def submit_channel_op(self, channel_id: str, contents: Any,
+                          local_op_metadata: Any) -> None:
+        self.parent.submit_datastore_op(
+            self.id,
+            {"address": channel_id, "contents": contents},
+            local_op_metadata,
+        )
+
+    def process(self, message: SequencedDocumentMessage, local: bool,
+                local_op_metadata: Any) -> None:
+        envelope = message.contents
+        channel = self.channels[envelope["address"]]
+        channel.process(
+            replace(message, contents=envelope["contents"]),
+            local,
+            local_op_metadata,
+        )
+
+    def resubmit(self, envelope: dict, local_op_metadata: Any) -> None:
+        channel = self.channels[envelope["address"]]
+        channel.resubmit(envelope["contents"], local_op_metadata)
+
+    # -- summary --------------------------------------------------------------
+
+    def summarize(self) -> dict:
+        return {
+            "channels": {
+                channel_id: channel.summarize()
+                for channel_id, channel in sorted(self.channels.items())
+            }
+        }
+
+    def load(self, snapshot: dict) -> None:
+        for channel_id, channel_snapshot in snapshot["channels"].items():
+            channel_type = channel_snapshot["attributes"]["type"]
+            channel = self.registry.get(channel_type).load(
+                self, channel_id, channel_snapshot)
+            self._bind(channel)

@@ -1,0 +1,57 @@
+"""DeltaQueue — pausable single-consumer FIFO.
+
+Reference parity: packages/loader/container-loader/src/deltaQueue.ts:10.
+Pausing is the test-orchestration primitive the reference uses for
+deterministic op interleaving (test-utils OpProcessingController).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class DeltaQueue(Generic[T]):
+    def __init__(self, handler: Callable[[T], None]) -> None:
+        self._handler = handler
+        self._queue: deque[T] = deque()
+        self._pause_count = 1  # starts paused; resume() when connected
+        self._processing = False
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def paused(self) -> bool:
+        return self._pause_count > 0
+
+    def push(self, item: T) -> None:
+        self._queue.append(item)
+        self._drain()
+
+    def pause(self) -> None:
+        self._pause_count += 1
+
+    def resume(self) -> None:
+        assert self._pause_count > 0, "resume without matching pause"
+        self._pause_count -= 1
+        self._drain()
+
+    def process_one(self) -> bool:
+        """Process a single item regardless of pause state (test stepping)."""
+        if not self._queue:
+            return False
+        self._handler(self._queue.popleft())
+        return True
+
+    def _drain(self) -> None:
+        if self._processing:
+            return
+        self._processing = True
+        try:
+            while self._queue and not self.paused:
+                self._handler(self._queue.popleft())
+        finally:
+            self._processing = False

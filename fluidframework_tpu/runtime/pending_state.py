@@ -1,0 +1,56 @@
+"""PendingStateManager — tracks unacked local ops for ack matching + replay.
+
+Reference parity: packages/runtime/container-runtime/src/
+pendingStateManager.ts:56 — local ops are enqueued at submit with their
+localOpMetadata; when the server echoes our op back (same clientId), the
+front of the queue must match by clientSequenceNumber and yields the metadata
+for the local apply; on reconnect the whole queue is replayed through
+``ContainerRuntime.reSubmit`` (containerRuntime.ts:989-1027).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass(frozen=True, slots=True)
+class PendingMessage:
+    client_seq: int
+    contents: Any
+    local_op_metadata: Any
+
+
+class PendingStateManager:
+    def __init__(self) -> None:
+        self._pending: deque[PendingMessage] = deque()
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    @property
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def on_submit(self, client_seq: int, contents: Any,
+                  local_op_metadata: Any) -> None:
+        self._pending.append(
+            PendingMessage(client_seq, contents, local_op_metadata))
+
+    def process_own_message(self, client_seq: int) -> Any:
+        """Pop the matching pending entry; returns its localOpMetadata."""
+        assert self._pending, "ack for an op we never submitted"
+        front = self._pending.popleft()
+        assert front.client_seq == client_seq, (
+            f"unordered ack: expected clientSeq {front.client_seq}, "
+            f"got {client_seq}"
+        )
+        return front.local_op_metadata
+
+    def drain_for_replay(self) -> list[PendingMessage]:
+        """Take everything pending (reconnect replay). Queue is emptied; the
+        replay re-submits and re-enqueues with fresh client seq numbers."""
+        items = list(self._pending)
+        self._pending.clear()
+        return items

@@ -1,0 +1,212 @@
+"""LocalCollabServer — full in-process ordering service for tests/dev.
+
+Reference parity: server/routerlicious/packages/local-server/src/
+localDeltaConnectionServer.ts (``LocalDeltaConnectionServer``) + tinylicious:
+the alfred front-door, deli sequencer, scriptorium op log, broadcaster
+fan-out and snapshot store collapsed into one deterministic in-proc service.
+
+The sequencer is pluggable: the default scalar ``DocumentSequencer`` per
+document, or the batched device kernel via
+:class:`fluidframework_tpu.server.kernel_host.KernelSequencerHost` — both
+produce identical tickets (differentially tested), so the e2e stack runs
+unchanged on either.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    ScopeType,
+    SequencedDocumentMessage,
+)
+from ..ops import opcodes as oc
+from .sequencer import DocumentSequencer, RawOperation, Ticket
+
+
+@dataclass
+class _Connection:
+    client_id: str
+    document: "_Document"
+    handler: Callable[[list[SequencedDocumentMessage]], None]
+    on_nack: Callable[[NackMessage], None] | None = None
+    on_signal: Callable[[Any], None] | None = None
+    open: bool = True
+
+    def submit(self, messages: list[DocumentMessage]) -> None:
+        assert self.open, "submit on closed connection"
+        self.document.server.submit(self.document.doc_id, self.client_id,
+                                    messages)
+
+    def close(self) -> None:
+        if self.open:
+            self.open = False
+            self.document.server.disconnect(self.document.doc_id,
+                                            self.client_id)
+
+
+@dataclass
+class _Document:
+    doc_id: str
+    server: "LocalCollabServer"
+    sequencer: DocumentSequencer = field(default_factory=DocumentSequencer)
+    log: list[SequencedDocumentMessage] = field(default_factory=list)
+    connections: dict[str, _Connection] = field(default_factory=dict)
+    snapshots: list[dict] = field(default_factory=list)
+    last_broadcast_seq: int = 0
+    # Broadcast queue: a client handler may re-entrantly submit (in-proc),
+    # sequencing new messages mid-fan-out; they must not overtake the
+    # message currently being delivered for connections not yet visited.
+    delivery: list[SequencedDocumentMessage] = field(default_factory=list)
+    delivering: bool = False
+
+
+class LocalCollabServer:
+    """In-memory multi-document ordering + storage service."""
+
+    def __init__(self, sequencer_factory: Callable[[], DocumentSequencer]
+                 = DocumentSequencer) -> None:
+        self._sequencer_factory = sequencer_factory
+        self._documents: dict[str, _Document] = {}
+        self._client_counter = itertools.count(1)
+        self._clock = itertools.count(1)  # deterministic timestamps
+
+    def _document(self, doc_id: str) -> _Document:
+        if doc_id not in self._documents:
+            self._documents[doc_id] = _Document(
+                doc_id, self, sequencer=self._sequencer_factory())
+        return self._documents[doc_id]
+
+    # -- connection lifecycle (alfred connect_document) -----------------------
+
+    def connect(
+        self,
+        doc_id: str,
+        handler: Callable[[list[SequencedDocumentMessage]], None],
+        on_nack: Callable[[NackMessage], None] | None = None,
+        on_signal: Callable[[Any], None] | None = None,
+        mode: str = "write",
+        scopes: tuple[str, ...] = ScopeType.ALL,
+    ) -> _Connection:
+        document = self._document(doc_id)
+        client_id = f"client-{next(self._client_counter)}"
+        connection = _Connection(client_id, document, handler, on_nack,
+                                 on_signal)
+        document.connections[client_id] = connection
+        detail = ClientDetail(client_id=client_id, mode=mode, scopes=scopes)
+        self._sequence_raw(document, RawOperation(
+            client_id=None,
+            type=MessageType.CLIENT_JOIN,
+            data=detail,
+            timestamp=next(self._clock),
+            can_summarize=ScopeType.SUMMARY_WRITE in scopes,
+        ))
+        return connection
+
+    def disconnect(self, doc_id: str, client_id: str) -> None:
+        document = self._document(doc_id)
+        document.connections.pop(client_id, None)
+        self._sequence_raw(document, RawOperation(
+            client_id=None,
+            type=MessageType.CLIENT_LEAVE,
+            data=client_id,
+            timestamp=next(self._clock),
+        ))
+
+    # -- op path (alfred submitOp → deli → scriptorium/broadcaster) -----------
+
+    def submit(self, doc_id: str, client_id: str,
+               messages: list[DocumentMessage]) -> None:
+        document = self._document(doc_id)
+        for message in messages:
+            raw = RawOperation(
+                client_id=client_id,
+                type=message.type,
+                client_seq=message.client_sequence_number,
+                ref_seq=message.reference_sequence_number,
+                timestamp=next(self._clock),
+                contents=message.contents,
+            )
+            ticket = document.sequencer.ticket(raw)
+            if ticket.kind == oc.OUT_NACK:
+                connection = document.connections.get(client_id)
+                if connection is not None and connection.on_nack is not None:
+                    connection.on_nack(NackMessage(
+                        operation=message,
+                        sequence_number=ticket.seq,
+                        code=403 if ticket.nack_code == oc.NACK_NO_SUMMARY_SCOPE
+                        else 400,
+                        error_type=ticket.nack_code,
+                        message=f"nack:{ticket.nack_code}",
+                    ))
+                continue
+            if ticket.kind == oc.OUT_SEQUENCED:
+                self._emit(document, raw, ticket)
+
+    def signal(self, doc_id: str, client_id: str, content: Any) -> None:
+        """Transient broadcast, never sequenced (alfred submitSignal)."""
+        document = self._document(doc_id)
+        for connection in list(document.connections.values()):
+            if connection.on_signal is not None:
+                connection.on_signal({"client_id": client_id,
+                                      "content": content})
+
+    def _sequence_raw(self, document: _Document, raw: RawOperation) -> None:
+        ticket = document.sequencer.ticket(raw)
+        if ticket.kind == oc.OUT_SEQUENCED:
+            self._emit(document, raw, ticket)
+
+    def _emit(self, document: _Document, raw: RawOperation,
+              ticket: Ticket) -> None:
+        # Un-revved carriers (delayed no-ops) are consolidated away: only
+        # messages that advanced the sequence number broadcast.
+        if ticket.seq <= document.last_broadcast_seq:
+            return
+        document.last_broadcast_seq = ticket.seq
+        sequenced = SequencedDocumentMessage(
+            client_id=raw.client_id,
+            sequence_number=ticket.seq,
+            minimum_sequence_number=ticket.msn,
+            client_sequence_number=raw.client_seq,
+            reference_sequence_number=raw.ref_seq,
+            type=raw.type,
+            contents=raw.contents,
+            timestamp=raw.timestamp,
+            data=raw.data,
+        )
+        document.log.append(sequenced)
+        document.delivery.append(sequenced)
+        if document.delivering:
+            return
+        document.delivering = True
+        try:
+            while document.delivery:
+                message = document.delivery.pop(0)
+                for connection in list(document.connections.values()):
+                    connection.handler([message])
+        finally:
+            document.delivering = False
+
+    # -- storage (scriptorium/historian equivalents) --------------------------
+
+    def get_deltas(self, doc_id: str, from_seq: int,
+                   to_seq: int | None = None) -> list[SequencedDocumentMessage]:
+        log = self._document(doc_id).log
+        return [m for m in log
+                if m.sequence_number > from_seq
+                and (to_seq is None or m.sequence_number <= to_seq)]
+
+    def upload_snapshot(self, doc_id: str, snapshot: dict) -> str:
+        document = self._document(doc_id)
+        document.snapshots.append(snapshot)
+        return f"{doc_id}/snapshots/{len(document.snapshots) - 1}"
+
+    def get_latest_snapshot(self, doc_id: str) -> dict | None:
+        snapshots = self._document(doc_id).snapshots
+        return snapshots[-1] if snapshots else None

@@ -31,6 +31,16 @@ from typing import Any
 from ..ops import opcodes as oc
 from ..protocol.messages import MessageType
 
+# Types only the service itself may inject into a document's stream.
+_SERVICE_ONLY_TYPES = frozenset({
+    MessageType.CLIENT_JOIN,
+    MessageType.CLIENT_LEAVE,
+    MessageType.NO_CLIENT,
+    MessageType.CONTROL,
+    MessageType.SUMMARY_ACK,
+    MessageType.SUMMARY_NACK,
+})
+
 
 @dataclass(slots=True)
 class ClientEntry:
@@ -58,6 +68,7 @@ class RawOperation:
     data: Any = None  # join: ClientEntry-like detail; leave: client_id
     # join-time flags (carried in data for the scalar path):
     can_summarize: bool = True
+    can_evict: bool = True
 
 
 @dataclass(frozen=True, slots=True)
@@ -82,6 +93,7 @@ class SequencerCheckpoint:
     no_active_clients: bool
     clients: list[dict]
     nack_future: bool = False
+    client_timeout_ms: int = 5 * 60 * 1000
     log_offset: int = -1
 
 
@@ -111,6 +123,7 @@ class DocumentSequencer:
         ref_seq: int,
         timestamp: int,
         can_summarize: bool = True,
+        can_evict: bool = True,
         nack: bool = False,
     ) -> bool:
         """Returns True iff this is a new client (clientSeqManager.upsertClient)."""
@@ -126,6 +139,7 @@ class DocumentSequencer:
             client_seq=client_seq,
             ref_seq=ref_seq,
             last_update=timestamp,
+            can_evict=can_evict,
             can_summarize=can_summarize,
             nack=nack,
         )
@@ -180,16 +194,31 @@ class DocumentSequencer:
                     return Ticket(kind=oc.OUT_IGNORED, op=op)
                 del self.clients[op.data]
             elif op.type == MessageType.CLIENT_JOIN:
+                # data carries the join detail (ClientDetail) or a bare id
+                # (reference IClientJoin {clientId, detail}).
+                join_id = getattr(op.data, "client_id", op.data)
                 is_new = self._upsert(
-                    op.data,
+                    join_id,
                     0,
                     self.minimum_sequence_number,
                     op.timestamp,
                     can_summarize=op.can_summarize,
+                    can_evict=op.can_evict,
                 )
                 if not is_new:
                     return Ticket(kind=oc.OUT_IGNORED, op=op)
         else:
+            # Service-only types are rejected from clients: CONTROL could set
+            # nack_future (DoS), NO_CLIENT/JOIN/LEAVE forge membership, and
+            # SUMMARY_ACK/NACK forge the summary protocol.
+            if op.type in _SERVICE_ONLY_TYPES:
+                return Ticket(
+                    kind=oc.OUT_NACK,
+                    seq=self.sequence_number,
+                    msn=self.minimum_sequence_number,
+                    nack_code=oc.NACK_INVALID_TYPE,
+                    op=op,
+                )
             entry = self.clients.get(op.client_id)
             if entry is None or entry.nack:
                 return Ticket(
@@ -299,6 +328,7 @@ class DocumentSequencer:
             last_sent_msn=self.last_sent_msn,
             no_active_clients=self.no_active_clients,
             nack_future=self.nack_future,
+            client_timeout_ms=self.client_timeout_ms,
             clients=[
                 {
                     "client_id": e.client_id,
@@ -319,6 +349,7 @@ class DocumentSequencer:
         seq = cls(
             sequence_number=cp.sequence_number,
             minimum_sequence_number=cp.minimum_sequence_number,
+            client_timeout_ms=cp.client_timeout_ms,
         )
         seq.last_sent_msn = cp.last_sent_msn
         seq.no_active_clients = cp.no_active_clients

@@ -1,0 +1,205 @@
+"""SharedMap engine tests: pending-local semantics + device-kernel equivalence.
+
+The MiniSequencer mirrors the reference's MockContainerRuntimeFactory
+(test-runtime-utils/src/mocks.ts:193): local ops queue centrally, process_all
+stamps seq numbers and delivers to every replica. Fuzz asserts (a) all
+replicas converge, (b) the batched LWW device kernel over the same sequenced
+stream produces the identical map.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.dds.map_data import MapData
+from fluidframework_tpu.ops import map_kernel as mk
+
+
+class MiniSequencer:
+    """Central op queue assigning sequence numbers, delivering to replicas."""
+
+    def __init__(self, replicas: list[MapData]):
+        self.replicas = replicas
+        self.queue: list[tuple[int, dict, int]] = []  # (origin, op, metadata)
+        self.seq = 0
+        self.log: list[tuple[int, dict]] = []  # sequenced (seq, op)
+
+    def submit(self, origin: int, op_meta: tuple[dict, int]) -> None:
+        op, metadata = op_meta
+        self.queue.append((origin, op, metadata))
+
+    def process_all(self) -> None:
+        while self.queue:
+            origin, op, metadata = self.queue.pop(0)
+            self.seq += 1
+            self.log.append((self.seq, op))
+            for i, replica in enumerate(self.replicas):
+                local = i == origin
+                replica.process(op, local, metadata if local else None)
+
+
+def contents(m: MapData) -> dict:
+    return dict(m.items())
+
+
+class TestMapPendingSemantics:
+    def test_basic_set_converges(self):
+        a, b = MapData(), MapData()
+        seq = MiniSequencer([a, b])
+        seq.submit(0, a.local_set("k", 1))
+        seq.submit(1, b.local_set("k", 2))
+        seq.process_all()
+        assert contents(a) == contents(b) == {"k": 2}
+
+    def test_pending_local_shadows_remote(self):
+        a, b = MapData(), MapData()
+        seq = MiniSequencer([a, b])
+        seq.submit(0, a.local_set("k", "mine"))
+        # Remote set sequenced FIRST, but a's local pending op shadows it
+        # until a's own op acks — and a's op wins the total order anyway.
+        seq.submit(1, b.local_set("k", "theirs"))
+        # Before processing: each replica sees only its local value.
+        assert a.get("k") == "mine" and b.get("k") == "theirs"
+        seq.process_all()
+        assert contents(a) == contents(b)
+
+    def test_remote_clear_preserves_pending_keys(self):
+        a, b = MapData(), MapData()
+        seq = MiniSequencer([a, b])
+        seq.submit(0, a.local_set("stay", 1))
+        seq.process_all()
+        # b clears; a has a NEW pending key when the clear arrives.
+        seq.submit(1, b.local_clear())
+        seq.submit(0, a.local_set("pend", 2))
+        seq.process_all()
+        assert contents(a) == contents(b) == {"pend": 2}
+
+    def test_pending_clear_shadows_key_ops(self):
+        a, b = MapData(), MapData()
+        seq = MiniSequencer([a, b])
+        seq.submit(0, a.local_set("k", 1))
+        seq.process_all()
+        seq.submit(0, a.local_clear())
+        seq.submit(1, b.local_set("k", 9))
+        seq.process_all()
+        # a's clear sequenced before b's set: set wins on both.
+        assert contents(a) == contents(b) == {"k": 9}
+
+    def test_key_ack_under_pending_clear_unshadows_key(self):
+        # Regression for a reference bug (mapKernel.ts:617-624): local set,
+        # then local clear; after both ack, a remote set on the key must
+        # apply — the stale pendingKeys entry must not shadow it forever.
+        a, b = MapData(), MapData()
+        seq = MiniSequencer([a, b])
+        seq.submit(0, a.local_set("k", 1))
+        seq.submit(0, a.local_clear())
+        seq.process_all()
+        seq.submit(1, b.local_set("k", 92))
+        seq.process_all()
+        assert contents(a) == contents(b) == {"k": 92}
+
+    def test_delete_and_resubmit(self):
+        a, b = MapData(), MapData()
+        seq = MiniSequencer([a, b])
+        seq.submit(0, a.local_set("k", 1))
+        seq.process_all()
+        op, meta = a.local_delete("k")
+        # Simulate reconnect: the op is re-stamped before submission.
+        seq.submit(0, a.resubmit(op, meta))
+        seq.process_all()
+        assert contents(a) == contents(b) == {}
+
+
+def lww_oracle(log):
+    """Plain LWW fold of the sequenced stream."""
+    state = {}
+    for _seq, op in log:
+        if op["type"] == "set":
+            state[op["key"]] = op["value"]
+        elif op["type"] == "delete":
+            state.pop(op["key"], None)
+        else:
+            state.clear()
+    return state
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_map_fuzz_replicas_and_kernel_converge(seed):
+    rng = random.Random(seed)
+    n_replicas, n_docs = 4, 3
+    keys = [f"key{i}" for i in range(10)]
+
+    docs = []
+    for _ in range(n_docs):
+        replicas = [MapData() for _ in range(n_replicas)]
+        docs.append((replicas, MiniSequencer(replicas)))
+
+    for _round in range(8):
+        for replicas, seq in docs:
+            for _ in range(rng.randrange(6)):
+                origin = rng.randrange(n_replicas)
+                r = rng.random()
+                replica = replicas[origin]
+                if r < 0.55:
+                    seq.submit(origin, replica.local_set(
+                        rng.choice(keys), rng.randrange(100)))
+                elif r < 0.85:
+                    seq.submit(origin, replica.local_delete(rng.choice(keys)))
+                else:
+                    seq.submit(origin, replica.local_clear())
+            # Interleave partial delivery across rounds.
+            if rng.random() < 0.7:
+                seq.process_all()
+    for _replicas, seq in docs:
+        seq.process_all()
+
+    # (a) replica convergence per doc
+    for replicas, _seq in docs:
+        reference = contents(replicas[0])
+        for replica in replicas[1:]:
+            assert contents(replica) == reference
+
+    # (b) device kernel over the same sequenced streams (split into ticks)
+    key_slot = {k: i for i, k in enumerate(keys)}
+    state = mk.init_state(n_docs, len(keys))
+    max_len = max(len(seq.log) for _r, seq in docs)
+    tick_size = 16
+    for start in range(0, max_len, tick_size):
+        ops_per_doc = []
+        for _replicas, seq in docs:
+            chunk = seq.log[start:start + tick_size]
+            enc = []
+            for s, op in chunk:
+                if op["type"] == "set":
+                    enc.append(dict(kind=mk.MAP_SET, slot=key_slot[op["key"]],
+                                    value=op["value"], seq=s))
+                elif op["type"] == "delete":
+                    enc.append(dict(kind=mk.MAP_DELETE,
+                                    slot=key_slot[op["key"]], seq=s))
+                else:
+                    enc.append(dict(kind=mk.MAP_CLEAR, seq=s))
+            ops_per_doc.append(enc)
+        state = mk.apply_tick(
+            state, mk.make_map_op_batch(ops_per_doc, n_docs, tick_size))
+
+    for d, (replicas, seq) in enumerate(docs):
+        expected = contents(replicas[0])
+        assert expected == lww_oracle(seq.log)
+        device = {
+            keys[slot]: int(state.value[d, slot])
+            for slot in range(len(keys))
+            if bool(state.present[d, slot])
+        }
+        assert device == expected, (seed, d)
+
+
+def test_map_snapshot_roundtrip():
+    a = MapData()
+    seq = MiniSequencer([a])
+    seq.submit(0, a.local_set("x", [1, 2]))
+    seq.submit(0, a.local_set("y", {"n": 3}))
+    seq.process_all()
+    b = MapData.load(a.snapshot())
+    assert contents(b) == contents(a)
+    assert b.snapshot() == a.snapshot()

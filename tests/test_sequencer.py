@@ -174,6 +174,13 @@ def random_stream(rng: random.Random, n_ops: int, n_clients: int):
                           mtype=MessageType.SUMMARIZE, ts=ts))
             if cid in joined:
                 next_cseq[cid] = cseq + 1
+        elif r < 0.33:
+            # Client tries to forge a service-only type → NACK_INVALID_TYPE.
+            cseq = next_cseq.get(cid, 1)
+            forged = rng.choice([MessageType.CONTROL, MessageType.NO_CLIENT,
+                                 MessageType.SUMMARY_ACK])
+            ops.append(op(cid, cseq, rng.randrange(seq_guess + 1),
+                          mtype=forged, ts=ts))
         else:
             # Normal op; refseq sometimes stale, sometimes -1 (REST).
             cseq = next_cseq.get(cid, 1)
@@ -253,6 +260,51 @@ def test_kernel_matches_scalar_fuzz(seed):
                     assert int(state.cseq[d, c]) == e.client_seq
                     assert int(state.cref[d, c]) == e.ref_seq
                     assert bool(state.cnack[d, c]) == e.nack
+
+
+def test_client_cannot_forge_service_types():
+    # Scalar and kernel both NACK a client-submitted CONTROL (e.g. trying to
+    # set nack_future) with NACK_INVALID_TYPE, and state is untouched.
+    s = DocumentSequencer()
+    s.ticket(join("a"))
+    t = s.ticket(RawOperation(client_id="a", type=MessageType.CONTROL,
+                              client_seq=1, ref_seq=1,
+                              contents={"type": "nackFuture"}))
+    assert (t.kind, t.nack_code) == (oc.OUT_NACK, oc.NACK_INVALID_TYPE)
+    assert not s.nack_future
+    assert s.ticket(op("a", 1, 1)).kind == oc.OUT_SEQUENCED
+
+    state = seqk.init_state(1, num_slots=2)
+    ops = seqk.make_op_batch([[
+        dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=0),
+        dict(kind=int(MessageType.CONTROL), slot=0, client_seq=1, ref_seq=1,
+             is_nack_future=True),
+        dict(kind=int(MessageType.OPERATION), slot=0, client_seq=1, ref_seq=1),
+    ]], 1, 4)
+    state, out = seqk.process_batch(state, ops)
+    assert int(out.nack_code[0, 1]) == oc.NACK_INVALID_TYPE
+    assert not bool(state.nack_future[0])
+    assert int(out.kind[0, 2]) == oc.OUT_SEQUENCED
+
+
+def test_find_idle_respects_can_evict():
+    state = seqk.init_state(1, num_slots=2)
+    ops = seqk.make_op_batch([[
+        dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=0,
+             timestamp=0, can_evict=False),
+        dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=1,
+             timestamp=0),
+    ]], 1, 2)
+    state, _ = seqk.process_batch(state, ops)
+    idle = np.asarray(seqk.find_idle(state, now=10_000, timeout_ms=100))
+    assert idle[0].tolist() == [False, True]
+
+
+def test_checkpoint_preserves_client_timeout():
+    s = DocumentSequencer(client_timeout_ms=100)
+    s.ticket(join("a", ts=0))
+    s2 = DocumentSequencer.restore(s.checkpoint())
+    assert s2.get_idle_client(now=500) == "a"
 
 
 def test_kernel_nack_future_control():
