@@ -62,6 +62,11 @@ class SharedCell(SharedObject):
         else:
             self._value = _EMPTY
 
+    def on_attach(self) -> None:
+        # Detached writes never submitted → never acked; drop the pending id
+        # so remote ops are not shadowed forever.
+        self._pending_message_id = -1
+
     def summarize_core(self) -> dict:
         if self._value is _EMPTY:
             return {"empty": True}

@@ -56,6 +56,9 @@ class SharedMap(SharedObject):
                      local_op_metadata: Any) -> None:
         self.data.process(message.contents, local, local_op_metadata)
 
+    def on_attach(self) -> None:
+        self.data.normalize_detached()
+
     def summarize_core(self) -> dict:
         return self.data.snapshot()
 

@@ -165,6 +165,13 @@ class MapData:
         }
         self._data = kept
 
+    def normalize_detached(self) -> None:
+        """Detached → attached: detached edits were never submitted, so their
+        pending entries will never ack; without this they'd shadow remote ops
+        forever. The data itself ships via the attach snapshot."""
+        self._pending_keys.clear()
+        self._pending_clear_id = -1
+
     # -- summary --------------------------------------------------------------
 
     def snapshot(self) -> dict:

@@ -1,0 +1,558 @@
+"""Scalar merge-tree engine — the sequence CRDT merge rules on a flat table.
+
+Reference parity: packages/dds/merge-tree/src/mergeTree.ts. The reference
+stores segments in a B-tree with per-block partial lengths for O(log n)
+position transforms; this engine keeps the *semantics* on a flat segment
+list (order of the list = document order), because (a) it is the oracle the
+batched TPU kernel is differentially tested against, and (b) the flat table
+IS the device representation (ops/mergetree_kernel.py vectorizes exactly
+this walk with prefix sums).
+
+Core rules mirrored exactly:
+
+* Visibility (mergeTree.ts nodeLength): a segment is visible to
+  (refSeq, client) iff inserted (seq <= refSeq or by that client) and not
+  removed (removed_seq <= refSeq, or removed by that client, or that client
+  is in the overlap-remove set).
+* Insert walk (insertingWalk:2363 + breakTie:2267): skip whole visible
+  segments; at a zero-visible-length boundary: skip segments removed at
+  removedSeq <= refSeq; a local edit goes before everything else; remote
+  edits go before acked segments ("newer merges left", so concurrent
+  same-position inserts order by descending seq) but after OUR unacked
+  segments (which will sequence later — i.e. newer still).
+* Remove (markRangeRemoved:2626): earliest sequenced remove owns
+  removed_seq; later concurrent removers join the overlap set; a pending
+  local remove is overwritten by a remote remove ("comes later").
+* Annotate (PropertiesManager): per-key LWW with pending-local shadowing.
+* Ack (ackPendingSegment:1883): FIFO pending groups get the sequenced seq.
+* Zamboni (mergeTree.ts:1412): on minSeq advance, drop segments removed at
+  or below minSeq and coalesce adjacent out-of-window segments —
+  deterministic, so replicas stay structurally identical.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+UNASSIGNED = -1  # reference UnassignedSequenceNumber (pending local op)
+
+# Non-text segment content: a marker (reference Marker, refType + optional id
+# + props). Markers have visible length 1 in position space.
+@dataclass(frozen=True, slots=True)
+class Marker:
+    ref_type: str = "simple"
+    id: str | None = None
+
+
+@dataclass(slots=True)
+class Segment:
+    content: str | Marker
+    seq: int                      # UNASSIGNED while pending
+    client: str | None            # inserting client (None = loaded baseline)
+    local_seq: int | None = None
+    removed_seq: int | None = None  # None = live; UNASSIGNED = pending local
+    removed_client: str | None = None
+    removed_local_seq: int | None = None
+    removed_overlap: set[str] = field(default_factory=set)
+    props: dict | None = None
+    # key -> [count of unacked local annotate ops shadowing that key,
+    #         acked base value (the LWW value on the acked timeline, shown
+    #         in canonical snapshots while the local value shadows the view)]
+    pending_props: dict[str, list] = field(default_factory=dict)
+    # pending-op groups this segment belongs to (split halves share groups)
+    groups: list["SegmentGroup"] = field(default_factory=list)
+
+    @property
+    def length(self) -> int:
+        return len(self.content) if isinstance(self.content, str) else 1
+
+    @property
+    def is_marker(self) -> bool:
+        return isinstance(self.content, Marker)
+
+    def clone_tail(self, offset: int) -> "Segment":
+        """Split: return the tail half at text offset, sharing state/groups."""
+        assert isinstance(self.content, str) and 0 < offset < len(self.content)
+        tail = Segment(
+            content=self.content[offset:],
+            seq=self.seq,
+            client=self.client,
+            local_seq=self.local_seq,
+            removed_seq=self.removed_seq,
+            removed_client=self.removed_client,
+            removed_local_seq=self.removed_local_seq,
+            removed_overlap=set(self.removed_overlap),
+            props=dict(self.props) if self.props is not None else None,
+            pending_props={k: list(v) for k, v in self.pending_props.items()},
+            groups=list(self.groups),
+        )
+        self.content = self.content[:offset]
+        for group in tail.groups:
+            group.segments.append(tail)
+        return tail
+
+
+@dataclass(slots=True)
+class SegmentGroup:
+    """One submitted-but-unacked local op and the segments it touched."""
+
+    op_kind: str  # "insert" | "remove" | "annotate"
+    segments: list[Segment]
+    local_seq: int
+    props_keys: tuple[str, ...] = ()
+
+
+class MergeEngine:
+    """Merge rules for one sequence (one replica)."""
+
+    def __init__(self, local_client: str | None = None) -> None:
+        self.local_client = local_client
+        self.segments: list[Segment] = []
+        self.current_seq = 0
+        self.min_seq = 0
+        self._local_seq_counter = 0
+        self.pending_groups: deque[SegmentGroup] = deque()
+
+    # -- views ----------------------------------------------------------------
+
+    def _vis_len(self, seg: Segment, ref_seq: int, client: str | None) -> int:
+        if seg.seq == UNASSIGNED:
+            if seg.client != client:
+                return 0
+        elif seg.seq > ref_seq and seg.client != client:
+            return 0
+        if seg.removed_seq is not None:
+            if seg.removed_seq == UNASSIGNED:
+                if seg.removed_client == client:
+                    return 0
+            elif (seg.removed_seq <= ref_seq or seg.removed_client == client
+                  or client in seg.removed_overlap):
+                return 0
+        return seg.length
+
+    def get_text(self, ref_seq: int | None = None,
+                 client: str | None = "__local__") -> str:
+        """Text of the (refSeq, client) view; defaults to the local view."""
+        if ref_seq is None:
+            ref_seq = self.current_seq
+        if client == "__local__":
+            client = self.local_client
+        parts = []
+        for seg in self.segments:
+            if self._vis_len(seg, ref_seq, client) and not seg.is_marker:
+                parts.append(seg.content)
+        return "".join(parts)
+
+    def local_length(self) -> int:
+        return sum(self._vis_len(s, self.current_seq, self.local_client)
+                   for s in self.segments)
+
+    def get_position(self, target: Segment, ref_seq: int | None = None,
+                     client: str | None = "__local__") -> int:
+        """Character position of a segment in a view (mergeTree.ts:1578)."""
+        if ref_seq is None:
+            ref_seq = self.current_seq
+        if client == "__local__":
+            client = self.local_client
+        pos = 0
+        for seg in self.segments:
+            if seg is target:
+                return pos
+            pos += self._vis_len(seg, ref_seq, client)
+        raise ValueError("segment not in engine")
+
+    # -- resolution ------------------------------------------------------------
+
+    def _split(self, index: int, offset: int) -> None:
+        tail = self.segments[index].clone_tail(offset)
+        self.segments.insert(index + 1, tail)
+
+    def _break_tie(self, seg: Segment, ref_seq: int, is_local: bool) -> bool:
+        rs = seg.removed_seq
+        if rs is not None and rs != UNASSIGNED and rs <= ref_seq:
+            return False
+        if is_local:
+            return True  # local change sees everything (breakTie:2283)
+        return seg.seq != UNASSIGNED  # newer merges left; skip our pending
+
+    def _resolve_insert(self, pos: int, ref_seq: int, client: str | None,
+                        is_local: bool) -> int:
+        """Index at which an insert at `pos` lands (splitting if needed)."""
+        remaining = pos
+        i = 0
+        while i < len(self.segments):
+            seg = self.segments[i]
+            vis = self._vis_len(seg, ref_seq, client)
+            if remaining < vis:
+                if remaining == 0:
+                    return i
+                self._split(i, remaining)
+                return i + 1
+            if remaining == 0 and self._break_tie(seg, ref_seq, is_local):
+                return i
+            remaining -= vis
+            i += 1
+        if remaining > 0:
+            raise IndexError(f"insert position {pos} beyond sequence end")
+        return len(self.segments)
+
+    def _ensure_boundary(self, pos: int, ref_seq: int,
+                         client: str | None) -> None:
+        """Split so that a segment boundary exists at visible position pos."""
+        remaining = pos
+        for i, seg in enumerate(self.segments):
+            vis = self._vis_len(seg, ref_seq, client)
+            if remaining < vis:
+                if remaining > 0:
+                    self._split(i, remaining)
+                return
+            remaining -= vis
+
+    def _range_segments(self, start: int, end: int, ref_seq: int,
+                        client: str | None) -> Iterable[Segment]:
+        """Visible segments covering [start, end) in the (refSeq, client)
+        view, after boundary splits."""
+        self._ensure_boundary(start, ref_seq, client)
+        self._ensure_boundary(end, ref_seq, client)
+        pos = 0
+        for seg in self.segments:
+            if pos >= end:
+                break
+            vis = self._vis_len(seg, ref_seq, client)
+            if vis and pos >= start:
+                yield seg
+            pos += vis
+
+    # -- local edits -----------------------------------------------------------
+
+    def _next_local_seq(self) -> int:
+        self._local_seq_counter += 1
+        return self._local_seq_counter
+
+    def insert_local(self, pos: int, content: str | Marker,
+                     props: dict | None = None) -> dict:
+        """Apply a local insert; returns the op payload to submit."""
+        local_seq = self._next_local_seq()
+        index = self._resolve_insert(pos, self.current_seq, self.local_client,
+                                     is_local=True)
+        seg = Segment(content=content, seq=UNASSIGNED, client=self.local_client,
+                      local_seq=local_seq,
+                      props=dict(props) if props else None)
+        group = SegmentGroup(op_kind="insert", segments=[seg],
+                             local_seq=local_seq)
+        seg.groups.append(group)
+        self.pending_groups.append(group)
+        self.segments.insert(index, seg)
+        op: dict = {"type": "insert", "pos": pos}
+        if isinstance(content, str):
+            op["text"] = content
+        else:
+            op["marker"] = {"ref_type": content.ref_type, "id": content.id}
+        if props:
+            op["props"] = dict(props)
+        return op
+
+    def remove_local(self, start: int, end: int) -> dict:
+        local_seq = self._next_local_seq()
+        group = SegmentGroup(op_kind="remove", segments=[], local_seq=local_seq)
+        for seg in self._range_segments(start, end, self.current_seq,
+                                        self.local_client):
+            if seg.removed_seq is None:
+                seg.removed_seq = UNASSIGNED
+                seg.removed_client = self.local_client
+                seg.removed_local_seq = local_seq
+                seg.groups.append(group)
+                group.segments.append(seg)
+        self.pending_groups.append(group)
+        return {"type": "remove", "start": start, "end": end}
+
+    def annotate_local(self, start: int, end: int, props: dict) -> dict:
+        local_seq = self._next_local_seq()
+        group = SegmentGroup(op_kind="annotate", segments=[],
+                             local_seq=local_seq,
+                             props_keys=tuple(sorted(props)))
+        for seg in self._range_segments(start, end, self.current_seq,
+                                        self.local_client):
+            for key in props:
+                pending = seg.pending_props.get(key)
+                if pending is None:
+                    base = (seg.props or {}).get(key)
+                    seg.pending_props[key] = [1, base]
+                else:
+                    pending[0] += 1
+            self._apply_props(seg, props)
+            seg.groups.append(group)
+            group.segments.append(seg)
+        self.pending_groups.append(group)
+        return {"type": "annotate", "start": start, "end": end,
+                "props": dict(props)}
+
+    @staticmethod
+    def _apply_props(seg: Segment, props: dict) -> None:
+        merged = dict(seg.props or {})
+        for key, value in props.items():
+            if value is None:
+                merged.pop(key, None)
+            else:
+                merged[key] = value
+        seg.props = merged or None
+
+    # -- remote apply ----------------------------------------------------------
+
+    def apply_remote(self, op: dict, seq: int, ref_seq: int,
+                     client: str) -> None:
+        """Apply a sequenced op from another client (client.ts applyRemoteOp)."""
+        kind = op["type"]
+        if kind == "insert":
+            index = self._resolve_insert(op["pos"], ref_seq, client,
+                                         is_local=False)
+            content: str | Marker
+            if "text" in op:
+                content = op["text"]
+            else:
+                content = Marker(ref_type=op["marker"]["ref_type"],
+                                 id=op["marker"]["id"])
+            self.segments.insert(index, Segment(
+                content=content, seq=seq, client=client,
+                props=dict(op["props"]) if op.get("props") else None))
+        elif kind == "remove":
+            for seg in self._range_segments(op["start"], op["end"], ref_seq,
+                                            client):
+                if seg.removed_seq is None:
+                    seg.removed_seq = seq
+                    seg.removed_client = client
+                elif seg.removed_seq == UNASSIGNED:
+                    # Overwrites our pending remove: the remote remove is the
+                    # earlier sequenced one (markRangeRemoved:2644-2649).
+                    seg.removed_seq = seq
+                    seg.removed_client = client
+                    seg.removed_local_seq = None
+                else:
+                    seg.removed_overlap.add(client)
+        elif kind == "annotate":
+            for seg in self._range_segments(op["start"], op["end"], ref_seq,
+                                            client):
+                live = {}
+                for key, value in op["props"].items():
+                    pending = seg.pending_props.get(key)
+                    if pending is None:
+                        live[key] = value
+                    else:
+                        # Shadowed in the view, but it IS the latest value on
+                        # the acked timeline until our annotate acks.
+                        pending[1] = value
+                if live:
+                    self._apply_props(seg, live)
+        else:
+            raise ValueError(f"unknown merge-tree op {kind!r}")
+        self._advance_seq(seq)
+
+    # -- ack of own ops --------------------------------------------------------
+
+    def ack(self, seq: int) -> None:
+        """Our oldest pending op got sequenced (ackPendingSegment:1883)."""
+        group = self.pending_groups.popleft()
+        for seg in group.segments:
+            seg.groups.remove(group)
+            if group.op_kind == "insert":
+                assert seg.seq == UNASSIGNED
+                seg.seq = seq
+                seg.local_seq = None
+            elif group.op_kind == "remove":
+                if seg.removed_seq == UNASSIGNED:
+                    seg.removed_seq = seq
+                    seg.removed_client = self.local_client
+                    seg.removed_local_seq = None
+                # else: a remote remove already owns it (overwrite case)
+            else:  # annotate
+                for key in group.props_keys:
+                    pending = seg.pending_props.get(key)
+                    if pending is None:
+                        continue
+                    pending[0] -= 1
+                    if pending[0] <= 0:
+                        del seg.pending_props[key]
+        self._advance_seq(seq)
+
+    def _advance_seq(self, seq: int) -> None:
+        assert seq >= self.current_seq
+        self.current_seq = seq
+
+    def update_local_client(self, new_client: str) -> None:
+        """Reconnect gave us a new client id (reference: collabWindow.clientId
+        updated by startOrUpdateCollaboration). Pending segments re-stamp to
+        the new identity — their resubmitted ops will sequence under it —
+        while acked segments keep the id they sequenced under."""
+        old = self.local_client
+        self.local_client = new_client
+        if old is None or old == new_client:
+            return
+        for seg in self.segments:
+            if seg.seq == UNASSIGNED and seg.client == old:
+                seg.client = new_client
+            if seg.removed_seq == UNASSIGNED and seg.removed_client == old:
+                seg.removed_client = new_client
+
+    # -- reconnect regeneration (client.ts regeneratePendingOp) ---------------
+
+    def _vis_len_at_local_seq(self, seg: Segment, limit: int) -> int:
+        """Visible length in the view 'acked state + my pending ops with
+        localSeq < limit' — the state the op with localSeq=limit was
+        originally submitted against (reference getPosition w/ localSeq)."""
+        if seg.seq == UNASSIGNED:
+            if seg.client != self.local_client or (seg.local_seq or 0) > limit:
+                return 0
+        if seg.removed_seq is not None:
+            if seg.removed_seq == UNASSIGNED:
+                # <= limit: segments removed by the SAME group count as gone —
+                # the applier processes the group's subops sequentially, so an
+                # earlier subop's removal is already invisible (same client,
+                # same seq) when a later subop's range resolves.
+                if (seg.removed_client == self.local_client
+                        and (seg.removed_local_seq or 0) <= limit):
+                    return 0
+            else:
+                return 0
+        return seg.length
+
+    def get_position_at_local_seq(self, target: Segment, limit: int) -> int:
+        pos = 0
+        for seg in self.segments:
+            if seg is target:
+                return pos
+            pos += self._vis_len_at_local_seq(seg, limit)
+        raise ValueError("segment not in engine")
+
+    def normalize_detached(self) -> None:
+        """Detached → attached: local-only segments become baseline (seq 0),
+        so they serialize into the attach snapshot."""
+        for seg in self.segments:
+            if seg.seq == UNASSIGNED:
+                seg.seq = 0
+                seg.local_seq = None
+                seg.groups.clear()
+            if seg.removed_seq == UNASSIGNED:
+                # A detached local remove is simply gone from the baseline.
+                seg.removed_seq = 0
+                seg.removed_client = None
+                seg.removed_local_seq = None
+        self.segments = [s for s in self.segments if s.removed_seq is None]
+        self.pending_groups.clear()
+        self._local_seq_counter = 0
+
+    # -- collab window / zamboni ----------------------------------------------
+
+    def update_min_seq(self, min_seq: int) -> None:
+        """Advance the collab window floor; compact (zamboni, mergeTree:1412).
+        Deterministic given the op stream, so replicas stay identical."""
+        if min_seq <= self.min_seq:
+            return
+        self.min_seq = min_seq
+        kept: list[Segment] = []
+        for seg in self.segments:
+            if (seg.removed_seq is not None and seg.removed_seq != UNASSIGNED
+                    and seg.removed_seq <= min_seq):
+                continue  # removed outside the window: gone forever
+            if seg.seq != UNASSIGNED and seg.seq <= min_seq:
+                # Below the window: no in-flight op can reference this seq
+                # (the sequencer NACKs refSeq < MSN), so normalize identity.
+                seg.seq = 0
+                seg.client = None
+            prev = kept[-1] if kept else None
+            if (
+                prev is not None
+                and not prev.is_marker and not seg.is_marker
+                and prev.removed_seq is None and seg.removed_seq is None
+                and prev.seq == 0 and seg.seq == 0
+                and prev.client is None and seg.client is None
+                and prev.props == seg.props
+                and not prev.pending_props and not seg.pending_props
+                and not prev.groups and not seg.groups
+            ):
+                prev.content = prev.content + seg.content  # coalesce
+                continue
+            kept.append(seg)
+        self.segments = kept
+
+    # -- snapshot (snapshotV1.ts equivalent; canonical acked state) ------------
+
+    def snapshot(self) -> dict:
+        """Canonical snapshot: pure acked state, structure-normalized so ALL
+        converged replicas emit byte-identical summaries regardless of how
+        their local edit history happened to split segments.
+
+        Normalization rules: pending inserts excluded; pending removes appear
+        live; pending annotate values replaced by their acked base; segments
+        removed at or below min_seq dropped; below-window identity erased
+        (seq→0, client→None); adjacent entries with identical metadata
+        coalesced."""
+        segs: list[dict] = []
+        for seg in self.segments:
+            if seg.seq == UNASSIGNED:
+                continue  # pending local insert is never summarized
+            removed = (seg.removed_seq is not None
+                       and seg.removed_seq != UNASSIGNED)
+            if removed and seg.removed_seq <= self.min_seq:
+                continue  # tombstone below the window: gone
+            below = seg.seq <= self.min_seq
+            props = dict(seg.props or {})
+            for key, (_count, base) in seg.pending_props.items():
+                if base is None:
+                    props.pop(key, None)
+                else:
+                    props[key] = base
+            entry: dict[str, Any] = {
+                "seq": 0 if below else seg.seq,
+                "client": None if below else seg.client,
+            }
+            if seg.is_marker:
+                entry["marker"] = {"ref_type": seg.content.ref_type,
+                                   "id": seg.content.id}
+            else:
+                entry["text"] = seg.content
+            if props:
+                entry["props"] = dict(sorted(props.items()))
+            if removed:
+                entry["removed_seq"] = seg.removed_seq
+                entry["removed_client"] = seg.removed_client
+                if seg.removed_overlap:
+                    entry["removed_overlap"] = sorted(seg.removed_overlap)
+            prev = segs[-1] if segs else None
+            if (
+                prev is not None
+                and "text" in prev and "text" in entry
+                and all(prev.get(k) == entry.get(k) for k in
+                        ("seq", "client", "props", "removed_seq",
+                         "removed_client", "removed_overlap"))
+            ):
+                prev["text"] += entry["text"]
+                continue
+            segs.append(entry)
+        return {"seq": self.current_seq, "min_seq": self.min_seq,
+                "segments": segs}
+
+    @classmethod
+    def load(cls, snapshot: dict, local_client: str | None = None
+             ) -> "MergeEngine":
+        engine = cls(local_client)
+        engine.current_seq = snapshot["seq"]
+        engine.min_seq = snapshot["min_seq"]
+        for entry in snapshot["segments"]:
+            content: str | Marker
+            if "marker" in entry:
+                content = Marker(ref_type=entry["marker"]["ref_type"],
+                                 id=entry["marker"]["id"])
+            else:
+                content = entry["text"]
+            engine.segments.append(Segment(
+                content=content,
+                seq=entry["seq"],
+                client=entry["client"],
+                removed_seq=entry.get("removed_seq"),
+                removed_client=entry.get("removed_client"),
+                removed_overlap=set(entry.get("removed_overlap", ())),
+                props=dict(entry["props"]) if entry.get("props") else None,
+            ))
+        return engine

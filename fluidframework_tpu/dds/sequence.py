@@ -1,0 +1,167 @@
+"""SharedString DDS — collaborative text + markers over the merge engine.
+
+Reference parity: packages/dds/sequence/src/sharedString.ts:36 (SharedString:
+insertText:141, removeText, annotateRange, markers) and sequence.ts:51
+(SharedSegmentSequence.processCore:552, reSubmitCore:484) over merge-tree's
+Client (client.ts:44 — applyMsg:819, applyRemoteOp:790, ack:610,
+regeneratePendingOp:877).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..protocol.messages import SequencedDocumentMessage
+from .mergetree import Marker, MergeEngine, UNASSIGNED
+from .shared_object import ChannelFactory, SharedObject
+
+
+class SharedString(SharedObject):
+    channel_type = "https://graph.microsoft.com/types/mergeTree"
+
+    def __init__(self, channel_id: str, runtime=None, attributes=None) -> None:
+        super().__init__(channel_id, runtime, attributes)
+        # The engine needs the local client id to stamp pending segments; we
+        # bind it lazily at first submit/process via the container.
+        self.engine = MergeEngine(local_client=None)
+
+    # -- identity ------------------------------------------------------------
+
+    def _bind_client(self) -> None:
+        if self.runtime is None:
+            return
+        container = self.runtime.parent.container
+        if (container.client_id is not None
+                and container.client_id != self.engine.local_client):
+            self.engine.update_local_client(container.client_id)
+
+    # -- public API (sharedString.ts) ----------------------------------------
+
+    def insert_text(self, pos: int, text: str,
+                    props: dict | None = None) -> None:
+        self._bind_client()
+        op = self.engine.insert_local(pos, text, props)
+        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+
+    def insert_marker(self, pos: int, ref_type: str = "simple",
+                      marker_id: str | None = None,
+                      props: dict | None = None) -> None:
+        self._bind_client()
+        op = self.engine.insert_local(
+            pos, Marker(ref_type=ref_type, id=marker_id), props)
+        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+
+    def remove_text(self, start: int, end: int) -> None:
+        self._bind_client()
+        op = self.engine.remove_local(start, end)
+        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        self._bind_client()
+        op = self.engine.annotate_local(start, end, props)
+        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+
+    def get_text(self) -> str:
+        return self.engine.get_text()
+
+    def __len__(self) -> int:
+        return self.engine.local_length()
+
+    # -- SharedObject contract ------------------------------------------------
+
+    def process_core(self, message: SequencedDocumentMessage, local: bool,
+                     local_op_metadata: Any) -> None:
+        self._bind_client()
+        if local:
+            self.engine.ack(message.sequence_number)
+        else:
+            contents = message.contents
+            ops = (contents["ops"] if contents["type"] == "group"
+                   else [contents])
+            for op in ops:
+                self.engine.apply_remote(
+                    op,
+                    message.sequence_number,
+                    message.reference_sequence_number,
+                    message.client_id,
+                )
+        self.engine.update_min_seq(message.minimum_sequence_number)
+
+    def resubmit_core(self, contents: Any, metadata: Any) -> None:
+        """Reconnect: regenerate every pending op against current state
+        (client.ts regeneratePendingOp). Called once per pending message in
+        FIFO order; each call regenerates the oldest *unregenerated* group."""
+        self._bind_client()
+        # metadata = the original op's localSeq; re-entrant acks may have
+        # already popped earlier groups, so look the group up, not index it.
+        group = next((g for g in self.engine.pending_groups
+                      if g.local_seq == metadata), None)
+        if group is None:
+            return  # already acked through an earlier replay round
+        # Positions are computed in the view as of this op's localSeq —
+        # later local pending ops must not shift them (the remote applier
+        # won't have seen those yet when this op sequences).
+        limit = group.local_seq
+        subops = []
+        if group.op_kind == "insert":
+            for seg in group.segments:
+                if seg.seq != UNASSIGNED:
+                    continue
+                pos = self.engine.get_position_at_local_seq(seg, limit)
+                op: dict = {"type": "insert", "pos": pos}
+                if seg.is_marker:
+                    op["marker"] = {"ref_type": seg.content.ref_type,
+                                    "id": seg.content.id}
+                else:
+                    op["text"] = seg.content
+                if seg.props:
+                    op["props"] = dict(seg.props)
+                subops.append(op)
+        elif group.op_kind == "remove":
+            for seg in group.segments:
+                if seg.removed_seq != UNASSIGNED:
+                    continue  # a remote remove won; nothing to resubmit
+                pos = self.engine.get_position_at_local_seq(seg, limit)
+                subops.append({"type": "remove", "start": pos,
+                               "end": pos + seg.length})
+        else:  # annotate
+            for seg in group.segments:
+                if not any(k in seg.pending_props for k in group.props_keys):
+                    continue
+                pos = self.engine.get_position_at_local_seq(seg, limit)
+                props = {k: (seg.props or {}).get(k)
+                         for k in group.props_keys}
+                subops.append({"type": "annotate", "start": pos,
+                               "end": pos + seg.length, "props": props})
+        self.submit_local_message({"type": "group", "ops": subops},
+                                  group.local_seq)
+
+    def on_attach(self) -> None:
+        self.engine.normalize_detached()
+
+    def summarize_core(self) -> dict:
+        return self.engine.snapshot()
+
+    def load_core(self, content: dict) -> None:
+        self.engine = MergeEngine.load(content,
+                                       local_client=self.engine.local_client)
+
+    def apply_stashed_op(self, contents: Any) -> Any:
+        ops = (contents["ops"] if contents["type"] == "group" else [contents])
+        for op in ops:
+            if op["type"] == "insert":
+                content = (op["text"] if "text" in op
+                           else Marker(ref_type=op["marker"]["ref_type"],
+                                       id=op["marker"]["id"]))
+                self.engine.insert_local(op["pos"], content, op.get("props"))
+            elif op["type"] == "remove":
+                self.engine.remove_local(op["start"], op["end"])
+            else:
+                self.engine.annotate_local(op["start"], op["end"],
+                                           op["props"])
+        return None
+
+
+class SharedStringFactory(ChannelFactory):
+    channel_type = SharedString.channel_type
+    shared_object_cls = SharedString

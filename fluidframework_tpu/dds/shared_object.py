@@ -51,6 +51,10 @@ class SharedObject:
         """Called by the data store when the channel becomes live."""
         self._connection = connection
 
+    def on_attach(self) -> None:
+        """Container went detached → attached: normalize local-only state
+        into baseline state (it ships via the attach snapshot)."""
+
     # -- op plumbing ---------------------------------------------------------
 
     def submit_local_message(self, contents: Any, metadata: Any = None) -> None:

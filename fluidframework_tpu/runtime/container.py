@@ -71,6 +71,7 @@ class Container:
         """Publish the detached state as the document's base snapshot and go
         live (container.ts attach: detached → attached lifecycle)."""
         assert not self.attached, "already attached"
+        self.runtime.on_attach()
         self._service.storage.upload_snapshot(self.summarize())
         self.attached = True
         self.connect()

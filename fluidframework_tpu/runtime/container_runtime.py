@@ -82,6 +82,11 @@ class ContainerRuntime:
             datastore = self.datastores[envelope["address"]]
             datastore.resubmit(envelope["contents"], item.local_op_metadata)
 
+    def on_attach(self) -> None:
+        for datastore in self.datastores.values():
+            for channel in datastore.channels.values():
+                channel.on_attach()
+
     # -- summary --------------------------------------------------------------
 
     def summarize(self) -> dict:

@@ -72,6 +72,29 @@ class TestClickerSmoke:
         assert root2.get("pre") == "attach"
         assert clicks2.value == 5
 
+    def test_detached_pending_state_does_not_shadow_after_attach(self):
+        # Regression: detached edits are never submitted/acked; their pending
+        # entries must reset at attach or they shadow remote ops forever.
+        server = LocalCollabServer()
+        service = LocalDocumentService(server, "doc")
+        c1 = Container.create_detached(service)
+        datastore = c1.runtime.create_datastore("default")
+        root = datastore.create_channel("root", SharedMap.channel_type)
+        from fluidframework_tpu.dds.cell import SharedCell
+        cell = datastore.create_channel("cell", SharedCell.channel_type)
+        root.set("k", "detached")
+        root.clear()
+        root.set("k2", "detached2")
+        cell.set("detached-cell")
+        c1.attach()
+        c2 = open_doc(server)
+        ds2 = c2.runtime.get_datastore("default")
+        ds2.get_channel("root").set("k", "remote")
+        ds2.get_channel("cell").set("remote-cell")
+        assert root.get("k") == "remote"
+        assert cell.get() == "remote-cell"
+        assert c1.summarize() == c2.summarize()
+
     def test_quorum_membership_tracks_connections(self):
         server = LocalCollabServer()
         c1 = make_doc(server)

@@ -1,0 +1,208 @@
+"""Merge-tree engine tests: exact conflict semantics + convergence farms.
+
+Unit tests pin the reference's documented behaviors (mergeTree.ts breakTie /
+markRangeRemoved / PropertiesManager); the farms port the reference's
+conflictFarm/reconnectFarm stress model (client.conflictFarm.spec.ts:20-57):
+random concurrent edits across N clients, replica text equality asserted
+after every drain, byte-identical summaries at the end.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.mergetree import Marker, MergeEngine, UNASSIGNED
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+class TestEngineSemantics:
+    def test_local_insert_and_text(self):
+        e = MergeEngine("a")
+        e.insert_local(0, "hello")
+        e.insert_local(5, " world")
+        e.insert_local(5, ",")
+        assert e.get_text() == "hello, world"
+
+    def test_concurrent_same_position_newer_merges_left(self):
+        # Observer applies A's insert (seq 1) then B's insert (seq 2), both
+        # at position 0 with refSeq 0: later-sequenced lands left (breakTie).
+        e = MergeEngine("obs")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "AAA"}, 1, 0, "a")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "BBB"}, 2, 0, "b")
+        assert e.get_text() == "BBBAAA"
+
+    def test_remote_lands_after_local_pending(self):
+        e = MergeEngine("a")
+        e.insert_local(0, "X")  # pending, will sequence later than B's op
+        e.apply_remote({"type": "insert", "pos": 0, "text": "Y"}, 1, 0, "b")
+        assert e.get_text() == "XY"
+        e.ack(2)
+        assert e.get_text() == "XY"
+        # The convergent order on a pure observer:
+        o = MergeEngine("obs")
+        o.apply_remote({"type": "insert", "pos": 0, "text": "Y"}, 1, 0, "b")
+        o.apply_remote({"type": "insert", "pos": 0, "text": "X"}, 2, 0, "a")
+        assert o.get_text() == "XY"
+
+    def test_insert_into_concurrently_removed_range(self):
+        # B inserts into a range A removed concurrently: the insert survives.
+        o = MergeEngine("obs")
+        o.apply_remote({"type": "insert", "pos": 0, "text": "abcdef"}, 1, 0, "x")
+        o.apply_remote({"type": "remove", "start": 0, "end": 6}, 2, 1, "a")
+        o.apply_remote({"type": "insert", "pos": 3, "text": "NEW"}, 3, 1, "b")
+        assert o.get_text() == "NEW"
+
+    def test_overlapping_concurrent_removes(self):
+        o = MergeEngine("obs")
+        o.apply_remote({"type": "insert", "pos": 0, "text": "abcdef"}, 1, 0, "x")
+        o.apply_remote({"type": "remove", "start": 1, "end": 5}, 2, 1, "a")
+        o.apply_remote({"type": "remove", "start": 0, "end": 6}, 3, 1, "b")
+        assert o.get_text() == ""
+        # Earliest remove owns removed_seq; b joins the overlap set.
+        removed = [s for s in o.segments if s.removed_seq is not None]
+        assert any(s.removed_seq == 2 and "b" in s.removed_overlap
+                   for s in removed)
+
+    def test_pending_local_remove_overwritten_by_remote(self):
+        e = MergeEngine("a")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "abc"}, 1, 0, "x")
+        e.remove_local(0, 3)  # pending
+        e.apply_remote({"type": "remove", "start": 0, "end": 3}, 2, 1, "b")
+        e.ack(3)  # our remove acks after b's: removed_seq stays 2
+        assert all(s.removed_seq == 2 for s in e.segments
+                   if s.removed_seq is not None)
+        assert e.get_text() == ""
+
+    def test_annotate_lww_and_pending_shadow(self):
+        e = MergeEngine("a")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "abc"}, 1, 0, "x")
+        e.annotate_local(0, 3, {"bold": True})  # pending shadows the key
+        e.apply_remote({"type": "annotate", "start": 0, "end": 3,
+                        "props": {"bold": False, "em": True}}, 2, 1, "b")
+        # bold shadowed by pending local; em applies.
+        assert e.segments[0].props == {"bold": True, "em": True}
+        e.ack(3)
+        assert e.segments[0].props == {"bold": True, "em": True}
+
+    def test_zamboni_compacts_and_preserves_text(self):
+        e = MergeEngine("obs")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "aaa"}, 1, 0, "x")
+        e.apply_remote({"type": "insert", "pos": 3, "text": "bbb"}, 2, 1, "y")
+        e.apply_remote({"type": "remove", "start": 2, "end": 4}, 3, 2, "x")
+        assert e.get_text() == "aabb"
+        e.update_min_seq(3)
+        assert e.get_text() == "aabb"
+        # Tombstones dropped; adjacent in-window-exited segments coalesced.
+        assert all(s.removed_seq is None for s in e.segments)
+        assert len(e.segments) == 1
+
+    def test_markers_occupy_position_space(self):
+        e = MergeEngine("a")
+        e.insert_local(0, "ab")
+        e.insert_marker = None  # engine-level: markers via insert_local
+        e.insert_local(1, Marker(ref_type="tile", id="m1"))
+        assert e.get_text() == "ab"  # text excludes markers
+        assert e.local_length() == 3  # but they occupy position space
+
+    def test_snapshot_roundtrip_midwindow(self):
+        e = MergeEngine("obs")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "abc"}, 1, 0, "x")
+        e.apply_remote({"type": "remove", "start": 1, "end": 2}, 2, 1, "y")
+        snap = e.snapshot()
+        e2 = MergeEngine.load(snap, "z")
+        assert e2.get_text() == e.get_text() == "ac"
+        assert e2.snapshot() == snap
+        # The window op stream continues identically on the loaded replica.
+        for engine in (e, e2):
+            engine.apply_remote({"type": "insert", "pos": 1, "text": "Z"},
+                                3, 1, "w")
+        assert e.get_text() == e2.get_text()
+
+
+# -- farm harness -------------------------------------------------------------
+
+
+def make_string_doc(server, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("text", SharedString.channel_type)
+    container.attach()
+    return container
+
+
+def get_string(container) -> SharedString:
+    return container.runtime.get_datastore("default").get_channel("text")
+
+
+def random_edit(rng, text_channel):
+    length = len(text_channel)
+    r = rng.random()
+    if r < 0.55 or length == 0:
+        pos = rng.randrange(length + 1)
+        text_channel.insert_text(pos, rng.choice("abcdefgh") * rng.randrange(1, 4))
+    elif r < 0.85:
+        start = rng.randrange(length)
+        end = min(length, start + rng.randrange(1, 4))
+        text_channel.remove_text(start, end)
+    else:
+        start = rng.randrange(length)
+        end = min(length, start + rng.randrange(1, 4))
+        text_channel.annotate_range(start, end,
+                                    {"k": rng.randrange(3)})
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_conflict_farm(seed):
+    """Port of client.conflictFarm.spec.ts: concurrent random edits with
+    paused/interleaved delivery; replicas must match after every drain."""
+    rng = random.Random(seed)
+    server = LocalCollabServer()
+    c1 = make_string_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(3)]
+    strings = [get_string(c) for c in containers]
+
+    for _round in range(6):
+        # Random subset pauses inbound (edits pile up as pending-vs-remote).
+        paused = [c for c in containers if rng.random() < 0.4]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(4, 12)):
+            random_edit(rng, strings[rng.randrange(len(strings))])
+        for c in paused:
+            c.inbound.resume()
+        texts = [s.get_text() for s in strings]
+        assert all(t == texts[0] for t in texts), (seed, _round, texts)
+    summaries = [c.summarize() for c in containers]
+    assert all(s == summaries[0] for s in summaries), seed
+    for c in containers:
+        assert not c.nacks
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_reconnect_farm(seed):
+    """Port of client.reconnectFarm.spec.ts: random disconnect/reconnect with
+    pending-op regeneration; replicas converge after every reconnect wave."""
+    rng = random.Random(100 + seed)
+    server = LocalCollabServer()
+    c1 = make_string_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(2)]
+    strings = [get_string(c) for c in containers]
+
+    for _round in range(5):
+        offline = [c for c in containers[1:] if rng.random() < 0.5]
+        for c in offline:
+            c.disconnect()
+        for _ in range(rng.randrange(3, 9)):
+            random_edit(rng, strings[rng.randrange(len(strings))])
+        for c in offline:
+            c.reconnect()
+        texts = [s.get_text() for s in strings]
+        assert all(t == texts[0] for t in texts), (seed, _round, texts)
+    summaries = [c.summarize() for c in containers]
+    assert all(s == summaries[0] for s in summaries), seed
