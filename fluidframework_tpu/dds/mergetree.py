@@ -380,6 +380,12 @@ class MergeEngine:
         assert seq >= self.current_seq
         self.current_seq = seq
 
+    def observe_seq(self, seq: int) -> None:
+        """Record a sequenced message that carried no applicable ops (e.g.
+        an empty regenerated group) so current_seq — and therefore
+        snapshots — stay identical across replicas."""
+        self._advance_seq(seq)
+
     def update_local_client(self, new_client: str) -> None:
         """Reconnect gave us a new client id (reference: collabWindow.clientId
         updated by startOrUpdateCollaboration). Pending segments re-stamp to
@@ -453,8 +459,12 @@ class MergeEngine:
         kept: list[Segment] = []
         for seg in self.segments:
             if (seg.removed_seq is not None and seg.removed_seq != UNASSIGNED
-                    and seg.removed_seq <= min_seq):
-                continue  # removed outside the window: gone forever
+                    and seg.removed_seq <= min_seq and not seg.groups):
+                # Removed outside the window: gone forever. Segments still
+                # referenced by a pending local group survive (reconnect
+                # regeneration must be able to find them); their groups
+                # clear at ack and a later advance collects them.
+                continue
             if seg.seq != UNASSIGNED and seg.seq <= min_seq:
                 # Below the window: no in-flight op can reference this seq
                 # (the sequencer NACKs refSeq < MSN), so normalize identity.

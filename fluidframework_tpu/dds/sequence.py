@@ -85,6 +85,9 @@ class SharedString(SharedObject):
                     message.reference_sequence_number,
                     message.client_id,
                 )
+            # An empty regenerated group still advances the seq horizon, or
+            # replica snapshots would disagree on "seq".
+            self.engine.observe_seq(message.sequence_number)
         self.engine.update_min_seq(message.minimum_sequence_number)
 
     def resubmit_core(self, contents: Any, metadata: Any) -> None:
@@ -127,6 +130,10 @@ class SharedString(SharedObject):
         else:  # annotate
             for seg in group.segments:
                 if not any(k in seg.pending_props for k in group.props_keys):
+                    continue
+                if seg.removed_seq is not None:
+                    # A removed segment can never become visible again; a
+                    # regenerated range op would land on live neighbors.
                     continue
                 pos = self.engine.get_position_at_local_seq(seg, limit)
                 props = {k: (seg.props or {}).get(k)

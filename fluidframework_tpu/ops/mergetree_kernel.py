@@ -1,0 +1,338 @@
+"""Batched merge-tree apply kernel — the sequence CRDT on segment tables.
+
+Reference parity: the *sequenced* (server/converged) apply path of
+packages/dds/merge-tree/src/mergeTree.ts — insertingWalk/breakTie:2363/2267,
+markRangeRemoved:2626, annotateRange:2584 — reformulated branch-free over
+fixed-shape arrays:
+
+  * a document = a table of up to S segments in document order
+    (SoA: insert seq/client, removal seq/client/overlap-bitmask, length,
+    text-pool reference, interned property slots);
+  * visibility to (refSeq, client) = a mask; positions = masked prefix sums;
+  * the insert walk's tie-break = first-index argmin over a candidate mask
+    (skip acked-removed-below-refSeq holes, land before concurrent
+    newer-sequenced segments — "newer merges left");
+  * insert/remove = composition of two shift-by-one primitives
+    (split_at + place / split_at x2 + mark), annotate = masked scatter into
+    (key-slot, value-id) planes;
+  * one op = one lax.scan step; documents batch with vmap — the 10k-doc
+    axis from SURVEY.md §2.9.
+
+Text bytes never touch the device: ops carry (pool_start, length) into a
+host-side append-only char pool, and the final document is materialized by
+gathering the surviving segment order (see materialize()). Differential
+tests drive client-generated concurrent op streams through this kernel and
+the scalar MergeEngine and assert byte-identical text.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+NONE_SEQ = np.int32(2**31 - 1)  # "not removed" sentinel
+
+MT_INSERT = 0
+MT_REMOVE = 1
+MT_ANNOTATE = 2
+
+# rem_overlap is an i32 bitmask: at most 31 distinct client slots per
+# document lifetime on the device path. The host must route documents that
+# accumulate more (e.g. via reconnect slot churn) to the scalar path —
+# make_merge_op_batch enforces the bound.
+MAX_CLIENT_SLOTS = 31
+
+
+class MergeState(NamedTuple):
+    """Per-document segment table. Axes [B, S] (+[B, S, P] for props)."""
+
+    valid: jax.Array      # bool — slot holds a segment
+    length: jax.Array     # i32 character count (0 allowed transiently)
+    ins_seq: jax.Array    # i32 insert seq
+    ins_client: jax.Array  # i32 inserting client slot
+    rem_seq: jax.Array    # i32 removal seq; NONE_SEQ = live
+    rem_client: jax.Array  # i32 removing client slot (-1 none)
+    rem_overlap: jax.Array  # i32 bitmask of additional concurrent removers
+    pool_start: jax.Array  # i32 offset into the host text pool
+    prop_val: jax.Array   # i32[B, S, P] interned value ids (0 = unset)
+    count: jax.Array      # i32[B] live slot high-water mark
+
+
+class MergeOpBatch(NamedTuple):
+    """One tick of sequenced ops, padded to K per document. Axes [B, K]."""
+
+    valid: jax.Array    # bool
+    kind: jax.Array     # i32 MT_*
+    pos: jax.Array      # i32 insert position / range start
+    end: jax.Array      # i32 range end (remove/annotate)
+    seq: jax.Array      # i32
+    ref_seq: jax.Array  # i32
+    client: jax.Array   # i32 client slot
+    pool_start: jax.Array  # i32 (insert)
+    text_len: jax.Array    # i32 (insert)
+    prop_key: jax.Array    # i32 key slot (annotate)
+    prop_val: jax.Array    # i32 interned value id; 0 deletes (annotate)
+
+
+def init_state(num_docs: int, num_slots: int, num_props: int = 4
+               ) -> MergeState:
+    b, s, p = num_docs, num_slots, num_props
+    return MergeState(
+        valid=jnp.zeros((b, s), jnp.bool_),
+        length=jnp.zeros((b, s), I32),
+        ins_seq=jnp.zeros((b, s), I32),
+        ins_client=jnp.full((b, s), -1, I32),
+        rem_seq=jnp.full((b, s), NONE_SEQ, I32),
+        rem_client=jnp.full((b, s), -1, I32),
+        rem_overlap=jnp.zeros((b, s), I32),
+        pool_start=jnp.zeros((b, s), I32),
+        prop_val=jnp.zeros((b, s, p), I32),
+        count=jnp.zeros((b,), I32),
+    )
+
+
+def _vis_len(s: MergeState, ref_seq, client):
+    """Visible length per slot for (refSeq, client) — nodeLength equivalent."""
+    ins_vis = s.valid & ((s.ins_seq <= ref_seq) | (s.ins_client == client))
+    overlap_bit = (s.rem_overlap >> jnp.clip(client, 0, 30)) & 1
+    removed_vis = (
+        (s.rem_seq != NONE_SEQ)
+        & ((s.rem_seq <= ref_seq) | (s.rem_client == client)
+           | (overlap_bit == 1))
+    )
+    return jnp.where(ins_vis & ~removed_vis, s.length, 0)
+
+
+def _shift_insert(field: jax.Array, idx, value):
+    """Insert `value` at index idx, shifting the tail right by one."""
+    iota = jnp.arange(field.shape[0])
+    rolled = jnp.roll(field, 1, axis=0)
+    return jnp.where(iota < idx, field,
+                     jnp.where(iota == idx, jnp.asarray(value, field.dtype),
+                               rolled))
+
+
+def _split_at(s: MergeState, pos, ref_seq, client) -> MergeState:
+    """Ensure a segment boundary at visible position pos (may shift by 1)."""
+    vis = _vis_len(s, ref_seq, client)
+    cum = jnp.cumsum(vis) - vis  # exclusive prefix
+    inside = (cum < pos) & (pos < cum + vis)
+    has_split = jnp.any(inside)
+    idx = jnp.argmax(inside)  # first (only) hit
+    offset = pos - cum[idx]
+
+    def do_split(state: MergeState) -> MergeState:
+        tail_at = idx + 1
+        new = MergeState(
+            valid=_shift_insert(state.valid, tail_at, True),
+            length=_shift_insert(state.length, tail_at,
+                                 state.length[idx] - offset),
+            ins_seq=_shift_insert(state.ins_seq, tail_at, state.ins_seq[idx]),
+            ins_client=_shift_insert(state.ins_client, tail_at,
+                                     state.ins_client[idx]),
+            rem_seq=_shift_insert(state.rem_seq, tail_at, state.rem_seq[idx]),
+            rem_client=_shift_insert(state.rem_client, tail_at,
+                                     state.rem_client[idx]),
+            rem_overlap=_shift_insert(state.rem_overlap, tail_at,
+                                      state.rem_overlap[idx]),
+            pool_start=_shift_insert(state.pool_start, tail_at,
+                                     state.pool_start[idx] + offset),
+            prop_val=jax.vmap(
+                lambda plane: _shift_insert(plane, tail_at, plane[idx]),
+                in_axes=1, out_axes=1)(state.prop_val),
+            count=state.count + 1,
+        )
+        # Head keeps [0:offset].
+        return new._replace(
+            length=new.length.at[idx].set(offset))
+
+    return jax.lax.cond(has_split, do_split, lambda st: st, s)
+
+
+def _place_segment(s: MergeState, op) -> MergeState:
+    """Insert a new segment at a boundary position (breakTie semantics).
+    Precondition: a boundary exists at op.pos (call _split_at first)."""
+    vis = _vis_len(s, op.ref_seq, op.client)
+    cum = jnp.cumsum(vis) - vis
+    num_slots = s.valid.shape[0]
+    iota = jnp.arange(num_slots)
+    # Skip = invalid slots, and segments already removed at/below refSeq
+    # (invisible-old tombstones the walk steps over, breakTie branch 1).
+    skip = ~s.valid | ((s.rem_seq != NONE_SEQ) & (s.rem_seq <= op.ref_seq))
+    boundary = cum == op.pos
+    candidate = boundary & ~skip
+    has_candidate = jnp.any(candidate)
+    idx = jnp.where(has_candidate, jnp.argmax(candidate), s.count)
+
+    return MergeState(
+        valid=_shift_insert(s.valid, idx, True),
+        length=_shift_insert(s.length, idx, op.text_len),
+        ins_seq=_shift_insert(s.ins_seq, idx, op.seq),
+        ins_client=_shift_insert(s.ins_client, idx, op.client),
+        rem_seq=_shift_insert(s.rem_seq, idx, NONE_SEQ),
+        rem_client=_shift_insert(s.rem_client, idx, -1),
+        rem_overlap=_shift_insert(s.rem_overlap, idx, 0),
+        pool_start=_shift_insert(s.pool_start, idx, op.pool_start),
+        prop_val=jax.vmap(lambda plane: _shift_insert(plane, idx, 0),
+                          in_axes=1, out_axes=1)(s.prop_val),
+        count=s.count + 1,
+    )
+
+
+def _mark_range(s: MergeState, op) -> MergeState:
+    """Mark [pos, end) removed at op.seq (markRangeRemoved semantics).
+    Precondition: boundaries exist at pos and end."""
+    vis = _vis_len(s, op.ref_seq, op.client)
+    cum = jnp.cumsum(vis) - vis
+    in_range = (vis > 0) & (cum >= op.pos) & (cum < op.end)
+    fresh = in_range & (s.rem_seq == NONE_SEQ)
+    again = in_range & (s.rem_seq != NONE_SEQ)
+    bit = I32(1) << jnp.clip(op.client, 0, 30)
+    return s._replace(
+        rem_seq=jnp.where(fresh, op.seq, s.rem_seq),
+        rem_client=jnp.where(fresh, op.client, s.rem_client),
+        rem_overlap=jnp.where(again, s.rem_overlap | bit, s.rem_overlap),
+    )
+
+
+def _annotate_range(s: MergeState, op) -> MergeState:
+    """LWW property write over [pos, end): ops arrive in seq order, so a
+    plain overwrite is the LWW fold (value 0 deletes)."""
+    vis = _vis_len(s, op.ref_seq, op.client)
+    cum = jnp.cumsum(vis) - vis
+    in_range = (vis > 0) & (cum >= op.pos) & (cum < op.end)
+    num_props = s.prop_val.shape[1]
+    key_onehot = jnp.arange(num_props) == op.prop_key
+    write = in_range[:, None] & key_onehot[None, :]
+    return s._replace(
+        prop_val=jnp.where(write, op.prop_val, s.prop_val))
+
+
+def _apply_op(s: MergeState, op) -> MergeState:
+    def do_insert(state):
+        state = _split_at(state, op.pos, op.ref_seq, op.client)
+        return _place_segment(state, op)
+
+    def do_remove(state):
+        state = _split_at(state, op.pos, op.ref_seq, op.client)
+        state = _split_at(state, op.end, op.ref_seq, op.client)
+        return _mark_range(state, op)
+
+    def do_annotate(state):
+        state = _split_at(state, op.pos, op.ref_seq, op.client)
+        state = _split_at(state, op.end, op.ref_seq, op.client)
+        return _annotate_range(state, op)
+
+    applied = jax.lax.switch(jnp.clip(op.kind, 0, 2),
+                             [do_insert, do_remove, do_annotate], s)
+    return jax.tree.map(
+        lambda new, old: jnp.where(op.valid, new, old), applied, s)
+
+
+def _step(state: MergeState, op):
+    return _apply_op(state, op), ()
+
+
+def _process_doc(state: MergeState, ops: MergeOpBatch):
+    final, _ = jax.lax.scan(_step, state, ops)
+    return final
+
+
+@jax.jit
+def apply_tick(state: MergeState, ops: MergeOpBatch) -> MergeState:
+    """Apply one tick of sequenced merge-tree ops for every document."""
+    return jax.vmap(_process_doc)(state, ops)
+
+
+def capacity_margin(state: MergeState) -> np.ndarray:
+    """Free slots per document. Each op can consume up to 2 slots (split +
+    place); overflow is SILENT (segments drop off the table), so the serving
+    host must check ``capacity_margin(state) >= 2 * ops_in_tick`` and route
+    over-capacity documents to the scalar path (or compact() first)."""
+    return np.asarray(state.valid.shape[1] - state.count)
+
+
+def compact(state: MergeState, min_seq: jax.Array) -> MergeState:
+    """Zamboni: drop tombstones removed at/below min_seq[B] and pack live
+    slots to the front (stable order). Pure gather — no host round-trip."""
+    def one(s: MergeState, ms):
+        keep = s.valid & ~((s.rem_seq != NONE_SEQ) & (s.rem_seq <= ms))
+        order = jnp.cumsum(keep) - 1
+        num_slots = s.valid.shape[0]
+        # Dropped slots scatter out of bounds (mode="drop") so they can
+        # never clobber a kept slot's destination.
+        dst = jnp.where(keep, order, num_slots)
+        def pack(field, fill):
+            out = jnp.full_like(field, fill)
+            return out.at[dst].set(field, mode="drop")
+        packed = MergeState(
+            valid=jnp.zeros_like(s.valid).at[dst].set(keep, mode="drop"),
+            length=pack(s.length, 0),
+            ins_seq=pack(s.ins_seq, 0),
+            ins_client=pack(s.ins_client, -1),
+            rem_seq=pack(s.rem_seq, NONE_SEQ),
+            rem_client=pack(s.rem_client, -1),
+            rem_overlap=pack(s.rem_overlap, 0),
+            pool_start=pack(s.pool_start, 0),
+            prop_val=pack(s.prop_val, 0),
+            count=jnp.sum(keep).astype(I32),
+        )
+        return packed
+    return jax.vmap(one)(state, min_seq)
+
+
+# -- host-side helpers --------------------------------------------------------
+
+
+class TextPool:
+    """Append-only per-document character pool (host side)."""
+
+    def __init__(self, num_docs: int) -> None:
+        self.chunks: list[list[str]] = [[] for _ in range(num_docs)]
+        self.used = [0] * num_docs
+
+    def append(self, doc: int, text: str) -> int:
+        start = self.used[doc]
+        self.chunks[doc].append(text)
+        self.used[doc] += len(text)
+        return start
+
+    def buffer(self, doc: int) -> str:
+        return "".join(self.chunks[doc])
+
+
+def make_merge_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
+                        k: int) -> MergeOpBatch:
+    fields = {name: np.zeros((num_docs, k), np.int32)
+              for name in ("kind", "pos", "end", "seq", "ref_seq", "client",
+                           "pool_start", "text_len", "prop_key", "prop_val")}
+    valid = np.zeros((num_docs, k), np.bool_)
+    for d, doc_ops in enumerate(ops_per_doc):
+        assert len(doc_ops) <= k, f"tick overflow: {len(doc_ops)} > {k}"
+        for i, op in enumerate(doc_ops):
+            assert 0 <= op.get("client", 0) < MAX_CLIENT_SLOTS, (
+                f"client slot {op.get('client')} exceeds device bitmask "
+                f"capacity ({MAX_CLIENT_SLOTS}); route doc to scalar path")
+            valid[d, i] = True
+            for name in fields:
+                fields[name][d, i] = op.get(name, 0)
+    return MergeOpBatch(valid=jnp.asarray(valid),
+                        **{n: jnp.asarray(v) for n, v in fields.items()})
+
+
+def materialize(state: MergeState, pool: TextPool, doc: int) -> str:
+    """Final converged text of one document (acked view: everything live)."""
+    valid = np.asarray(state.valid[doc])
+    length = np.asarray(state.length[doc])
+    rem = np.asarray(state.rem_seq[doc])
+    start = np.asarray(state.pool_start[doc])
+    buffer = pool.buffer(doc)
+    parts = []
+    for i in range(valid.shape[0]):
+        if valid[i] and rem[i] == NONE_SEQ and length[i] > 0:
+            parts.append(buffer[start[i]:start[i] + length[i]])
+    return "".join(parts)

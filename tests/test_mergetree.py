@@ -102,10 +102,40 @@ class TestEngineSemantics:
     def test_markers_occupy_position_space(self):
         e = MergeEngine("a")
         e.insert_local(0, "ab")
-        e.insert_marker = None  # engine-level: markers via insert_local
         e.insert_local(1, Marker(ref_type="tile", id="m1"))
         assert e.get_text() == "ab"  # text excludes markers
         assert e.local_length() == 3  # but they occupy position space
+
+    def test_zamboni_keeps_segments_with_pending_groups(self):
+        # Regression: a pending local annotate references a segment that a
+        # remote remove + minSeq advance would collect; regeneration must
+        # still find it.
+        e = MergeEngine("a")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "abc"}, 1, 0, "x")
+        e.annotate_local(0, 3, {"bold": True})  # pending
+        e.apply_remote({"type": "remove", "start": 0, "end": 3}, 2, 1, "b")
+        e.update_min_seq(2)
+        group = e.pending_groups[0]
+        for seg in group.segments:
+            e.get_position_at_local_seq(seg, group.local_seq)  # must not raise
+        e.ack(3)
+        e.update_min_seq(3)
+        assert e.segments == [] or all(s.groups == [] for s in e.segments)
+
+    def test_empty_group_op_advances_seq_on_remotes(self):
+        # Regression: an empty regenerated group must advance current_seq on
+        # replicas that apply it remotely, or snapshots diverge.
+        from fluidframework_tpu.dds.sequence import SharedString
+        from fluidframework_tpu.protocol.messages import (
+            MessageType, SequencedDocumentMessage)
+        s = SharedString("t")
+        s.process_core(SequencedDocumentMessage(
+            client_id="other", sequence_number=5, minimum_sequence_number=0,
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"type": "group", "ops": []}), local=False,
+            local_op_metadata=None)
+        assert s.engine.current_seq == 5
 
     def test_snapshot_roundtrip_midwindow(self):
         e = MergeEngine("obs")

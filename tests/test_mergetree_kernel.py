@@ -1,0 +1,154 @@
+"""Batched merge-tree kernel: differential tests against real op streams.
+
+The streams come from the live client stack (SharedString replicas over the
+local server — genuine concurrency, splits, overlapping removes, reconnect
+group ops); the kernel plays the sequenced log as the server-side merge and
+must reproduce the replicas' converged text byte-for-byte.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from tests.test_mergetree import get_string, make_string_doc, random_edit
+
+
+def encode_log(messages, pool: mtk.TextPool, doc: int, client_slots: dict,
+               key_slots: dict, val_ids: dict):
+    """Sequenced OPERATION messages → kernel op dicts (+ pool appends)."""
+    out = []
+    for m in messages:
+        if m.type != MessageType.OPERATION:
+            continue
+        channel_op = m.contents["contents"]["contents"]
+        subops = (channel_op["ops"] if channel_op["type"] == "group"
+                  else [channel_op])
+        slot = client_slots.setdefault(m.client_id, len(client_slots))
+        for op in subops:
+            base = dict(seq=m.sequence_number,
+                        ref_seq=m.reference_sequence_number, client=slot)
+            if op["type"] == "insert":
+                text = op.get("text", "\x00")  # markers take 1 pool char
+                out.append(dict(base, kind=mtk.MT_INSERT, pos=op["pos"],
+                                pool_start=pool.append(doc, text),
+                                text_len=len(text)))
+            elif op["type"] == "remove":
+                out.append(dict(base, kind=mtk.MT_REMOVE, pos=op["start"],
+                                end=op["end"]))
+            else:
+                for key, value in sorted(op["props"].items()):
+                    kslot = key_slots.setdefault(key, len(key_slots))
+                    if value is None:
+                        vid = 0
+                    else:
+                        vid = val_ids.setdefault(repr(value), len(val_ids) + 1)
+                    out.append(dict(base, kind=mtk.MT_ANNOTATE,
+                                    pos=op["start"], end=op["end"],
+                                    prop_key=kslot, prop_val=vid))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_kernel_matches_replicas(seed):
+    rng = random.Random(seed)
+    n_docs = 3
+    server = LocalCollabServer()
+    docs = []
+    for d in range(n_docs):
+        c1 = make_string_doc(server, f"doc{d}")
+        others = [Container.load(LocalDocumentService(server, f"doc{d}"))
+                  for _ in range(2)]
+        docs.append([c1] + others)
+
+    for _round in range(5):
+        for containers in docs:
+            paused = [c for c in containers if rng.random() < 0.3]
+            for c in paused:
+                c.inbound.pause()
+            for _ in range(rng.randrange(3, 8)):
+                random_edit(rng, get_string(
+                    containers[rng.randrange(len(containers))]))
+            for c in paused:
+                c.inbound.resume()
+
+    # Converged replica texts (the oracle).
+    expected = []
+    for containers in docs:
+        texts = [get_string(c).get_text() for c in containers]
+        assert all(t == texts[0] for t in texts)
+        expected.append(texts[0])
+
+    # Kernel replay of the sequenced logs.
+    pool = mtk.TextPool(n_docs)
+    client_slots: dict = {}
+    key_slots: dict = {}
+    val_ids: dict = {}
+    streams = [encode_log(server.get_deltas(f"doc{d}", 0), pool, d,
+                          client_slots, key_slots, val_ids)
+               for d in range(n_docs)]
+    state = mtk.init_state(n_docs, num_slots=512)
+    k = 16
+    longest = max(len(s) for s in streams)
+    for start in range(0, longest, k):
+        chunk = [s[start:start + k] for s in streams]
+        state = mtk.apply_tick(
+            state, mtk.make_merge_op_batch(chunk, n_docs, k))
+
+    for d in range(n_docs):
+        got = mtk.materialize(state, pool, d)
+        # Strip marker placeholder chars from the kernel text.
+        got = got.replace("\x00", "")
+        assert got == expected[d], (seed, d, got, expected[d])
+
+
+def test_kernel_basic_concurrent_insert_order():
+    # Two concurrent inserts at pos 0: later seq lands left (breakTie).
+    pool = mtk.TextPool(1)
+    ops = [
+        dict(kind=mtk.MT_INSERT, pos=0, seq=1, ref_seq=0, client=0,
+             pool_start=pool.append(0, "AAA"), text_len=3),
+        dict(kind=mtk.MT_INSERT, pos=0, seq=2, ref_seq=0, client=1,
+             pool_start=pool.append(0, "BBB"), text_len=3),
+    ]
+    state = mtk.init_state(1, num_slots=16)
+    state = mtk.apply_tick(state, mtk.make_merge_op_batch([ops], 1, 4))
+    assert mtk.materialize(state, pool, 0) == "BBBAAA"
+
+
+def test_kernel_insert_into_removed_range():
+    pool = mtk.TextPool(1)
+    ops = [
+        dict(kind=mtk.MT_INSERT, pos=0, seq=1, ref_seq=0, client=0,
+             pool_start=pool.append(0, "abcdef"), text_len=6),
+        dict(kind=mtk.MT_REMOVE, pos=0, end=6, seq=2, ref_seq=1, client=1),
+        dict(kind=mtk.MT_INSERT, pos=3, seq=3, ref_seq=1, client=2,
+             pool_start=pool.append(0, "NEW"), text_len=3),
+    ]
+    state = mtk.init_state(1, num_slots=16)
+    state = mtk.apply_tick(state, mtk.make_merge_op_batch([ops], 1, 4))
+    assert mtk.materialize(state, pool, 0) == "NEW"
+
+
+def test_kernel_compact_drops_old_tombstones():
+    pool = mtk.TextPool(2)
+    ops0 = [
+        dict(kind=mtk.MT_INSERT, pos=0, seq=1, ref_seq=0, client=0,
+             pool_start=pool.append(0, "hello"), text_len=5),
+        dict(kind=mtk.MT_REMOVE, pos=1, end=3, seq=2, ref_seq=1, client=0),
+    ]
+    state = mtk.init_state(2, num_slots=16)
+    state = mtk.apply_tick(state, mtk.make_merge_op_batch([ops0, []], 2, 4))
+    before = int(np.sum(np.asarray(state.valid[0])))
+    state = mtk.compact(state, jnp.asarray([2, 0], np.int32))
+    after = int(np.sum(np.asarray(state.valid[0])))
+    assert after < before
+    assert mtk.materialize(state, pool, 0) == "hlo"
+    # Doc 1 untouched.
+    assert int(state.count[1]) == 0
