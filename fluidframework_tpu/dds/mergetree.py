@@ -48,7 +48,7 @@ class Marker:
 
 @dataclass(slots=True)
 class Segment:
-    content: str | Marker
+    content: str | tuple | Marker  # text, handle run, or marker
     seq: int                      # UNASSIGNED while pending
     client: str | None            # inserting client (None = loaded baseline)
     local_seq: int | None = None
@@ -66,15 +66,18 @@ class Segment:
 
     @property
     def length(self) -> int:
-        return len(self.content) if isinstance(self.content, str) else 1
+        if isinstance(self.content, Marker):
+            return 1
+        return len(self.content)
 
     @property
     def is_marker(self) -> bool:
         return isinstance(self.content, Marker)
 
     def clone_tail(self, offset: int) -> "Segment":
-        """Split: return the tail half at text offset, sharing state/groups."""
-        assert isinstance(self.content, str) and 0 < offset < len(self.content)
+        """Split: return the tail half at item offset, sharing state/groups."""
+        assert not isinstance(self.content, Marker)
+        assert 0 < offset < len(self.content)
         tail = Segment(
             content=self.content[offset:],
             seq=self.seq,
@@ -114,6 +117,12 @@ class MergeEngine:
         self.min_seq = 0
         self._local_seq_counter = 0
         self.pending_groups: deque[SegmentGroup] = deque()
+        # (head, tail, offset) hooks fired on every segment split — local
+        # reference holders (interval collections) re-anchor here.
+        self.on_split: list = []
+        # {old_segment_id: (replacement_segment_or_None, offset_delta)}
+        # fired after zamboni compaction drops/coalesces segments.
+        self.on_compact: list = []
 
     # -- views ----------------------------------------------------------------
 
@@ -166,8 +175,11 @@ class MergeEngine:
     # -- resolution ------------------------------------------------------------
 
     def _split(self, index: int, offset: int) -> None:
-        tail = self.segments[index].clone_tail(offset)
+        head = self.segments[index]
+        tail = head.clone_tail(offset)
         self.segments.insert(index + 1, tail)
+        for cb in self.on_split:
+            cb(head, tail, offset)
 
     def _break_tie(self, seg: Segment, ref_seq: int, is_local: bool) -> bool:
         rs = seg.removed_seq
@@ -248,6 +260,8 @@ class MergeEngine:
         op: dict = {"type": "insert", "pos": pos}
         if isinstance(content, str):
             op["text"] = content
+        elif isinstance(content, tuple):
+            op["items"] = list(content)
         else:
             op["marker"] = {"ref_type": content.ref_type, "id": content.id}
         if props:
@@ -308,9 +322,11 @@ class MergeEngine:
         if kind == "insert":
             index = self._resolve_insert(op["pos"], ref_seq, client,
                                          is_local=False)
-            content: str | Marker
+            content: str | tuple | Marker
             if "text" in op:
                 content = op["text"]
+            elif "items" in op:
+                content = tuple(op["items"])  # permutation-vector handles
             else:
                 content = Marker(ref_type=op["marker"]["ref_type"],
                                  id=op["marker"]["id"])
@@ -457,6 +473,11 @@ class MergeEngine:
             return
         self.min_seq = min_seq
         kept: list[Segment] = []
+        # Anchor rebinding for compaction: id(old_seg) -> (replacement,
+        # delta). delta None = slide to the replacement's start (offset 0);
+        # otherwise new_offset = old_offset + delta (coalesce).
+        rebind: dict[int, tuple[Segment | None, int | None]] = {}
+        pending_drops: list[Segment] = []
         for seg in self.segments:
             if (seg.removed_seq is not None and seg.removed_seq != UNASSIGNED
                     and seg.removed_seq <= min_seq and not seg.groups):
@@ -464,6 +485,7 @@ class MergeEngine:
                 # referenced by a pending local group survive (reconnect
                 # regeneration must be able to find them); their groups
                 # clear at ack and a later advance collects them.
+                pending_drops.append(seg)
                 continue
             if seg.seq != UNASSIGNED and seg.seq <= min_seq:
                 # Below the window: no in-flight op can reference this seq
@@ -474,6 +496,7 @@ class MergeEngine:
             if (
                 prev is not None
                 and not prev.is_marker and not seg.is_marker
+                and isinstance(prev.content, type(seg.content))
                 and prev.removed_seq is None and seg.removed_seq is None
                 and prev.seq == 0 and seg.seq == 0
                 and prev.client is None and seg.client is None
@@ -481,10 +504,21 @@ class MergeEngine:
                 and not prev.pending_props and not seg.pending_props
                 and not prev.groups and not seg.groups
             ):
+                rebind[id(seg)] = (prev, len(prev.content))
                 prev.content = prev.content + seg.content  # coalesce
-                continue
-            kept.append(seg)
+            else:
+                kept.append(seg)
+            # Dropped tombstones slide anchors to the next survivor's start.
+            for dropped in pending_drops:
+                rebind[id(dropped)] = (kept[-1], None)
+            pending_drops = []
+        for dropped in pending_drops:
+            rebind[id(dropped)] = (None, None)  # end of sequence
         self.segments = kept
+        if rebind:
+            # Chase chains (dropped -> coalesced target -> ...).
+            for cb in self.on_compact:
+                cb(rebind)
 
     # -- snapshot (snapshotV1.ts equivalent; canonical acked state) ------------
 
@@ -520,6 +554,8 @@ class MergeEngine:
             if seg.is_marker:
                 entry["marker"] = {"ref_type": seg.content.ref_type,
                                    "id": seg.content.id}
+            elif isinstance(seg.content, tuple):
+                entry["items"] = list(seg.content)
             else:
                 entry["text"] = seg.content
             if props:
@@ -530,14 +566,16 @@ class MergeEngine:
                 if seg.removed_overlap:
                     entry["removed_overlap"] = sorted(seg.removed_overlap)
             prev = segs[-1] if segs else None
+            mergeable_key = "text" if "text" in entry else (
+                "items" if "items" in entry else None)
             if (
-                prev is not None
-                and "text" in prev and "text" in entry
+                prev is not None and mergeable_key is not None
+                and mergeable_key in prev
                 and all(prev.get(k) == entry.get(k) for k in
                         ("seq", "client", "props", "removed_seq",
                          "removed_client", "removed_overlap"))
             ):
-                prev["text"] += entry["text"]
+                prev[mergeable_key] += entry[mergeable_key]
                 continue
             segs.append(entry)
         return {"seq": self.current_seq, "min_seq": self.min_seq,
@@ -550,10 +588,12 @@ class MergeEngine:
         engine.current_seq = snapshot["seq"]
         engine.min_seq = snapshot["min_seq"]
         for entry in snapshot["segments"]:
-            content: str | Marker
+            content: str | tuple | Marker
             if "marker" in entry:
                 content = Marker(ref_type=entry["marker"]["ref_type"],
                                  id=entry["marker"]["id"])
+            elif "items" in entry:
+                content = tuple(entry["items"])
             else:
                 content = entry["text"]
             engine.segments.append(Segment(

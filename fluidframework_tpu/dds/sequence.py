@@ -24,6 +24,7 @@ class SharedString(SharedObject):
         # The engine needs the local client id to stamp pending segments; we
         # bind it lazily at first submit/process via the container.
         self.engine = MergeEngine(local_client=None)
+        self._interval_collections: dict[str, "IntervalCollection"] = {}
 
     # -- identity ------------------------------------------------------------
 
@@ -61,6 +62,15 @@ class SharedString(SharedObject):
         op = self.engine.annotate_local(start, end, props)
         self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
 
+    def get_interval_collection(self, label: str) -> "IntervalCollection":
+        """Named interval collection over this string (sequence.ts
+        getIntervalCollection)."""
+        from .intervals import IntervalCollection
+        if label not in self._interval_collections:
+            self._interval_collections[label] = IntervalCollection(
+                label, self.engine, self.submit_local_message)
+        return self._interval_collections[label]
+
     def get_text(self) -> str:
         return self.engine.get_text()
 
@@ -72,6 +82,14 @@ class SharedString(SharedObject):
     def process_core(self, message: SequencedDocumentMessage, local: bool,
                      local_op_metadata: Any) -> None:
         self._bind_client()
+        contents = message.contents
+        if isinstance(contents, dict) and str(
+                contents.get("type", "")).startswith("interval"):
+            collection = self.get_interval_collection(contents["label"])
+            collection.process(contents, local, local_op_metadata, message)
+            self.engine.observe_seq(message.sequence_number)
+            self.engine.update_min_seq(message.minimum_sequence_number)
+            return
         if local:
             self.engine.ack(message.sequence_number)
         else:
@@ -95,6 +113,25 @@ class SharedString(SharedObject):
         (client.ts regeneratePendingOp). Called once per pending message in
         FIFO order; each call regenerates the oldest *unregenerated* group."""
         self._bind_client()
+        if isinstance(metadata, tuple) and metadata and metadata[0] == "interval":
+            _tag, label, interval_id, pending_id, horizon = metadata
+            collection = self.get_interval_collection(label)
+            if collection._pending.get(interval_id) != pending_id:
+                return  # superseded by a newer local op on this interval
+            interval = collection.intervals.get(interval_id)
+            if interval is None:
+                self.submit_local_message(
+                    {"type": "intervalDelete", "label": label,
+                     "id": interval_id}, metadata)
+                return
+            # Positions in the frame at this op's submission horizon — later
+            # pending text ops replay after us and re-shift remotely.
+            self.submit_local_message(
+                {"type": "intervalAdd", "label": label, "id": interval_id,
+                 "start": collection._resolve_at(interval.start, horizon),
+                 "end": collection._resolve_at(interval.end, horizon),
+                 "props": dict(interval.props)}, metadata)
+            return
         # metadata = the original op's localSeq; re-entrant acks may have
         # already popped earlier groups, so look the group up, not index it.
         group = next((g for g in self.engine.pending_groups
@@ -147,13 +184,51 @@ class SharedString(SharedObject):
         self.engine.normalize_detached()
 
     def summarize_core(self) -> dict:
-        return self.engine.snapshot()
+        content = self.engine.snapshot()
+        collections = [c.snapshot()
+                       for _l, c in sorted(self._interval_collections.items())]
+        collections = [c for c in collections if c["intervals"]]
+        if collections:
+            content["interval_collections"] = collections
+        return content
 
     def load_core(self, content: dict) -> None:
         self.engine = MergeEngine.load(content,
                                        local_client=self.engine.local_client)
+        self._interval_collections = {}
+        for snap in content.get("interval_collections", ()):
+            self.get_interval_collection(snap["label"]).load(snap)
 
     def apply_stashed_op(self, contents: Any) -> Any:
+        if str(contents.get("type", "")).startswith("interval"):
+            collection = self.get_interval_collection(contents["label"])
+            interval_id = contents["id"]
+            pending_id = next(collection._next_pending)
+            collection._pending[interval_id] = pending_id
+            if contents["type"] == "intervalDelete":
+                collection.intervals.pop(interval_id, None)
+            elif contents["type"] == "intervalAdd":
+                from .intervals import LocalRef, SequenceInterval
+                collection.intervals[interval_id] = SequenceInterval(
+                    id=interval_id,
+                    start=collection._anchor(contents["start"],
+                                             self.engine.current_seq,
+                                             self.engine.local_client),
+                    end=collection._anchor(contents["end"],
+                                           self.engine.current_seq,
+                                           self.engine.local_client),
+                    props=dict(contents.get("props") or {}),
+                )
+            else:  # intervalChange
+                interval = collection.intervals.get(interval_id)
+                if interval is not None:
+                    for key, value in (contents.get("props") or {}).items():
+                        if value is None:
+                            interval.props.pop(key, None)
+                        else:
+                            interval.props[key] = value
+            return ("interval", contents["label"], interval_id, pending_id,
+                    self.engine._local_seq_counter)
         ops = (contents["ops"] if contents["type"] == "group" else [contents])
         for op in ops:
             if op["type"] == "insert":

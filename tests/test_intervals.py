@@ -1,0 +1,182 @@
+"""Interval collection tests: ranges tracking text through concurrent edits.
+
+Reference model: intervalCollection.spec behaviors — intervals shift with
+inserts, slide past removals, LWW per id, survive summaries.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from tests.test_mergetree import get_string, make_string_doc
+
+
+def setup_pair():
+    server = LocalCollabServer()
+    c1 = make_string_doc(server)
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    return server, c1, c2, get_string(c1), get_string(c2)
+
+
+class TestIntervals:
+    def test_interval_follows_inserts(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "hello world")
+        ic1 = t1.get_interval_collection("highlights")
+        ic2 = t2.get_interval_collection("highlights")
+        interval = ic1.add(6, 11, {"color": "yellow"})  # "world"
+        assert ic2.resolved()[interval.id][:2] == (6, 11)
+        # Insert before: both replicas' interval shifts right.
+        t2.insert_text(0, ">>> ")
+        assert ic1.resolved()[interval.id][:2] == (10, 15)
+        assert ic2.resolved()[interval.id][:2] == (10, 15)
+        # Insert before start: both endpoints shift.
+        t1.insert_text(8, "XX")
+        assert ic1.resolved()[interval.id][:2] == (12, 17)
+        # Insert inside: interval stretches.
+        t2.insert_text(14, "YY")
+        assert ic1.resolved()[interval.id][:2] == (12, 19)
+        assert ic1.resolved() == ic2.resolved()
+
+    def test_interval_slides_past_removed_text(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "abcdefghij")
+        ic1 = t1.get_interval_collection("x")
+        ic2 = t2.get_interval_collection("x")
+        interval = ic1.add(3, 7)  # "defg"
+        t2.remove_text(2, 5)      # removes "cde" including interval start
+        r1, r2 = ic1.resolved()[interval.id], ic2.resolved()[interval.id]
+        assert r1 == r2
+        start, end, _ = r1
+        assert 0 <= start <= end <= len(t1.get_text())
+
+    def test_change_and_delete_lww(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "0123456789")
+        ic1 = t1.get_interval_collection("x")
+        ic2 = t2.get_interval_collection("x")
+        interval = ic1.add(1, 3)
+        ic2.change(interval.id, start=5, end=8, props={"p": 1})
+        assert ic1.resolved() == ic2.resolved()
+        assert ic1.resolved()[interval.id] == (5, 8, {"p": 1})
+        ic1.delete(interval.id)
+        assert ic1.resolved() == ic2.resolved() == {}
+
+    def test_summary_roundtrip_with_intervals(self):
+        server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "summary text")
+        ic1 = t1.get_interval_collection("marks")
+        ic1.add(0, 7, {"k": 1})
+        assert c1.summarize() == c2.summarize()
+        server.upload_snapshot("doc", c1.summarize())
+        c3 = Container.load(LocalDocumentService(server, "doc"))
+        t3 = get_string(c3)
+        assert t3.get_interval_collection("marks").resolved() == ic1.resolved()
+        assert c3.summarize() == c1.summarize()
+
+    def test_reconnect_replays_interval_ops(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "offline interval target")
+        c2.disconnect()
+        ic2 = t2.get_interval_collection("x")
+        interval = ic2.add(0, 7, {"made": "offline"})
+        t1.insert_text(0, "shift ")
+        c2.reconnect()
+        ic1 = t1.get_interval_collection("x")
+        assert ic1.resolved() == ic2.resolved()
+        assert interval.id in ic1.resolved()
+        assert c1.summarize() == c2.summarize()
+
+
+class TestIntervalRegressions:
+    def test_concurrent_delete_vs_change_converges_on_delete(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "0123456789")
+        ic1 = t1.get_interval_collection("x")
+        ic2 = t2.get_interval_collection("x")
+        interval = ic1.add(1, 3)
+        c1.inbound.pause()
+        ic2.delete(interval.id)               # sequenced first
+        ic1.change(interval.id, props={"p": 9})  # pending at c1
+        c1.inbound.resume()
+        # Delete wins: both replicas drop the interval.
+        assert ic1.resolved() == ic2.resolved() == {}
+        assert c1.summarize() == c2.summarize()
+
+    def test_anchor_survives_zamboni_compaction(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "abcdefghij")
+        ic1 = t1.get_interval_collection("x")
+        ic2 = t2.get_interval_collection("x")
+        interval = ic1.add(5, 8)
+        # Remove text before the interval, then churn ops so the collab
+        # window advances far past the removal and zamboni compacts.
+        t2.remove_text(0, 3)
+        for _ in range(6):
+            t1.insert_text(0, "z")
+            t2.insert_text(0, "z")
+        r1 = ic1.resolved()[interval.id]
+        r2 = ic2.resolved()[interval.id]
+        assert r1 == r2
+        # Anchor must not have jumped to the end of the document.
+        assert r1[0] < len(t1.get_text())
+
+    def test_summary_positions_use_acked_view(self):
+        server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "abcdef")
+        ic1 = t1.get_interval_collection("x")
+        ic1.add(4, 5)
+        # A pending (never-sequenced) local insert must not offset the
+        # summarized interval positions.
+        c1.disconnect()
+        t1.insert_text(0, "XX")
+        snap = t1.summarize_core()
+        assert snap["interval_collections"][0]["intervals"][0]["start"] == 4
+        c1.reconnect()
+        assert c1.summarize() == c2.summarize()
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_interval_farm(seed):
+    rng = random.Random(200 + seed)
+    server = LocalCollabServer()
+    c1 = make_string_doc(server)
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    t1, t2 = get_string(c1), get_string(c2)
+    t1.insert_text(0, "x" * 30)
+    collections = [t.get_interval_collection("f") for t in (t1, t2)]
+    containers = [c1, c2]
+    texts = [t1, t2]
+    ids: list[str] = []
+
+    for _round in range(5):
+        paused = [c for c in containers if rng.random() < 0.3]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(3, 8)):
+            i = rng.randrange(2)
+            text, ic = texts[i], collections[i]
+            n = len(text)
+            r = rng.random()
+            if r < 0.35 and n > 1:
+                a = rng.randrange(n - 1)
+                b = a + 1 + rng.randrange(min(4, n - a - 1) or 1)
+                ids.append(ic.add(a, min(b, n)).id)
+            elif r < 0.5 and ids:
+                known = [x for x in ids if ic.get(x)]
+                if known:
+                    ic.delete(rng.choice(known))
+            elif r < 0.8:
+                text.insert_text(rng.randrange(n + 1), "ab")
+            elif n > 2:
+                a = rng.randrange(n - 1)
+                text.remove_text(a, min(n, a + 2))
+        for c in paused:
+            c.inbound.resume()
+        assert t1.get_text() == t2.get_text(), (seed, _round)
+        assert collections[0].resolved() == collections[1].resolved(), (
+            seed, _round)
+    assert c1.summarize() == c2.summarize()
