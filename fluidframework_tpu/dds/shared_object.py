@@ -135,31 +135,24 @@ class ChannelRegistry:
 
 def default_registry() -> ChannelRegistry:
     """Registry with every built-in DDS type registered."""
-    from . import cell, counter, map  # local import to avoid cycles
+    from . import (cell, counter, directory, ink, map, matrix,
+                   ordered_collection, register_collection, sequence,
+                   summary_block)
     factories: list[ChannelFactory] = [
         map.SharedMapFactory(),
+        directory.SharedDirectoryFactory(),
         counter.SharedCounterFactory(),
         cell.SharedCellFactory(),
+        sequence.SharedStringFactory(),
+        matrix.SharedMatrixFactory(),
+        ordered_collection.ConsensusQueueFactory(),
+        register_collection.ConsensusRegisterCollectionFactory(),
+        ink.InkFactory(),
+        summary_block.SharedSummaryBlockFactory(),
     ]
     try:  # registered as they land
-        from . import sequence
-        factories.append(sequence.SharedStringFactory())
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        from . import matrix
-        factories.append(matrix.SharedMatrixFactory())
-    except ImportError:  # pragma: no cover
-        pass
-    try:
         from . import tree
         factories.append(tree.SharedTreeFactory())
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        from . import ordered_collection, register_collection
-        factories.append(ordered_collection.ConsensusQueueFactory())
-        factories.append(register_collection.ConsensusRegisterCollectionFactory())
     except ImportError:  # pragma: no cover
         pass
     return ChannelRegistry(factories)

@@ -1,0 +1,160 @@
+"""SharedTree DDS — whole-document tree CRDT with rebase-by-reapplication.
+
+Reference parity: experimental/dds/tree/src/SharedTree.ts:446 (processCore:
+append sequenced edit, rebase local edits), Checkout.ts:172 (rebase),
+CachingLogViewer (snapshot per revision — here: cached sequenced snapshot +
+recomputed local view), and undo via inverse edits.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+from ..protocol.messages import SequencedDocumentMessage
+from .shared_object import ChannelFactory, SharedObject
+from .tree_core import (
+    EditLog,
+    INVALID,
+    ROOT_ID,
+    Transaction,
+    TreeSnapshot,
+    VALID,
+    invert_edit,
+)
+
+
+class SharedTree(SharedObject):
+    channel_type = "https://graph.microsoft.com/types/tree"
+
+    def __init__(self, channel_id: str, runtime=None, attributes=None) -> None:
+        super().__init__(channel_id, runtime, attributes)
+        self.log = EditLog()
+        self._sequenced_snapshot = TreeSnapshot()
+        self._view: TreeSnapshot | None = self._sequenced_snapshot
+        self._edit_counter = itertools.count(1)
+        # seq -> snapshot BEFORE that sequenced edit (undo support, bounded).
+        self._history: dict[str, TreeSnapshot] = {}
+
+    # -- views ----------------------------------------------------------------
+
+    @property
+    def current_view(self) -> TreeSnapshot:
+        """Sequenced state + local pending edits reapplied (rebase)."""
+        if self._view is None:
+            view = self._sequenced_snapshot
+            for edit in self.log.local:
+                txn = Transaction(view)
+                if txn.apply_edit(edit) == VALID:
+                    view = txn.snapshot
+            self._view = view
+        return self._view
+
+    # -- edit builders (typed convenience API) ---------------------------------
+
+    def _next_edit_id(self) -> str:
+        container = (self.runtime.parent.container
+                     if self.runtime is not None else None)
+        owner = (container.client_id or "detached") if container else "detached"
+        return f"{owner}-e{next(self._edit_counter)}"
+
+    def apply_edit(self, changes: list[dict]) -> str:
+        """Submit an edit (a list of changes applied atomically)."""
+        edit = {"id": self._next_edit_id(), "changes": changes}
+        self.log.add_local(edit)
+        self._view = None
+        self.submit_local_message({"type": "edit", "edit": edit})
+        return edit["id"]
+
+    def insert_node(self, spec: dict, destination: dict) -> str:
+        build_id = f"b-{spec['id']}"
+        return self.apply_edit([
+            {"type": "build", "source": [spec], "destination": build_id},
+            {"type": "insert", "source": build_id,
+             "destination": destination},
+        ])
+
+    def move_range(self, source_range: dict, destination: dict) -> str:
+        detach_id = f"m-{next(self._edit_counter)}"
+        return self.apply_edit([
+            {"type": "detach", "source": source_range,
+             "destination": detach_id},
+            {"type": "insert", "source": detach_id,
+             "destination": destination},
+        ])
+
+    def delete_range(self, source_range: dict) -> str:
+        return self.apply_edit([
+            {"type": "detach", "source": source_range}])
+
+    def set_payload(self, node_id: str, payload: Any) -> str:
+        return self.apply_edit([
+            {"type": "set_value", "node": node_id, "payload": payload}])
+
+    def undo(self, edit_id: str) -> str | None:
+        """Submit the inverse of a previously *sequenced* edit."""
+        before = self._history.get(edit_id)
+        entry = next((e for e in self.log.sequenced
+                      if e.edit["id"] == edit_id), None)
+        if before is None or entry is None or entry.validity != VALID:
+            return None
+        inverse = invert_edit(entry.edit, before)
+        if inverse is None:
+            return None
+        self.log.add_local(inverse)
+        self._view = None
+        self.submit_local_message({"type": "edit", "edit": inverse})
+        return inverse["id"]
+
+    # -- SharedObject contract ------------------------------------------------
+
+    def process_core(self, message: SequencedDocumentMessage, local: bool,
+                     local_op_metadata: Any) -> None:
+        edit = message.contents["edit"]
+        if local:
+            front = self.log.ack_front_local()
+            assert front["id"] == edit["id"], "out-of-order tree ack"
+        self._history[edit["id"]] = self._sequenced_snapshot
+        txn = Transaction(self._sequenced_snapshot)
+        validity = txn.apply_edit(edit)
+        if validity == VALID:
+            self._sequenced_snapshot = txn.snapshot
+        self.log.add_sequenced(edit, message.sequence_number, validity)
+        self._view = None  # local edits rebase onto the new sequenced state
+        # Bound history to the collab window (minSeq advance ~ zamboni).
+        if len(self._history) > 256:
+            for edit_id in list(self._history)[:64]:
+                del self._history[edit_id]
+
+    def resubmit_core(self, contents: Any, metadata: Any) -> None:
+        # Stable ids anchor the edit; it is resubmitted unchanged and
+        # re-validated at its new sequence point.
+        self.submit_local_message(contents, metadata)
+
+    def on_attach(self) -> None:
+        # Detached edits fold into the baseline snapshot.
+        view = self.current_view
+        self._sequenced_snapshot = view
+        self.log = EditLog()
+        self._view = view
+
+    def summarize_core(self) -> dict:
+        return {
+            "tree": self._sequenced_snapshot.serialize(),
+            "edit_ids": [e.edit["id"] for e in self.log.sequenced][-64:],
+        }
+
+    def load_core(self, content: dict) -> None:
+        self._sequenced_snapshot = TreeSnapshot.load(content["tree"])
+        self._view = self._sequenced_snapshot
+        self.log = EditLog()
+
+    def apply_stashed_op(self, contents: Any) -> Any:
+        self.log.add_local(contents["edit"])
+        self._view = None
+        return None
+
+
+class SharedTreeFactory(ChannelFactory):
+    channel_type = SharedTree.channel_type
+    shared_object_cls = SharedTree

@@ -1,0 +1,129 @@
+"""Batched SharedTree rebase kernel — edit apply + validity across documents.
+
+Reference parity target: the rebase hot loop of experimental/dds/tree
+(Transaction apply over snapshots, re-validating anchors) batched across
+documents (BASELINE config 5: 1k docs batched rebase).
+
+Device encoding: a document's tree = a fixed-capacity node table
+(SoA over [B, N]): exists mask, parent slot, payload id. One edit op per
+scan step, vmapped over documents:
+
+  * set_value(node, payload)   — valid iff the node exists;
+  * detach(node)               — removes the whole subtree (parent-pointer
+                                 mask propagation, log-depth passes);
+  * insert(slot, parent, payload) — activates a free slot under a parent,
+                                 valid iff the parent exists and slot free.
+
+Outputs per op: applied/invalid flags — the *validity masking* that the
+scalar Transaction computes sequentially (invalid edits drop whole).
+Sibling ordering inside traits is host-side state in this round (ordering
+does not affect validity or payload/topology convergence here); the
+merge-tree kernel's order machinery is the planned device path for it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+
+TREE_SET_VALUE = 0
+TREE_DETACH = 1
+TREE_INSERT = 2
+
+MAX_DEPTH_PASSES = 16  # supports trees up to depth 2^16 via doubling
+
+
+class TreeState(NamedTuple):
+    exists: jax.Array   # bool[B, N] (slot 0 = root, always exists)
+    parent: jax.Array   # i32[B, N] parent slot (-1 for root)
+    payload: jax.Array  # i32[B, N] interned payload id
+
+
+class TreeOpBatch(NamedTuple):
+    valid: jax.Array    # bool[B, K]
+    kind: jax.Array     # i32[B, K]
+    node: jax.Array     # i32[B, K] target slot
+    parent: jax.Array   # i32[B, K] (insert)
+    payload: jax.Array  # i32[B, K]
+
+
+def init_state(num_docs: int, num_slots: int) -> TreeState:
+    exists = jnp.zeros((num_docs, num_slots), jnp.bool_).at[:, 0].set(True)
+    return TreeState(
+        exists=exists,
+        parent=jnp.full((num_docs, num_slots), -1, I32),
+        payload=jnp.zeros((num_docs, num_slots), I32),
+    )
+
+
+def _apply_op(s: TreeState, op):
+    node = jnp.clip(op.node, 0, s.exists.shape[0] - 1)
+    parent = jnp.clip(op.parent, 0, s.exists.shape[0] - 1)
+    node_exists = s.exists[node]
+    parent_exists = s.exists[parent]
+
+    is_set = op.kind == TREE_SET_VALUE
+    is_detach = op.kind == TREE_DETACH
+    is_insert = op.kind == TREE_INSERT
+
+    ok = op.valid & jnp.where(
+        is_insert, parent_exists & ~node_exists & (op.node != 0),
+        node_exists & jnp.where(is_detach, op.node != 0, True))
+
+    # set_value
+    lanes = jnp.arange(s.exists.shape[0])
+    target = lanes == node
+    payload = jnp.where(target & ok & is_set, op.payload, s.payload)
+
+    # detach: drop node + all descendants. True pointer-doubling: each pass
+    # both ORs in ancestors' removal AND squares the ancestor jump, so
+    # MAX_DEPTH_PASSES passes cover depth 2^MAX_DEPTH_PASSES.
+    def drop_subtree(exists):
+        def body(_i, carry):
+            removed, anc = carry
+            has_anc = anc >= 0
+            safe = jnp.clip(anc, 0, None)
+            removed = removed | (removed[safe] & has_anc)
+            anc = jnp.where(has_anc, anc[safe], -1)
+            return removed, anc
+        removed, _ = jax.lax.fori_loop(
+            0, MAX_DEPTH_PASSES, body, (target, s.parent))
+        return exists & ~removed
+    exists = jnp.where(ok & is_detach, drop_subtree(s.exists), s.exists)
+
+    # insert
+    exists = jnp.where(target & ok & is_insert, True, exists)
+    parent_arr = jnp.where(target & ok & is_insert, parent, s.parent)
+    payload = jnp.where(target & ok & is_insert, op.payload, payload)
+
+    return TreeState(exists=exists, parent=parent_arr, payload=payload), ok
+
+
+def _process_doc(state: TreeState, ops: TreeOpBatch):
+    return jax.lax.scan(_apply_op, state, ops)
+
+
+@jax.jit
+def apply_tick(state: TreeState, ops: TreeOpBatch):
+    """(state', applied_mask[B, K]) for one tick of tree edits."""
+    return jax.vmap(_process_doc)(state, ops)
+
+
+def make_tree_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
+                       k: int) -> TreeOpBatch:
+    fields = {name: np.zeros((num_docs, k), np.int32)
+              for name in ("kind", "node", "parent", "payload")}
+    valid = np.zeros((num_docs, k), np.bool_)
+    for d, doc_ops in enumerate(ops_per_doc):
+        assert len(doc_ops) <= k
+        for i, op in enumerate(doc_ops):
+            valid[d, i] = True
+            for name in fields:
+                fields[name][d, i] = op.get(name, 0)
+    return TreeOpBatch(valid=jnp.asarray(valid),
+                       **{n: jnp.asarray(v) for n, v in fields.items()})

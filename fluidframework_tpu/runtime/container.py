@@ -31,6 +31,7 @@ class Container:
         self._service = document_service
         self.protocol = ProtocolOpHandler()
         self.runtime = ContainerRuntime(self, registry)
+        self._wire_quorum()
         self.client_id: str | None = None
         self.attached = False
         self._connection: Any = None
@@ -54,6 +55,7 @@ class Container:
         snapshot = document_service.storage.get_latest_snapshot()
         if snapshot is not None:
             container.protocol = ProtocolOpHandler.load(snapshot["protocol"])
+            container._wire_quorum()
             container.runtime.load(snapshot["runtime"])
             container.last_processed_seq = snapshot["sequence_number"]
         container.attached = True
@@ -75,6 +77,18 @@ class Container:
         self._service.storage.upload_snapshot(self.summarize())
         self.attached = True
         self.connect()
+
+    def _wire_quorum(self) -> None:
+        """Membership events fan out to interested channels (e.g. consensus
+        queues auto-release a departed client's leases)."""
+        self.protocol.quorum.on_remove_member.append(self._on_member_removed)
+
+    def _on_member_removed(self, client_id: str) -> None:
+        for datastore in self.runtime.datastores.values():
+            for channel in datastore.channels.values():
+                on_leave = getattr(channel, "on_client_leave", None)
+                if on_leave is not None:
+                    on_leave(client_id)
 
     # -- connection state machine --------------------------------------------
 

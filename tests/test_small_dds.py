@@ -1,0 +1,200 @@
+"""Tests for the smaller DDSes: directory, consensus collections, ink,
+summary block — convergence + consensus semantics over the local server."""
+
+from fluidframework_tpu.dds.directory import SharedDirectory
+from fluidframework_tpu.dds.ink import Ink
+from fluidframework_tpu.dds.ordered_collection import ConsensusQueue
+from fluidframework_tpu.dds.register_collection import (
+    ConsensusRegisterCollection,
+)
+from fluidframework_tpu.dds.summary_block import SharedSummaryBlock
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+def make_doc(server, channel_type, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("x", channel_type)
+    container.attach()
+    return container
+
+
+def open_doc(server, doc_id="doc"):
+    return Container.load(LocalDocumentService(server, doc_id))
+
+
+def chan(container):
+    return container.runtime.get_datastore("default").get_channel("x")
+
+
+class TestSharedDirectory:
+    def test_nested_dirs_converge(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, SharedDirectory.channel_type)
+        c2 = open_doc(server)
+        d1, d2 = chan(c1), chan(c2)
+        d1.set("top", 1)
+        sub = d1.create_sub_directory("settings")
+        sub.set("theme", "dark")
+        nested = sub.create_sub_directory("advanced")
+        nested.set("flag", True)
+        assert d2.get("top") == 1
+        assert d2.get_sub_directory("settings").get("theme") == "dark"
+        s2 = d2.get_sub_directory("settings")
+        assert s2.get_sub_directory("advanced").get("flag") is True
+        assert s2.subdirectories() == ["advanced"]
+        assert c1.summarize() == c2.summarize()
+
+    def test_conflicts_and_clear_per_subdir(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, SharedDirectory.channel_type)
+        c2 = open_doc(server)
+        d1, d2 = chan(c1), chan(c2)
+        sub1 = d1.create_sub_directory("s")
+        sub1.set("k", "one")
+        d2.get_sub_directory("s").set("k", "two")
+        assert d1.get_sub_directory("s").get("k") == "two"
+        d1.set("rootk", 1)
+        d2.get_sub_directory("s").clear()
+        assert d1.get_sub_directory("s").get("k") is None
+        assert d1.get("rootk") == 1  # clear scoped to the subdirectory
+        assert c1.summarize() == c2.summarize()
+
+    def test_reconnect_replay(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, SharedDirectory.channel_type)
+        c2 = open_doc(server)
+        d2 = chan(c2)
+        c2.disconnect()
+        d2.set("offline", "yes")
+        c2.reconnect()
+        assert chan(c1).get("offline") == "yes"
+        assert c1.summarize() == c2.summarize()
+
+
+class TestConsensusRegister:
+    def test_write_wins_when_saw_previous(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, ConsensusRegisterCollection.channel_type)
+        c2 = open_doc(server)
+        r1, r2 = chan(c1), chan(c2)
+        r1.write("leader", "alice")
+        assert r1.read("leader") == r2.read("leader") == "alice"
+        r2.write("leader", "bob")  # saw alice's write → supersedes
+        assert r1.read("leader") == "bob"
+        assert r1.read_versions("leader") == ["bob"]
+
+    def test_concurrent_writes_keep_versions(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, ConsensusRegisterCollection.channel_type)
+        c2 = open_doc(server)
+        r1, r2 = chan(c1), chan(c2)
+        c1.inbound.pause()
+        c2.inbound.pause()
+        r1.write("k", "from1")
+        r2.write("k", "from2")  # concurrent: neither saw the other
+        c1.inbound.resume()
+        c2.inbound.resume()
+        assert r1.read_versions("k") == r2.read_versions("k")
+        assert len(r1.read_versions("k")) == 2
+        # Atomic read = first sequenced; LWW = last.
+        assert r1.read("k") == "from1"
+        assert r1.read("k", policy=r1.LWW) == "from2"
+        assert c1.summarize() == c2.summarize()
+
+
+class TestConsensusQueue:
+    def test_exactly_once_acquire(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, ConsensusQueue.channel_type)
+        c2 = open_doc(server)
+        q1, q2 = chan(c1), chan(c2)
+        q1.add("job-a")
+        q1.add("job-b")
+        # Both clients race to acquire: exactly one gets each item.
+        q1.acquire()
+        q2.acquire()
+        got1, got2 = q1.acquired_items(), q2.acquired_items()
+        assert len(got1) == 1 and len(got2) == 1
+        assert set(got1.values()) | set(got2.values()) == {"job-a", "job-b"}
+        assert len(q1) == len(q2) == 0
+        # Complete one, release the other: released returns to the queue.
+        (id1,) = got1
+        (id2,) = got2
+        q1.complete(id1)
+        q2.release(id2)
+        assert len(q1) == len(q2) == 1
+        assert c1.summarize() == c2.summarize()
+
+    def test_departed_client_leases_auto_release(self):
+        # Regression: a leaving client's leased items return to the queue.
+        server = LocalCollabServer()
+        c1 = make_doc(server, ConsensusQueue.channel_type)
+        c2 = open_doc(server)
+        q1, q2 = chan(c1), chan(c2)
+        q1.add("orphanable")
+        q2.acquire()
+        assert len(q1) == 0 and q2.acquired_items()
+        c2.close()  # leave sequences; lease must release on c1
+        assert len(q1) == 1
+        assert q1.jobs == {}
+
+    def test_acquire_on_empty_queue_is_noop(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, ConsensusQueue.channel_type)
+        q1 = chan(c1)
+        q1.acquire()
+        assert q1.acquired_items() == {}
+
+
+class TestInk:
+    def test_concurrent_same_stroke_points_order_identically(self):
+        # Regression: points apply at sequencing so interleavings match.
+        server = LocalCollabServer()
+        c1 = make_doc(server, Ink.channel_type)
+        c2 = open_doc(server)
+        ink1, ink2 = chan(c1), chan(c2)
+        stroke = ink1.create_stroke({})
+        c1.inbound.pause()
+        c2.inbound.pause()
+        ink1.append_point(stroke, 1, 1)
+        ink2.append_point(stroke, 2, 2)
+        c1.inbound.resume()
+        c2.inbound.resume()
+        p1 = [p["x"] for p in ink1.get_stroke(stroke)["points"]]
+        p2 = [p["x"] for p in ink2.get_stroke(stroke)["points"]]
+        assert p1 == p2
+        assert c1.summarize() == c2.summarize()
+
+    def test_strokes_converge(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, Ink.channel_type)
+        c2 = open_doc(server)
+        ink1, ink2 = chan(c1), chan(c2)
+        stroke = ink1.create_stroke({"color": "red"})
+        ink1.append_point(stroke, 1.0, 2.0)
+        ink1.append_point(stroke, 3.0, 4.0)
+        stroke2 = ink2.create_stroke({"color": "blue"})
+        ink2.append_point(stroke2, 9.0, 9.0)
+        assert ink2.get_stroke(stroke)["points"][1]["x"] == 3.0
+        assert ink1.get_stroke(stroke2)["pen"] == {"color": "blue"}
+        assert c1.summarize() == c2.summarize()
+
+
+class TestSummaryBlock:
+    def test_data_rides_summaries_only(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, SharedSummaryBlock.channel_type)
+        block = chan(c1)
+        block.set("checkpoint", {"stats": 42})
+        # Not replicated live: a joiner from the pre-set attach snapshot
+        # does not see it...
+        c2 = open_doc(server)
+        assert chan(c2).get("checkpoint") is None
+        # ...but a joiner from a later summary does.
+        server.upload_snapshot("doc", c1.summarize())
+        c3 = open_doc(server)
+        assert chan(c3).get("checkpoint") == {"stats": 42}

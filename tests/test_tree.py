@@ -1,0 +1,182 @@
+"""SharedTree tests: id-anchored edits, invalid-edit dropping, rebase,
+move/undo, convergence farm (BASELINE config 5 model)."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.tree import SharedTree
+from fluidframework_tpu.dds.tree_core import ROOT_ID, VALID, INVALID
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+def make_tree_doc(server, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("tree", SharedTree.channel_type)
+    container.attach()
+    return container
+
+
+def get_tree(container) -> SharedTree:
+    return container.runtime.get_datastore("default").get_channel("tree")
+
+
+def node(nid, payload=None, **traits):
+    return {"id": nid, "definition": "n", "payload": payload,
+            "traits": {k: list(v) for k, v in traits.items()}}
+
+
+def end_of(parent, label="children"):
+    return {"referenceTrait": {"parent": parent, "label": label},
+            "side": "end"}
+
+
+def range_of(nid):
+    return {"start": {"referenceSibling": nid, "side": "before"},
+            "end": {"referenceSibling": nid, "side": "after"}}
+
+
+class TestTreeBasics:
+    def test_insert_and_converge(self):
+        server = LocalCollabServer()
+        c1 = make_tree_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        t1, t2 = get_tree(c1), get_tree(c2)
+        t1.insert_node(node("a", payload=1), end_of(ROOT_ID))
+        t2.insert_node(node("b", payload=2), end_of(ROOT_ID))
+        assert t1.current_view.serialize() == t2.current_view.serialize()
+        assert t1.current_view.children(ROOT_ID, "children") == ["a", "b"]
+        assert c1.summarize() == c2.summarize()
+
+    def test_set_value_and_move(self):
+        server = LocalCollabServer()
+        c1 = make_tree_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        t1, t2 = get_tree(c1), get_tree(c2)
+        t1.insert_node(node("x"), end_of(ROOT_ID))
+        t1.insert_node(node("y"), end_of(ROOT_ID))
+        t2.set_payload("x", {"deep": True})
+        t2.move_range(range_of("x"),
+                      {"referenceSibling": "y", "side": "after"})
+        assert t1.current_view.children(ROOT_ID, "children") == ["y", "x"]
+        assert t1.current_view.get("x").payload == {"deep": True}
+        assert c1.summarize() == c2.summarize()
+
+    def test_concurrent_edit_to_deleted_subtree_is_dropped(self):
+        server = LocalCollabServer()
+        c1 = make_tree_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        t1, t2 = get_tree(c1), get_tree(c2)
+        t1.insert_node(node("doomed", "alive"), end_of(ROOT_ID))
+        c2.inbound.pause()
+        t1.delete_range(range_of("doomed"))       # sequenced first
+        t2.set_payload("doomed", "too late")      # anchored to a gone node
+        c2.inbound.resume()
+        assert not t1.current_view.has("doomed")
+        assert not t2.current_view.has("doomed")
+        # The late edit is recorded INVALID identically on both replicas.
+        assert [e.validity for e in t1.log.sequenced] == \
+               [e.validity for e in t2.log.sequenced]
+        assert INVALID in [e.validity for e in t1.log.sequenced]
+        assert c1.summarize() == c2.summarize()
+
+    def test_local_pending_rebase_over_remote(self):
+        server = LocalCollabServer()
+        c1 = make_tree_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        t1, t2 = get_tree(c1), get_tree(c2)
+        t1.insert_node(node("base"), end_of(ROOT_ID))
+        c1.inbound.pause()
+        t2.insert_node(node("remote"), end_of(ROOT_ID))
+        t1.insert_node(node("mine"), end_of(ROOT_ID))  # pending at c1
+        # c1's local view shows its pending edit.
+        assert "mine" in t1.current_view.nodes
+        c1.inbound.resume()
+        assert t1.current_view.serialize() == t2.current_view.serialize()
+        assert t1.current_view.children(ROOT_ID, "children") == [
+            "base", "remote", "mine"]
+
+    def test_undo_of_insert_and_detach(self):
+        server = LocalCollabServer()
+        c1 = make_tree_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        t1, t2 = get_tree(c1), get_tree(c2)
+        eid = t1.insert_node(node("u", payload=7), end_of(ROOT_ID))
+        assert t2.current_view.has("u")
+        t1.undo(eid)
+        assert not t1.current_view.has("u")
+        assert not t2.current_view.has("u")
+        # Undo a delete: the subtree comes back, same position.
+        t1.insert_node(node("keep1"), end_of(ROOT_ID))
+        t1.insert_node(node("mid", payload="m"), end_of(ROOT_ID))
+        t1.insert_node(node("keep2"), end_of(ROOT_ID))
+        del_id = t1.delete_range(range_of("mid"))
+        assert not t1.current_view.has("mid")
+        t2_del = [e for e in t2.log.sequenced if e.edit["id"] == del_id]
+        assert t2_del and t2_del[0].validity == VALID
+        t1.undo(del_id)
+        assert t1.current_view.children(ROOT_ID, "children") == [
+            "keep1", "mid", "keep2"]
+        assert t1.current_view.get("mid").payload == "m"
+        assert c1.summarize() == c2.summarize()
+
+    def test_reconnect_replays_tree_edits(self):
+        server = LocalCollabServer()
+        c1 = make_tree_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        t1, t2 = get_tree(c1), get_tree(c2)
+        t1.insert_node(node("a"), end_of(ROOT_ID))
+        c2.disconnect()
+        t2.insert_node(node("offline"), end_of(ROOT_ID))
+        t1.set_payload("a", "changed while away")
+        c2.reconnect()
+        assert t1.current_view.serialize() == t2.current_view.serialize()
+        assert t1.current_view.has("offline")
+        assert c1.summarize() == c2.summarize()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_tree_farm(seed):
+    rng = random.Random(seed)
+    server = LocalCollabServer()
+    c1 = make_tree_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(2)]
+    trees = [get_tree(c) for c in containers]
+    counter = 0
+
+    for _round in range(6):
+        paused = [c for c in containers if rng.random() < 0.35]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(3, 8)):
+            i = rng.randrange(len(trees))
+            tree = trees[i]
+            view = tree.current_view
+            ids = [n for n in view.nodes if n != ROOT_ID]
+            r = rng.random()
+            if r < 0.45 or not ids:
+                counter += 1
+                anchor = rng.choice(ids) if ids and rng.random() < 0.5 else None
+                dest = ({"referenceSibling": anchor, "side": "after"}
+                        if anchor else end_of(ROOT_ID))
+                tree.insert_node(node(f"n{i}-{counter}",
+                                      payload=rng.randrange(100)), dest)
+            elif r < 0.65:
+                tree.set_payload(rng.choice(ids), rng.randrange(100))
+            elif r < 0.85 and len(ids) >= 2:
+                a, b = rng.sample(ids, 2)
+                tree.move_range(range_of(a),
+                                {"referenceSibling": b, "side": "after"})
+            else:
+                tree.delete_range(range_of(rng.choice(ids)))
+        for c in paused:
+            c.inbound.resume()
+        views = [t.current_view.serialize() for t in trees]
+        assert views[0] == views[1] == views[2], (seed, _round)
+    summaries = [c.summarize() for c in containers]
+    assert summaries[0] == summaries[1] == summaries[2], seed

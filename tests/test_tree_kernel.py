@@ -1,0 +1,131 @@
+"""Differential test: batched tree kernel vs scalar Transaction semantics."""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.dds.tree_core import (
+    ROOT_ID, Transaction, TreeSnapshot, VALID,
+)
+from fluidframework_tpu.ops import tree_kernel as tk
+
+
+def scalar_apply(snapshot, op_dicts, slot_names):
+    """Apply kernel-shaped ops through the scalar Transaction; returns
+    (snapshot, applied flags)."""
+    applied = []
+    for op in op_dicts:
+        name = slot_names[op["node"]]
+        if op["kind"] == tk.TREE_SET_VALUE:
+            changes = [{"type": "set_value", "node": name,
+                        "payload": op["payload"]}]
+        elif op["kind"] == tk.TREE_DETACH:
+            changes = [{"type": "detach", "source": {
+                "start": {"referenceSibling": name, "side": "before"},
+                "end": {"referenceSibling": name, "side": "after"}}}]
+        else:
+            parent = slot_names[op["parent"]]
+            changes = [
+                {"type": "build",
+                 "source": [{"id": name, "definition": "n",
+                             "payload": op["payload"]}],
+                 "destination": f"b-{name}-{len(applied)}"},
+                {"type": "insert", "source": f"b-{name}-{len(applied)}",
+                 "destination": {"referenceTrait": {
+                     "parent": parent, "label": "c"}, "side": "end"}},
+            ]
+        txn = Transaction(snapshot)
+        ok = txn.apply_edit({"id": "e", "changes": changes}) == VALID
+        if ok:
+            snapshot = txn.snapshot
+        applied.append(ok)
+    return snapshot, applied
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tree_kernel_matches_scalar(seed):
+    rng = random.Random(seed)
+    n_docs, n_slots, k, ticks = 3, 24, 12, 4
+    slot_names = {0: ROOT_ID, **{i: f"s{i}" for i in range(1, n_slots)}}
+
+    state = tk.init_state(n_docs, n_slots)
+    snapshots = [TreeSnapshot() for _ in range(n_docs)]
+    all_applied_scalar = [[] for _ in range(n_docs)]
+    all_applied_kernel = [[] for _ in range(n_docs)]
+
+    for _tick in range(ticks):
+        ops_per_doc = []
+        for d in range(n_docs):
+            ops = []
+            for _ in range(rng.randrange(k + 1)):
+                r = rng.random()
+                if r < 0.45:
+                    ops.append(dict(kind=tk.TREE_INSERT,
+                                    node=rng.randrange(1, n_slots),
+                                    parent=rng.randrange(n_slots),
+                                    payload=rng.randrange(1, 100)))
+                elif r < 0.75:
+                    ops.append(dict(kind=tk.TREE_SET_VALUE,
+                                    node=rng.randrange(n_slots),
+                                    payload=rng.randrange(1, 100)))
+                else:
+                    ops.append(dict(kind=tk.TREE_DETACH,
+                                    node=rng.randrange(n_slots)))
+            ops_per_doc.append(ops)
+
+        state, ok = tk.apply_tick(
+            state, tk.make_tree_op_batch(ops_per_doc, n_docs, k))
+        for d in range(n_docs):
+            snapshots[d], applied = scalar_apply(
+                snapshots[d], ops_per_doc[d], slot_names)
+            all_applied_scalar[d].extend(applied)
+            all_applied_kernel[d].extend(
+                np.asarray(ok[d][:len(ops_per_doc[d])]).tolist())
+
+    for d in range(n_docs):
+        assert all_applied_kernel[d] == all_applied_scalar[d], (seed, d)
+        # Topology + payload equality (order is host-side by design).
+        exists = np.asarray(state.exists[d])
+        payload = np.asarray(state.payload[d])
+        parent = np.asarray(state.parent[d])
+        for slot in range(n_slots):
+            name = slot_names[slot]
+            assert bool(exists[slot]) == snapshots[d].has(name), (seed, d, slot)
+            if exists[slot] and slot != 0:
+                node = snapshots[d].get(name)
+                assert node.payload == int(payload[slot]) or (
+                    node.payload is None and payload[slot] == 0)
+                assert slot_names[int(parent[slot])] == node.parent[0]
+
+
+def test_tree_kernel_detach_deep_chain():
+    # Regression: pointer-doubling must remove descendants deeper than the
+    # number of passes (chain of 20 > 16 passes).
+    depth = 20
+    state = tk.init_state(1, depth + 2)
+    ops = [dict(kind=tk.TREE_INSERT, node=i, parent=i - 1, payload=i)
+           for i in range(1, depth + 1)]
+    state, ok = tk.apply_tick(
+        state, tk.make_tree_op_batch([ops], 1, depth + 2))
+    assert bool(np.asarray(ok)[0, :depth].all())
+    state, ok = tk.apply_tick(
+        state, tk.make_tree_op_batch([[dict(kind=tk.TREE_DETACH, node=1)]],
+                                     1, 2))
+    exists = np.asarray(state.exists[0])
+    assert exists[0] and not exists[1:depth + 1].any()
+
+
+def test_tree_kernel_detach_removes_descendants():
+    state = tk.init_state(1, 8)
+    ops = [
+        dict(kind=tk.TREE_INSERT, node=1, parent=0, payload=1),
+        dict(kind=tk.TREE_INSERT, node=2, parent=1, payload=2),
+        dict(kind=tk.TREE_INSERT, node=3, parent=2, payload=3),
+        dict(kind=tk.TREE_DETACH, node=1),
+        dict(kind=tk.TREE_SET_VALUE, node=3, payload=9),  # invalid: gone
+    ]
+    state, ok = tk.apply_tick(state, tk.make_tree_op_batch([ops], 1, 8))
+    assert np.asarray(state.exists[0]).tolist()[:4] == [True, False, False,
+                                                        False]
+    assert np.asarray(ok[0]).tolist()[:5] == [True, True, True, True, False]
