@@ -1,0 +1,246 @@
+"""KernelSequencerHost differential tests: device-batched sequencing through
+the host (string client ids, slot allocation/reuse, multi-doc flush,
+checkpoint round-trip) must match the scalar DocumentSequencer exactly, and
+the e2e LocalCollabServer stack must converge identically on either."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.ops import opcodes as oc
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.sequencer import DocumentSequencer, RawOperation
+
+from test_sequencer import join, leave, op, random_stream
+
+
+def assert_tickets_equal(got, want, ctx):
+    assert got.kind == want.kind, (ctx, got, want)
+    if want.kind != oc.OUT_IGNORED:
+        assert got.seq == want.seq, (ctx, got, want)
+        assert got.msn == want.msn, (ctx, got, want)
+    assert got.send == want.send, (ctx, got, want)
+    assert got.nack_code == want.nack_code, (ctx, got, want)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_sync_path_matches_scalar_fuzz(seed):
+    rng = random.Random(seed)
+    host = KernelSequencerHost(num_slots=8, initial_capacity=2)
+    docs = ["alpha", "beta", "gamma"]  # 3 docs > capacity 2 forces growth
+    scalars = {d: DocumentSequencer() for d in docs}
+    for i in range(150):
+        doc = rng.choice(docs)
+        stream = random_stream(rng, 1, n_clients=6)
+        if not stream:
+            continue
+        raw = stream[0]
+        want = scalars[doc].ticket(raw)
+        got = host.sequence(doc, raw)
+        assert_tickets_equal(got, want, (seed, i, doc, raw))
+    for doc in docs:
+        cp_host = host.checkpoint(doc)
+        cp_scalar = scalars[doc].checkpoint()
+        assert cp_host.sequence_number == cp_scalar.sequence_number
+        assert cp_host.minimum_sequence_number == \
+            cp_scalar.minimum_sequence_number
+        assert cp_host.last_sent_msn == cp_scalar.last_sent_msn
+        assert cp_host.clients == cp_scalar.clients
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_flush_path_matches_scalar_fuzz(seed):
+    rng = random.Random(100 + seed)
+    host = KernelSequencerHost(num_slots=8, initial_capacity=4)
+    docs = ["a", "b", "c", "d", "e"]
+    scalars = {d: DocumentSequencer() for d in docs}
+    for _tick in range(5):
+        streams = {d: random_stream(rng, rng.randrange(12), 6) for d in docs}
+        for d, stream in streams.items():
+            for raw in stream:
+                host.submit(d, raw)
+        results = host.flush()
+        for d, stream in streams.items():
+            want = [scalars[d].ticket(raw) for raw in stream]
+            got = results.get(d, [])
+            assert len(got) == len(want)
+            for i, (g, w) in enumerate(zip(got, want)):
+                assert_tickets_equal(g, w, (seed, d, i))
+
+
+def test_slot_reuse_after_leave():
+    host = KernelSequencerHost(num_slots=2, initial_capacity=1)
+    s = DocumentSequencer()
+    # Cycle 5 distinct clients through 2 slots.
+    for i in range(5):
+        cid = f"c{i}"
+        assert_tickets_equal(host.sequence("doc", join(cid, ts=i)),
+                             s.ticket(join(cid, ts=i)), i)
+        assert_tickets_equal(host.sequence("doc", op(cid, 1, i)),
+                             s.ticket(op(cid, 1, i)), i)
+        assert_tickets_equal(host.sequence("doc", leave(cid, ts=i)),
+                             s.ticket(leave(cid, ts=i)), i)
+
+
+def test_unknown_client_nacked_then_can_join():
+    host = KernelSequencerHost(num_slots=4)
+    s = DocumentSequencer()
+    for raw in [op("ghost", 1, 0), join("ghost"), op("ghost", 1, 1),
+                leave("nobody"), leave("ghost"), leave("ghost")]:
+        assert_tickets_equal(host.sequence("doc", raw), s.ticket(raw), raw)
+
+
+def test_nack_future_applies_mid_tick():
+    # Ops after a control(nackFuture) in the SAME flush tick must NACK.
+    host = KernelSequencerHost(num_slots=4)
+    s = DocumentSequencer()
+    control = RawOperation(client_id=None, type=MessageType.CONTROL,
+                           contents={"type": "nackFuture"})
+    stream = [join("a"), op("a", 1, 1), control, op("a", 2, 2),
+              join("late"), leave("nobody")]
+    for raw in stream:
+        host.submit("doc", raw)
+    got = host.flush()["doc"]
+    want = [s.ticket(raw) for raw in stream]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert_tickets_equal(g, w, i)
+    assert got[3].nack_code == oc.NACK_FUTURE
+    assert got[4].nack_code == oc.NACK_FUTURE
+
+
+def test_leave_rejoin_same_tick_keeps_mapping():
+    # Regression: a leave then rejoin of one client inside a single flush
+    # tick must keep the slot mapping live (and not leak the device lane).
+    host = KernelSequencerHost(num_slots=4)
+    s = DocumentSequencer()
+    tick1 = [join("b"), join("a"), op("a", 1, 2)]
+    tick2 = [leave("b"), leave("a"), join("a")]
+    for raw in tick1 + tick2:
+        host.submit("doc", raw)
+        s.ticket(raw)
+    host.flush()
+    follow = op("a", 1, 3)
+    assert_tickets_equal(host.sequence("doc", follow), s.ticket(follow),
+                         "post-rejoin op")
+    assert set(host._slots[0]) == {"a"}
+
+
+def test_unknown_client_with_full_slots_nacks_not_raises():
+    # Regression: with every lane taken, an op/leave from an unknown client
+    # must produce the scalar's NACK/IGNORED (via the ghost lane), and a
+    # further join must grow the slot axis rather than fail.
+    host = KernelSequencerHost(num_slots=2)
+    s = DocumentSequencer()
+    stream = [join("a"), join("b"), op("ghost", 1, 0), leave("nobody"),
+              join("c"), op("c", 1, 3)]
+    for raw in stream:
+        assert_tickets_equal(host.sequence("doc", raw), s.ticket(raw), raw)
+    assert host._alloc_slots == 4
+
+
+def test_restore_more_clients_than_slots():
+    s = DocumentSequencer()
+    for i in range(20):
+        s.ticket(join(f"c{i}", ts=i))
+    host = KernelSequencerHost(num_slots=16)
+    host.restore("doc", s.checkpoint())
+    follow = op("c3", 1, 5)
+    assert_tickets_equal(host.sequence("doc", follow), s.ticket(follow),
+                         "post-restore")
+
+
+def test_checkpoint_restore_roundtrip():
+    host = KernelSequencerHost(num_slots=4)
+    for raw in [join("a"), join("b"), op("a", 1, 1), op("b", 1, 2)]:
+        host.sequence("doc", raw)
+    cp = host.checkpoint("doc", log_offset=7)
+    assert cp.log_offset == 7
+
+    # Restore into a fresh host and into a scalar; both continue identically.
+    host2 = KernelSequencerHost(num_slots=4)
+    host2.restore("doc", cp)
+    scalar = DocumentSequencer.restore(cp)
+    for raw in [op("a", 2, 3), leave("b"), op("a", 3, 4)]:
+        assert_tickets_equal(host2.sequence("doc", raw), scalar.ticket(raw),
+                             raw)
+
+
+def test_bad_timestamp_rejected_before_mutation():
+    host = KernelSequencerHost(num_slots=4)
+    host.sequence("doc", join("a"))
+    with pytest.raises(ValueError):
+        host.submit("doc", op("a", 1, 1, ts=2**40))  # epoch-ms mistake
+    # Host is not poisoned: normal flow continues.
+    s = DocumentSequencer()
+    s.ticket(join("a"))
+    assert_tickets_equal(host.sequence("doc", op("a", 1, 1)),
+                         s.ticket(op("a", 1, 1)), "after rejection")
+
+
+def test_sync_call_drains_pending_first():
+    # A sequence() call may not overtake ops queued via submit().
+    host = KernelSequencerHost(num_slots=4)
+    s = DocumentSequencer()
+    host.sequence("doc", join("a"))
+    s.ticket(join("a"))
+    host.submit("doc", op("a", 1, 1))
+    want_queued = s.ticket(op("a", 1, 1))
+    got_leave = host.sequence("doc", leave("a"))
+    want_leave = s.ticket(leave("a"))
+    assert_tickets_equal(got_leave, want_leave, "leave after drain")
+    assert want_queued.seq < got_leave.seq
+
+
+def test_restore_preserves_client_timeout():
+    s = DocumentSequencer(client_timeout_ms=100)
+    s.ticket(join("a", ts=0))
+    host = KernelSequencerHost(num_slots=4)
+    host.restore("doc", s.checkpoint())
+    assert host.idle_clients(now=500) == [("doc", "a")]
+
+
+def test_min_one_slot_even_if_zero_requested():
+    host = KernelSequencerHost(num_slots=0)
+    s = DocumentSequencer()
+    for raw in [join("a"), op("a", 1, 1), join("b"), op("b", 1, 2)]:
+        assert_tickets_equal(host.sequence("doc", raw), s.ticket(raw), raw)
+
+
+def test_idle_clients_across_docs():
+    host = KernelSequencerHost(num_slots=4)
+    host.sequence("d1", join("a", ts=0))
+    host.sequence("d1", join("b", ts=0))
+    host.sequence("d2", join("c", ts=0))
+    host.sequence("d1", op("b", 1, 1, ts=900))
+    idle = set(host.idle_clients(now=1000, timeout_ms=500))
+    assert idle == {("d1", "a"), ("d2", "c")}
+
+
+def test_e2e_server_on_kernel_sequencer():
+    """The full client stack over LocalCollabServer runs identically on the
+    device-kernel sequencer and the scalar default."""
+    from fluidframework_tpu.dds.map import SharedMap
+    from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+    from fluidframework_tpu.runtime.container import Container
+
+    def run(server):
+        c1 = Container.create_detached(LocalDocumentService(server, "doc"))
+        ds1 = c1.runtime.create_datastore("default")
+        m1 = ds1.create_channel("root", SharedMap.channel_type)
+        c1.attach()
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        m2 = c2.runtime.get_datastore("default").get_channel("root")
+        m1.set("x", 1)
+        m2.set("y", 2)
+        m1.set("x", 3)
+        m2.delete("y")
+        assert c1.summarize() == c2.summarize()
+        return dict(m1.items()), dict(m2.items())
+
+    host = KernelSequencerHost(num_slots=8)
+    a1, a2 = run(LocalCollabServer(
+        sequencer_factory=host.document_factory()))
+    b1, b2 = run(LocalCollabServer())
+    assert a1 == a2 == b1 == b2 == {"x": 3}
