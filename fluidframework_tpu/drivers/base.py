@@ -22,6 +22,8 @@ class DeltaConnection(Protocol):
 
     def submit(self, messages: list[DocumentMessage]) -> None: ...
 
+    def signal(self, content: Any) -> None: ...
+
     def close(self) -> None: ...
 
 
@@ -46,5 +48,5 @@ class DocumentService(Protocol):
 
     def connect(self, handler: IncomingHandler,
                 on_nack: Callable[[NackMessage], None] | None = None,
-                on_signal: Callable[[Any], None] | None = None
-                ) -> DeltaConnection: ...
+                on_signal: Callable[[Any], None] | None = None,
+                mode: str = "write") -> DeltaConnection: ...

@@ -48,8 +48,9 @@ class LocalDocumentService:
 
     def connect(self, handler: IncomingHandler,
                 on_nack: Callable[[NackMessage], None] | None = None,
-                on_signal: Callable[[Any], None] | None = None):
-        kwargs = {}
+                on_signal: Callable[[Any], None] | None = None,
+                mode: str = "write"):
+        kwargs = {"mode": mode}
         if self._scopes is not None:
             kwargs["scopes"] = self._scopes
         return self.server.connect(self.doc_id, handler, on_nack, on_signal,

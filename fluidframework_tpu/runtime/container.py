@@ -2,9 +2,10 @@
 
 Reference parity: packages/loader/container-loader/src/container.ts
 (``Container``: load:277/1115, processRemoteMessage:1700, connection state)
-with the DeltaManager inbound/outbound queues (deltaManager.ts:147,197-199)
-collapsed into one class — transport is a driver-provided delta connection;
-storage is a driver-provided snapshot/delta reader.
+over a :class:`fluidframework_tpu.runtime.delta_manager.DeltaManager`
+(deltaManager.ts:147 — inbound/outbound queues, gap fetch, readonly) —
+transport is a driver-provided delta connection; storage is a
+driver-provided snapshot/delta reader.
 
 The container owns the protocol handler (quorum) and the ContainerRuntime;
 protocol messages route to the former, OPERATION envelopes to the latter.
@@ -17,12 +18,11 @@ from typing import Any, Callable
 from ..drivers.base import DocumentService
 from ..protocol.handler import ProtocolOpHandler
 from ..protocol.messages import (
-    DocumentMessage,
     MessageType,
     SequencedDocumentMessage,
 )
 from .container_runtime import ContainerRuntime
-from .delta_queue import DeltaQueue
+from .delta_manager import DeltaManager
 
 
 class Container:
@@ -32,15 +32,17 @@ class Container:
         self.protocol = ProtocolOpHandler()
         self.runtime = ContainerRuntime(self, registry)
         self._wire_quorum()
-        self.client_id: str | None = None
         self.attached = False
-        self._connection: Any = None
-        self.client_seq = 0
-        self.last_processed_seq = 0
-        self.inbound: DeltaQueue[SequencedDocumentMessage] = DeltaQueue(
-            self._process_remote_message)
+        self.delta_manager = DeltaManager(
+            document_service,
+            process_message=self._process_remote_message,
+            process_signal=self._process_signal,
+            on_nack=self._on_nack,
+        )
+        self._mode = "write"
         self.on_connected: list[Callable[[str], None]] = []
         self.on_disconnected: list[Callable[[], None]] = []
+        self.on_signal: list[Callable[[Any], None]] = []
         # Service rejections of our ops (never silent — tests assert empty).
         self.nacks: list[Any] = []
         self.on_nack: list[Callable[[Any], None]] = []
@@ -48,8 +50,8 @@ class Container:
     # -- load -----------------------------------------------------------------
 
     @classmethod
-    def load(cls, document_service: DocumentService, registry=None
-             ) -> "Container":
+    def load(cls, document_service: DocumentService, registry=None,
+             mode: str = "write") -> "Container":
         """Open an existing document: snapshot + trailing deltas + connect."""
         container = cls(document_service, registry)
         snapshot = document_service.storage.get_latest_snapshot()
@@ -57,9 +59,12 @@ class Container:
             container.protocol = ProtocolOpHandler.load(snapshot["protocol"])
             container._wire_quorum()
             container.runtime.load(snapshot["runtime"])
-            container.last_processed_seq = snapshot["sequence_number"]
+            container.delta_manager.last_processed_seq = \
+                snapshot["sequence_number"]
+            container.delta_manager.last_queued_seq = \
+                snapshot["sequence_number"]
         container.attached = True
-        container.connect()
+        container.connect(mode)
         return container
 
     @classmethod
@@ -94,32 +99,37 @@ class Container:
 
     @property
     def connected(self) -> bool:
-        return self._connection is not None
+        return self.delta_manager.connected
 
-    def connect(self) -> None:
-        assert self._connection is None, "already connected"
-        # Catch up on deltas missed while away BEFORE the live stream starts;
-        # both land in the paused inbound queue in seq order (the reference's
-        # fetchMissingDeltas + early-op queueing, deltaManager.ts:1298-1360).
-        for message in self._service.delta_storage.get_deltas(
-                self.last_processed_seq):
-            self.inbound.push(message)
-        connection = self._service.connect(self._on_incoming,
-                                           on_nack=self._on_nack)
-        self._connection = connection
-        self.client_id = connection.client_id
-        self.client_seq = 0
-        self.inbound.resume()
+    @property
+    def client_id(self) -> str | None:
+        return self.delta_manager.client_id
+
+    @property
+    def last_processed_seq(self) -> int:
+        return self.delta_manager.last_processed_seq
+
+    @property
+    def inbound(self):
+        return self.delta_manager.inbound
+
+    @property
+    def outbound(self):
+        return self.delta_manager.outbound
+
+    def connect(self, mode: str | None = None) -> None:
+        """Connect in the given mode; omitted = keep the container's mode
+        (so reconnect of a read-only container stays read-only)."""
+        if mode is not None:
+            self._mode = mode
+        client_id = self.delta_manager.connect(self._mode)
         for cb in self.on_connected:
-            cb(connection.client_id)
+            cb(client_id)
 
     def disconnect(self) -> None:
-        if self._connection is None:
+        if not self.connected:
             return
-        self._connection.close()
-        self._connection = None
-        self.client_id = None
-        self.inbound.pause()
+        self.delta_manager.disconnect()
         for cb in self.on_disconnected:
             cb()
 
@@ -133,22 +143,11 @@ class Container:
     # -- outbound -------------------------------------------------------------
 
     def allocate_client_seq(self) -> int | None:
-        """Claim the next clientSequenceNumber, or None when disconnected.
-        Callers record pending state against it BEFORE send_message — the
-        ack may arrive re-entrantly during the send (in-proc server)."""
-        if self._connection is None:
-            return None
-        self.client_seq += 1
-        return self.client_seq
+        return self.delta_manager.allocate_client_seq()
 
     def send_message(self, mtype: MessageType, contents: Any,
                      client_seq: int) -> None:
-        self._connection.submit([DocumentMessage(
-            client_sequence_number=client_seq,
-            reference_sequence_number=self.last_processed_seq,
-            type=mtype,
-            contents=contents,
-        )])
+        self.delta_manager.submit(mtype, contents, client_seq)
 
     def submit_message(self, mtype: MessageType, contents: Any) -> int | None:
         """Stamp + send a message with no pending tracking (protocol msgs).
@@ -161,28 +160,26 @@ class Container:
     def propose(self, key: str, value: Any) -> None:
         self.submit_message(MessageType.PROPOSE, {"key": key, "value": value})
 
-    # -- inbound --------------------------------------------------------------
+    def submit_signal(self, content: Any) -> None:
+        """Transient broadcast: never sequenced, never durable (presence,
+        cursors — container.ts submitSignal)."""
+        self.delta_manager.submit_signal(content)
 
-    def _on_incoming(self, messages: list[SequencedDocumentMessage]) -> None:
-        for message in messages:
-            self.inbound.push(message)
+    # -- inbound --------------------------------------------------------------
 
     def _on_nack(self, nack: Any) -> None:
         self.nacks.append(nack)
         for cb in self.on_nack:
             cb(nack)
 
+    def _process_signal(self, signal: Any) -> None:
+        for cb in self.on_signal:
+            cb(signal)
+
     def _process_remote_message(self, message: SequencedDocumentMessage) -> None:
         local = (
             self.client_id is not None and message.client_id == self.client_id
         )
-        if message.sequence_number <= self.last_processed_seq:
-            return  # duplicate during catch-up overlap
-        assert message.sequence_number == self.last_processed_seq + 1, (
-            f"sequence gap: got {message.sequence_number}, "
-            f"expected {self.last_processed_seq + 1}"
-        )
-        self.last_processed_seq = message.sequence_number
         result = self.protocol.process_message(message, local)
         if message.type == MessageType.OPERATION:
             self.runtime.process(message, local)

@@ -1,0 +1,230 @@
+"""DeltaManager — the loader-layer transport engine for one container.
+
+Reference parity: packages/loader/container-loader/src/deltaManager.ts:147
+(inbound/outbound/inboundSignal DeltaQueues :197-199, sequence-gap detection
++ fetchMissingDeltas :1298-1360, connect/disconnect lifecycle :566-692,
+readonly mode) — reshaped for a synchronous in-proc client: queues drain
+eagerly on the pushing thread; pausing is the deterministic-interleaving
+primitive tests use (test-utils OpProcessingController).
+
+Gap handling: the live stream may skip sequence numbers (dropped socket
+messages, reconnect races). Out-of-order arrivals park in ``_parked`` and a
+catch-up read from delta storage fills the hole; duplicates (seq already
+queued) drop silently. ``DataCorruptionError`` fires when the same seq
+arrives twice with different payloads (deltaManager.ts:1336-1346).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..drivers.base import DocumentService
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+)
+from .delta_queue import DeltaQueue
+
+
+class DataCorruptionError(Exception):
+    """Same sequence number delivered twice with different payloads."""
+
+
+class FlushMode:
+    """Outbound batching mode (containerRuntime.ts FlushMode)."""
+
+    IMMEDIATE = "immediate"  # every submit flushes (reference Automatic)
+    MANUAL = "manual"        # accumulate until flush() (orderSequentially)
+
+
+class DeltaManager:
+    """Inbound/outbound op pump between a driver connection and a handler."""
+
+    def __init__(
+        self,
+        service: DocumentService,
+        process_message: Callable[[SequencedDocumentMessage], None],
+        process_signal: Callable[[Any], None] | None = None,
+        on_nack: Callable[[Any], None] | None = None,
+    ) -> None:
+        self._service = service
+        self._connection: Any = None
+        self.client_id: str | None = None
+        self.client_seq = 0
+        self.last_processed_seq = 0   # seq of last message run through handler
+        self.last_queued_seq = 0      # seq of last message accepted inbound
+        self.flush_mode = FlushMode.IMMEDIATE
+        self._parked: dict[int, SequencedDocumentMessage] = {}
+        self._fetching = False
+        self._read_mode = False
+
+        self.inbound: DeltaQueue[SequencedDocumentMessage] = DeltaQueue(
+            self._process_inbound)
+        self.outbound: DeltaQueue[list[DocumentMessage]] = DeltaQueue(
+            self._send_batch)
+        self.inbound_signal: DeltaQueue[Any] = DeltaQueue(
+            process_signal if process_signal is not None else lambda _s: None)
+        self._process_message = process_message
+        self._on_nack_cb = on_nack
+        self._batch: list[DocumentMessage] = []
+
+    # -- connection lifecycle --------------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._connection is not None
+
+    @property
+    def readonly(self) -> bool:
+        return self._read_mode
+
+    def connect(self, mode: str = "write") -> str:
+        """Catch up from delta storage, then go live. Returns the client id.
+
+        Catch-up ops and the live stream both land in the (paused) inbound
+        queue in seq order; overlap dedupes by sequence number.
+        """
+        assert self._connection is None, "already connected"
+        self._read_mode = mode == "read"
+        for message in self._service.delta_storage.get_deltas(
+                self.last_queued_seq):
+            self._accept(message)
+        connection = self._service.connect(
+            self._enqueue_messages,
+            on_nack=self._handle_nack,
+            on_signal=self.inbound_signal.push,
+            mode=mode,
+        )
+        self._connection = connection
+        self.client_id = connection.client_id
+        self.client_seq = 0
+        self.inbound.resume()
+        self.outbound.resume()
+        self.inbound_signal.resume()
+        return connection.client_id
+
+    def disconnect(self) -> None:
+        if self._connection is None:
+            return
+        self._connection.close()
+        self._connection = None
+        self.client_id = None
+        self._batch = []
+        self.outbound.clear()  # stale clientSeqs; pending ops resubmit fresh
+        self.inbound.pause()
+        self.outbound.pause()
+        self.inbound_signal.pause()
+
+    # -- inbound: dedupe, order, gap-fetch -------------------------------------
+
+    def _enqueue_messages(self,
+                          messages: list[SequencedDocumentMessage]) -> None:
+        for message in messages:
+            self._accept(message)
+        if self._parked and not self._fetching:
+            self._fetch_missing()
+
+    def _accept(self, message: SequencedDocumentMessage) -> None:
+        seq = message.sequence_number
+        if seq <= self.last_queued_seq:
+            return  # duplicate from catch-up overlap / rebroadcast
+        if seq == self.last_queued_seq + 1:
+            self.last_queued_seq = seq
+            self.inbound.push(message)
+            # Unpark any directly-following messages.
+            while self.last_queued_seq + 1 in self._parked:
+                nxt = self._parked.pop(self.last_queued_seq + 1)
+                self.last_queued_seq = nxt.sequence_number
+                self.inbound.push(nxt)
+            return
+        # Gap: park and (re)fetch the hole from durable storage.
+        parked = self._parked.get(seq)
+        if parked is not None and parked != message:
+            raise DataCorruptionError(
+                f"two different messages for seq {seq}")
+        self._parked[seq] = message
+
+    def _fetch_missing(self) -> None:
+        """Read the hole [last_queued+1, first_parked) from delta storage
+        (deltaManager.ts fetchMissingDeltas → enqueueMessages)."""
+        self._fetching = True
+        try:
+            while self._parked:
+                first_parked = min(self._parked)
+                if first_parked <= self.last_queued_seq + 1:
+                    # Hole already closed by unparking.
+                    while self.last_queued_seq + 1 in self._parked:
+                        nxt = self._parked.pop(self.last_queued_seq + 1)
+                        self.last_queued_seq = nxt.sequence_number
+                        self.inbound.push(nxt)
+                    # Drop any parked duplicates below the watermark.
+                    for seq in [s for s in self._parked
+                                if s <= self.last_queued_seq]:
+                        del self._parked[seq]
+                    continue
+                fetched = self._service.delta_storage.get_deltas(
+                    self.last_queued_seq, first_parked - 1)
+                progressed = False
+                for message in fetched:
+                    before = self.last_queued_seq
+                    self._accept(message)
+                    progressed |= self.last_queued_seq > before
+                if not progressed:
+                    # Storage doesn't have the hole yet (broadcast raced the
+                    # durable write); leave messages parked — the next
+                    # delivery retries the fetch.
+                    return
+        finally:
+            self._fetching = False
+
+    def _process_inbound(self, message: SequencedDocumentMessage) -> None:
+        if message.sequence_number <= self.last_processed_seq:
+            return
+        assert message.sequence_number == self.last_processed_seq + 1, (
+            f"inbound queue disorder: got {message.sequence_number}, "
+            f"expected {self.last_processed_seq + 1}")
+        self.last_processed_seq = message.sequence_number
+        self._process_message(message)
+
+    def _handle_nack(self, nack: Any) -> None:
+        if self._on_nack_cb is not None:
+            self._on_nack_cb(nack)
+
+    # -- outbound --------------------------------------------------------------
+
+    def allocate_client_seq(self) -> int | None:
+        """Claim the next clientSequenceNumber, or None when disconnected.
+        Callers record pending state against it BEFORE submit — the ack may
+        arrive re-entrantly during the send (in-proc server)."""
+        if self._connection is None or self._read_mode:
+            return None
+        self.client_seq += 1
+        return self.client_seq
+
+    def submit(self, mtype: MessageType, contents: Any,
+               client_seq: int) -> None:
+        assert not self._read_mode, "submit on a read-only connection"
+        message = DocumentMessage(
+            client_sequence_number=client_seq,
+            reference_sequence_number=self.last_processed_seq,
+            type=mtype,
+            contents=contents,
+        )
+        self._batch.append(message)
+        if self.flush_mode == FlushMode.IMMEDIATE:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._batch:
+            return
+        batch, self._batch = self._batch, []
+        self.outbound.push(batch)
+
+    def _send_batch(self, batch: list[DocumentMessage]) -> None:
+        assert self._connection is not None, "outbound drain while disconnected"
+        self._connection.submit(batch)
+
+    def submit_signal(self, content: Any) -> None:
+        assert self._connection is not None, "signal while disconnected"
+        self._connection.signal(content)

@@ -39,6 +39,11 @@ class DeltaQueue(Generic[T]):
         self._pause_count -= 1
         self._drain()
 
+    def clear(self) -> None:
+        """Drop all queued items (outbound teardown on disconnect: pending
+        ops resubmit with fresh clientSeqNumbers, never the stale batches)."""
+        self._queue.clear()
+
     def process_one(self) -> bool:
         """Process a single item regardless of pause state (test stepping)."""
         if not self._queue:

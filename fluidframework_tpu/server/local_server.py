@@ -38,11 +38,17 @@ class _Connection:
     on_nack: Callable[[NackMessage], None] | None = None
     on_signal: Callable[[Any], None] | None = None
     open: bool = True
+    mode: str = "write"
 
     def submit(self, messages: list[DocumentMessage]) -> None:
         assert self.open, "submit on closed connection"
         self.document.server.submit(self.document.doc_id, self.client_id,
                                     messages)
+
+    def signal(self, content: Any) -> None:
+        assert self.open, "signal on closed connection"
+        self.document.server.signal(self.document.doc_id, self.client_id,
+                                    content)
 
     def close(self) -> None:
         if self.open:
@@ -98,20 +104,28 @@ class LocalCollabServer:
         client_id = f"client-{next(self._client_counter)}"
         connection = _Connection(client_id, document, handler, on_nack,
                                  on_signal)
+        connection.mode = mode
         document.connections[client_id] = connection
-        detail = ClientDetail(client_id=client_id, mode=mode, scopes=scopes)
-        self._sequence_raw(document, RawOperation(
-            client_id=None,
-            type=MessageType.CLIENT_JOIN,
-            data=detail,
-            timestamp=next(self._clock),
-            can_summarize=ScopeType.SUMMARY_WRITE in scopes,
-        ))
+        # Read clients receive the broadcast stream but never enter the
+        # quorum or the MSN calculation (the reference sequences joins only
+        # for write connections — a reader must not pin minSeq).
+        if mode != "read":
+            detail = ClientDetail(client_id=client_id, mode=mode,
+                                  scopes=scopes)
+            self._sequence_raw(document, RawOperation(
+                client_id=None,
+                type=MessageType.CLIENT_JOIN,
+                data=detail,
+                timestamp=next(self._clock),
+                can_summarize=ScopeType.SUMMARY_WRITE in scopes,
+            ))
         return connection
 
     def disconnect(self, doc_id: str, client_id: str) -> None:
         document = self._document(doc_id)
-        document.connections.pop(client_id, None)
+        connection = document.connections.pop(client_id, None)
+        if connection is not None and connection.mode == "read":
+            return
         self._sequence_raw(document, RawOperation(
             client_id=None,
             type=MessageType.CLIENT_LEAVE,
