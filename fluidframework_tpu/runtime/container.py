@@ -43,6 +43,10 @@ class Container:
         self.on_connected: list[Callable[[str], None]] = []
         self.on_disconnected: list[Callable[[], None]] = []
         self.on_signal: list[Callable[[Any], None]] = []
+        # Fired after every sequenced message is applied (summary manager,
+        # telemetry, tests). Receives the SequencedDocumentMessage.
+        self.on_op_processed: list[Callable[[SequencedDocumentMessage],
+                                            None]] = []
         # Service rejections of our ops (never silent — tests assert empty).
         self.nacks: list[Any] = []
         self.on_nack: list[Callable[[Any], None]] = []
@@ -183,6 +187,8 @@ class Container:
         result = self.protocol.process_message(message, local)
         if message.type == MessageType.OPERATION:
             self.runtime.process(message, local)
+        for cb in self.on_op_processed:
+            cb(message)
         if result["immediate_noop"] and self.connected:
             # Expedite proposal commit (quorum.ts:326): a contentful noop revs
             # and carries our advanced refSeq to the sequencer.

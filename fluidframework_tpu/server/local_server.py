@@ -64,7 +64,11 @@ class _Document:
     sequencer: DocumentSequencer = field(default_factory=DocumentSequencer)
     log: list[SequencedDocumentMessage] = field(default_factory=list)
     connections: dict[str, _Connection] = field(default_factory=dict)
-    snapshots: list[dict] = field(default_factory=list)
+    snapshots: dict[str, dict] = field(default_factory=dict)
+    # Only ACKED summaries are load-visible (scribe writes the git commit
+    # before emitting summaryAck); the attach-time base upload is implicitly
+    # acked as the document's root.
+    acked_snapshot: str | None = None
     last_broadcast_seq: int = 0
     # Broadcast queue: a client handler may re-entrantly submit (in-proc),
     # sequencing new messages mid-fan-out; they must not overtake the
@@ -162,6 +166,50 @@ class LocalCollabServer:
                 continue
             if ticket.kind == oc.OUT_SEQUENCED:
                 self._emit(document, raw, ticket)
+                if message.type == MessageType.SUMMARIZE:
+                    self._scribe_handle_summary(document, message, ticket)
+
+    def _scribe_handle_summary(self, document: _Document,
+                               message: DocumentMessage,
+                               ticket: Ticket) -> None:
+        """Scribe lambda analog: validate the client summary offer, make it
+        durable/load-visible, and sequence the ack into the op stream
+        (scribe/lambda.ts:190-250 + summaryWriter.writeClientSummary)."""
+        handle = (message.contents or {}).get("handle")
+        proposal = {"summary_proposal": {
+            "summary_sequence_number": ticket.seq}}
+
+        def nack(reason: str) -> None:
+            self._sequence_raw(document, RawOperation(
+                client_id=None,
+                type=MessageType.SUMMARY_NACK,
+                contents={"message": reason, "handle": handle, **proposal},
+                timestamp=next(self._clock),
+            ))
+
+        offered = document.snapshots.get(handle)
+        if offered is None:
+            nack(f"unknown summary handle {handle!r}")
+            return
+        # Ancestry check (scribe validates the proposal against the current
+        # summary head): a stale or replayed offer must not roll the acked
+        # snapshot back to an older sequence number.
+        current = document.snapshots.get(document.acked_snapshot or "")
+        offered_seq = (offered or {}).get("sequence_number")
+        if not isinstance(offered_seq, int):
+            nack("summary content missing sequence_number")
+            return
+        if current is not None and offered_seq < current["sequence_number"]:
+            nack(f"stale summary at seq {offered_seq} < "
+                 f"current {current['sequence_number']}")
+            return
+        document.acked_snapshot = handle
+        self._sequence_raw(document, RawOperation(
+            client_id=None,
+            type=MessageType.SUMMARY_ACK,
+            contents={"handle": handle, **proposal},
+            timestamp=next(self._clock),
+        ))
 
     def signal(self, doc_id: str, client_id: str, content: Any) -> None:
         """Transient broadcast, never sequenced (alfred submitSignal)."""
@@ -217,10 +265,18 @@ class LocalCollabServer:
                 and (to_seq is None or m.sequence_number <= to_seq)]
 
     def upload_snapshot(self, doc_id: str, snapshot: dict) -> str:
+        """Store a summary blob; returns its handle. The first upload of a
+        document is its attach-time base and becomes load-visible at once;
+        later uploads become visible only via a sequenced summarize→ack."""
         document = self._document(doc_id)
-        document.snapshots.append(snapshot)
-        return f"{doc_id}/snapshots/{len(document.snapshots) - 1}"
+        handle = f"{doc_id}/snapshots/{len(document.snapshots)}"
+        document.snapshots[handle] = snapshot
+        if document.acked_snapshot is None:
+            document.acked_snapshot = handle
+        return handle
 
     def get_latest_snapshot(self, doc_id: str) -> dict | None:
-        snapshots = self._document(doc_id).snapshots
-        return snapshots[-1] if snapshots else None
+        document = self._document(doc_id)
+        if document.acked_snapshot is None:
+            return None
+        return document.snapshots[document.acked_snapshot]

@@ -194,7 +194,12 @@ class TestSummaryBlock:
         # does not see it...
         c2 = open_doc(server)
         assert chan(c2).get("checkpoint") is None
-        # ...but a joiner from a later summary does.
-        server.upload_snapshot("doc", c1.summarize())
+        # ...but a joiner from a later ACKED summary does (a bare upload is
+        # not load-visible until the sequenced summarize→ack makes it so).
+        from fluidframework_tpu.runtime.summarizer import (
+            SummaryConfig,
+            SummaryManager,
+        )
+        SummaryManager(c1, SummaryConfig(max_ops=10**6)).summarize_now()
         c3 = open_doc(server)
         assert chan(c3).get("checkpoint") == {"stats": 42}

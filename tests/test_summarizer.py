@@ -1,0 +1,221 @@
+"""Summary subsystem tests: scribe ack flow, oldest-client election,
+ops-count heuristics, load-from-acked-summary, and nack recovery.
+
+Reference parity model: summarizer.ts / summaryManager.ts heuristics +
+scribe/lambda.ts summary write + summaryAck, and the rule that only ACKED
+summaries are load-visible to new clients.
+"""
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.protocol.messages import MessageType, ScopeType
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.runtime.summarizer import SummaryConfig, SummaryManager
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+def make_doc(server, doc_id="doc", scopes=None):
+    service = LocalDocumentService(server, doc_id, scopes=scopes)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("root", SharedMap.channel_type)
+    datastore.create_channel("clicks", SharedCounter.channel_type)
+    container.attach()
+    return container
+
+
+def open_doc(server, doc_id="doc", scopes=None):
+    return Container.load(LocalDocumentService(server, doc_id, scopes=scopes))
+
+
+def root_of(c):
+    return c.runtime.get_datastore("default").get_channel("root")
+
+
+def test_manual_summary_acked_and_load_visible():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    root_of(c1).set("x", 1)
+    handle = sm.summarize_now(reason="test")
+    assert handle is not None
+    # The ack was sequenced and observed; in-flight state cleared.
+    assert sm.pending_handle is None
+    kinds = [e.kind for e in sm.events]
+    assert kinds == ["generated", "acked"]
+    # A fresh client loads from the acked summary, not the attach base.
+    c2 = open_doc(server)
+    assert root_of(c2).get("x") == 1
+    assert c1.summarize() == c2.summarize()
+
+
+def test_unacked_upload_not_load_visible():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    root_of(c1).set("x", 1)
+    # Upload WITHOUT offering it through the sequenced summarize op.
+    c1._service.storage.upload_snapshot(c1.summarize())
+    c2 = open_doc(server)
+    # c2 still converges — via the attach base + trailing deltas.
+    assert root_of(c2).get("x") == 1
+    assert c1.summarize() == c2.summarize()
+
+
+def test_heuristics_trigger_at_max_ops():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=5))
+    m = root_of(c1)
+    for i in range(4):
+        m.set(f"k{i}", i)
+    assert [e.kind for e in sm.events] == []
+    m.set("k4", 4)  # fifth op crosses the threshold
+    assert [e.kind for e in sm.events] == ["generated", "acked"]
+    # Counter reset: no immediate re-summary.
+    m.set("k5", 5)
+    assert len(sm.events) == 2
+
+
+def test_only_oldest_eligible_client_summarizes():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    c2 = open_doc(server)
+    sm1 = SummaryManager(c1, SummaryConfig(max_ops=3))
+    sm2 = SummaryManager(c2, SummaryConfig(max_ops=3))
+    assert sm1.is_elected and not sm2.is_elected
+    for i in range(6):
+        root_of(c2).set(f"k{i}", i)
+    # Six ops at threshold 3 = two complete summary cycles, all by c1.
+    assert [e.kind for e in sm1.events if e.kind == "generated"] == \
+        ["generated", "generated"]
+    assert [e.kind for e in sm2.events if e.kind == "generated"] == []
+    # Both observed the ack and reset their counters identically.
+    assert sm1.ops_since_ack == sm2.ops_since_ack
+
+
+def test_election_falls_over_on_leave():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    c2 = open_doc(server)
+    sm2 = SummaryManager(c2, SummaryConfig(max_ops=3))
+    assert not sm2.is_elected
+    c1.close()
+    root_of(c2).set("after", 1)  # leave processed; c2 now oldest
+    assert sm2.is_elected
+    for i in range(3):
+        root_of(c2).set(f"k{i}", i)
+    assert "generated" in [e.kind for e in sm2.events]
+
+
+def test_clients_without_summary_scope_not_elected():
+    server = LocalCollabServer()
+    scopes = (ScopeType.READ, ScopeType.WRITE)
+    c1 = make_doc(server, scopes=scopes)  # oldest but ineligible
+    c2 = open_doc(server)                 # full scopes
+    sm1 = SummaryManager(c1, SummaryConfig(max_ops=3))
+    sm2 = SummaryManager(c2, SummaryConfig(max_ops=3))
+    assert not sm1.is_elected
+    assert sm2.is_elected
+    for i in range(4):
+        root_of(c1).set(f"k{i}", i)
+    assert [e.kind for e in sm1.events if e.kind == "generated"] == []
+    assert "generated" in [e.kind for e in sm2.events]
+
+
+def test_bad_handle_nacked_then_retries():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=4))
+    # Forge an offer with a bogus handle (simulates a lost upload).
+    c1.submit_message(MessageType.SUMMARIZE,
+                      {"handle": "no/such/handle", "head": 0})
+    assert [e.kind for e in sm.events] == ["nacked"]
+    # Heuristics recover: the next threshold crossing summarizes for real.
+    m = root_of(c1)
+    for i in range(6):
+        m.set(f"k{i}", i)
+    assert [e.kind for e in sm.events][-2:] == ["generated", "acked"]
+    c2 = open_doc(server)
+    assert c1.summarize() == c2.summarize()
+
+
+def test_stale_summary_offer_cannot_roll_back():
+    # Re-offering an OLD handle must be nacked, not roll acked state back.
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    m = root_of(c1)
+    m.set("a", 1)
+    old_handle = sm.summarize_now()
+    m.set("b", 2)
+    new_handle = sm.summarize_now()
+    assert server.get_latest_snapshot("doc")["sequence_number"] == \
+        server._documents["doc"].snapshots[new_handle]["sequence_number"]
+    c1.submit_message(MessageType.SUMMARIZE, {"handle": old_handle})
+    assert sm.events[-1].kind == "nacked"
+    # Latest acked snapshot unchanged; a joiner loads the NEW one.
+    c2 = open_doc(server)
+    assert root_of(c2).get("b") == 2
+    assert c1.summarize() == c2.summarize()
+
+
+def test_peer_nack_does_not_cancel_own_offer():
+    # A peer's rejected offer must not clear the elected client's in-flight
+    # tracking: correlated by handle.
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    sm.pending_handle = "in/flight"  # simulate an offer awaiting its ack
+    sm.pending_since_seq = c1.last_processed_seq
+    c1.submit_message(MessageType.SUMMARIZE, {"handle": "bogus"})
+    assert sm.events[-1].kind == "nacked"
+    assert sm.pending_handle == "in/flight"  # untouched
+
+
+def test_no_summary_while_local_ops_pending():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=2))
+    c1.disconnect()
+    m = root_of(c1)
+    m.set("offline", 1)  # optimistic, unacked
+    assert c1.runtime.pending.has_pending
+    assert sm.summarize_now() is None
+    c1.connect()
+    c1.runtime.replay_pending()
+    assert not c1.runtime.pending.has_pending
+    # Clean state summarizes fine.
+    assert sm.summarize_now() is not None
+    c2 = open_doc(server)
+    assert c1.summarize() == c2.summarize()
+
+
+def test_ack_wait_timeout_unsticks_summaries():
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=3, max_ack_wait_ops=5))
+    sm.pending_handle = "lost/offer"  # its ack will never arrive
+    sm.pending_since_seq = c1.last_processed_seq
+    m = root_of(c1)
+    for i in range(12):
+        m.set(f"k{i}", i)
+    # After the wait expired, heuristics resumed and a real summary landed.
+    assert "acked" in [e.kind for e in sm.events]
+
+
+def test_summary_compacts_catchup_reads():
+    # After an acked summary at seq N, a fresh client needs only deltas > N.
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    m = root_of(c1)
+    for i in range(20):
+        m.set(f"k{i}", i)
+    sm.summarize_now()
+    snap = server.get_latest_snapshot("doc")
+    trailing = server.get_deltas("doc", snap["sequence_number"])
+    # Only the summarize + ack trail the snapshot.
+    assert len(trailing) <= 2
+    c2 = open_doc(server)
+    assert c1.summarize() == c2.summarize()
