@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Any
 
 from ..protocol.messages import SequencedDocumentMessage
+from ..runtime.handles import decode_value, encode_value
 from .shared_object import ChannelFactory, SharedObject
 
 _EMPTY = object()
@@ -26,6 +27,7 @@ class SharedCell(SharedObject):
     # -- public API -----------------------------------------------------------
 
     def set(self, value: Any) -> None:
+        value = encode_value(value)
         self._value = value
         self.submit_local_message({"type": "setCell", "value": value},
                                   self._pend())
@@ -35,7 +37,8 @@ class SharedCell(SharedObject):
         self.submit_local_message({"type": "deleteCell"}, self._pend())
 
     def get(self) -> Any:
-        return None if self._value is _EMPTY else self._value
+        return None if self._value is _EMPTY else \
+            decode_value(self._value, self._handle_resolver())
 
     @property
     def empty(self) -> bool:

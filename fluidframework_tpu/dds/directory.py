@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 from ..protocol.messages import SequencedDocumentMessage
+from ..runtime.handles import decode_value, encode_value
 from .map_data import MapData
 from .shared_object import ChannelFactory, SharedObject
 
@@ -30,12 +31,14 @@ class SubDirectory:
     # -- keys -----------------------------------------------------------------
 
     def set(self, key: str, value: Any) -> "SubDirectory":
-        self._owner._submit_key_op(self.path, "set", key, value)
+        self._owner._submit_key_op(self.path, "set", key, encode_value(value))
         return self
 
     def get(self, key: str, default: Any = None) -> Any:
         data = self._owner._dirs.get(self.path)
-        return data.get(key, default) if data else default
+        if data is None or not data.has(key):
+            return default  # caller's default returned untouched
+        return decode_value(data.get(key), self._owner._handle_resolver())
 
     def has(self, key: str) -> bool:
         data = self._owner._dirs.get(self.path)
@@ -53,7 +56,10 @@ class SubDirectory:
 
     def items(self):
         data = self._owner._dirs.get(self.path)
-        return iter(data.items()) if data else iter(())
+        if data is None:
+            return iter(())
+        resolver = self._owner._handle_resolver()
+        return ((k, decode_value(v, resolver)) for k, v in data.items())
 
     # -- subdirectories --------------------------------------------------------
 
@@ -98,6 +104,18 @@ class SharedDirectory(SharedObject):
 
     def get(self, key: str, default: Any = None) -> Any:
         return self.root.get(key, default)
+
+    def has(self, key: str) -> bool:
+        return self.root.has(key)
+
+    def delete(self, key: str) -> None:
+        self.root.delete(key)
+
+    def items(self):
+        return self.root.items()
+
+    def keys(self):
+        return self.root.keys()
 
     def create_sub_directory(self, name: str) -> SubDirectory:
         return self.root.create_sub_directory(name)

@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Any
 
 from ..protocol.messages import SequencedDocumentMessage
+from ..runtime.handles import decode_value, encode_value
 from .map_data import MapData
 from .shared_object import ChannelFactory, SharedObject
 
@@ -23,12 +24,14 @@ class SharedMap(SharedObject):
     # -- public API (map.ts set/get/delete/clear) ----------------------------
 
     def set(self, key: str, value: Any) -> "SharedMap":
-        op, metadata = self.data.local_set(key, value)
+        op, metadata = self.data.local_set(key, encode_value(value))
         self.submit_local_message(op, metadata)
         return self
 
     def get(self, key: str, default: Any = None) -> Any:
-        return self.data.get(key, default)
+        if not self.data.has(key):
+            return default  # caller's default returned untouched
+        return decode_value(self.data.get(key), self._handle_resolver())
 
     def has(self, key: str) -> bool:
         return self.data.has(key)
@@ -45,7 +48,8 @@ class SharedMap(SharedObject):
         return self.data.keys()
 
     def items(self):
-        return self.data.items()
+        resolver = self._handle_resolver()
+        return ((k, decode_value(v, resolver)) for k, v in self.data.items())
 
     def __len__(self) -> int:
         return len(self.data)

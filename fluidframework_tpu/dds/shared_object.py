@@ -47,6 +47,25 @@ class SharedObject:
     def is_attached(self) -> bool:
         return self._connection is not None
 
+    @property
+    def handle(self):
+        """A serializable FluidHandle to this channel (handle.ts)."""
+        from ..runtime.handles import FluidHandle
+        assert self.runtime is not None, "detached channel has no handle"
+        return FluidHandle(f"/{self.runtime.id}/{self.id}",
+                           self.runtime.resolve_path)
+
+    def _handle_resolver(self):
+        """Path resolver for decoding stored handles (None when hosted
+        outside a data store, e.g. direct unit tests)."""
+        return None if self.runtime is None else self.runtime.resolve_path
+
+    def get_gc_data(self) -> list[str]:
+        """Outbound GC routes = handles stored in this channel's state
+        (runtime-utils scans serialized summary content the same way)."""
+        from ..runtime.handles import collect_handle_routes
+        return collect_handle_routes(self.summarize_core())
+
     def bind_connection(self, connection: Any) -> None:
         """Called by the data store when the channel becomes live."""
         self._connection = connection

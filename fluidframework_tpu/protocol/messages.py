@@ -40,6 +40,7 @@ class MessageType(IntEnum):
     NO_CLIENT = 11    # service: no active clients remain
     ROUND_TRIP = 12   # latency probe
     CONTROL = 13      # service-internal control; never sequenced
+    ATTACH = 14       # a data store created post-attach (carries snapshot)
 
 
 class ScopeType:

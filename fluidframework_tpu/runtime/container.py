@@ -187,6 +187,8 @@ class Container:
         result = self.protocol.process_message(message, local)
         if message.type == MessageType.OPERATION:
             self.runtime.process(message, local)
+        elif message.type == MessageType.ATTACH:
+            self.runtime.process_attach(message, local)
         for cb in self.on_op_processed:
             cb(message)
         if result["immediate_noop"] and self.connected:

@@ -26,19 +26,53 @@ class ContainerRuntime:
         self.container = container
         self.registry = registry if registry is not None else default_registry()
         self.datastores: dict[str, DataStoreRuntime] = {}
+        # Root (aliased) data stores: GC-reachable from "/" even with no
+        # stored handle to them (containerRuntime.ts createRootDataStore).
+        self.root_datastores: set[str] = set()
         self.pending = PendingStateManager()
 
     # -- data store lifecycle -------------------------------------------------
 
-    def create_datastore(self, datastore_id: str) -> DataStoreRuntime:
+    def create_datastore(self, datastore_id: str,
+                         root: bool = True) -> DataStoreRuntime:
         if datastore_id in self.datastores:
             raise ValueError(f"datastore {datastore_id!r} already exists")
         datastore = DataStoreRuntime(datastore_id, self, self.registry)
         self.datastores[datastore_id] = datastore
+        if root:
+            self.root_datastores.add(datastore_id)
+        if self.container.attached:
+            # Announce to peers (containerRuntime.ts attach message): the
+            # snapshot ships the store's channels as of submit time; later
+            # channel/DDS ops are sequenced after this and replay on top.
+            self._submit_attach(datastore)
         return datastore
 
     def get_datastore(self, datastore_id: str) -> DataStoreRuntime:
         return self.datastores[datastore_id]
+
+    def resolve_path(self, absolute_path: str):
+        """Resolve a handle path: ``/ds`` → DataStoreRuntime,
+        ``/ds/channel`` → SharedObject."""
+        parts = absolute_path.strip("/").split("/")
+        datastore = self.datastores[parts[0]]
+        return datastore if len(parts) == 1 else \
+            datastore.get_channel(parts[1])
+
+    # -- garbage collection ---------------------------------------------------
+
+    def run_gc(self, datastore_summaries: dict | None = None):
+        """Mark-phase GC over stored handle routes (garbageCollector.ts):
+        roots = every root data store. Pass already-serialized datastore
+        summaries to avoid re-serializing channel state for the graph."""
+        from .garbage_collector import run_garbage_collection
+        graph: dict[str, list[str]] = {}
+        for ds_id, datastore in self.datastores.items():
+            summary = None if datastore_summaries is None else \
+                datastore_summaries[ds_id]
+            graph.update(datastore.get_gc_data(summary))
+        roots = [f"/{ds_id}" for ds_id in sorted(self.root_datastores)]
+        return run_garbage_collection(graph, roots)
 
     # -- outbound -------------------------------------------------------------
 
@@ -55,6 +89,40 @@ class ContainerRuntime:
         if client_seq is not None:
             self.container.send_message(
                 MessageType.OPERATION, envelope, client_seq)
+
+    def _submit_attach(self, datastore: DataStoreRuntime) -> None:
+        contents = {
+            "id": datastore.id,
+            "root": datastore.id in self.root_datastores,
+            "snapshot": datastore.summarize(),
+        }
+        client_seq = self.container.allocate_client_seq()
+        # Tracked pending like any op so a disconnected create replays on
+        # reconnect; the replay marker is the "attach" type key.
+        self.pending.on_submit(
+            client_seq, {"type": "attach", **contents}, None)
+        if client_seq is not None:
+            self.container.send_message(
+                MessageType.ATTACH, contents, client_seq)
+
+    def process_attach(self, message: SequencedDocumentMessage,
+                       local: bool) -> None:
+        if local:
+            self.pending.process_own_message(message.client_sequence_number)
+            return
+        contents = message.contents
+        if contents["id"] in self.datastores:
+            # Concurrent create: first sequenced attach wins the state, but
+            # the root flag is the OR of all creates (commutative, so every
+            # replica converges regardless of arrival order).
+            if contents["root"]:
+                self.root_datastores.add(contents["id"])
+            return
+        datastore = DataStoreRuntime(contents["id"], self, self.registry)
+        self.datastores[contents["id"]] = datastore
+        datastore.load(contents["snapshot"])
+        if contents["root"]:
+            self.root_datastores.add(contents["id"])
 
     # -- inbound --------------------------------------------------------------
 
@@ -79,6 +147,11 @@ class ContainerRuntime:
         regenerate/restamp (containerRuntime.ts replayPendingStates)."""
         for item in self.pending.drain_for_replay():
             envelope = item.contents
+            if envelope.get("type") == "attach":
+                # Re-announce with the store's CURRENT snapshot (any channel
+                # ops still pending follow it in the replay order).
+                self._submit_attach(self.datastores[envelope["id"]])
+                continue
             datastore = self.datastores[envelope["address"]]
             datastore.resubmit(envelope["contents"], item.local_op_metadata)
 
@@ -90,11 +163,17 @@ class ContainerRuntime:
     # -- summary --------------------------------------------------------------
 
     def summarize(self) -> dict:
+        datastores = {
+            datastore_id: datastore.summarize()
+            for datastore_id, datastore in sorted(self.datastores.items())
+        }
+        gc = self.run_gc(datastores)
         return {
-            "datastores": {
-                datastore_id: datastore.summarize()
-                for datastore_id, datastore in sorted(self.datastores.items())
-            }
+            "datastores": datastores,
+            "roots": sorted(self.root_datastores),
+            # GC state rides the summary (containerRuntime.ts:1383-1430);
+            # unreferenced nodes are reported, not yet swept.
+            "gc": {"unreferenced": gc.deleted},
         }
 
     def load(self, snapshot: dict) -> None:
@@ -102,3 +181,5 @@ class ContainerRuntime:
             datastore = DataStoreRuntime(datastore_id, self, self.registry)
             self.datastores[datastore_id] = datastore
             datastore.load(datastore_snapshot)
+        self.root_datastores = set(
+            snapshot.get("roots", snapshot["datastores"].keys()))

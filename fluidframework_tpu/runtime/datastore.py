@@ -38,6 +38,32 @@ class DataStoreRuntime:
         self.registry = registry
         self.channels: dict[str, SharedObject] = {}
 
+    @property
+    def handle(self):
+        """A serializable FluidHandle to this data store."""
+        from .handles import FluidHandle
+        return FluidHandle(f"/{self.id}", self.resolve_path)
+
+    def resolve_path(self, absolute_path: str):
+        """Resolve ``/ds`` or ``/ds/channel`` via the container runtime."""
+        return self.parent.resolve_path(absolute_path)
+
+    def get_gc_data(self, summary: dict | None = None) -> dict[str, list[str]]:
+        """GC graph fragment: this store's node (implicit edges to its
+        channels) + each channel's node (its stored handle routes). Pass an
+        already-built ``summarize()`` result to scan it instead of
+        re-serializing channel state."""
+        from .handles import collect_handle_routes
+        graph = {f"/{self.id}": [f"/{self.id}/{cid}" for cid in self.channels]}
+        for channel_id, channel in self.channels.items():
+            if summary is not None:
+                routes = collect_handle_routes(
+                    summary["channels"][channel_id]["content"])
+            else:
+                routes = channel.get_gc_data()
+            graph[f"/{self.id}/{channel_id}"] = routes
+        return graph
+
     # -- channel lifecycle ----------------------------------------------------
 
     def create_channel(self, channel_id: str, channel_type: str) -> SharedObject:
@@ -45,6 +71,15 @@ class DataStoreRuntime:
             raise ValueError(f"channel {channel_id!r} already exists")
         channel = self.registry.get(channel_type).create(self, channel_id)
         self._bind(channel)
+        if self.parent.container.attached:
+            # Announce the new channel to peers (dataStoreRuntime.ts:405
+            # bindChannel → attach op carrying the channel snapshot).
+            self.parent.submit_datastore_op(
+                self.id,
+                {"type": "attach_channel", "address": channel_id,
+                 "snapshot": channel.summarize()},
+                None,
+            )
         return channel
 
     def get_channel(self, channel_id: str) -> SharedObject:
@@ -67,6 +102,14 @@ class DataStoreRuntime:
     def process(self, message: SequencedDocumentMessage, local: bool,
                 local_op_metadata: Any) -> None:
         envelope = message.contents
+        if envelope.get("type") == "attach_channel":
+            if not local and envelope["address"] not in self.channels:
+                snapshot = envelope["snapshot"]
+                channel = self.registry.get(
+                    snapshot["attributes"]["type"]).load(
+                        self, envelope["address"], snapshot)
+                self._bind(channel)
+            return
         channel = self.channels[envelope["address"]]
         channel.process(
             replace(message, contents=envelope["contents"]),
@@ -75,6 +118,16 @@ class DataStoreRuntime:
         )
 
     def resubmit(self, envelope: dict, local_op_metadata: Any) -> None:
+        if envelope.get("type") == "attach_channel":
+            # Re-announce with the channel's current snapshot.
+            channel = self.channels[envelope["address"]]
+            self.parent.submit_datastore_op(
+                self.id,
+                {"type": "attach_channel", "address": envelope["address"],
+                 "snapshot": channel.summarize()},
+                None,
+            )
+            return
         channel = self.channels[envelope["address"]]
         channel.resubmit(envelope["contents"], local_op_metadata)
 

@@ -1,0 +1,131 @@
+"""Handles + reference-graph GC tests.
+
+Reference parity model: packages/runtime/garbage-collector tests
+(mark reachable from root over handle routes) and handle round-tripping
+through SharedMap/SharedDirectory values.
+"""
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.runtime.garbage_collector import (
+    run_garbage_collection,
+)
+from fluidframework_tpu.runtime.handles import (
+    FluidHandle,
+    collect_handle_routes,
+    encode_value,
+)
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+class TestGraph:
+    def test_mark_from_root(self):
+        graph = {
+            "/a": ["/a/ch"],
+            "/a/ch": ["/b"],
+            "/b": ["/b/ch"],
+            "/b/ch": [],
+            "/c": ["/c/ch"],
+            "/c/ch": [],
+        }
+        result = run_garbage_collection(graph, ["/a"])
+        assert result.referenced == ["/a", "/a/ch", "/b", "/b/ch"]
+        assert result.deleted == ["/c", "/c/ch"]
+
+    def test_channel_route_keeps_parent_store_alive(self):
+        graph = {"/a": ["/a/ch"], "/a/ch": ["/b/ch"],
+                 "/b": ["/b/ch"], "/b/ch": []}
+        result = run_garbage_collection(graph, ["/a"])
+        assert "/b" in result.referenced
+
+    def test_cycle_not_reachable_from_root_is_deleted(self):
+        graph = {"/a": [], "/b": ["/c"], "/c": ["/b"]}
+        result = run_garbage_collection(graph, ["/a"])
+        assert result.deleted == ["/b", "/c"]
+
+
+class TestHandleEncoding:
+    def test_encode_and_collect_nested(self):
+        value = {"x": [1, {"h": FluidHandle("/ds/chan")}],
+                 "y": FluidHandle("/other")}
+        encoded = encode_value(value)
+        assert sorted(collect_handle_routes(encoded)) == ["/ds/chan", "/other"]
+
+
+def _make(server, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    ds = container.runtime.create_datastore("default")
+    ds.create_channel("root", SharedMap.channel_type)
+    container.attach()
+    return container
+
+
+class TestLiveHandles:
+    def test_handle_roundtrip_across_clients(self):
+        server = LocalCollabServer()
+        c1 = _make(server)
+        ds1 = c1.runtime.get_datastore("default")
+        counter = ds1.create_channel("clicks", SharedCounter.channel_type)
+        root1 = ds1.get_channel("root")
+        root1.set("counter", counter.handle)
+        counter.increment(5)
+
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        root2 = c2.runtime.get_datastore("default").get_channel("root")
+        handle = root2.get("counter")
+        assert isinstance(handle, FluidHandle)
+        assert handle.absolute_path == "/default/clicks"
+        assert handle.get().value == 5
+
+    def test_gc_reports_unreferenced_datastore(self):
+        server = LocalCollabServer()
+        c1 = _make(server)
+        # Non-root store with no handle to it anywhere → unreferenced.
+        orphan = c1.runtime.create_datastore("orphan", root=False)
+        orphan.create_channel("data", SharedMap.channel_type)
+        result = c1.runtime.run_gc()
+        assert "/orphan" in result.deleted
+        assert "/orphan/data" in result.deleted
+        assert "/default" in result.referenced
+
+        # Storing a handle to it flips it to referenced.
+        root = c1.runtime.get_datastore("default").get_channel("root")
+        root.set("link", orphan.handle)
+        result = c1.runtime.run_gc()
+        assert "/orphan" in result.referenced
+        assert "/orphan/data" in result.referenced
+
+    def test_live_datastore_and_channel_attach_propagate(self):
+        """Stores/channels created AFTER attach reach already-open peers
+        via ATTACH ops (containerRuntime.ts attach message path)."""
+        server = LocalCollabServer()
+        c1 = _make(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+
+        ds = c1.runtime.create_datastore("extra", root=False)
+        chan = ds.create_channel("notes", SharedMap.channel_type)
+        chan.set("k", 1)
+
+        ds2 = c2.runtime.get_datastore("extra")
+        assert ds2.get_channel("notes").get("k") == 1
+        # And the reverse direction, onto an existing store.
+        c2.runtime.get_datastore("default").create_channel(
+            "late", SharedCounter.channel_type).increment(3)
+        assert c1.runtime.get_datastore("default").get_channel(
+            "late").value == 3
+        assert c1.summarize() == c2.summarize()
+
+    def test_gc_state_in_summary_and_roots_persist(self):
+        server = LocalCollabServer()
+        c1 = _make(server)
+        c1.runtime.create_datastore("orphan", root=False)
+        summary = c1.summarize()
+        assert summary["runtime"]["gc"]["unreferenced"] == ["/orphan"]
+        assert summary["runtime"]["roots"] == ["default"]
+
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        assert c2.runtime.root_datastores == {"default"}
+        assert c1.summarize() == c2.summarize()
