@@ -29,8 +29,10 @@ class MapData:
         self._pending_keys: dict[str, int] = {}
         self._pending_clear_id: int = -1
         self._next_message_id: int = 0
-        # (key, local, previous_value) change hooks, fired on every applied op.
-        self.on_value_changed: list[Callable[[str, bool, Any], None]] = []
+        # (key, local, previous_value, key_existed) change hooks, fired on
+        # every applied op; key_existed disambiguates a stored None.
+        self.on_value_changed: list[Callable[[str, bool, Any, bool],
+                                             None]] = []
         self.on_clear: list[Callable[[bool], None]] = []
 
     # -- reads ---------------------------------------------------------------
@@ -139,17 +141,18 @@ class MapData:
     # -- core mutators --------------------------------------------------------
 
     def _set_core(self, key: str, value: Any, local: bool) -> None:
+        existed = key in self._data
         previous = self._data.get(key)
         self._data[key] = value
         for cb in self.on_value_changed:
-            cb(key, local, previous)
+            cb(key, local, previous, existed)
 
     def _delete_core(self, key: str, local: bool) -> bool:
         if key not in self._data:
             return False
         previous = self._data.pop(key)
         for cb in self.on_value_changed:
-            cb(key, local, previous)
+            cb(key, local, previous, True)
         return True
 
     def _clear_core(self, local: bool) -> None:

@@ -46,7 +46,7 @@ class Marker:
     id: str | None = None
 
 
-@dataclass(slots=True)
+@dataclass(slots=True, eq=False)  # identity eq: groups↔segments is cyclic
 class Segment:
     content: str | tuple | Marker  # text, handle run, or marker
     seq: int                      # UNASSIGNED while pending
@@ -97,7 +97,7 @@ class Segment:
         return tail
 
 
-@dataclass(slots=True)
+@dataclass(slots=True, eq=False)  # identity eq: groups↔segments is cyclic
 class SegmentGroup:
     """One submitted-but-unacked local op and the segments it touched."""
 
@@ -105,6 +105,27 @@ class SegmentGroup:
     segments: list[Segment]
     local_seq: int
     props_keys: tuple[str, ...] = ()
+
+
+class TrackingGroup:
+    """Follows a set of segments across splits (the reference merge-tree's
+    TrackingGroup, used by undo-redo): membership rides ``Segment.groups``
+    so ``clone_tail`` adds split tails automatically, and zamboni keeps
+    tracked segments alive until :meth:`unlink_all`."""
+
+    def __init__(self) -> None:
+        self.segments: list[Segment] = []
+
+    def link(self, seg: Segment) -> None:
+        seg.groups.append(self)
+        self.segments.append(seg)
+
+    def unlink_all(self) -> None:
+        """Release every segment (re-enabling compaction)."""
+        for seg in self.segments:
+            if self in seg.groups:  # normalize_detached may have cleared it
+                seg.groups.remove(self)
+        self.segments.clear()
 
 
 class MergeEngine:

@@ -25,6 +25,10 @@ class SharedString(SharedObject):
         # bind it lazily at first submit/process via the container.
         self.engine = MergeEngine(local_client=None)
         self._interval_collections: dict[str, "IntervalCollection"] = {}
+        # Local-edit notifications (undo-redo, attribution): fired after a
+        # local public-API edit submits, with enough info to invert it
+        # (the reference's sequenceDelta event on local ops).
+        self.on_local_edit: list = []
 
     # -- identity ------------------------------------------------------------
 
@@ -42,7 +46,11 @@ class SharedString(SharedObject):
                     props: dict | None = None) -> None:
         self._bind_client()
         op = self.engine.insert_local(pos, text, props)
-        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+        group = self.engine.pending_groups[-1]
+        self.submit_local_message(op, group.local_seq)
+        for cb in self.on_local_edit:
+            cb({"kind": "insert", "pos": pos, "length": len(text),
+                "segments": list(group.segments)})
 
     def insert_marker(self, pos: int, ref_type: str = "simple",
                       marker_id: str | None = None,
@@ -50,12 +58,29 @@ class SharedString(SharedObject):
         self._bind_client()
         op = self.engine.insert_local(
             pos, Marker(ref_type=ref_type, id=marker_id), props)
-        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+        group = self.engine.pending_groups[-1]
+        self.submit_local_message(op, group.local_seq)
+        for cb in self.on_local_edit:
+            cb({"kind": "insert", "pos": pos, "length": 1,
+                "segments": list(group.segments)})
 
     def remove_text(self, start: int, end: int) -> None:
         self._bind_client()
         op = self.engine.remove_local(start, end)
-        self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+        group = self.engine.pending_groups[-1]
+        self.submit_local_message(op, group.local_seq)
+        if self.on_local_edit:
+            # The removed content comes from the segments this local remove
+            # actually hit (positions in get_text() would miscount markers).
+            items = [
+                {"marker": {"ref_type": seg.content.ref_type,
+                            "id": seg.content.id}}
+                if seg.is_marker else {"text": seg.content}
+                for seg in group.segments
+            ]
+            for cb in self.on_local_edit:
+                cb({"kind": "remove", "start": start, "items": items,
+                    "segments": list(group.segments)})
 
     def annotate_range(self, start: int, end: int, props: dict) -> None:
         self._bind_client()

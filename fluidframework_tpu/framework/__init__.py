@@ -1,0 +1,22 @@
+"""App-facing framework layer (the aqueduct equivalent).
+
+Reference parity: packages/framework/aqueduct — ``DataObject``,
+``PureDataObject``, ``DataObjectFactory``,
+``ContainerRuntimeFactoryWithDefaultDataStore`` — plus the simplified
+one-call client of experimental/framework/fluid-static.
+"""
+
+from .data_object import DataObject, PureDataObject
+from .data_object_factory import DataObjectFactory
+from .runtime_factory import ContainerRuntimeFactoryWithDefaultDataStore
+from .fluid_static import FluidContainer, create_container, get_container
+
+__all__ = [
+    "DataObject",
+    "PureDataObject",
+    "DataObjectFactory",
+    "ContainerRuntimeFactoryWithDefaultDataStore",
+    "FluidContainer",
+    "create_container",
+    "get_container",
+]

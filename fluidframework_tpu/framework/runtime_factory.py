@@ -1,0 +1,67 @@
+"""ContainerRuntimeFactoryWithDefaultDataStore.
+
+Reference parity: packages/framework/aqueduct/src/container-runtime-
+factories/containerRuntimeFactoryWithDefaultDataStore.ts:25 — assembles a
+container whose "/" resolves to a default data object, with a registry of
+data-object factories for any further objects created at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..drivers.base import DocumentService
+from ..runtime.container import Container
+from .data_object_factory import DataObjectFactory
+from .data_object import PureDataObject
+
+
+class ContainerRuntimeFactoryWithDefaultDataStore:
+    DEFAULT_ID = "default"
+
+    def __init__(self, default_factory: DataObjectFactory,
+                 registry_entries: list[DataObjectFactory] | None = None
+                 ) -> None:
+        self.default_factory = default_factory
+        self.registry: dict[str, DataObjectFactory] = {
+            f.type: f for f in (registry_entries or [])}
+        self.registry.setdefault(default_factory.type, default_factory)
+
+    # -- document lifecycle ---------------------------------------------------
+
+    def create_document(self, service: DocumentService,
+                        props: Any = None) -> tuple[Container, PureDataObject]:
+        """New detached document with the default object at "/default";
+        caller attaches when ready (container.ts detached lifecycle)."""
+        container = Container.create_detached(service)
+        obj = self.default_factory.create(
+            container.runtime, self.DEFAULT_ID, root=True, props=props)
+        return container, obj
+
+    def load_document(self, service: DocumentService
+                      ) -> tuple[Container, PureDataObject]:
+        container = Container.load(service)
+        return container, self.get_default_object(container)
+
+    # -- request routing ("/" → default object) -------------------------------
+
+    def get_default_object(self, container: Container) -> PureDataObject:
+        return self.get_object(container, self.DEFAULT_ID)
+
+    def get_object(self, container: Container,
+                   datastore_id: str) -> PureDataObject:
+        """Resolve a data store id to its typed DataObject via the factory
+        registry (request-handler equivalent)."""
+        datastore = container.runtime.get_datastore(datastore_id)
+        object_type = datastore.attributes.get("type")
+        if object_type not in self.registry:
+            raise KeyError(
+                f"no data object factory registered for {object_type!r}")
+        return self.registry[object_type].get(datastore)
+
+    def create_object(self, container: Container, factory_type: str,
+                      props: Any = None) -> PureDataObject:
+        """Create a further (non-root) data object at runtime; store its
+        handle somewhere reachable or GC will report it unreferenced."""
+        return self.registry[factory_type].create(
+            container.runtime, props=props)

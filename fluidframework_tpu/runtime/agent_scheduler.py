@@ -1,0 +1,122 @@
+"""AgentScheduler — distributed task leasing + leader election.
+
+Reference parity: packages/runtime/agent-scheduler/src/scheduler.ts — tasks
+are claimed by writing the claimant's clientId into a
+ConsensusRegisterCollection register (linearizable at sequencing, so the
+first sequenced claim wins); when the claimant leaves the quorum, interested
+clients volunteer again. Leader election = picking the well-known "leader"
+task (scheduler.ts leadership helper).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..dds.register_collection import ConsensusRegisterCollection
+from .container import Container
+
+UNCLAIMED = None
+LEADER_TASK = "leader"
+
+
+class AgentScheduler:
+    DATASTORE_ID = "_agent_scheduler"
+    CHANNEL_ID = "tasks"
+
+    def __init__(self, container: Container,
+                 channel: ConsensusRegisterCollection) -> None:
+        self.container = container
+        self._tasks = channel
+        # task id → callback to run when (re)claimed by this client.
+        self._interested: dict[str, Callable[[], None] | None] = {}
+        self._held: set[str] = set()
+        self._in_flight: set[str] = set()  # volunteer writes not yet decided
+        self._tasks.on_op.append(lambda _msg, _local: self._evaluate())
+        container.protocol.quorum.on_remove_member.append(
+            self._on_member_removed)
+
+    # -- wiring ---------------------------------------------------------------
+
+    @classmethod
+    def get(cls, container: Container) -> "AgentScheduler":
+        """Create-or-open the scheduler's hidden data store (the reference
+        mounts it at the well-known "_scheduler" route)."""
+        try:
+            datastore = container.runtime.get_datastore(cls.DATASTORE_ID)
+        except KeyError:
+            datastore = container.runtime.create_datastore(cls.DATASTORE_ID)
+            datastore.create_channel(
+                cls.CHANNEL_ID, ConsensusRegisterCollection.channel_type)
+        return cls(container, datastore.get_channel(cls.CHANNEL_ID))
+
+    # -- task API (scheduler.ts pick/release/pickedTasks) ---------------------
+
+    def pick(self, task_id: str,
+             callback: Callable[[], None] | None = None) -> None:
+        """Register interest: claim the task if unclaimed, and re-volunteer
+        whenever the current claimant leaves."""
+        self._interested[task_id] = callback
+        if self.claimant(task_id) is UNCLAIMED:
+            self._volunteer(task_id)
+        else:
+            self._evaluate()
+
+    def release(self, task_id: str) -> None:
+        """Give the task up (only valid while holding it)."""
+        assert task_id in self._held, f"not holding {task_id!r}"
+        self._interested.pop(task_id, None)
+        self._held.discard(task_id)
+        self._tasks.write(task_id, UNCLAIMED)
+
+    def claimant(self, task_id: str) -> str | None:
+        """Current consensus holder (atomic read = first sequenced claim)."""
+        return self._tasks.read(task_id, ConsensusRegisterCollection.ATOMIC)
+
+    def picked_tasks(self) -> list[str]:
+        return sorted(self._held)
+
+    # -- leadership ------------------------------------------------------------
+
+    def volunteer_for_leadership(
+            self, on_elected: Callable[[], None] | None = None) -> None:
+        self.pick(LEADER_TASK, on_elected)
+
+    @property
+    def leader(self) -> str | None:
+        return self.claimant(LEADER_TASK)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.leader == self.container.client_id
+
+    # -- claim machinery -------------------------------------------------------
+
+    def _volunteer(self, task_id: str) -> None:
+        if self.container.client_id is None or task_id in self._in_flight:
+            return
+        self._in_flight.add(task_id)
+        self._tasks.write(task_id, self.container.client_id)
+
+    def _evaluate(self) -> None:
+        """After any sequenced write: fire callbacks for newly-won tasks and
+        re-volunteer for interested tasks that became unclaimed (voluntary
+        release by the previous holder)."""
+        # Snapshot: a callback may pick() more tasks mid-iteration.
+        for task_id, callback in list(self._interested.items()):
+            claimant = self.claimant(task_id)
+            if claimant is not UNCLAIMED:
+                self._in_flight.discard(task_id)  # the race was decided
+            held = claimant == self.container.client_id
+            if held and task_id not in self._held:
+                self._held.add(task_id)
+                if callback is not None:
+                    callback()
+            elif not held:
+                self._held.discard(task_id)
+                if claimant is UNCLAIMED:
+                    self._volunteer(task_id)
+
+    def _on_member_removed(self, client_id: str) -> None:
+        for task_id in self._interested:
+            if self.claimant(task_id) == client_id:
+                self._volunteer(task_id)

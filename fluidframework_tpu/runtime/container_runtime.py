@@ -33,11 +33,12 @@ class ContainerRuntime:
 
     # -- data store lifecycle -------------------------------------------------
 
-    def create_datastore(self, datastore_id: str,
-                         root: bool = True) -> DataStoreRuntime:
+    def create_datastore(self, datastore_id: str, root: bool = True,
+                         attributes: dict | None = None) -> DataStoreRuntime:
         if datastore_id in self.datastores:
             raise ValueError(f"datastore {datastore_id!r} already exists")
-        datastore = DataStoreRuntime(datastore_id, self, self.registry)
+        datastore = DataStoreRuntime(datastore_id, self, self.registry,
+                                     attributes)
         self.datastores[datastore_id] = datastore
         if root:
             self.root_datastores.add(datastore_id)
@@ -90,11 +91,16 @@ class ContainerRuntime:
             self.container.send_message(
                 MessageType.OPERATION, envelope, client_seq)
 
-    def _submit_attach(self, datastore: DataStoreRuntime) -> None:
+    def _submit_attach(self, datastore: DataStoreRuntime,
+                       snapshot: dict | None = None) -> None:
+        # The snapshot is captured at CREATE time (not resend time): any
+        # state added later travels as its own pending ops, which must not
+        # also be baked into a replayed attach (or remotes apply it twice).
         contents = {
             "id": datastore.id,
             "root": datastore.id in self.root_datastores,
-            "snapshot": datastore.summarize(),
+            "snapshot": datastore.summarize() if snapshot is None
+            else snapshot,
         }
         client_seq = self.container.allocate_client_seq()
         # Tracked pending like any op so a disconnected create replays on
@@ -148,9 +154,10 @@ class ContainerRuntime:
         for item in self.pending.drain_for_replay():
             envelope = item.contents
             if envelope.get("type") == "attach":
-                # Re-announce with the store's CURRENT snapshot (any channel
-                # ops still pending follow it in the replay order).
-                self._submit_attach(self.datastores[envelope["id"]])
+                # Re-announce with the ORIGINAL create-time snapshot; the
+                # state added since rides the pending ops replayed after us.
+                self._submit_attach(self.datastores[envelope["id"]],
+                                    snapshot=envelope["snapshot"])
                 continue
             datastore = self.datastores[envelope["address"]]
             datastore.resubmit(envelope["contents"], item.local_op_metadata)

@@ -32,11 +32,16 @@ class ChannelDeltaConnection:
 
 class DataStoreRuntime:
     def __init__(self, datastore_id: str, parent: "ContainerRuntime",
-                 registry: ChannelRegistry) -> None:
+                 registry: ChannelRegistry,
+                 attributes: dict | None = None) -> None:
         self.id = datastore_id
         self.parent = parent
         self.registry = registry
         self.channels: dict[str, SharedObject] = {}
+        # Persisted metadata, e.g. {"type": <data-object type>} — what the
+        # reference stores as the data store's package path so the right
+        # DataObject class re-instantiates on load (dataStoreContext.ts).
+        self.attributes: dict = attributes or {}
 
     @property
     def handle(self):
@@ -119,14 +124,10 @@ class DataStoreRuntime:
 
     def resubmit(self, envelope: dict, local_op_metadata: Any) -> None:
         if envelope.get("type") == "attach_channel":
-            # Re-announce with the channel's current snapshot.
-            channel = self.channels[envelope["address"]]
-            self.parent.submit_datastore_op(
-                self.id,
-                {"type": "attach_channel", "address": envelope["address"],
-                 "snapshot": channel.summarize()},
-                None,
-            )
+            # Re-announce with the ORIGINAL create-time snapshot — edits made
+            # since are their own pending ops and replay right after this
+            # (re-snapshotting here would double-apply them on remotes).
+            self.parent.submit_datastore_op(self.id, envelope, None)
             return
         channel = self.channels[envelope["address"]]
         channel.resubmit(envelope["contents"], local_op_metadata)
@@ -135,13 +136,15 @@ class DataStoreRuntime:
 
     def summarize(self) -> dict:
         return {
+            "attributes": dict(sorted(self.attributes.items())),
             "channels": {
                 channel_id: channel.summarize()
                 for channel_id, channel in sorted(self.channels.items())
-            }
+            },
         }
 
     def load(self, snapshot: dict) -> None:
+        self.attributes = snapshot.get("attributes", {})
         for channel_id, channel_snapshot in snapshot["channels"].items():
             channel_type = channel_snapshot["attributes"]["type"]
             channel = self.registry.get(channel_type).load(

@@ -1,0 +1,116 @@
+"""Aqueduct-equivalent framework layer tests.
+
+Reference parity model: packages/framework/aqueduct tests + the clicker
+example (examples/data-objects/clicker) written against DataObject /
+DataObjectFactory / ContainerRuntimeFactoryWithDefaultDataStore, and the
+fluid-static simplified client.
+"""
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.framework import (
+    ContainerRuntimeFactoryWithDefaultDataStore,
+    DataObject,
+    DataObjectFactory,
+    create_container,
+    get_container,
+)
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+class Clicker(DataObject):
+    """The reference's flagship example app (examples/data-objects/clicker):
+    a SharedCounter reached via a handle stored in the root directory."""
+
+    def initializing_first_time(self, props=None) -> None:
+        counter = self.runtime.create_channel(
+            "clicks", SharedCounter.channel_type)
+        self.root.set("clicks", counter.handle)
+
+    @property
+    def counter(self) -> SharedCounter:
+        return self.root.get("clicks").get()
+
+    def click(self) -> None:
+        self.counter.increment()
+
+
+ClickerFactory = DataObjectFactory("clicker", Clicker)
+
+
+def _runtime_factory():
+    return ContainerRuntimeFactoryWithDefaultDataStore(ClickerFactory)
+
+
+class TestDataObject:
+    def test_clicker_two_clients_converge(self):
+        server = LocalCollabServer()
+        factory = _runtime_factory()
+        c1, clicker1 = factory.create_document(
+            LocalDocumentService(server, "doc"))
+        c1.attach()
+        c2, clicker2 = factory.load_document(
+            LocalDocumentService(server, "doc"))
+
+        clicker1.click()
+        clicker2.click()
+        clicker2.click()
+        assert clicker1.counter.value == clicker2.counter.value == 3
+        assert c1.summarize() == c2.summarize()
+
+    def test_default_object_is_gc_root(self):
+        server = LocalCollabServer()
+        factory = _runtime_factory()
+        c1, clicker = factory.create_document(
+            LocalDocumentService(server, "doc"))
+        c1.attach()
+        gc = c1.runtime.run_gc()
+        assert "/default" in gc.referenced
+        assert "/default/clicks" in gc.referenced  # via the stored handle
+
+    def test_create_object_at_runtime_reachable_via_handle(self):
+        server = LocalCollabServer()
+        factory = _runtime_factory()
+        c1, clicker1 = factory.create_document(
+            LocalDocumentService(server, "doc"))
+        c1.attach()
+        c2, clicker2 = factory.load_document(
+            LocalDocumentService(server, "doc"))
+
+        extra = factory.create_object(c1, "clicker")
+        clicker1.root.set("extra", extra.handle)
+        extra.click()
+
+        extra2_handle = clicker2.root.get("extra")
+        extra2 = factory.get_object(c2, extra2_handle.get().id)
+        assert extra2.counter.value == 1
+        extra2.click()
+        assert extra.counter.value == 2
+        assert "/%s" % extra.id in c1.runtime.run_gc().referenced
+        assert c1.summarize() == c2.summarize()
+
+    def test_type_attribute_persisted(self):
+        server = LocalCollabServer()
+        factory = _runtime_factory()
+        c1, _ = factory.create_document(LocalDocumentService(server, "doc"))
+        c1.attach()
+        c2, _ = factory.load_document(LocalDocumentService(server, "doc"))
+        ds = c2.runtime.get_datastore("default")
+        assert ds.attributes["type"] == "clicker"
+
+
+class TestFluidStatic:
+    def test_initial_objects_roundtrip(self):
+        server = LocalCollabServer()
+        fc1 = create_container(
+            LocalDocumentService(server, "doc"),
+            {"kv": SharedMap, "text": SharedString})
+        fc2 = get_container(LocalDocumentService(server, "doc"))
+
+        fc1.initial_objects["kv"].set("a", 1)
+        fc2.initial_objects["text"].insert_text(0, "hello")
+        assert fc2.initial_objects["kv"].get("a") == 1
+        assert fc1.initial_objects["text"].get_text() == "hello"
+        assert fc1.container.summarize() == fc2.container.summarize()

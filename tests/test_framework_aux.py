@@ -1,0 +1,248 @@
+"""Agent scheduler, undo-redo, interceptions, last-edited tests.
+
+Reference parity model: packages/runtime/agent-scheduler tests (task claims,
+leader election, reassignment on leave), packages/framework/undo-redo,
+dds-interceptions, last-edited.
+"""
+
+from fluidframework_tpu.dds.cell import SharedCell
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.dds.summary_block import SharedSummaryBlock
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.framework.interceptions import (
+    create_map_with_interception,
+    create_string_with_interception,
+)
+from fluidframework_tpu.framework.last_edited import LastEditedTracker
+from fluidframework_tpu.framework.undo_redo import UndoRedoStackManager
+from fluidframework_tpu.runtime.agent_scheduler import AgentScheduler
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+def _doc(server, *channels, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    ds = container.runtime.create_datastore("default")
+    for name, cls in channels:
+        ds.create_channel(name, cls.channel_type)
+    container.attach()
+    return container
+
+
+def _open(server, doc_id="doc"):
+    return Container.load(LocalDocumentService(server, doc_id))
+
+
+def _chan(container, name):
+    return container.runtime.get_datastore("default").get_channel(name)
+
+
+class TestAgentScheduler:
+    def test_single_claimant_wins(self):
+        server = LocalCollabServer()
+        c1 = _doc(server)
+        c2 = _open(server)
+        s1, s2 = AgentScheduler.get(c1), AgentScheduler.get(c2)
+
+        won = []
+        s1.pick("summarizer", lambda: won.append("c1"))
+        s2.pick("summarizer", lambda: won.append("c2"))
+        assert won == ["c1"]
+        assert s1.claimant("summarizer") == c1.client_id
+        assert s2.claimant("summarizer") == c1.client_id
+        assert s1.picked_tasks() == ["summarizer"]
+        assert s2.picked_tasks() == []
+
+    def test_reassign_on_leave(self):
+        server = LocalCollabServer()
+        c1 = _doc(server)
+        c2 = _open(server)
+        s1, s2 = AgentScheduler.get(c1), AgentScheduler.get(c2)
+
+        elected = []
+        s1.volunteer_for_leadership(lambda: elected.append("c1"))
+        s2.volunteer_for_leadership(lambda: elected.append("c2"))
+        assert s1.is_leader and not s2.is_leader
+
+        c1.disconnect()
+        assert s2.is_leader
+        assert elected == ["c1", "c2"]
+
+    def test_release_reassigns_to_interested_client(self):
+        server = LocalCollabServer()
+        c1 = _doc(server)
+        c2 = _open(server)
+        s1, s2 = AgentScheduler.get(c1), AgentScheduler.get(c2)
+
+        s1.pick("task")
+        s2.pick("task")
+        s1.release("task")
+        # c2 re-volunteers automatically when it sees the release land.
+        assert s2.claimant("task") == c2.client_id
+        assert s2.picked_tasks() == ["task"]
+
+    def test_callback_may_pick_more_tasks(self):
+        server = LocalCollabServer()
+        c1 = _doc(server)
+        s1 = AgentScheduler.get(c1)
+        won = []
+        s1.pick("first", lambda: (won.append("first"),
+                                  s1.pick("second",
+                                          lambda: won.append("second"))))
+        assert won == ["first", "second"]
+
+
+class TestUndoRedo:
+    def test_map_undo_redo(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("m", SharedMap))
+        c2 = _open(server)
+        m1 = _chan(c1, "m")
+        undo = UndoRedoStackManager()
+        undo.subscribe_map(m1)
+
+        m1.set("a", 1)
+        undo.close_current_operation()
+        m1.set("a", 2)
+        undo.close_current_operation()
+
+        undo.undo()
+        assert m1.get("a") == 1
+        undo.undo()
+        assert not m1.has("a")
+        undo.redo()
+        assert m1.get("a") == 1
+        undo.redo()
+        assert m1.get("a") == 2
+        assert _chan(c2, "m").get("a") == 2
+        assert c1.summarize() == c2.summarize()
+
+    def test_grouped_operation_undoes_atomically(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("m", SharedMap))
+        m = _chan(c1, "m")
+        undo = UndoRedoStackManager()
+        undo.subscribe_map(m)
+
+        m.set("x", 1)
+        m.set("y", 2)
+        undo.close_current_operation()
+        undo.undo()
+        assert not m.has("x") and not m.has("y")
+
+    def test_counter_and_cell(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("n", SharedCounter), ("c", SharedCell))
+        counter, cell = _chan(c1, "n"), _chan(c1, "c")
+        undo = UndoRedoStackManager()
+        undo.subscribe_counter(counter)
+        undo.subscribe_cell(cell)
+
+        counter.increment(5)
+        undo.close_current_operation()
+        cell.set("v1")
+        undo.close_current_operation()
+
+        undo.undo()
+        assert cell.empty
+        undo.undo()
+        assert counter.value == 0
+        undo.redo()
+        assert counter.value == 5
+        undo.redo()
+        assert cell.get() == "v1"
+
+    def test_map_stored_none_restored_not_deleted(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("m", SharedMap))
+        m = _chan(c1, "m")
+        undo = UndoRedoStackManager()
+        undo.subscribe_map(m)
+        m.set("k", None)
+        undo.close_current_operation()
+        m.set("k", 1)
+        undo.close_current_operation()
+        undo.undo()
+        assert m.has("k") and m.get("k") is None
+
+    def test_string_undo_restores_markers(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("s", SharedString))
+        s = _chan(c1, "s")
+        undo = UndoRedoStackManager()
+        undo.subscribe_string(s)
+        s.insert_text(0, "ab")
+        undo.close_current_operation()
+        s.insert_marker(1, "simple", "mk")
+        undo.close_current_operation()
+        s.remove_text(2, 3)  # removes 'b' (marker occupies position 1)
+        undo.close_current_operation()
+        assert s.get_text() == "a"
+        undo.undo()
+        assert s.get_text() == "ab"
+
+    def test_string_undo_redo_converges(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("s", SharedString))
+        c2 = _open(server)
+        s1 = _chan(c1, "s")
+        undo = UndoRedoStackManager()
+        undo.subscribe_string(s1)
+
+        s1.insert_text(0, "hello world")
+        undo.close_current_operation()
+        s1.remove_text(5, 11)
+        undo.close_current_operation()
+        assert s1.get_text() == "hello"
+
+        undo.undo()
+        assert s1.get_text() == "hello world"
+        undo.undo()
+        assert s1.get_text() == ""
+        undo.redo()
+        undo.redo()
+        assert s1.get_text() == "hello"
+        assert _chan(c2, "s").get_text() == "hello"
+        assert c1.summarize() == c2.summarize()
+
+
+class TestInterceptions:
+    def test_map_attribution_stamp(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("m", SharedMap))
+        m = _chan(c1, "m")
+        wrapped = create_map_with_interception(
+            m, lambda key, value: {"value": value, "author": "alice"})
+        wrapped.set("k", 42)
+        assert m.get("k") == {"value": 42, "author": "alice"}
+        assert wrapped.get("k") == {"value": 42, "author": "alice"}
+
+    def test_string_props_stamp(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("s", SharedString))
+        s = _chan(c1, "s")
+        wrapped = create_string_with_interception(
+            s, lambda props: {**(props or {}), "author": "bob"})
+        wrapped.insert_text(0, "hi")
+        assert s.get_text() == "hi"
+        seg = next(seg for seg in s.engine.segments if seg.length > 0)
+        assert seg.props["author"] == "bob"
+
+
+class TestLastEdited:
+    def test_tracks_latest_op_identically_on_replicas(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("m", SharedMap), ("b", SharedSummaryBlock))
+        c2 = _open(server)
+        t1 = LastEditedTracker(c1, _chan(c1, "b"))
+        t2 = LastEditedTracker(c2, _chan(c2, "b"))
+
+        _chan(c1, "m").set("k", 1)
+        _chan(c2, "m").set("k", 2)
+        assert t1.last_edited is not None
+        assert t1.last_edited["client_id"] == c2.client_id
+        assert t1.last_edited == t2.last_edited
+        assert c1.summarize() == c2.summarize()

@@ -118,6 +118,23 @@ class TestLiveHandles:
             "late").value == 3
         assert c1.summarize() == c2.summarize()
 
+    def test_disconnected_create_replays_without_double_apply(self):
+        """The replayed attach must carry the CREATE-time snapshot; the
+        counter increments ride their own replayed ops exactly once."""
+        server = LocalCollabServer()
+        c1 = _make(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+
+        c1.disconnect()
+        ds = c1.runtime.create_datastore("offline", root=True)
+        counter = ds.create_channel("n", SharedCounter.channel_type)
+        counter.increment(5)
+        c1.reconnect()
+
+        assert counter.value == 5
+        assert c2.runtime.get_datastore("offline").get_channel("n").value == 5
+        assert c1.summarize() == c2.summarize()
+
     def test_gc_state_in_summary_and_roots_persist(self):
         server = LocalCollabServer()
         c1 = _make(server)
