@@ -33,7 +33,8 @@ class MapData:
         # every applied op; key_existed disambiguates a stored None.
         self.on_value_changed: list[Callable[[str, bool, Any, bool],
                                              None]] = []
-        self.on_clear: list[Callable[[bool], None]] = []
+        # (local, previous_items) — previous enables clear-undo.
+        self.on_clear: list[Callable[[bool, dict], None]] = []
 
     # -- reads ---------------------------------------------------------------
 
@@ -156,9 +157,10 @@ class MapData:
         return True
 
     def _clear_core(self, local: bool) -> None:
+        previous = dict(self._data)
         self._data.clear()
         for cb in self.on_clear:
-            cb(local)
+            cb(local, previous)
 
     def _clear_except_pending(self) -> None:
         kept = {

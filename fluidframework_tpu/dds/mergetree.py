@@ -430,8 +430,11 @@ class MergeEngine:
         while acked segments keep the id they sequenced under."""
         old = self.local_client
         self.local_client = new_client
-        if old is None or old == new_client:
+        if old == new_client:
             return
+        # old may be None: edits made while never-yet-connected stamp
+        # client=None and must adopt the first real identity, or their
+        # acked segments diverge from what remotes recorded.
         for seg in self.segments:
             if seg.seq == UNASSIGNED and seg.client == old:
                 seg.client = new_client

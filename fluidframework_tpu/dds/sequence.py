@@ -73,9 +73,10 @@ class SharedString(SharedObject):
             # The removed content comes from the segments this local remove
             # actually hit (positions in get_text() would miscount markers).
             items = [
-                {"marker": {"ref_type": seg.content.ref_type,
-                            "id": seg.content.id}}
-                if seg.is_marker else {"text": seg.content}
+                {**({"marker": {"ref_type": seg.content.ref_type,
+                                "id": seg.content.id}}
+                    if seg.is_marker else {"text": seg.content}),
+                 **({"props": dict(seg.props)} if seg.props else {})}
                 for seg in group.segments
             ]
             for cb in self.on_local_edit:

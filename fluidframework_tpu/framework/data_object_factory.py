@@ -8,15 +8,13 @@ subclasses), and runs the initialize lifecycle.
 
 from __future__ import annotations
 
-import itertools
+import uuid
 from typing import Any
 
 from ..dds.directory import SharedDirectory
 from ..runtime.container_runtime import ContainerRuntime
 from ..runtime.datastore import DataStoreRuntime
 from .data_object import DataObject, PureDataObject
-
-_uid = itertools.count()
 
 
 class DataObjectFactory:
@@ -33,7 +31,10 @@ class DataObjectFactory:
         """Create a new instance: data store + root channel + first-time
         init (dataObjectFactory.ts createInstance flow)."""
         if datastore_id is None:
-            datastore_id = f"{self.type}-{next(_uid)}"
+            # Globally unique (uuid, as in the reference): two clients
+            # auto-creating objects must never collide on a store id —
+            # process_attach would silently merge them.
+            datastore_id = f"{self.type}-{uuid.uuid4().hex}"
         datastore = container_runtime.create_datastore(
             datastore_id, root=root, attributes={"type": self.type})
         obj = self.data_object_cls(datastore)

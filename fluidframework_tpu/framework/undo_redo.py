@@ -119,7 +119,17 @@ class UndoRedoStackManager:
                 self._deliver(Revertible(lambda: shared_map.delete(key)))
             else:
                 self._deliver(Revertible(lambda: shared_map.set(key, previous)))
+
+        def on_clear(local: bool, previous: dict) -> None:
+            if not local:
+                return
+
+            def restore() -> None:
+                for key, value in previous.items():
+                    shared_map.set(key, value)
+            self._deliver(Revertible(restore))
         shared_map.data.on_value_changed.append(on_value_changed)
+        shared_map.data.on_clear.append(on_clear)
 
     def subscribe_counter(self, counter: SharedCounter) -> None:
         original = counter.increment
@@ -205,13 +215,14 @@ class UndoRedoStackManager:
             try:
                 for i, item in enumerate(items):
                     captured.clear()
+                    props = item.get("props")
                     if "marker" in item:
                         shared_string.insert_marker(
                             pos, item["marker"]["ref_type"],
-                            item["marker"]["id"])
+                            item["marker"]["id"], props)
                         pos += 1
                     else:
-                        shared_string.insert_text(pos, item["text"])
+                        shared_string.insert_text(pos, item["text"], props)
                         pos += len(item["text"])
                     if i < len(old_segments) and captured:
                         new_seg = captured[-1][0]

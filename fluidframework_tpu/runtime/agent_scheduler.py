@@ -34,20 +34,29 @@ class AgentScheduler:
         self._tasks.on_op.append(lambda _msg, _local: self._evaluate())
         container.protocol.quorum.on_remove_member.append(
             self._on_member_removed)
+        # A pick() made while disconnected volunteers on (re)connect.
+        container.on_connected.append(lambda _cid: self._evaluate())
 
     # -- wiring ---------------------------------------------------------------
 
     @classmethod
     def get(cls, container: Container) -> "AgentScheduler":
         """Create-or-open the scheduler's hidden data store (the reference
-        mounts it at the well-known "_scheduler" route)."""
+        mounts it at the well-known "_scheduler" route). Idempotent: one
+        scheduler instance per container (cached), or double-subscribed
+        hooks would disagree about held tasks."""
+        existing = getattr(container, "_agent_scheduler", None)
+        if existing is not None:
+            return existing
         try:
             datastore = container.runtime.get_datastore(cls.DATASTORE_ID)
         except KeyError:
             datastore = container.runtime.create_datastore(cls.DATASTORE_ID)
             datastore.create_channel(
                 cls.CHANNEL_ID, ConsensusRegisterCollection.channel_type)
-        return cls(container, datastore.get_channel(cls.CHANNEL_ID))
+        scheduler = cls(container, datastore.get_channel(cls.CHANNEL_ID))
+        container._agent_scheduler = scheduler
+        return scheduler
 
     # -- task API (scheduler.ts pick/release/pickedTasks) ---------------------
 
@@ -69,8 +78,18 @@ class AgentScheduler:
         self._tasks.write(task_id, UNCLAIMED)
 
     def claimant(self, task_id: str) -> str | None:
-        """Current consensus holder (atomic read = first sequenced claim)."""
-        return self._tasks.read(task_id, ConsensusRegisterCollection.ATOMIC)
+        """Current valid holder: the consensus register value (atomic read =
+        first sequenced claim), but only while that client is a quorum
+        member — a claim stamped with a departed/stale id (e.g. a volunteer
+        write replayed across a reconnect under the old identity) is void,
+        exactly as the reference validates picks against the quorum
+        (scheduler.ts pickCore)."""
+        raw = self._tasks.read(task_id, ConsensusRegisterCollection.ATOMIC)
+        if raw is UNCLAIMED:
+            return UNCLAIMED
+        if raw not in self.container.protocol.quorum.get_members():
+            return UNCLAIMED
+        return raw
 
     def picked_tasks(self) -> list[str]:
         return sorted(self._held)
@@ -87,7 +106,8 @@ class AgentScheduler:
 
     @property
     def is_leader(self) -> bool:
-        return self.leader == self.container.client_id
+        client_id = self.container.client_id
+        return client_id is not None and self.leader == client_id
 
     # -- claim machinery -------------------------------------------------------
 
@@ -102,11 +122,12 @@ class AgentScheduler:
         re-volunteer for interested tasks that became unclaimed (voluntary
         release by the previous holder)."""
         # Snapshot: a callback may pick() more tasks mid-iteration.
+        client_id = self.container.client_id
         for task_id, callback in list(self._interested.items()):
             claimant = self.claimant(task_id)
             if claimant is not UNCLAIMED:
                 self._in_flight.discard(task_id)  # the race was decided
-            held = claimant == self.container.client_id
+            held = client_id is not None and claimant == client_id
             if held and task_id not in self._held:
                 self._held.add(task_id)
                 if callback is not None:

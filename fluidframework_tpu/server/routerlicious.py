@@ -1,0 +1,455 @@
+"""Routerlicious-equivalent service assembly over the partitioned bus.
+
+Reference parity: server/routerlicious — alfred front door (connect /
+submitOp → produce to ``rawdeltas``: alfred/index.ts:367), deli sequencer
+lambda (rawdeltas → ticket → ``deltas``: deli/lambda.ts:82), scriptorium
+(durable op log: scriptorium/lambda.ts:16), broadcaster (fan-out:
+broadcaster/lambda.ts:42) and scribe (summary ack flow:
+scribe/lambda.ts:40), each an independently checkpointed consumer of the
+same ``deltas`` stream — restartable from its own offsets.
+
+The assembly exposes the same duck-typed surface as ``LocalCollabServer``
+(connect/submit/signal/get_deltas/upload_snapshot/...), so the whole
+client stack runs over it unchanged via ``LocalDocumentService``. Pumping
+is synchronous after every produce (deterministic for tests); a real
+deployment pumps each lambda on its own cadence.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..ops import opcodes as oc
+from ..protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    ScopeType,
+    SequencedDocumentMessage,
+)
+from .bus import BusMessage, MessageBus, StateStore
+from .lambdas import PartitionManager
+from .sequencer import DocumentSequencer, RawOperation, SequencerCheckpoint
+
+RAWDELTAS = "rawdeltas"
+DELTAS = "deltas"
+
+
+# -- deli ---------------------------------------------------------------------
+
+
+class DeliDocumentLambda:
+    """Per-document sequencer lambda (deli/lambda.ts ticket loop)."""
+
+    def __init__(self, doc_id: str, store: StateStore, bus: MessageBus,
+                 sequencer_factory: Callable[[], DocumentSequencer]) -> None:
+        self.doc_id = doc_id
+        self._store = store
+        self._bus = bus
+        cp = store.get(f"deli/{doc_id}")
+        if cp is not None:
+            self.sequencer = DocumentSequencer.restore(
+                SequencerCheckpoint(**cp))
+            self._last_offset = cp["log_offset"]
+        else:
+            self.sequencer = sequencer_factory()
+            self._last_offset = -1
+
+    def handler(self, message: BusMessage) -> None:
+        if message.offset <= self._last_offset:
+            return  # replayed below our checkpoint (deli/lambda.ts:148-151)
+        self._last_offset = message.offset
+        raw: RawOperation = message.value
+        ticket = self.sequencer.ticket(raw)
+        if ticket.kind == oc.OUT_NACK:
+            self._bus.produce(DELTAS, self.doc_id, {
+                "kind": "nack",
+                "target": raw.client_id,
+                "operation": raw,
+                "seq": ticket.seq,
+                "code": ticket.nack_code,
+            })
+        elif ticket.kind == oc.OUT_SEQUENCED:
+            self._bus.produce(DELTAS, self.doc_id, {
+                "kind": "op",
+                "message": SequencedDocumentMessage(
+                    client_id=raw.client_id,
+                    sequence_number=ticket.seq,
+                    minimum_sequence_number=ticket.msn,
+                    client_sequence_number=raw.client_seq,
+                    reference_sequence_number=raw.ref_seq,
+                    type=raw.type,
+                    contents=raw.contents,
+                    timestamp=raw.timestamp,
+                    data=raw.data,
+                ),
+            })
+
+    def checkpoint(self, next_offset: int) -> None:
+        cp = self.sequencer.checkpoint(self._last_offset)
+        self._store.put(f"deli/{self.doc_id}", {
+            "sequence_number": cp.sequence_number,
+            "minimum_sequence_number": cp.minimum_sequence_number,
+            "last_sent_msn": cp.last_sent_msn,
+            "no_active_clients": cp.no_active_clients,
+            "clients": cp.clients,
+            "nack_future": cp.nack_future,
+            "client_timeout_ms": cp.client_timeout_ms,
+            "log_offset": cp.log_offset,
+        })
+
+
+class _DeliFactory:
+    def __init__(self, store: StateStore, bus: MessageBus,
+                 sequencer_factory: Callable[[], DocumentSequencer]) -> None:
+        self._store, self._bus = store, bus
+        self._sequencer_factory = sequencer_factory
+
+    def create(self, doc_id: str) -> DeliDocumentLambda:
+        return DeliDocumentLambda(doc_id, self._store, self._bus,
+                                  self._sequencer_factory)
+
+
+# -- scriptorium --------------------------------------------------------------
+
+
+class ScriptoriumDocumentLambda:
+    """Durable op log writer (scriptorium/lambda.ts insertOp). Idempotent on
+    replay: ops at-or-below the stored tail sequence number drop."""
+
+    def __init__(self, doc_id: str, store: StateStore) -> None:
+        self.doc_id = doc_id
+        self._store = store
+
+    def handler(self, message: BusMessage) -> None:
+        if message.value["kind"] != "op":
+            return
+        op: SequencedDocumentMessage = message.value["message"]
+        log: list = self._store.get(f"ops/{self.doc_id}", [])
+        if log and op.sequence_number <= log[-1].sequence_number:
+            return  # replay after crash-before-commit
+        self._store.append(f"ops/{self.doc_id}", [op])
+
+    def checkpoint(self, next_offset: int) -> None:
+        pass  # the op log IS the durable state; offsets commit in the pump
+
+
+class _ScriptoriumFactory:
+    def __init__(self, store: StateStore) -> None:
+        self._store = store
+
+    def create(self, doc_id: str) -> ScriptoriumDocumentLambda:
+        return ScriptoriumDocumentLambda(doc_id, self._store)
+
+
+# -- broadcaster --------------------------------------------------------------
+
+
+@dataclass
+class _LiveConnection:
+    client_id: str
+    doc_id: str
+    service: "RouterliciousService"
+    handler: Callable[[list[SequencedDocumentMessage]], None]
+    on_nack: Callable[[NackMessage], None] | None = None
+    on_signal: Callable[[Any], None] | None = None
+    open: bool = True
+    mode: str = "write"
+
+    def submit(self, messages: list[DocumentMessage]) -> None:
+        assert self.open, "submit on closed connection"
+        self.service.submit(self.doc_id, self.client_id, messages)
+
+    def signal(self, content: Any) -> None:
+        assert self.open, "signal on closed connection"
+        self.service.signal(self.doc_id, self.client_id, content)
+
+    def close(self) -> None:
+        if self.open:
+            self.open = False
+            self.service.disconnect(self.doc_id, self.client_id)
+
+
+class BroadcasterDocumentLambda:
+    """Fan-out to live connections (broadcaster/lambda.ts emit). Delivery is
+    per-connection resumable: each connection tracks the last seq it saw, so
+    replayed messages after a crash dedupe naturally."""
+
+    def __init__(self, doc_id: str,
+                 connections: dict[str, _LiveConnection]) -> None:
+        self.doc_id = doc_id
+        self._connections = connections
+        self._delivered_seq: dict[str, int] = {}
+
+    def handler(self, message: BusMessage) -> None:
+        value = message.value
+        if value["kind"] == "nack":
+            conn = self._connections.get(value["target"])
+            if conn is not None and conn.on_nack is not None:
+                raw: RawOperation = value["operation"]
+                conn.on_nack(NackMessage(
+                    operation=DocumentMessage(
+                        type=raw.type,
+                        contents=raw.contents,
+                        client_sequence_number=raw.client_seq,
+                        reference_sequence_number=raw.ref_seq,
+                    ),
+                    sequence_number=value["seq"],
+                    code=403 if value["code"] == oc.NACK_NO_SUMMARY_SCOPE
+                    else 400,
+                    error_type=value["code"],
+                    message=f"nack:{value['code']}",
+                ))
+            return
+        op: SequencedDocumentMessage = value["message"]
+        for client_id, conn in list(self._connections.items()):
+            if not conn.open:
+                continue
+            if op.sequence_number <= self._delivered_seq.get(client_id, 0):
+                continue
+            self._delivered_seq[client_id] = op.sequence_number
+            conn.handler([op])
+
+    def checkpoint(self, next_offset: int) -> None:
+        pass  # live fan-out has no durable state
+
+
+class _BroadcasterFactory:
+    def __init__(self, service: "RouterliciousService") -> None:
+        self._service = service
+
+    def create(self, doc_id: str) -> BroadcasterDocumentLambda:
+        return BroadcasterDocumentLambda(
+            doc_id, self._service._connections_for(doc_id))
+
+
+# -- scribe -------------------------------------------------------------------
+
+
+class ScribeDocumentLambda:
+    """Summary validation + durable head + ack (scribe/lambda.ts:190-250).
+    The ack/nack is produced into RAWDELTAS so deli sequences it — the same
+    loop the reference uses (scribe → deli → deltas)."""
+
+    def __init__(self, doc_id: str, store: StateStore, bus: MessageBus,
+                 clock: Callable[[], int]) -> None:
+        self.doc_id = doc_id
+        self._store = store
+        self._bus = bus
+        self._clock = clock
+        self._handled_seq = int(
+            self._store.get(f"scribe/{self.doc_id}", {}).get("seq", 0))
+
+    def handler(self, message: BusMessage) -> None:
+        value = message.value
+        if value["kind"] != "op":
+            return
+        op: SequencedDocumentMessage = value["message"]
+        if op.sequence_number <= self._handled_seq:
+            return  # replayed
+        self._handled_seq = op.sequence_number
+        if op.type != MessageType.SUMMARIZE:
+            return
+        handle = (op.contents or {}).get("handle")
+        proposal = {"summary_proposal": {
+            "summary_sequence_number": op.sequence_number}}
+        snapshots = self._store.get(f"snapshots/{self.doc_id}", {})
+        offered = snapshots.get(handle)
+        acked_handle = self._store.get(f"summary_head/{self.doc_id}")
+        current = snapshots.get(acked_handle) if acked_handle else None
+        offered_seq = (offered or {}).get("sequence_number")
+
+        def produce_raw(mtype: MessageType, contents: dict) -> None:
+            self._bus.produce(RAWDELTAS, self.doc_id, RawOperation(
+                client_id=None, type=mtype, contents=contents,
+                timestamp=self._clock()))
+
+        if offered is None:
+            produce_raw(MessageType.SUMMARY_NACK, {
+                "message": f"unknown summary handle {handle!r}",
+                "handle": handle, **proposal})
+        elif not isinstance(offered_seq, int):
+            produce_raw(MessageType.SUMMARY_NACK, {
+                "message": "summary content missing sequence_number",
+                "handle": handle, **proposal})
+        elif current is not None and \
+                offered_seq < current["sequence_number"]:
+            produce_raw(MessageType.SUMMARY_NACK, {
+                "message": f"stale summary at seq {offered_seq} < "
+                           f"current {current['sequence_number']}",
+                "handle": handle, **proposal})
+        else:
+            self._store.put(f"summary_head/{self.doc_id}", handle)
+            produce_raw(MessageType.SUMMARY_ACK,
+                        {"handle": handle, **proposal})
+
+    def checkpoint(self, next_offset: int) -> None:
+        self._store.put(f"scribe/{self.doc_id}", {"seq": self._handled_seq})
+
+
+class _ScribeFactory:
+    def __init__(self, store: StateStore, bus: MessageBus,
+                 clock: Callable[[], int]) -> None:
+        self._store, self._bus, self._clock = store, bus, clock
+
+    def create(self, doc_id: str) -> ScribeDocumentLambda:
+        return ScribeDocumentLambda(doc_id, self._store, self._bus,
+                                    self._clock)
+
+
+# -- service assembly ---------------------------------------------------------
+
+
+class RouterliciousService:
+    """The assembled ordering service. Same duck-typed surface as
+    LocalCollabServer, so drivers/containers run over it unchanged.
+
+    Durability boundary: ``bus`` + ``store`` survive a service restart
+    (pass them to a new instance = recover from checkpoints); connections
+    and lambda instances do not.
+    """
+
+    def __init__(self, bus: MessageBus | None = None,
+                 store: StateStore | None = None,
+                 num_partitions: int = 4,
+                 sequencer_factory: Callable[[], DocumentSequencer]
+                 = DocumentSequencer) -> None:
+        self.bus = bus if bus is not None else MessageBus()
+        self.store = store if store is not None else StateStore()
+        self.bus.create_topic(RAWDELTAS, num_partitions)
+        self.bus.create_topic(DELTAS, num_partitions)
+        self._connections: dict[str, dict[str, _LiveConnection]] = {}
+        # Client ids must never repeat across service restarts (a reused id
+        # would make old ops look local to a new client), so the counter is
+        # durable like the reference's UUID ids are globally unique.
+        self._client_counter = itertools.count(
+            int(self.store.get("client_counter", 0)) + 1)
+        clock_start = int(self.store.get("clock", 0))
+        self._clock_iter = itertools.count(clock_start + 1)
+        self._pumping = False
+
+        self._deli = PartitionManager(
+            self.bus, RAWDELTAS, "deli",
+            _DeliFactory(self.store, self.bus, sequencer_factory))
+        self._scriptorium = PartitionManager(
+            self.bus, DELTAS, "scriptorium", _ScriptoriumFactory(self.store))
+        self._broadcaster = PartitionManager(
+            self.bus, DELTAS, "broadcaster", _BroadcasterFactory(self))
+        self._scribe = PartitionManager(
+            self.bus, DELTAS, "scribe",
+            _ScribeFactory(self.store, self.bus, self._clock))
+
+    # -- internals -------------------------------------------------------------
+
+    def _clock(self) -> int:
+        tick = next(self._clock_iter)
+        self.store.put("clock", tick)  # restarts keep timestamps monotonic
+        return tick
+
+    def _connections_for(self, doc_id: str) -> dict[str, _LiveConnection]:
+        return self._connections.setdefault(doc_id, {})
+
+    def pump(self) -> None:
+        """Drain every lambda until quiescent (scribe may feed deli)."""
+        if self._pumping:
+            return  # re-entrant submit during broadcast; outer loop drains
+        self._pumping = True
+        try:
+            while True:
+                moved = self._deli.pump()
+                moved += self._scriptorium.pump()
+                moved += self._scribe.pump()
+                moved += self._broadcaster.pump()
+                if moved == 0:
+                    break
+        finally:
+            self._pumping = False
+
+    # -- alfred front door -----------------------------------------------------
+
+    def connect(
+        self,
+        doc_id: str,
+        handler: Callable[[list[SequencedDocumentMessage]], None],
+        on_nack: Callable[[NackMessage], None] | None = None,
+        on_signal: Callable[[Any], None] | None = None,
+        mode: str = "write",
+        scopes: tuple[str, ...] = ScopeType.ALL,
+    ) -> _LiveConnection:
+        client_number = next(self._client_counter)
+        self.store.put("client_counter", client_number)
+        client_id = f"client-{client_number}"
+        connection = _LiveConnection(client_id, doc_id, self, handler,
+                                     on_nack, on_signal, mode=mode)
+        self._connections_for(doc_id)[client_id] = connection
+        if mode != "read":
+            self.bus.produce(RAWDELTAS, doc_id, RawOperation(
+                client_id=None,
+                type=MessageType.CLIENT_JOIN,
+                data=ClientDetail(client_id=client_id, mode=mode,
+                                  scopes=scopes),
+                timestamp=self._clock(),
+                can_summarize=ScopeType.SUMMARY_WRITE in scopes,
+            ))
+            self.pump()
+        return connection
+
+    def disconnect(self, doc_id: str, client_id: str) -> None:
+        connection = self._connections_for(doc_id).pop(client_id, None)
+        if connection is not None and connection.mode == "read":
+            return
+        self.bus.produce(RAWDELTAS, doc_id, RawOperation(
+            client_id=None,
+            type=MessageType.CLIENT_LEAVE,
+            data=client_id,
+            timestamp=self._clock(),
+        ))
+        self.pump()
+
+    def submit(self, doc_id: str, client_id: str,
+               messages: list[DocumentMessage]) -> None:
+        for message in messages:
+            self.bus.produce(RAWDELTAS, doc_id, RawOperation(
+                client_id=client_id,
+                type=message.type,
+                client_seq=message.client_sequence_number,
+                ref_seq=message.reference_sequence_number,
+                timestamp=self._clock(),
+                contents=message.contents,
+            ))
+        self.pump()
+
+    def signal(self, doc_id: str, client_id: str, content: Any) -> None:
+        for connection in list(self._connections_for(doc_id).values()):
+            if connection.on_signal is not None:
+                connection.on_signal({"client_id": client_id,
+                                      "content": content})
+
+    # -- storage (historian/gitrest + scriptorium reads) -----------------------
+
+    def get_deltas(self, doc_id: str, from_seq: int,
+                   to_seq: int | None = None) -> list[SequencedDocumentMessage]:
+        self.pump()
+        log: list[SequencedDocumentMessage] = self.store.get(
+            f"ops/{doc_id}", [])
+        return [m for m in log
+                if m.sequence_number > from_seq
+                and (to_seq is None or m.sequence_number <= to_seq)]
+
+    def upload_snapshot(self, doc_id: str, snapshot: dict) -> str:
+        snapshots: dict = self.store.get(f"snapshots/{doc_id}", {})
+        handle = f"{doc_id}/snapshots/{len(snapshots)}"
+        snapshots[handle] = snapshot
+        self.store.put(f"snapshots/{doc_id}", snapshots)
+        if self.store.get(f"summary_head/{doc_id}") is None:
+            self.store.put(f"summary_head/{doc_id}", handle)
+        return handle
+
+    def get_latest_snapshot(self, doc_id: str) -> dict | None:
+        head = self.store.get(f"summary_head/{doc_id}")
+        if head is None:
+            return None
+        return self.store.get(f"snapshots/{doc_id}", {}).get(head)

@@ -1,0 +1,124 @@
+"""Cross-DDS randomized convergence farm.
+
+Reference parity model: the merge-tree "farm" strategy (conflictFarm /
+reconnectFarm) generalized across DDS types the way the e2e suites cover
+map/directory/matrix/counter together — random concurrent ops with paused
+delivery and random reconnects, asserting byte-identical summaries after
+every drain. This is the eventual-consistency sanitizer (SURVEY §5.2).
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.cell import SharedCell
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.directory import SharedDirectory
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+CHANNELS = [
+    ("map", SharedMap),
+    ("dir", SharedDirectory),
+    ("grid", SharedMatrix),
+    ("count", SharedCounter),
+    ("cell", SharedCell),
+]
+
+
+def make_doc(server, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    for name, cls in CHANNELS:
+        datastore.create_channel(name, cls.channel_type)
+    container.attach()
+    return container
+
+
+def chan(container, name):
+    return container.runtime.get_datastore("default").get_channel(name)
+
+
+def random_op(rng: random.Random, container) -> None:
+    which = rng.randrange(5)
+    if which == 0:
+        m = chan(container, "map")
+        r = rng.random()
+        key = f"k{rng.randrange(6)}"
+        if r < 0.7:
+            m.set(key, rng.randrange(100))
+        elif r < 0.9:
+            m.delete(key)
+        else:
+            m.clear()
+    elif which == 1:
+        d = chan(container, "dir")
+        sub = rng.choice(["/", "a", "a/b"])
+        node = d.root if sub == "/" else d.create_sub_directory(sub) \
+            if rng.random() < 0.3 else d.root
+        node.set(f"k{rng.randrange(4)}", rng.randrange(100))
+    elif which == 2:
+        g = chan(container, "grid")
+        if g.row_count == 0 or rng.random() < 0.25:
+            g.insert_rows(rng.randrange(g.row_count + 1), 1)
+        if g.col_count == 0 or rng.random() < 0.25:
+            g.insert_cols(rng.randrange(g.col_count + 1), 1)
+        if g.row_count and g.col_count:
+            g.set_cell(rng.randrange(g.row_count),
+                       rng.randrange(g.col_count), rng.randrange(100))
+    elif which == 3:
+        chan(container, "count").increment(rng.randrange(1, 5))
+    else:
+        c = chan(container, "cell")
+        if rng.random() < 0.8:
+            c.set(rng.randrange(100))
+        else:
+            c.delete()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_cross_dds_conflict_farm(seed):
+    rng = random.Random(1000 + seed)
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(3)]
+
+    for _round in range(6):
+        paused = [c for c in containers if rng.random() < 0.4]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(6, 16)):
+            random_op(rng, containers[rng.randrange(len(containers))])
+        for c in paused:
+            c.inbound.resume()
+        summaries = [c.summarize() for c in containers]
+        assert all(s == summaries[0] for s in summaries), (seed, _round)
+    for c in containers:
+        assert not c.nacks
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cross_dds_reconnect_farm(seed):
+    rng = random.Random(2000 + seed)
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(2)]
+
+    for _round in range(5):
+        offline = [c for c in containers[1:] if rng.random() < 0.5]
+        for c in offline:
+            c.disconnect()
+        for _ in range(rng.randrange(5, 12)):
+            random_op(rng, containers[rng.randrange(len(containers))])
+        for c in offline:
+            c.reconnect()
+        summaries = [c.summarize() for c in containers]
+        assert all(s == summaries[0] for s in summaries), (seed, _round)
+    for c in containers:
+        assert not c.nacks

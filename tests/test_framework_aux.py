@@ -184,6 +184,22 @@ class TestUndoRedo:
         undo.undo()
         assert s.get_text() == "ab"
 
+    def test_string_undo_restores_annotation_props(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("s", SharedString))
+        s = _chan(c1, "s")
+        undo = UndoRedoStackManager()
+        undo.subscribe_string(s)
+        s.insert_text(0, "bold", {"weight": "bold"})
+        undo.close_current_operation()
+        s.remove_text(0, 4)
+        undo.close_current_operation()
+        undo.undo()
+        assert s.get_text() == "bold"
+        seg = next(seg for seg in s.engine.segments
+                   if seg.length and seg.removed_seq is None)
+        assert seg.props == {"weight": "bold"}
+
     def test_string_undo_redo_converges(self):
         server = LocalCollabServer()
         c1 = _doc(server, ("s", SharedString))
