@@ -144,18 +144,24 @@ class MergeEngine:
         # {old_segment_id: (replacement_segment_or_None, offset_delta)}
         # fired after zamboni compaction drops/coalesces segments.
         self.on_compact: list = []
+        # While True, visibility excludes local unacked state even when the
+        # op author equals the local client: set during apply_remote of a
+        # VOIDED_LOCAL_ECHO (own op re-applied as remote after a lost
+        # concurrent-create race) — no other replica has our pending
+        # segments, so positions must resolve without them.
+        self._foreign_self = False
 
     # -- views ----------------------------------------------------------------
 
     def _vis_len(self, seg: Segment, ref_seq: int, client: str | None) -> int:
         if seg.seq == UNASSIGNED:
-            if seg.client != client:
+            if self._foreign_self or seg.client != client:
                 return 0
         elif seg.seq > ref_seq and seg.client != client:
             return 0
         if seg.removed_seq is not None:
             if seg.removed_seq == UNASSIGNED:
-                if seg.removed_client == client:
+                if seg.removed_client == client and not self._foreign_self:
                     return 0
             elif (seg.removed_seq <= ref_seq or seg.removed_client == client
                   or client in seg.removed_overlap):
@@ -337,8 +343,18 @@ class MergeEngine:
     # -- remote apply ----------------------------------------------------------
 
     def apply_remote(self, op: dict, seq: int, ref_seq: int,
-                     client: str) -> None:
-        """Apply a sequenced op from another client (client.ts applyRemoteOp)."""
+                     client: str, foreign_self: bool = False) -> None:
+        """Apply a sequenced op from another client (client.ts applyRemoteOp).
+        foreign_self: the op's author is the local client but it must apply
+        as remotes do — excluding local unacked state from visibility (a
+        VOIDED_LOCAL_ECHO after a lost concurrent-create race)."""
+        if foreign_self:
+            self._foreign_self = True
+            try:
+                self.apply_remote(op, seq, ref_seq, client)
+            finally:
+                self._foreign_self = False
+            return
         kind = op["type"]
         if kind == "insert":
             index = self._resolve_insert(op["pos"], ref_seq, client,

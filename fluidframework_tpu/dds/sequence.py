@@ -13,7 +13,7 @@ from typing import Any
 
 from ..protocol.messages import SequencedDocumentMessage
 from .mergetree import Marker, MergeEngine, UNASSIGNED
-from .shared_object import ChannelFactory, SharedObject
+from .shared_object import VOIDED_LOCAL_ECHO, ChannelFactory, SharedObject
 
 
 class SharedString(SharedObject):
@@ -85,8 +85,26 @@ class SharedString(SharedObject):
 
     def annotate_range(self, start: int, end: int, props: dict) -> None:
         self._bind_client()
+        prior = None
+        if self.on_local_edit:
+            # Per-segment prior values for the annotated keys, captured
+            # BEFORE the apply so undo can re-annotate them back (the
+            # reference's merge-tree revertibles invert annotate via
+            # propertyChanged deltas). _range_segments splits at the range
+            # boundaries, so the same call inside annotate_local sees the
+            # identical segment list.
+            prior = [
+                (seg, {k: (seg.props or {}).get(k) for k in props})
+                for seg in self.engine._range_segments(
+                    start, end, self.engine.current_seq,
+                    self.engine.local_client)
+            ]
         op = self.engine.annotate_local(start, end, props)
         self.submit_local_message(op, self.engine.pending_groups[-1].local_seq)
+        if prior is not None:
+            for cb in self.on_local_edit:
+                cb({"kind": "annotate", "start": start, "end": end,
+                    "props": dict(props), "prior": prior})
 
     def get_interval_collection(self, label: str) -> "IntervalCollection":
         """Named interval collection over this string (sequence.ts
@@ -128,6 +146,7 @@ class SharedString(SharedObject):
                     message.sequence_number,
                     message.reference_sequence_number,
                     message.client_id,
+                    foreign_self=local_op_metadata is VOIDED_LOCAL_ECHO,
                 )
             # An empty regenerated group still advances the seq horizon, or
             # replica snapshots would disagree on "seq".

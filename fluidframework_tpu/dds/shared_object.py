@@ -27,6 +27,13 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..runtime.datastore import DataStoreRuntime
 
 
+# Metadata sentinel: this sequenced message is OUR OWN op, voided by a lost
+# concurrent-create race and applied as a remote op. Merge engines must then
+# exclude local unacked state from visibility (no other replica has it) even
+# though the op's author id equals the local client id.
+VOIDED_LOCAL_ECHO = object()
+
+
 class SharedObject:
     """Base DDS channel."""
 

@@ -232,7 +232,32 @@ class UndoRedoStackManager:
             finally:
                 shared_string.on_local_edit.remove(hook)
 
+        def revert_annotate(entries: list) -> None:
+            # One tracking group per original segment keeps its prior values
+            # attached across splits: every segment in a group (split tails
+            # auto-join) re-annotates back to that original's prior props.
+            for group, prior in entries:
+                segments = list(group.segments)
+                group.unlink_all()
+                for seg in segments:
+                    if engine._vis_len(seg, engine.current_seq,
+                                       engine.local_client) == 0:
+                        continue  # removed meanwhile; nothing to restore
+                    pos = engine.get_position(seg)
+                    shared_string.annotate_range(pos, pos + seg.length,
+                                                 dict(prior))
+
         def on_local_edit(edit: dict) -> None:
+            if edit["kind"] == "annotate":
+                entries = [(track([seg]), prior)
+                           for seg, prior in edit["prior"]]
+
+                def discard_annotate() -> None:
+                    for group, _prior in entries:
+                        group.unlink_all()
+                self._deliver(Revertible(
+                    lambda: revert_annotate(entries), discard_annotate))
+                return
             group = track(edit["segments"])
             if edit["kind"] == "insert":
                 self._deliver(Revertible(

@@ -137,7 +137,9 @@ class AgentScheduler:
                 if claimant is UNCLAIMED:
                     self._volunteer(task_id)
 
-    def _on_member_removed(self, client_id: str) -> None:
-        for task_id in self._interested:
-            if self.claimant(task_id) == client_id:
-                self._volunteer(task_id)
+    def _on_member_removed(self, _client_id: str) -> None:
+        # The quorum has already dropped the member by the time this callback
+        # fires, so claimant() for any task they held now reads UNCLAIMED —
+        # re-run the claim loop, which re-volunteers for every interested
+        # unclaimed task (scheduler.ts pick-on-leave).
+        self._evaluate()

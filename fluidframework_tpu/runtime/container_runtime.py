@@ -30,6 +30,10 @@ class ContainerRuntime:
         # stored handle to them (containerRuntime.ts createRootDataStore).
         self.root_datastores: set[str] = set()
         self.pending = PendingStateManager()
+        # Client seqs of ops voided by a lost concurrent-create race: their
+        # echoes apply as REMOTE ops (the local state they referenced was
+        # replaced by the winner's snapshot) — see process_attach.
+        self._voided: set[int] = set()
 
     # -- data store lifecycle -------------------------------------------------
 
@@ -114,7 +118,14 @@ class ContainerRuntime:
     def process_attach(self, message: SequencedDocumentMessage,
                        local: bool) -> None:
         if local:
-            self.pending.process_own_message(message.client_sequence_number)
+            if message.client_sequence_number in self._voided:
+                # Echo of OUR losing create in a concurrent-create race:
+                # the winner's snapshot was already adopted; drop it (every
+                # remote replica ignores this second attach too).
+                self._voided.discard(message.client_sequence_number)
+            else:
+                self.pending.process_own_message(
+                    message.client_sequence_number)
             return
         contents = message.contents
         if contents["id"] in self.datastores:
@@ -123,6 +134,19 @@ class ContainerRuntime:
             # replica converges regardless of arrival order).
             if contents["root"]:
                 self.root_datastores.add(contents["id"])
+            # If OUR create of this id is still pending, the remote attach
+            # is the first-sequenced winner: adopt its snapshot, void our
+            # pending attach + ops (their echoes re-apply as remote ops so
+            # all replicas process the loser's ops identically). Matches the
+            # reference's alias resolution for well-known ids
+            # (containerRuntime.ts createRootDataStore / alias ops).
+            voided = self.pending.void_datastore(contents["id"])
+            if voided:
+                self._voided |= voided
+                # Adopt in place: held DataStoreRuntime AND channel object
+                # references stay valid, with their state reloaded from the
+                # winner's snapshot (see DataStoreRuntime.adopt).
+                self.datastores[contents["id"]].adopt(contents["snapshot"])
             return
         datastore = DataStoreRuntime(contents["id"], self, self.registry)
         self.datastores[contents["id"]] = datastore
@@ -130,14 +154,39 @@ class ContainerRuntime:
         if contents["root"]:
             self.root_datastores.add(contents["id"])
 
+    def void_channel(self, datastore_id: str, channel_id: str) -> bool:
+        """Void our pending create of a channel that lost a same-id race
+        (see PendingStateManager.void_channel); True if anything voided."""
+        voided = self.pending.void_channel(datastore_id, channel_id)
+        self._voided |= voided
+        return bool(voided)
+
+    def void_channel_ops(self, datastore_id: str, channel_id: str) -> None:
+        """Unconditionally void pending ops against a channel whose state is
+        being replaced by an adopting attach_channel."""
+        self._voided |= self.pending.void_channel_ops(
+            datastore_id, channel_id)
+
     # -- inbound --------------------------------------------------------------
 
     def process(self, message: SequencedDocumentMessage, local: bool) -> None:
         assert message.type == MessageType.OPERATION
         local_op_metadata = None
         if local:
-            local_op_metadata = self.pending.process_own_message(
-                message.client_sequence_number)
+            if message.client_sequence_number in self._voided:
+                # Own op voided by a lost create race: the channel state it
+                # was submitted against is gone (replaced by the winner's
+                # snapshot) — apply it as a remote op, exactly as every other
+                # replica does. The sentinel tells merge engines to exclude
+                # local unacked state from visibility despite the author id
+                # being our own.
+                from ..dds.shared_object import VOIDED_LOCAL_ECHO
+                self._voided.discard(message.client_sequence_number)
+                local = False
+                local_op_metadata = VOIDED_LOCAL_ECHO
+            else:
+                local_op_metadata = self.pending.process_own_message(
+                    message.client_sequence_number)
         envelope = message.contents
         datastore = self.datastores[envelope["address"]]
         datastore.process(
@@ -151,6 +200,16 @@ class ContainerRuntime:
     def replay_pending(self) -> None:
         """Resubmit every unacked op through the owning channel so it can
         regenerate/restamp (containerRuntime.ts replayPendingStates)."""
+        # Ops pending against still-unadopted channels must not replay (the
+        # state they target is provisional — if their adopting
+        # attach_channel was sequenced, catch-up delivers it and the old
+        # ops as remote ops from our previous identity).
+        for datastore in self.datastores.values():
+            datastore.void_adoption_pending_ops()
+        # Voided ops from a lost create race never echo across a reconnect
+        # under the OLD client seqs (client seqs restart with the new
+        # connection) — clear so stale entries can't void fresh ops.
+        self._voided.clear()
         for item in self.pending.drain_for_replay():
             envelope = item.contents
             if envelope.get("type") == "attach":

@@ -42,6 +42,11 @@ class DataStoreRuntime:
         # reference stores as the data store's package path so the right
         # DataObject class re-instantiates on load (dataStoreContext.ts).
         self.attributes: dict = attributes or {}
+        # Channel ids created by ops voided in a lost concurrent-create
+        # race: the first-sequenced attach_channel to arrive (the winner's,
+        # or our own voided echo) reloads its snapshot into the existing
+        # object in place. See adopt()/process().
+        self._adoption_pending: set[str] = set()
 
     @property
     def handle(self):
@@ -59,13 +64,15 @@ class DataStoreRuntime:
         already-built ``summarize()`` result to scan it instead of
         re-serializing channel state."""
         from .handles import collect_handle_routes
-        graph = {f"/{self.id}": [f"/{self.id}/{cid}" for cid in self.channels]}
-        for channel_id, channel in self.channels.items():
+        live = [cid for cid in self.channels
+                if cid not in self._adoption_pending]
+        graph = {f"/{self.id}": [f"/{self.id}/{cid}" for cid in live]}
+        for channel_id in live:
             if summary is not None:
                 routes = collect_handle_routes(
                     summary["channels"][channel_id]["content"])
             else:
-                routes = channel.get_gc_data()
+                routes = self.channels[channel_id].get_gc_data()
             graph[f"/{self.id}/{channel_id}"] = routes
         return graph
 
@@ -108,12 +115,26 @@ class DataStoreRuntime:
                 local_op_metadata: Any) -> None:
         envelope = message.contents
         if envelope.get("type") == "attach_channel":
-            if not local and envelope["address"] not in self.channels:
-                snapshot = envelope["snapshot"]
-                channel = self.registry.get(
-                    snapshot["attributes"]["type"]).load(
-                        self, envelope["address"], snapshot)
-                self._bind(channel)
+            if local:
+                return
+            address = envelope["address"]
+            if address not in self.channels:
+                self._adopt_channel(address, envelope["snapshot"])
+                return
+            if address in self._adoption_pending:
+                # Datastore-race leftover: the FIRST sequenced
+                # attach_channel for this id (winner's, or our own voided
+                # echo) defines its state on every replica.
+                self._adopt_channel(address, envelope["snapshot"])
+                return
+            # Same-id channel create race on a shared datastore: if OUR
+            # create of this channel is still pending, the remote
+            # attach_channel sequenced first — adopt its snapshot and void
+            # our pending create + ops (their echoes re-apply as remote
+            # ops, like every replica). Otherwise our create already won:
+            # ignore the later one (all replicas do).
+            if self.parent.void_channel(self.id, address):
+                self._adopt_channel(address, envelope["snapshot"])
             return
         channel = self.channels[envelope["address"]]
         channel.process(
@@ -132,14 +153,64 @@ class DataStoreRuntime:
         channel = self.channels[envelope["address"]]
         channel.resubmit(envelope["contents"], local_op_metadata)
 
+    def adopt(self, snapshot: dict) -> None:
+        """Replace this store's state with a concurrent-create winner's
+        snapshot IN PLACE: channels sharing id+type reload their state into
+        the existing objects, so references held by app code stay live (and
+        keep submitting/processing against the adopted state). Channels
+        absent from the winner's snapshot were announced by our now-voided
+        attach_channel ops — they stay, marked adoption-pending, and the
+        first-sequenced attach_channel to arrive for that id (the winner's
+        or our own voided echo) reloads its snapshot into them, which is
+        exactly the state every remote replica builds."""
+        self.attributes = snapshot.get("attributes", {})
+        winner_channels = snapshot["channels"]
+        for channel_id in self.channels:
+            if channel_id not in winner_channels:
+                self._adoption_pending.add(channel_id)
+        for channel_id, channel_snapshot in winner_channels.items():
+            self._adopt_channel(channel_id, channel_snapshot)
+
+    def _adopt_channel(self, channel_id: str, snapshot: dict) -> None:
+        """Reload a channel snapshot into the existing object (keeping its
+        identity) when the types agree, else rebind a fresh instance. Any
+        local ops still pending against the pre-adopt state are voided —
+        their echoes apply as remote ops, exactly as every replica applies
+        them to the adopted state."""
+        self._adoption_pending.discard(channel_id)
+        self.parent.void_channel_ops(self.id, channel_id)
+        channel_type = snapshot["attributes"]["type"]
+        existing = self.channels.get(channel_id)
+        if (existing is not None
+                and existing.attributes.get("type") == channel_type):
+            existing.load(snapshot)
+        else:
+            self._bind(self.registry.get(channel_type).load(
+                self, channel_id, snapshot))
+
+    def void_adoption_pending_ops(self) -> None:
+        """Reconnect while channel adoptions are still unresolved: pending
+        ops against those channels must not replay (the state they were
+        recorded against is provisional; if the adopting attach_channel was
+        sequenced it arrives in catch-up and its ops with it). The channels
+        themselves stay, still marked — catch-up may yet adopt them, and
+        until then summarize()/GC exclude them."""
+        for channel_id in self._adoption_pending:
+            self.parent.void_channel_ops(self.id, channel_id)
+
     # -- summary --------------------------------------------------------------
 
     def summarize(self) -> dict:
+        # Adoption-pending channels are provisional local state: on every
+        # other replica they either do not exist yet or will be defined by
+        # the first-sequenced attach_channel — excluding them keeps
+        # summaries byte-identical across replicas during the race window.
         return {
             "attributes": dict(sorted(self.attributes.items())),
             "channels": {
                 channel_id: channel.summarize()
                 for channel_id, channel in sorted(self.channels.items())
+                if channel_id not in self._adoption_pending
             },
         }
 

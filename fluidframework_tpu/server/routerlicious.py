@@ -51,10 +51,13 @@ class DeliDocumentLambda:
         self._bus = bus
         cp = store.get(f"deli/{doc_id}")
         if cp is not None:
+            cp = dict(cp)
+            self._summary_responded = cp.pop("summary_responded", 0)
             self.sequencer = DocumentSequencer.restore(
                 SequencerCheckpoint(**cp))
             self._last_offset = cp["log_offset"]
         else:
+            self._summary_responded = 0
             self.sequencer = sequencer_factory()
             self._last_offset = -1
 
@@ -63,6 +66,20 @@ class DeliDocumentLambda:
             return  # replayed below our checkpoint (deli/lambda.ts:148-151)
         self._last_offset = message.offset
         raw: RawOperation = message.value
+        if raw.client_id is None and raw.type in (MessageType.SUMMARY_ACK,
+                                                  MessageType.SUMMARY_NACK):
+            # Scribe crash-replay can re-produce its response to the same
+            # SUMMARIZE op as a NEW raw message (fresh offset, so the offset
+            # guard above can't catch it). Proposal seqs are unique and
+            # monotonic — dedupe here, where the checkpoint is atomic with
+            # the consumed offset, so the drop survives our own replay too.
+            # Service-produced only (client_id None): a client-forged ack is
+            # NACKed by the sequencer and must not poison the watermark.
+            sseq = (raw.contents or {}).get(
+                "summary_proposal", {}).get("summary_sequence_number", 0)
+            if sseq <= self._summary_responded:
+                return
+            self._summary_responded = sseq
         ticket = self.sequencer.ticket(raw)
         if ticket.kind == oc.OUT_NACK:
             self._bus.produce(DELTAS, self.doc_id, {
@@ -99,6 +116,7 @@ class DeliDocumentLambda:
             "nack_future": cp.nack_future,
             "client_timeout_ms": cp.client_timeout_ms,
             "log_offset": cp.log_offset,
+            "summary_responded": self._summary_responded,
         })
 
 

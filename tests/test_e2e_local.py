@@ -150,6 +150,144 @@ class TestConflictsAndInterleaving:
         assert c1.summarize() == c2.summarize() == c3.summarize()
 
 
+class TestConcurrentCreateRace:
+    def test_racing_creates_of_same_datastore_converge(self):
+        # Two clients race to create the same well-known datastore id while
+        # blind to each other (inbound paused). The first-sequenced attach
+        # wins the state; the loser adopts the winner's snapshot and its
+        # already-submitted ops apply as remote ops on every replica.
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = open_doc(server)
+        c1.inbound.pause()
+        c2.inbound.pause()
+        ds1 = c1.runtime.create_datastore("shared")
+        m1 = ds1.create_channel("data", SharedMap.channel_type)
+        m1.set("who", "c1")
+        m1.set("only1", 1)
+        ds2 = c2.runtime.create_datastore("shared")
+        m2 = ds2.create_channel("data", SharedMap.channel_type)
+        m2.set("who", "c2")
+        m2.set("only2", 2)
+        c1.inbound.resume()
+        c2.inbound.resume()
+        # c2's writes were sequenced later → LWW winner for the shared key;
+        # both clients' unique keys survive on the adopted store.
+        got1 = dict(c1.runtime.get_datastore("shared")
+                    .get_channel("data").items())
+        got2 = dict(c2.runtime.get_datastore("shared")
+                    .get_channel("data").items())
+        assert got1 == got2 == {"who": "c2", "only1": 1, "only2": 2}
+        assert c1.summarize() == c2.summarize()
+        # Adoption is in place: BOTH the DataStoreRuntime and the channel
+        # object identities survive, so held references stay live...
+        assert c2.runtime.get_datastore("shared") is ds2
+        assert c2.runtime.get_datastore("shared").get_channel("data") is m2
+        # ...and post-race writes through the held references converge.
+        m2.set("after", "race")
+        m1.set("also", "fine")
+        got1 = dict(m1.items())
+        got2 = dict(m2.items())
+        assert got1 == got2 == {"who": "c2", "only1": 1, "only2": 2,
+                                "after": "race", "also": "fine"}
+        assert c1.summarize() == c2.summarize()
+
+    def test_racing_channel_creates_on_shared_datastore(self):
+        # Same-id CHANNEL race on an already-shared datastore: the
+        # first-sequenced attach_channel wins; the loser adopts in place.
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = open_doc(server)
+        c1.runtime.create_datastore("shared")
+        ds2 = c2.runtime.get_datastore("shared")
+        ds1 = c1.runtime.get_datastore("shared")
+        c1.inbound.pause()
+        c2.inbound.pause()
+        m1 = ds1.create_channel("m", SharedMap.channel_type)
+        m1.set("who", "c1")
+        m2 = ds2.create_channel("m", SharedMap.channel_type)
+        m2.set("who", "c2")
+        c1.inbound.resume()
+        c2.inbound.resume()
+        assert ds2.get_channel("m") is m2  # loser adopted in place
+        got1, got2 = dict(m1.items()), dict(m2.items())
+        assert got1 == got2 == {"who": "c2"}
+        m2.set("post", 1)
+        assert dict(m1.items()) == dict(m2.items())
+        assert c1.summarize() == c2.summarize()
+
+    def test_write_during_adoption_window_converges(self):
+        # The loser writes through its held channel reference AFTER adopting
+        # the winner's datastore but BEFORE the adopting attach_channel
+        # arrives: that op's pending state targets the pre-adopt kernel, so
+        # it must be voided at adoption and its echo applied as a remote op.
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = open_doc(server)
+        c1.inbound.pause()
+        c2.inbound.pause()
+        ds1 = c1.runtime.create_datastore("shared")
+        m1 = ds1.create_channel("m", SharedMap.channel_type)
+        m1.set("who", "c1")
+        ds2 = c2.runtime.create_datastore("shared")
+        m2 = ds2.create_channel("m", SharedMap.channel_type)
+        m2.set("who", "c2")
+        c1.inbound.resume()
+        # Step exactly one message on c2: the winner's attach → adoption;
+        # channel "m" is now adoption-pending.
+        assert c2.inbound.process_one()
+        assert "m" in ds2._adoption_pending
+        m2.set("window", 1)  # written against the provisional state
+        c2.inbound.resume()
+        got1, got2 = dict(m1.items()), dict(m2.items())
+        assert got1 == got2 == {"who": "c2", "window": 1}
+        assert c1.summarize() == c2.summarize()
+
+    def test_reconnect_during_adoption_window(self):
+        # The loser disconnects mid-window with an unsent write pending on
+        # an unadopted channel: replay must not crash, the provisional write
+        # is dropped, and catch-up delivers the adopting attach_channel so
+        # replicas converge (held channel reference stays live).
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = open_doc(server)
+        c1.inbound.pause()
+        c2.inbound.pause()
+        ds1 = c1.runtime.create_datastore("shared")
+        m1 = ds1.create_channel("m", SharedMap.channel_type)
+        m1.set("who", "c1")
+        ds2 = c2.runtime.create_datastore("shared")
+        m2 = ds2.create_channel("m", SharedMap.channel_type)
+        m2.set("who", "c2")
+        c1.inbound.resume()
+        assert c2.inbound.process_one()  # adoption; "m" pending
+        c2.disconnect()
+        m2.set("lost", 1)  # never sent: provisional AND disconnected
+        # While unadopted, the provisional channel stays out of summaries.
+        assert "m" not in (c2.summarize()["runtime"]["datastores"]
+                           ["shared"]["channels"])
+        c2.inbound.resume()  # release the test's pause (disconnect holds its own)
+        c2.reconnect()
+        assert c2.runtime.get_datastore("shared").get_channel("m") is m2
+        got1, got2 = dict(m1.items()), dict(m2.items())
+        assert got1 == got2 == {"who": "c2"}, (got1, got2)
+        assert c1.summarize() == c2.summarize()
+
+    def test_late_create_with_sequenced_attach_keeps_state(self):
+        # Not a race: c1's attach is long since sequenced; c2 opening and
+        # writing must not void anything on c1.
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        ds1 = c1.runtime.create_datastore("shared")
+        m1 = ds1.create_channel("data", SharedMap.channel_type)
+        m1.set("k", "v")
+        c2 = open_doc(server)
+        m2 = c2.runtime.get_datastore("shared").get_channel("data")
+        m2.set("k2", "v2")
+        assert dict(m1.items()) == dict(m2.items()) == {"k": "v", "k2": "v2"}
+        assert c1.summarize() == c2.summarize()
+
+
 class TestSummaryAndCatchup:
     def test_late_joiner_loads_summary_plus_trailing_deltas(self):
         server = LocalCollabServer()

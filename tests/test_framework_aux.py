@@ -200,6 +200,46 @@ class TestUndoRedo:
                    if seg.length and seg.removed_seq is None)
         assert seg.props == {"weight": "bold"}
 
+    def test_string_annotate_undo_redo(self):
+        server = LocalCollabServer()
+        c1 = _doc(server, ("s", SharedString))
+        c2 = _open(server)
+        s = _chan(c1, "s")
+        undo = UndoRedoStackManager()
+        undo.subscribe_string(s)
+        s.insert_text(0, "hello world")
+        undo.close_current_operation()
+        # Range spans two differently-propped regions: each segment must
+        # revert to ITS prior value, not a blanket one.
+        s.annotate_range(0, 5, {"weight": "bold"})
+        undo.close_current_operation()
+        s.annotate_range(3, 8, {"weight": "heavy", "style": "italic"})
+        undo.close_current_operation()
+
+        def props_at(i):
+            pos = 0
+            for seg in s.engine.segments:
+                vis = s.engine._vis_len(seg, s.engine.current_seq,
+                                        s.engine.local_client)
+                if vis and pos <= i < pos + vis:
+                    return dict(seg.props or {})
+                pos += vis
+            raise IndexError(i)
+
+        assert props_at(0) == {"weight": "bold"}
+        assert props_at(4) == {"weight": "heavy", "style": "italic"}
+        assert props_at(7) == {"weight": "heavy", "style": "italic"}
+        undo.undo()
+        assert props_at(4) == {"weight": "bold"}
+        assert props_at(7) == {}
+        undo.undo()
+        assert props_at(0) == {} and props_at(4) == {}
+        undo.redo()
+        assert props_at(0) == {"weight": "bold"}
+        undo.redo()
+        assert props_at(4) == {"weight": "heavy", "style": "italic"}
+        assert c1.summarize() == c2.summarize()
+
     def test_string_undo_redo_converges(self):
         server = LocalCollabServer()
         c1 = _doc(server, ("s", SharedString))

@@ -47,6 +47,44 @@ class TestEngineSemantics:
         o.apply_remote({"type": "insert", "pos": 0, "text": "X"}, 2, 0, "a")
         assert o.get_text() == "XY"
 
+    def test_foreign_self_excludes_local_unacked_state(self):
+        # A VOIDED_LOCAL_ECHO applies an op authored by the local client as
+        # remotes do: local pending inserts/removes must not shift positions
+        # (no other replica has them).
+        e = MergeEngine("a")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "base"}, 1, 0, "x")
+        e.insert_local(0, "PEND")  # unacked; invisible to every remote
+        # Echo of our own voided op: insert at pos 2 of the view WITHOUT
+        # the pending text — lands inside "base", not inside "PEND".
+        e.apply_remote({"type": "insert", "pos": 2, "text": "_"}, 2, 1, "a",
+                       foreign_self=True)
+        assert e.get_text() == "PENDba_se"
+        # An observer applying the same stream converges (modulo the
+        # pending text it cannot see yet).
+        o = MergeEngine("obs")
+        o.apply_remote({"type": "insert", "pos": 0, "text": "base"}, 1, 0, "x")
+        o.apply_remote({"type": "insert", "pos": 2, "text": "_"}, 2, 1, "a")
+        assert o.get_text() == "ba_se"
+
+    def test_foreign_self_pending_remove_stays_visible(self):
+        e = MergeEngine("a")
+        e.apply_remote({"type": "insert", "pos": 0, "text": "abcdef"},
+                       1, 0, "x")
+        e.remove_local(0, 3)  # pending remove hides "abc" locally only
+        # Voided echo removes [1, 3) of the view remotes see ("abcdef"),
+        # i.e. "bc" — resolved as if our pending remove did not exist.
+        e.apply_remote({"type": "remove", "start": 1, "end": 3}, 2, 1, "a",
+                       foreign_self=True)
+        o = MergeEngine("obs")
+        o.apply_remote({"type": "insert", "pos": 0, "text": "abcdef"},
+                       1, 0, "x")
+        o.apply_remote({"type": "remove", "start": 1, "end": 3}, 2, 1, "a")
+        assert o.get_text() == "adef"
+        # After our pending remove acks, both replicas show "def".
+        e.ack(3)
+        o.apply_remote({"type": "remove", "start": 0, "end": 1}, 3, 1, "a")
+        assert e.get_text() == o.get_text() == "def"
+
     def test_insert_into_concurrently_removed_range(self):
         # B inserts into a range A removed concurrently: the insert survives.
         o = MergeEngine("obs")

@@ -127,6 +127,37 @@ class TestRestartRecovery:
         assert parts(c3)[1].value == 3
         assert c2.summarize() == c3.summarize()
 
+    def test_scribe_crash_replay_does_not_duplicate_summary_ack(self):
+        # Crash window: scribe produced its SUMMARY_ACK into rawdeltas but
+        # died before committing its offsets. The replayed SUMMARIZE op makes
+        # scribe produce a SECOND ack raw-op (new offset) — deli must dedupe
+        # by summary_sequence_number so only one sequenced ack exists.
+        bus, store = MessageBus(), StateStore()
+        server1 = RouterliciousService(bus, store)
+        c1 = make_doc(server1)
+        parts(c1)[1].increment(5)
+        manager = SummaryManager(c1, SummaryConfig(max_ops=1000))
+        handle = manager.summarize_now()
+        assert handle is not None
+
+        from fluidframework_tpu.protocol.messages import MessageType
+        acks_before = sum(
+            1 for m in store.get("ops/doc")
+            if m.type == MessageType.SUMMARY_ACK)
+        assert acks_before == 1
+
+        # Wipe scribe's committed offsets: a new instance replays deltas
+        # (including the SUMMARIZE op) from the beginning.
+        for key in list(bus._offsets):
+            if key[1] == "scribe":
+                del bus._offsets[key]
+        server2 = RouterliciousService(bus, store)
+        server2.pump()
+        acks_after = sum(
+            1 for m in store.get("ops/doc")
+            if m.type == MessageType.SUMMARY_ACK)
+        assert acks_after == 1, "replayed SUMMARIZE must not re-ack"
+
     def test_scriptorium_idempotent_on_replay(self):
         bus, store = MessageBus(), StateStore()
         server1 = RouterliciousService(bus, store)
