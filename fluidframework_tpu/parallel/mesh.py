@@ -45,3 +45,27 @@ def shard_state(tree, mesh: Mesh):
 
 def doc_count_for_mesh(mesh: Mesh, per_device: int) -> int:
     return mesh.devices.size * per_device
+
+
+def aggregate_metrics(mesh: Mesh, tree):
+    """All-reduce [B]-leading metric leaves over the docs axis via psum.
+
+    The one collective in the system: per-shard partial sums of each metric
+    (ops sequenced, queue depth, ...) are psum'ed across the mesh so every
+    device — and the host — sees the global totals. The merge path itself
+    stays collective-free (reference analog: per-lambda metric counters
+    aggregated off the hot path, services-core/src/metricClient.ts).
+    """
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+
+    def local_reduce(*xs):
+        return tuple(
+            jax.lax.psum(jnp.sum(x, axis=0), DOCS_AXIS) for x in xs)
+
+    fn = jax.shard_map(
+        local_reduce, mesh=mesh,
+        in_specs=tuple(PartitionSpec(DOCS_AXIS) for _ in leaves),
+        out_specs=tuple(PartitionSpec() for _ in leaves))
+    return jax.tree_util.tree_unflatten(treedef, fn(*leaves))

@@ -1,0 +1,130 @@
+"""Mesh sharding tests: the document axis sharded over 8 virtual devices.
+
+Validates the framework's multi-chip thesis (SURVEY.md §2.9: documents are
+the data-parallel axis; the merge path needs no collectives) on the CPU
+mesh that conftest.py provisions: every kernel runs sharded over 8 devices
+with output shards resident on all of them, bit-identical to the unsharded
+run, and metrics aggregate via the one psum collective.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import map_kernel as mk
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.ops import sequencer as seqk
+from fluidframework_tpu.parallel import mesh as pmesh
+from fluidframework_tpu.protocol.messages import MessageType
+
+NUM_DOCS = 16  # 2 per device on the 8-device mesh
+
+
+@pytest.fixture(scope="module")
+def mesh(cpu_mesh_devices):
+    return pmesh.make_mesh(cpu_mesh_devices[:8])
+
+
+def _devices_holding(arr):
+    return {shard.device for shard in arr.addressable_shards}
+
+
+def _assert_match_and_sharded(sharded_out, plain_out, mesh):
+    """Every leaf bit-identical to the unsharded run; leading-axis leaves
+    resident on all mesh devices."""
+    s_leaves = jax.tree_util.tree_leaves(sharded_out)
+    p_leaves = jax.tree_util.tree_leaves(plain_out)
+    assert len(s_leaves) == len(p_leaves)
+    n_dev = mesh.devices.size
+    for s, p in zip(s_leaves, p_leaves):
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(p))
+        assert len(_devices_holding(s)) == n_dev
+
+
+def _seq_inputs():
+    state = seqk.init_state(NUM_DOCS, num_slots=8)
+    ops = seqk.make_op_batch(
+        [[dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=0,
+               timestamp=1),
+          dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=1,
+               timestamp=1),
+          dict(kind=int(MessageType.OPERATION), slot=0, client_seq=1,
+               ref_seq=1, timestamp=2),
+          dict(kind=int(MessageType.OPERATION), slot=1, client_seq=1,
+               ref_seq=2, timestamp=3),
+          # dup: same client_seq again → ignored
+          dict(kind=int(MessageType.OPERATION), slot=1, client_seq=1,
+               ref_seq=2, timestamp=4)]
+         for _ in range(NUM_DOCS)], NUM_DOCS, k=6)
+    return state, ops
+
+
+def test_sequencer_sharded_matches_unsharded(mesh):
+    state, ops = _seq_inputs()
+    plain_state, plain_tickets = seqk.process_batch(state, ops)
+
+    s_state = pmesh.shard_state(state, mesh)
+    s_ops = pmesh.shard_state(ops, mesh)
+    out_state, out_tickets = seqk.process_batch(s_state, s_ops)
+    jax.block_until_ready(out_state)
+
+    _assert_match_and_sharded(out_state, plain_state, mesh)
+    _assert_match_and_sharded(out_tickets, plain_tickets, mesh)
+
+
+def test_merge_kernel_sharded_matches_unsharded(mesh):
+    rng = np.random.default_rng(7)
+    state = mtk.init_state(NUM_DOCS, num_slots=32)
+    ops = mtk.make_merge_op_batch(
+        [[dict(kind=mtk.MT_INSERT, pos=0, seq=1, ref_seq=0, client=0,
+               pool_start=0, text_len=12),
+          dict(kind=mtk.MT_INSERT, pos=int(rng.integers(0, 12)), seq=2,
+               ref_seq=1, client=1, pool_start=12, text_len=6),
+          dict(kind=mtk.MT_REMOVE, pos=1, end=4, seq=3, ref_seq=2,
+               client=0)]
+         for _ in range(NUM_DOCS)], NUM_DOCS, k=4)
+
+    plain = mtk.apply_tick(state, ops)
+    out = mtk.apply_tick(pmesh.shard_state(state, mesh),
+                         pmesh.shard_state(ops, mesh))
+    jax.block_until_ready(out)
+    _assert_match_and_sharded(out, plain, mesh)
+
+
+def test_map_kernel_sharded_matches_unsharded(mesh):
+    state = mk.init_state(NUM_DOCS, num_slots=16)
+    ops = mk.make_map_op_batch(
+        [[dict(kind=mk.MAP_SET, slot=3, value=41, seq=1),
+          dict(kind=mk.MAP_SET, slot=3, value=42, seq=2),
+          dict(kind=mk.MAP_DELETE, slot=5, seq=3)]
+         for _ in range(NUM_DOCS)], NUM_DOCS, k=4)
+
+    plain = mk.apply_tick(state, ops)
+    out = mk.apply_tick(pmesh.shard_state(state, mesh),
+                        pmesh.shard_state(ops, mesh))
+    jax.block_until_ready(out)
+    _assert_match_and_sharded(out, plain, mesh)
+
+
+def test_aggregate_metrics_psum(mesh):
+    state, ops = _seq_inputs()
+    s_state, s_ops = (pmesh.shard_state(state, mesh),
+                      pmesh.shard_state(ops, mesh))
+    out_state, tickets = seqk.process_batch(s_state, s_ops)
+
+    totals = pmesh.aggregate_metrics(
+        mesh, {"seq": out_state.seq,
+               "sequenced": (tickets.kind == 1).astype(jnp.int32)})
+    # 4 revs per doc (2 joins + 2 ops; the dup is ignored).
+    assert int(totals["seq"]) == NUM_DOCS * 4
+    # sequenced tickets: [B, K] leaf reduces over docs leaving [K] — sum it.
+    assert int(jnp.sum(totals["sequenced"])) == NUM_DOCS * 4
+    # Result is replicated (a true all-reduce), not sharded.
+    assert len(_devices_holding(totals["seq"])) == mesh.devices.size
+
+
+def test_dryrun_impl_runs_on_virtual_mesh():
+    import __graft_entry__ as g
+
+    g._dryrun_impl(8)
