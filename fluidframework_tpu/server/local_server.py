@@ -81,11 +81,14 @@ class LocalCollabServer:
     """In-memory multi-document ordering + storage service."""
 
     def __init__(self, sequencer_factory: Callable[[], DocumentSequencer]
-                 = DocumentSequencer) -> None:
+                 = DocumentSequencer, merge_host=None) -> None:
         self._sequencer_factory = sequencer_factory
         self._documents: dict[str, _Document] = {}
         self._client_counter = itertools.count(1)
         self._clock = itertools.count(1)  # deterministic timestamps
+        # Optional KernelMergeHost: every sequenced message also feeds the
+        # device-resident server replica (server/merge_host.py).
+        self.merge_host = merge_host
 
     def _document(self, doc_id: str) -> _Document:
         if doc_id not in self._documents:
@@ -243,6 +246,8 @@ class LocalCollabServer:
             data=raw.data,
         )
         document.log.append(sequenced)
+        if self.merge_host is not None:
+            self.merge_host.ingest(document.doc_id, sequenced)
         document.delivery.append(sequenced)
         if document.delivering:
             return

@@ -1,0 +1,511 @@
+"""KernelMergeHost — device-resident converged document state on the server.
+
+Reference parity: the *server-observed* hot loops of the reference — the
+merge-tree sequenced apply path (packages/dds/merge-tree/src/mergeTree.ts:
+1974 insertingWalk, 2626 markRangeRemoved, 2584 annotateRange) and the
+SharedMap message fold (packages/dds/map/src/mapKernel.ts:510
+tryProcessMessage) — hosted *behind the service seams* as one batched
+device program, per SURVEY.md §7 / BASELINE.json: every (document,
+channel) is a row of :class:`~fluidframework_tpu.ops.mergetree_kernel.
+MergeState` or :class:`~fluidframework_tpu.ops.map_kernel.MapState`; a
+service tick applies the pending sequenced ops of *all* channels in one
+``apply_tick`` call (vmap over the row axis — the workload's data-parallel
+axis, shardable over the device mesh via
+:func:`fluidframework_tpu.parallel.mesh.shard_state`).
+
+The host owns what the kernels cannot:
+
+* string→int mappings (client id → slot lane, property key → key slot,
+  value → interned id, text → pool offsets);
+* capacity management — before each flush it checks
+  :func:`~fluidframework_tpu.ops.mergetree_kernel.capacity_margin`,
+  runs the device zamboni (:func:`~fluidframework_tpu.ops.
+  mergetree_kernel.compact`) on rows under pressure, and grows the slot
+  axes (doubling) when compaction is not enough;
+* overflow routing — a channel that exceeds the device client-slot
+  bitmask (``MAX_CLIENT_SLOTS``) is re-routed to the scalar
+  :class:`~fluidframework_tpu.dds.mergetree.MergeEngine` by replaying its
+  full op log (the "route over-capacity documents to the scalar path"
+  contract from ``capacity_margin``'s docstring);
+* summaries — converged channel contents materialized from device state.
+
+Wire in: feed every sequenced message via :meth:`ingest` (LocalCollabServer
+does this from its broadcast path; RouterliciousService via the merger
+lambda in routerlicious.py). Ops buffer host-side and hit the device in
+ticks — either when ``pending_ops`` crosses ``flush_threshold`` or when a
+reader forces :meth:`flush`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dds.mergetree import MergeEngine
+from ..ops import map_kernel as mk
+from ..ops import mergetree_kernel as mtk
+from ..protocol.messages import MessageType, SequencedDocumentMessage
+from .kernel_host import _next_pow2
+
+_MERGE_OPS = frozenset({"insert", "remove", "annotate", "group"})
+_MAP_OPS = frozenset({"set", "delete", "clear"})
+
+# A marker occupies one pool char; stripped at materialization. Real text
+# never contains NUL (the wire format is JSON-ish strings).
+_MARKER_CHAR = "\x00"
+
+
+class ChannelKey(NamedTuple):
+    doc_id: str
+    datastore: str
+    channel: str
+
+
+class _MergeRow:
+    __slots__ = ("row", "client_slots", "key_slots", "pending", "raw_log",
+                 "scalar", "min_seq", "last_seq", "markers")
+
+    def __init__(self, row: int) -> None:
+        self.row = row
+        self.client_slots: dict[str, int] = {}
+        self.key_slots: dict[str, int] = {}
+        self.pending: list[dict] = []
+        # Full sequenced history (subop, seq, ref_seq, client) — the replay
+        # source if this channel overflows to the scalar path.
+        self.raw_log: list[tuple[dict, int, int, str]] = []
+        self.scalar: MergeEngine | None = None
+        self.min_seq = 0
+        self.last_seq = 0
+        self.markers = 0
+
+
+class _MapRow:
+    __slots__ = ("row", "key_slots", "pending", "last_seq")
+
+    def __init__(self, row: int) -> None:
+        self.row = row
+        self.key_slots: dict[str, int] = {}
+        self.pending: list[dict] = []
+        self.last_seq = 0
+
+
+def _pad_axis(a, axis: int, extra: int, fill):
+    a = np.asarray(a)
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, extra)
+    return np.pad(a, widths, constant_values=fill)
+
+
+_MERGE_FILL = dict(valid=False, length=0, ins_seq=0, ins_client=-1,
+                   rem_seq=int(mtk.NONE_SEQ), rem_client=-1, rem_overlap=0,
+                   pool_start=0, prop_val=0, count=0)
+_MAP_FILL = dict(present=False, value=0, vseq=-1, cleared_seq=-1)
+
+
+class KernelMergeHost:
+    """Batched device host for the merge-tree and map apply kernels."""
+
+    def __init__(self, merge_slots: int = 128, map_slots: int = 32,
+                 num_props: int = 4, row_capacity: int = 8,
+                 flush_threshold: int = 256) -> None:
+        self._merge_capacity = max(1, row_capacity)
+        self._map_capacity = max(1, row_capacity)
+        self._merge_slots = max(8, merge_slots)
+        self._map_slots = max(4, map_slots)
+        self._num_props = max(1, num_props)
+        self.flush_threshold = flush_threshold
+
+        self._mstate = mtk.init_state(self._merge_capacity, self._merge_slots,
+                                      self._num_props)
+        self._xstate = mk.init_state(self._map_capacity, self._map_slots)
+        self._pool = mtk.TextPool(self._merge_capacity)
+
+        self._merge_rows: dict[ChannelKey, _MergeRow] = {}
+        self._map_rows: dict[ChannelKey, _MapRow] = {}
+        # Shared value interning (map values + annotate values). Id 0 is
+        # reserved for "absent"/None; ids index _val_rev.
+        self._vals: dict[str, int] = {}
+        self._val_rev: list[Any] = [None]
+        self._pending_ops = 0
+        # Counters surfaced by the telemetry layer (ops served by the
+        # device path vs routed to the scalar fallback).
+        self.stats = {"device_ops": 0, "scalar_ops": 0, "flushes": 0,
+                      "compactions": 0, "overflow_routed": 0}
+
+    # -- interning -------------------------------------------------------------
+
+    def _intern(self, value: Any) -> int:
+        if value is None:
+            return 0
+        key = repr(value)
+        vid = self._vals.get(key)
+        if vid is None:
+            vid = len(self._val_rev)
+            self._vals[key] = vid
+            self._val_rev.append(value)
+        return vid
+
+    # -- row allocation / growth -----------------------------------------------
+
+    def _merge_row(self, key: ChannelKey) -> _MergeRow:
+        state = self._merge_rows.get(key)
+        if state is None:
+            row = len(self._merge_rows)
+            if row >= self._merge_capacity:
+                self._grow_merge_rows()
+            state = _MergeRow(row)
+            self._merge_rows[key] = state
+        return state
+
+    def _map_row(self, key: ChannelKey) -> _MapRow:
+        state = self._map_rows.get(key)
+        if state is None:
+            row = len(self._map_rows)
+            if row >= self._map_capacity:
+                self._grow_map_rows()
+            state = _MapRow(row)
+            self._map_rows[key] = state
+        return state
+
+    def _grow_merge_rows(self) -> None:
+        old = self._merge_capacity
+        self._merge_capacity = old * 2
+        self._mstate = jax.device_put(mtk.MergeState(**{
+            f: _pad_axis(getattr(self._mstate, f), 0, old, _MERGE_FILL[f])
+            for f in mtk.MergeState._fields}))
+        self._pool.chunks += [[] for _ in range(old)]
+        self._pool.used += [0] * old
+
+    def _grow_map_rows(self) -> None:
+        old = self._map_capacity
+        self._map_capacity = old * 2
+        self._xstate = jax.device_put(mk.MapState(**{
+            f: _pad_axis(getattr(self._xstate, f), 0, old, _MAP_FILL[f])
+            for f in mk.MapState._fields}))
+
+    def _grow_merge_slots(self, need: int) -> None:
+        new = self._merge_slots
+        while new < need:
+            new *= 2
+        extra = new - self._merge_slots
+        self._mstate = jax.device_put(mtk.MergeState(**{
+            f: (_pad_axis(getattr(self._mstate, f), 1, extra, _MERGE_FILL[f])
+                if f != "count" else np.asarray(self._mstate.count))
+            for f in mtk.MergeState._fields}))
+        self._merge_slots = new
+
+    def _grow_props(self, need: int) -> None:
+        new = self._num_props
+        while new < need:
+            new *= 2
+        extra = new - self._num_props
+        self._mstate = self._mstate._replace(prop_val=jnp.asarray(
+            _pad_axis(self._mstate.prop_val, 2, extra, 0)))
+        self._num_props = new
+
+    def _grow_map_slots(self, need: int) -> None:
+        new = self._map_slots
+        while new < need:
+            new *= 2
+        extra = new - self._map_slots
+        self._xstate = jax.device_put(mk.MapState(**{
+            f: (_pad_axis(getattr(self._xstate, f), 1, extra, _MAP_FILL[f])
+                if f != "cleared_seq" else np.asarray(self._xstate.cleared_seq))
+            for f in mk.MapState._fields}))
+        self._map_slots = new
+
+    # -- ingest ----------------------------------------------------------------
+
+    def ingest(self, doc_id: str, message: SequencedDocumentMessage) -> None:
+        """Feed one sequenced message. Non-channel-ops are ignored; merge and
+        map channel ops are routed to their device rows."""
+        if message.type != MessageType.OPERATION:
+            return
+        envelope = message.contents
+        if not isinstance(envelope, dict) or "address" not in envelope:
+            return
+        inner = envelope.get("contents")
+        if not isinstance(inner, dict) or "address" not in inner:
+            return
+        channel_op = inner.get("contents")
+        if not isinstance(channel_op, dict) or "type" not in channel_op:
+            return
+        key = ChannelKey(doc_id, envelope["address"], inner["address"])
+        kind = channel_op["type"]
+        if kind in _MERGE_OPS:
+            self._ingest_merge(key, channel_op, message)
+        elif kind in _MAP_OPS:
+            self._ingest_map(key, channel_op, message)
+        if self._pending_ops >= self.flush_threshold:
+            self.flush()
+
+    def _ingest_merge(self, key: ChannelKey, channel_op: dict,
+                      message: SequencedDocumentMessage) -> None:
+        row = self._merge_row(key)
+        seq = message.sequence_number
+        if seq <= row.last_seq:
+            return  # bus replay
+        row.last_seq = seq
+        row.min_seq = message.minimum_sequence_number
+        ref_seq = message.reference_sequence_number
+        client = message.client_id
+        subops = (channel_op["ops"] if channel_op["type"] == "group"
+                  else [channel_op])
+        for op in subops:
+            row.raw_log.append((op, seq, ref_seq, client))
+        if row.scalar is not None:
+            for op in subops:
+                row.scalar.apply_remote(op, seq, ref_seq, client)
+            self.stats["scalar_ops"] += len(subops)
+            return
+        if (client not in row.client_slots
+                and len(row.client_slots) >= mtk.MAX_CLIENT_SLOTS):
+            self._route_to_scalar(key, row)
+            self.stats["scalar_ops"] += len(subops)
+            return
+        slot = row.client_slots.setdefault(client, len(row.client_slots))
+        for op in subops:
+            base = dict(seq=seq, ref_seq=ref_seq, client=slot)
+            if op["type"] == "insert":
+                if "text" in op:
+                    text = op["text"]
+                elif "items" in op:
+                    # Item-vector insert (e.g. permutation-vector handles):
+                    # one placeholder char per item keeps every later
+                    # position-based op resolving against correct visible
+                    # lengths; item payloads are opaque to the text plane.
+                    text = _MARKER_CHAR * len(op["items"])
+                    row.markers += len(op["items"])
+                else:
+                    text = _MARKER_CHAR
+                    row.markers += 1
+                enc = dict(base, kind=mtk.MT_INSERT, pos=op["pos"],
+                           pool_start=self._pool.append(row.row, text),
+                           text_len=len(text))
+                row.pending.append(enc)
+                self._pending_ops += 1
+                # An insert may also carry initial props; they apply to the
+                # fresh segment only, which at this seq is exactly the
+                # inserted range.
+                if op.get("props"):
+                    self._encode_annotates(
+                        row, base, op["pos"], op["pos"] + len(text),
+                        op["props"])
+            elif op["type"] == "remove":
+                row.pending.append(dict(base, kind=mtk.MT_REMOVE,
+                                        pos=op["start"], end=op["end"]))
+                self._pending_ops += 1
+            else:  # annotate
+                self._encode_annotates(row, base, op["start"], op["end"],
+                                       op["props"])
+
+    def _encode_annotates(self, row: _MergeRow, base: dict, start: int,
+                          end: int, props: dict) -> None:
+        for prop_key, value in sorted(props.items()):
+            kslot = row.key_slots.setdefault(prop_key, len(row.key_slots))
+            row.pending.append(dict(base, kind=mtk.MT_ANNOTATE, pos=start,
+                                    end=end, prop_key=kslot,
+                                    prop_val=self._intern(value)))
+            self._pending_ops += 1
+
+    def _route_to_scalar(self, key: ChannelKey, row: _MergeRow) -> None:
+        """Client-slot bitmask exhausted: replay the channel's full history
+        through the scalar engine and serve it host-side from now on."""
+        engine = MergeEngine(local_client=None)
+        for op, seq, ref_seq, client in row.raw_log:
+            engine.apply_remote(op, seq, ref_seq, client)
+        row.scalar = engine
+        self._pending_ops -= len(row.pending)
+        row.pending = []
+        # Release the abandoned device row: zeroing its valid mask keeps
+        # later apply_tick/compact passes from dragging stale segments.
+        self._mstate = mtk.MergeState(**{
+            f: (getattr(self._mstate, f).at[row.row].set(
+                _MERGE_FILL[f]) if f != "prop_val"
+                else self._mstate.prop_val.at[row.row].set(0))
+            for f in mtk.MergeState._fields})
+        self.stats["overflow_routed"] += 1
+
+    def _ingest_map(self, key: ChannelKey, channel_op: dict,
+                    message: SequencedDocumentMessage) -> None:
+        row = self._map_row(key)
+        seq = message.sequence_number
+        if seq <= row.last_seq:
+            return
+        row.last_seq = seq
+        kind = channel_op["type"]
+        if kind == "clear":
+            row.pending.append(dict(kind=mk.MAP_CLEAR, seq=seq))
+        else:
+            slot = row.key_slots.setdefault(channel_op["key"],
+                                            len(row.key_slots))
+            if kind == "set":
+                row.pending.append(dict(
+                    kind=mk.MAP_SET, slot=slot, seq=seq,
+                    value=self._intern(channel_op["value"])))
+            else:
+                row.pending.append(dict(kind=mk.MAP_DELETE, slot=slot,
+                                        seq=seq))
+        self._pending_ops += 1
+
+    # -- flush (the device tick) ----------------------------------------------
+
+    def flush(self) -> None:
+        """Apply every pending op: at most one ``apply_tick`` per kernel."""
+        self._flush_merge()
+        self._flush_map()
+        self._pending_ops = 0
+
+    def _flush_merge(self) -> None:
+        rows = [r for r in self._merge_rows.values() if r.pending]
+        if not rows:
+            return
+        # Prop-plane growth before batch encode (key slots are global per
+        # channel but the plane axis is shared).
+        max_props = max((len(r.key_slots) for r in rows), default=0)
+        if max_props > self._num_props:
+            self._grow_props(max_props)
+
+        # Capacity: each op can consume up to 2 fresh slots (split+place /
+        # split+split). Compact rows under pressure; grow if still short.
+        margins = mtk.capacity_margin(self._mstate)
+        need = np.zeros(self._merge_capacity, np.int64)
+        min_seq = np.full(self._merge_capacity, -1, np.int32)
+        for r in rows:
+            need[r.row] = 2 * len(r.pending) + 2
+        short = need > margins
+        if short.any():
+            for r in self._merge_rows.values():
+                if short[r.row]:
+                    min_seq[r.row] = r.min_seq
+            self._mstate = mtk.compact(self._mstate, jnp.asarray(min_seq))
+            self.stats["compactions"] += 1
+            margins = mtk.capacity_margin(self._mstate)
+            still = need > margins
+            if still.any():
+                worst = int((need - margins)[still].max())
+                self._grow_merge_slots(self._merge_slots + _next_pow2(worst))
+
+        k = _next_pow2(max(len(r.pending) for r in rows))
+        per_doc = [[] for _ in range(self._merge_capacity)]
+        for r in rows:
+            per_doc[r.row] = r.pending
+        batch = mtk.make_merge_op_batch(per_doc, self._merge_capacity, k)
+        self._mstate = mtk.apply_tick(self._mstate, batch)
+        self.stats["device_ops"] += sum(len(r.pending) for r in rows)
+        self.stats["flushes"] += 1
+        for r in rows:
+            r.pending = []
+
+    def _flush_map(self) -> None:
+        rows = [r for r in self._map_rows.values() if r.pending]
+        if not rows:
+            return
+        max_keys = max(len(r.key_slots) for r in rows)
+        if max_keys > self._map_slots:
+            self._grow_map_slots(max_keys)
+        k = _next_pow2(max(len(r.pending) for r in rows))
+        per_doc = [[] for _ in range(self._map_capacity)]
+        for r in rows:
+            per_doc[r.row] = r.pending
+        batch = mk.make_map_op_batch(per_doc, self._map_capacity, k)
+        self._xstate = mk.apply_tick(self._xstate, batch)
+        self.stats["device_ops"] += sum(len(r.pending) for r in rows)
+        self.stats["flushes"] += 1
+        for r in rows:
+            r.pending = []
+
+    # -- materialization -------------------------------------------------------
+
+    def channels(self, doc_id: str) -> list[ChannelKey]:
+        return sorted(
+            [k for k in self._merge_rows if k.doc_id == doc_id]
+            + [k for k in self._map_rows if k.doc_id == doc_id])
+
+    def text(self, doc_id: str, datastore: str, channel: str) -> str:
+        """Converged text of a string channel (markers stripped)."""
+        key = ChannelKey(doc_id, datastore, channel)
+        row = self._merge_rows[key]
+        if row.pending:
+            self.flush()
+        if row.scalar is not None:
+            return "".join(
+                seg.content for seg in row.scalar.segments
+                if seg.removed_seq is None and not seg.is_marker
+                and isinstance(seg.content, str))
+        text = mtk.materialize(self._mstate, self._pool, row.row)
+        return text.replace(_MARKER_CHAR, "")
+
+    def rich_text(self, doc_id: str, datastore: str,
+                  channel: str) -> list[tuple[str, dict | None]]:
+        """(text, props) runs of a string channel, markers as ("\\x00", …) —
+        the device-state analog of walking live segments."""
+        key = ChannelKey(doc_id, datastore, channel)
+        row = self._merge_rows[key]
+        if row.pending:
+            self.flush()
+        if row.scalar is not None:
+            return [(seg.content if isinstance(seg.content, str)
+                     else _MARKER_CHAR,
+                     dict(seg.props) if seg.props else None)
+                    for seg in row.scalar.segments
+                    if seg.removed_seq is None and seg.length > 0]
+        key_rev = {slot: name for name, slot in row.key_slots.items()}
+        valid = np.asarray(self._mstate.valid[row.row])
+        length = np.asarray(self._mstate.length[row.row])
+        rem = np.asarray(self._mstate.rem_seq[row.row])
+        start = np.asarray(self._mstate.pool_start[row.row])
+        pvals = np.asarray(self._mstate.prop_val[row.row])
+        buffer = self._pool.buffer(row.row)
+        out = []
+        for i in range(valid.shape[0]):
+            if not (valid[i] and rem[i] == mtk.NONE_SEQ and length[i] > 0):
+                continue
+            props = {key_rev[p]: self._val_rev[pvals[i, p]]
+                     for p in range(pvals.shape[1])
+                     if pvals[i, p] != 0 and p in key_rev}
+            out.append((buffer[start[i]:start[i] + length[i]],
+                        props or None))
+        return out
+
+    def map_entries(self, doc_id: str, datastore: str,
+                    channel: str) -> dict[str, Any]:
+        """Converged entries of a map channel (wire-format values)."""
+        key = ChannelKey(doc_id, datastore, channel)
+        row = self._map_rows[key]
+        if row.pending:
+            self.flush()
+        present = np.asarray(self._xstate.present[row.row])
+        value = np.asarray(self._xstate.value[row.row])
+        return {name: self._val_rev[value[slot]]
+                for name, slot in row.key_slots.items() if present[slot]}
+
+    def summarize(self, doc_id: str) -> dict:
+        """Materialize every tracked channel of a document from device state
+        (the summary the scribe would upload for the server-side replica)."""
+        self.flush()
+        datastores: dict[str, dict] = {}
+        for key in self.channels(doc_id):
+            channels = datastores.setdefault(key.datastore, {})
+            if key in self._merge_rows:
+                channels[key.channel] = {
+                    "kind": "mergeTree",
+                    "content": self.rich_text(*key),
+                }
+            else:
+                channels[key.channel] = {
+                    "kind": "map",
+                    "entries": self.map_entries(*key),
+                }
+        seqs = [r.last_seq for k, r in self._merge_rows.items()
+                if k.doc_id == doc_id]
+        seqs += [r.last_seq for k, r in self._map_rows.items()
+                 if k.doc_id == doc_id]
+        return {"datastores": datastores,
+                "sequence_number": max(seqs, default=0)}
+
+
+__all__ = ["KernelMergeHost", "ChannelKey"]

@@ -244,6 +244,47 @@ class _BroadcasterFactory:
             doc_id, self._service._connections_for(doc_id))
 
 
+# -- merger (device merge host consumer) --------------------------------------
+
+
+class MergerDocumentLambda:
+    """Feeds the sequenced stream into the device-resident KernelMergeHost
+    (server/merge_host.py). The analogue of hosting the merge kernels
+    behind the IPartitionLambdaFactory seam (BASELINE.json): ops buffer in
+    the host during the batch and hit the device once per checkpoint — the
+    lambda batch IS the device tick. Replayed messages dedupe inside the
+    host (per-channel last_seq guards).
+
+    Restart recovery: the host's device state is memory-only, but the
+    consumer group's offsets are durable — so a fresh lambda (fresh host
+    after a crash) first replays the scriptorium durable op log into the
+    host, then consumes from the committed offset. Overlap dedupes in the
+    host."""
+
+    def __init__(self, doc_id: str, host, store: StateStore) -> None:
+        self.doc_id = doc_id
+        self._host = host
+        for op in store.get(f"ops/{doc_id}", []):
+            host.ingest(doc_id, op)
+
+    def handler(self, message: BusMessage) -> None:
+        if message.value["kind"] != "op":
+            return
+        self._host.ingest(self.doc_id, message.value["message"])
+
+    def checkpoint(self, next_offset: int) -> None:
+        self._host.flush()
+
+
+class _MergerFactory:
+    def __init__(self, host, store: StateStore) -> None:
+        self._host = host
+        self._store = store
+
+    def create(self, doc_id: str) -> MergerDocumentLambda:
+        return MergerDocumentLambda(doc_id, self._host, self._store)
+
+
 # -- scribe -------------------------------------------------------------------
 
 
@@ -334,8 +375,9 @@ class RouterliciousService:
                  store: StateStore | None = None,
                  num_partitions: int = 4,
                  sequencer_factory: Callable[[], DocumentSequencer]
-                 = DocumentSequencer) -> None:
+                 = DocumentSequencer, merge_host=None) -> None:
         self.bus = bus if bus is not None else MessageBus()
+        self.merge_host = merge_host
         self.store = store if store is not None else StateStore()
         self.bus.create_topic(RAWDELTAS, num_partitions)
         self.bus.create_topic(DELTAS, num_partitions)
@@ -359,6 +401,10 @@ class RouterliciousService:
         self._scribe = PartitionManager(
             self.bus, DELTAS, "scribe",
             _ScribeFactory(self.store, self.bus, self._clock))
+        self._merger = (PartitionManager(
+            self.bus, DELTAS, "merger",
+            _MergerFactory(merge_host, self.store))
+            if merge_host is not None else None)
 
     # -- internals -------------------------------------------------------------
 
@@ -381,6 +427,8 @@ class RouterliciousService:
                 moved += self._scriptorium.pump()
                 moved += self._scribe.pump()
                 moved += self._broadcaster.pump()
+                if self._merger is not None:
+                    moved += self._merger.pump()
                 if moved == 0:
                     break
         finally:

@@ -1,0 +1,208 @@
+"""KernelMergeHost: the merge/map kernels serving behind the server.
+
+The north-star wiring (BASELINE.json): converged server-side state for
+SharedString + SharedMap documents is produced by the batched device
+kernels, fed from the live sequenced stream, and must match the client
+replicas byte-for-byte — including under capacity pressure (compaction,
+slot growth) and client-slot overflow (scalar rerouting).
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    SequencedDocumentMessage,
+)
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+from tests.test_mergetree import random_edit
+
+
+def make_doc(server, doc_id):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("text", SharedString.channel_type)
+    datastore.create_channel("root", SharedMap.channel_type)
+    container.attach()
+    return container
+
+
+def get_parts(container):
+    datastore = container.runtime.get_datastore("default")
+    return datastore.get_channel("text"), datastore.get_channel("root")
+
+
+def run_farm(server, host, rng, n_docs=2, n_clients=3, rounds=4):
+    docs = []
+    for d in range(n_docs):
+        c1 = make_doc(server, f"doc{d}")
+        others = [Container.load(LocalDocumentService(server, f"doc{d}"))
+                  for _ in range(n_clients - 1)]
+        docs.append([c1] + others)
+
+    for _round in range(rounds):
+        for containers in docs:
+            paused = [c for c in containers if rng.random() < 0.3]
+            for c in paused:
+                c.inbound.pause()
+            for _ in range(rng.randrange(3, 8)):
+                c = containers[rng.randrange(len(containers))]
+                text, root = get_parts(c)
+                if rng.random() < 0.6:
+                    random_edit(rng, text)
+                else:
+                    r = rng.random()
+                    if r < 0.6:
+                        root.set(f"k{rng.randrange(6)}", rng.randrange(100))
+                    elif r < 0.85:
+                        root.delete(f"k{rng.randrange(6)}")
+                    else:
+                        root.clear()
+            for c in paused:
+                c.inbound.resume()
+
+    # Replicas converged (the oracle) — then the device replica must match.
+    for d, containers in enumerate(docs):
+        texts = [get_parts(c)[0].get_text() for c in containers]
+        maps = [dict(get_parts(c)[1].data.items()) for c in containers]
+        assert all(t == texts[0] for t in texts)
+        assert all(m == maps[0] for m in maps)
+        assert host.text(f"doc{d}", "default", "text") == texts[0], d
+        assert host.map_entries(f"doc{d}", "default", "root") == maps[0], d
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_local_server_device_replica_matches_clients(seed):
+    host = KernelMergeHost(flush_threshold=16)
+    server = LocalCollabServer(merge_host=host)
+    run_farm(server, host, random.Random(seed))
+    assert host.stats["device_ops"] > 0
+
+
+def test_routerlicious_merger_lambda_matches_clients():
+    host = KernelMergeHost(flush_threshold=10_000)  # ticks via checkpoints
+    server = RouterliciousService(merge_host=host)
+    run_farm(server, host, random.Random(7))
+    # The merger lambda's checkpoint cadence flushed the host (flush
+    # threshold was never crossed).
+    assert host.stats["device_ops"] > 0
+
+
+def test_routerlicious_restart_rebuilds_fresh_host_from_op_log():
+    """The host is memory-only; a restarted service with a fresh host must
+    rebuild the device replica from the scriptorium durable log (the merger
+    lambda replays it on creation)."""
+    host1 = KernelMergeHost(flush_threshold=16)
+    server1 = RouterliciousService(merge_host=host1)
+    run_farm(server1, host1, random.Random(11), n_docs=2)
+    expected = {d: host1.text(f"doc{d}", "default", "text")
+                for d in range(2)}
+    maps = {d: host1.map_entries(f"doc{d}", "default", "root")
+            for d in range(2)}
+
+    host2 = KernelMergeHost(flush_threshold=16)
+    server2 = RouterliciousService(bus=server1.bus, store=server1.store,
+                                   merge_host=host2)
+    # Documents load lazily: touching each doc (a reconnecting client)
+    # instantiates its merger lambda, which replays the durable log.
+    for d in range(2):
+        server2.connect(f"doc{d}", lambda msgs: None)
+    for d in range(2):
+        assert host2.text(f"doc{d}", "default", "text") == expected[d]
+        assert host2.map_entries(f"doc{d}", "default", "root") == maps[d]
+
+
+def test_capacity_pressure_compacts_and_grows():
+    host = KernelMergeHost(merge_slots=8, map_slots=4, num_props=1,
+                           flush_threshold=4)
+    server = LocalCollabServer(merge_host=host)
+    rng = random.Random(3)
+    c1 = make_doc(server, "doc")
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    for _ in range(120):
+        c = c1 if rng.random() < 0.5 else c2
+        text, root = get_parts(c)
+        random_edit(rng, text)
+        root.set(f"key{rng.randrange(12)}", rng.randrange(10))
+    t1, m1 = get_parts(c1)
+    t2, m2 = get_parts(c2)
+    assert t1.get_text() == t2.get_text()
+    assert host.text("doc", "default", "text") == t1.get_text()
+    assert host.map_entries("doc", "default", "root") == dict(m1.data.items())
+    assert host.stats["compactions"] > 0 or host._merge_slots > 8
+    assert host._map_slots > 4  # 12 keys forced map slot growth
+
+
+def _op_message(seq, ref_seq, client_id, channel_op, msn=0):
+    return SequencedDocumentMessage(
+        client_id=client_id,
+        sequence_number=seq,
+        minimum_sequence_number=msn,
+        client_sequence_number=seq,
+        reference_sequence_number=ref_seq,
+        type=MessageType.OPERATION,
+        contents={"address": "default",
+                  "contents": {"address": "text", "contents": channel_op}},
+        timestamp=seq,
+        data=None,
+    )
+
+
+def test_client_slot_overflow_routes_to_scalar():
+    """More distinct writers than the device bitmask → scalar rerouting,
+    with the full history replayed and later ops still served."""
+    host = KernelMergeHost(merge_slots=256, flush_threshold=8)
+    n_clients = mtk.MAX_CLIENT_SLOTS + 5
+    seq = 0
+    for i in range(n_clients):
+        seq += 1
+        host.ingest("doc", _op_message(
+            seq, seq - 1, f"c{i}",
+            {"type": "insert", "pos": 0, "text": f"<{i}>"}))
+    expected = "".join(f"<{i}>" for i in reversed(range(n_clients)))
+    assert host.text("doc", "default", "text") == expected
+    assert host.stats["overflow_routed"] == 1
+    assert host.stats["scalar_ops"] > 0
+    # Ops after the reroute apply through the scalar engine.
+    seq += 1
+    host.ingest("doc", _op_message(seq, seq - 1, "c0",
+                                   {"type": "remove", "start": 0, "end": 4}))
+    assert host.text("doc", "default", "text") == expected[4:]
+
+
+def test_annotate_and_markers_materialize():
+    host = KernelMergeHost(flush_threshold=100)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_doc(server, "doc")
+    text, _ = get_parts(c1)
+    text.insert_text(0, "hello world")
+    text.annotate_range(0, 5, {"bold": True})
+    text.insert_marker(5, ref_type="tile", marker_id="m1")
+    assert host.text("doc", "default", "text") == "hello world"
+    runs = host.rich_text("doc", "default", "text")
+    assert ("hello", {"bold": True}) in runs
+    assert ("\x00", None) in runs
+
+
+def test_summarize_materializes_from_device():
+    host = KernelMergeHost(flush_threshold=100)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_doc(server, "doc")
+    text, root = get_parts(c1)
+    text.insert_text(0, "abc")
+    root.set("x", 1)
+    summary = host.summarize("doc")
+    channels = summary["datastores"]["default"]
+    assert channels["text"]["kind"] == "mergeTree"
+    assert "".join(t for t, _ in channels["text"]["content"]) == "abc"
+    assert channels["root"]["entries"] == {"x": 1}
+    assert summary["sequence_number"] > 0
