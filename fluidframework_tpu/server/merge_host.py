@@ -110,7 +110,9 @@ class KernelMergeHost:
 
     def __init__(self, merge_slots: int = 128, map_slots: int = 32,
                  num_props: int = 4, row_capacity: int = 8,
-                 flush_threshold: int = 256) -> None:
+                 flush_threshold: int = 256, metrics=None) -> None:
+        from ..utils import MetricsRegistry
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._merge_capacity = max(1, row_capacity)
         self._map_capacity = max(1, row_capacity)
         self._merge_slots = max(8, merge_slots)
@@ -355,8 +357,16 @@ class KernelMergeHost:
 
     def flush(self) -> None:
         """Apply every pending op: at most one ``apply_tick`` per kernel."""
+        import time as _time
+        self.metrics.gauge("merge_host.queue_depth").set(self._pending_ops)
+        start = _time.perf_counter()
         self._flush_merge()
         self._flush_map()
+        if self._pending_ops:
+            self.metrics.histogram("merge_host.tick_seconds").observe(
+                _time.perf_counter() - start)
+            self.metrics.counter("merge_host.merged_ops").inc(
+                self._pending_ops)
         self._pending_ops = 0
 
     def _flush_merge(self) -> None:

@@ -29,7 +29,9 @@ from ..protocol.messages import (
     NackMessage,
     ScopeType,
     SequencedDocumentMessage,
+    Trace,
 )
+from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
 from .bus import BusMessage, MessageBus, StateStore
 from .lambdas import PartitionManager
 from .sequencer import DocumentSequencer, RawOperation, SequencerCheckpoint
@@ -45,10 +47,12 @@ class DeliDocumentLambda:
     """Per-document sequencer lambda (deli/lambda.ts ticket loop)."""
 
     def __init__(self, doc_id: str, store: StateStore, bus: MessageBus,
-                 sequencer_factory: Callable[[], DocumentSequencer]) -> None:
+                 sequencer_factory: Callable[[], DocumentSequencer],
+                 metrics: MetricsRegistry | None = None) -> None:
         self.doc_id = doc_id
         self._store = store
         self._bus = bus
+        self._metrics = metrics if metrics is not None else MetricsRegistry()
         cp = store.get(f"deli/{doc_id}")
         if cp is not None:
             cp = dict(cp)
@@ -66,6 +70,7 @@ class DeliDocumentLambda:
             return  # replayed below our checkpoint (deli/lambda.ts:148-151)
         self._last_offset = message.offset
         raw: RawOperation = message.value
+        trace_start = Trace("deli", "start")
         if raw.client_id is None and raw.type in (MessageType.SUMMARY_ACK,
                                                   MessageType.SUMMARY_NACK):
             # Scribe crash-replay can re-produce its response to the same
@@ -82,6 +87,7 @@ class DeliDocumentLambda:
             self._summary_responded = sseq
         ticket = self.sequencer.ticket(raw)
         if ticket.kind == oc.OUT_NACK:
+            self._metrics.counter("deli.nacks").inc()
             self._bus.produce(DELTAS, self.doc_id, {
                 "kind": "nack",
                 "target": raw.client_id,
@@ -90,6 +96,7 @@ class DeliDocumentLambda:
                 "code": ticket.nack_code,
             })
         elif ticket.kind == oc.OUT_SEQUENCED:
+            self._metrics.counter("deli.sequenced_ops").inc()
             self._bus.produce(DELTAS, self.doc_id, {
                 "kind": "op",
                 "message": SequencedDocumentMessage(
@@ -102,6 +109,8 @@ class DeliDocumentLambda:
                     contents=raw.contents,
                     timestamp=raw.timestamp,
                     data=raw.data,
+                    traces=tuple(raw.traces) + (trace_start,
+                                                Trace("deli", "end")),
                 ),
             })
 
@@ -122,13 +131,15 @@ class DeliDocumentLambda:
 
 class _DeliFactory:
     def __init__(self, store: StateStore, bus: MessageBus,
-                 sequencer_factory: Callable[[], DocumentSequencer]) -> None:
+                 sequencer_factory: Callable[[], DocumentSequencer],
+                 metrics: MetricsRegistry | None = None) -> None:
         self._store, self._bus = store, bus
         self._sequencer_factory = sequencer_factory
+        self._metrics = metrics
 
     def create(self, doc_id: str) -> DeliDocumentLambda:
         return DeliDocumentLambda(doc_id, self._store, self._bus,
-                                  self._sequencer_factory)
+                                  self._sequencer_factory, self._metrics)
 
 
 # -- scriptorium --------------------------------------------------------------
@@ -375,9 +386,18 @@ class RouterliciousService:
                  store: StateStore | None = None,
                  num_partitions: int = 4,
                  sequencer_factory: Callable[[], DocumentSequencer]
-                 = DocumentSequencer, merge_host=None) -> None:
+                 = DocumentSequencer, merge_host=None,
+                 logger: TelemetryLogger | None = None,
+                 metrics: MetricsRegistry | None = None) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
+        self.logger = logger if logger is not None else NullLogger()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if merge_host is not None:
+            # One registry per service: hosted components report into it so
+            # a single snapshot covers the whole assembly (and the per-mesh
+            # psum aggregation sees merge-host counters too).
+            merge_host.metrics = self.metrics
         self.store = store if store is not None else StateStore()
         self.bus.create_topic(RAWDELTAS, num_partitions)
         self.bus.create_topic(DELTAS, num_partitions)
@@ -393,7 +413,8 @@ class RouterliciousService:
 
         self._deli = PartitionManager(
             self.bus, RAWDELTAS, "deli",
-            _DeliFactory(self.store, self.bus, sequencer_factory))
+            _DeliFactory(self.store, self.bus, sequencer_factory,
+                         self.metrics))
         self._scriptorium = PartitionManager(
             self.bus, DELTAS, "scriptorium", _ScriptoriumFactory(self.store))
         self._broadcaster = PartitionManager(
@@ -451,6 +472,8 @@ class RouterliciousService:
         connection = _LiveConnection(client_id, doc_id, self, handler,
                                      on_nack, on_signal, mode=mode)
         self._connections_for(doc_id)[client_id] = connection
+        self.logger.send_event("ClientConnect", docId=doc_id,
+                               clientId=client_id, mode=mode)
         if mode != "read":
             self.bus.produce(RAWDELTAS, doc_id, RawOperation(
                 client_id=None,
@@ -465,6 +488,8 @@ class RouterliciousService:
 
     def disconnect(self, doc_id: str, client_id: str) -> None:
         connection = self._connections_for(doc_id).pop(client_id, None)
+        self.logger.send_event("ClientDisconnect", docId=doc_id,
+                               clientId=client_id)
         if connection is not None and connection.mode == "read":
             return
         self.bus.produce(RAWDELTAS, doc_id, RawOperation(
@@ -477,6 +502,7 @@ class RouterliciousService:
 
     def submit(self, doc_id: str, client_id: str,
                messages: list[DocumentMessage]) -> None:
+        self.metrics.counter("alfred.submitted_ops").inc(len(messages))
         for message in messages:
             self.bus.produce(RAWDELTAS, doc_id, RawOperation(
                 client_id=client_id,
@@ -485,6 +511,7 @@ class RouterliciousService:
                 ref_seq=message.reference_sequence_number,
                 timestamp=self._clock(),
                 contents=message.contents,
+                traces=tuple(message.traces) + (Trace("alfred", "submit"),),
             ))
         self.pump()
 

@@ -69,6 +69,9 @@ class RawOperation:
     # join-time flags (carried in data for the scalar path):
     can_summarize: bool = True
     can_evict: bool = True
+    # Latency breadcrumbs riding the op (protocol.ts:53 ITrace); alfred
+    # stamps submit, deli appends start/end (deli/lambda.ts:160).
+    traces: tuple = ()
 
 
 @dataclass(frozen=True, slots=True)

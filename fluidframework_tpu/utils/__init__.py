@@ -1,4 +1,49 @@
-"""Base utilities: telemetry, tracing, events, heaps, config.
+"""Base utilities: telemetry, tracing, events, heaps, metrics, config.
 
-Reference parity: common/lib/common-utils, packages/utils/telemetry-utils.
+Reference parity: common/lib/common-utils, packages/utils/telemetry-utils,
+services-core/src/metricClient.ts, services-utils (nconf config).
 """
+
+from .config import Config, default_config
+from .events import BatchManager, Deferred, Heap, TypedEventEmitter
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .telemetry import (
+    ChildLogger,
+    CollectingLogger,
+    DebugLogger,
+    MultiSinkLogger,
+    NullLogger,
+    PerformanceEvent,
+    PerfTrace,
+    TelemetryLogger,
+    timed,
+)
+
+__all__ = [
+    "BatchManager",
+    "ChildLogger",
+    "CollectingLogger",
+    "Config",
+    "Counter",
+    "DebugLogger",
+    "Deferred",
+    "default_config",
+    "default_registry",
+    "Gauge",
+    "Heap",
+    "Histogram",
+    "MetricsRegistry",
+    "MultiSinkLogger",
+    "NullLogger",
+    "PerformanceEvent",
+    "PerfTrace",
+    "TelemetryLogger",
+    "timed",
+    "TypedEventEmitter",
+]

@@ -1,0 +1,137 @@
+"""Metrics registry: counters, gauges, latency histograms with p99.
+
+Reference parity: services-core/src/metricClient.ts (server metric seam),
+connectionTelemetry.ts (client op round-trip latency), merge-tree's
+accumTime/localTime micro counters (client.ts:45-55). TPU addition: a
+registry ``snapshot()`` is a flat dict of floats so per-chip snapshots can
+be summed across a mesh with one ``psum``
+(fluidframework_tpu.parallel.mesh.aggregate_metrics).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+
+class Counter:
+    """Monotonic event count (merged ops, ticks, nacks...)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    """Point-in-time level (queue depth, resident docs...)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def add(self, delta: float) -> None:
+        self.value += delta
+
+
+class Histogram:
+    """Latency histogram over log-spaced buckets; O(1) observe, quantiles
+    from bucket interpolation. Bounds default to 1us..60s — wide enough for
+    op-apply and device-tick latencies without per-sample storage (the
+    "reservoir" the reference never needed because it never measured)."""
+
+    __slots__ = ("_bounds", "_counts", "count", "total", "max")
+
+    def __init__(self, min_bound: float = 1e-6, max_bound: float = 60.0,
+                 buckets_per_decade: int = 10) -> None:
+        decades = math.log10(max_bound / min_bound)
+        n = max(1, int(math.ceil(decades * buckets_per_decade)))
+        self._bounds = [min_bound * (max_bound / min_bound) ** (i / n)
+                        for i in range(1, n + 1)]
+        self._counts = [0] * (n + 1)  # +1 overflow bucket
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value > self.max:
+            self.max = value
+        lo, hi = 0, len(self._bounds)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if value <= self._bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self._counts[lo] += 1
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                if i >= len(self._bounds):
+                    return self.max
+                # A bucket's upper bound can overshoot the true maximum.
+                return min(self._bounds[i], self.max)
+        return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Named metric bag. ``snapshot()`` flattens to {name: float}; counters
+    and gauges sum across shards, histograms export count/mean/p50/p99/max."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, Any] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, **kwargs: Any) -> Histogram:
+        if name not in self._metrics:
+            self._metrics[name] = Histogram(**kwargs)
+        metric = self._metrics[name]
+        assert isinstance(metric, Histogram), name
+        return metric
+
+    def _get(self, name: str, cls: type) -> Any:
+        if name not in self._metrics:
+            self._metrics[name] = cls()
+        metric = self._metrics[name]
+        assert isinstance(metric, cls), name
+        return metric
+
+    def snapshot(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for name, metric in self._metrics.items():
+            if isinstance(metric, (Counter, Gauge)):
+                out[name] = metric.value
+            else:
+                out[f"{name}.count"] = float(metric.count)
+                out[f"{name}.mean"] = metric.mean
+                out[f"{name}.p50"] = metric.quantile(0.50)
+                out[f"{name}.p99"] = metric.quantile(0.99)
+                out[f"{name}.max"] = metric.max
+        return out
+
+
+default_registry = MetricsRegistry()

@@ -1,0 +1,211 @@
+"""Telemetry/metrics/config/event utilities (reference: common-utils,
+telemetry-utils logger.ts, services-core metricClient.ts, nconf config)."""
+
+from __future__ import annotations
+
+from fluidframework_tpu.utils import (
+    BatchManager,
+    ChildLogger,
+    CollectingLogger,
+    Config,
+    Deferred,
+    Heap,
+    Histogram,
+    MetricsRegistry,
+    MultiSinkLogger,
+    PerformanceEvent,
+    TypedEventEmitter,
+    default_config,
+)
+
+
+class TestEvents:
+    def test_on_emit_off(self):
+        em = TypedEventEmitter()
+        seen = []
+        off = em.on("x", seen.append)
+        em.emit("x", 1)
+        em.emit("x", 2)
+        off()
+        em.emit("x", 3)
+        assert seen == [1, 2]
+
+    def test_once(self):
+        em = TypedEventEmitter()
+        seen = []
+        em.once("x", seen.append)
+        em.emit("x", 1)
+        em.emit("x", 2)
+        assert seen == [1]
+
+    def test_once_is_per_event(self):
+        em = TypedEventEmitter()
+        seen = []
+        em.once("a", seen.append)
+        em.on("b", seen.append)  # same callable, persistent on "b"
+        em.emit("b", 1)
+        em.emit("b", 2)
+        em.emit("a", 3)
+        em.emit("a", 4)
+        assert seen == [1, 2, 3]
+
+    def test_deferred_reject_notifies(self):
+        d: Deferred[int] = Deferred()
+        errors = []
+        d.then(lambda v: None, errors.append)
+        d.reject(RuntimeError("x"))
+        d.then(lambda v: None, errors.append)  # late subscriber
+        assert len(errors) == 2
+
+    def test_deferred(self):
+        d: Deferred[int] = Deferred()
+        seen = []
+        d.then(seen.append)
+        assert not d.is_completed
+        d.resolve(7)
+        d.resolve(8)  # set-once
+        d.then(seen.append)  # late subscriber fires immediately
+        assert seen == [7, 7] and d.value == 7
+
+    def test_batch_manager_flush_on_max(self):
+        batches = []
+        bm: BatchManager[int] = BatchManager(
+            lambda k, items: batches.append((k, items)), max_batch_size=3)
+        for i in range(7):
+            bm.add("doc", i)
+        bm.drain()
+        assert batches == [("doc", [0, 1, 2]), ("doc", [3, 4, 5]),
+                           ("doc", [6])]
+
+    def test_heap(self):
+        h: Heap[tuple] = Heap(key=lambda t: t[0])
+        for item in [(3, "c"), (1, "a"), (2, "b")]:
+            h.push(item)
+        assert [h.pop()[1] for _ in range(len(h))] == ["a", "b", "c"]
+
+
+class TestTelemetry:
+    def test_child_logger_namespacing_and_props(self):
+        root = CollectingLogger(namespace="fluid:telemetry")
+        child = ChildLogger.create(root, "DeltaManager", {"docId": "d1"})
+        child.send_event("ConnectionStateChange", state="Connected")
+        [event] = root.events
+        assert event["eventName"] == \
+            "fluid:telemetry:DeltaManager:ConnectionStateChange"
+        assert event["docId"] == "d1" and event["state"] == "Connected"
+        assert event["category"] == "generic"
+
+    def test_multi_sink(self):
+        a, b = CollectingLogger(), CollectingLogger()
+        multi = MultiSinkLogger([a, b])
+        multi.send_event("e")
+        assert len(a.events) == len(b.events) == 1
+
+    def test_performance_event_end_and_cancel(self):
+        log = CollectingLogger()
+        with PerformanceEvent(log, "summarize", emit_start=True):
+            pass
+        try:
+            with PerformanceEvent(log, "load"):
+                raise ValueError("boom")
+        except ValueError:
+            pass
+        names = [e["eventName"] for e in log.events]
+        assert names == ["summarize_start", "summarize_end", "load_cancel"]
+        assert log.events[1]["duration"] >= 0
+        assert "boom" in log.events[2]["error"]
+
+
+class TestMetrics:
+    def test_histogram_quantiles_bracket_true_p99(self):
+        h = Histogram(min_bound=1e-6, max_bound=10.0)
+        values = [i / 1000.0 for i in range(1, 1001)]  # 1ms..1s uniform
+        for v in values:
+            h.observe(v)
+        p99 = h.quantile(0.99)
+        # Log-bucketed estimate: within one bucket (~26%) of the true 0.99.
+        assert 0.7 <= p99 <= 1.3
+        assert h.count == 1000 and abs(h.mean - 0.5005) < 1e-9
+        assert h.quantile(1.0) == h.max == 1.0
+
+    def test_registry_snapshot(self):
+        reg = MetricsRegistry()
+        reg.counter("ops").inc(5)
+        reg.gauge("depth").set(3)
+        reg.histogram("lat").observe(0.01)
+        snap = reg.snapshot()
+        assert snap["ops"] == 5 and snap["depth"] == 3
+        assert snap["lat.count"] == 1 and snap["lat.p99"] > 0
+
+
+class TestConfig:
+    def test_layering_env_over_file_defaults(self, tmp_path):
+        f = tmp_path / "config.json"
+        f.write_text('{"bus": {"partitions": 8}, "name": "file"}')
+        cfg = Config(defaults={"bus": {"partitions": 4, "topic": "raw"},
+                               "name": "default"},
+                     file=f,
+                     env={"FF_TPU_BUS__PARTITIONS": "16",
+                          "FF_TPU_FLAG": "true", "HOME": "/x"},
+                     overrides={"name": "override"})
+        assert cfg.get("bus:partitions") == 16     # env beats file
+        assert cfg.get("bus:topic") == "raw"       # default survives merge
+        assert cfg.get("name") == "override"       # overrides beat all
+        assert cfg.get("flag") is True             # env JSON parsing
+        assert cfg.get("home") is None             # unprefixed env ignored
+        assert cfg.get("nope", 42) == 42
+
+    def test_default_config_sections(self):
+        cfg = default_config(overrides={"alfred": {"max_message_size": 1024}})
+        assert cfg.get("alfred:max_message_size") == 1024
+        assert cfg.section("deli").get("client_timeout_ms") == 300_000
+        assert cfg.require("bus:partitions") == 4
+
+
+class TestServiceTraces:
+    def test_op_traces_ride_sequenced_messages(self):
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage, MessageType, Trace)
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService)
+
+        service = RouterliciousService()
+        received = []
+        conn = service.connect("doc", lambda ms: received.extend(ms))
+        conn.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=1,
+            type=MessageType.OPERATION, contents={"x": 1},
+            traces=(Trace("client", "submit"),))])
+        ops = [m for m in received if m.type == MessageType.OPERATION]
+        assert ops, "operation not broadcast"
+        legs = [(t.service, t.action) for t in ops[-1].traces]
+        assert legs == [("client", "submit"), ("alfred", "submit"),
+                        ("deli", "start"), ("deli", "end")]
+        assert service.metrics.snapshot()["deli.sequenced_ops"] >= 1
+
+    def test_service_shares_registry_with_merge_host(self):
+        from fluidframework_tpu.server.merge_host import KernelMergeHost
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService)
+
+        host = KernelMergeHost()
+        service = RouterliciousService(merge_host=host)
+        assert host.metrics is service.metrics
+
+    def test_merge_host_flush_metrics(self):
+        from fluidframework_tpu.server.merge_host import KernelMergeHost
+        from fluidframework_tpu.protocol.messages import (
+            MessageType, SequencedDocumentMessage)
+
+        host = KernelMergeHost()
+        host.ingest("d", SequencedDocumentMessage(
+            client_id="c", sequence_number=1, minimum_sequence_number=0,
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"address": "ds", "contents": {
+                "address": "map", "contents": {
+                    "type": "set", "key": "k", "value": 1}}}))
+        host.flush()
+        snap = host.metrics.snapshot()
+        assert snap["merge_host.merged_ops"] == 1
+        assert snap["merge_host.tick_seconds.count"] == 1
