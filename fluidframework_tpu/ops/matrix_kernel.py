@@ -1,0 +1,296 @@
+"""Batched SharedMatrix apply kernel — composed from the merge-tree kernel.
+
+Reference parity: packages/dds/matrix/src/matrix.ts:547 (``processCore``)
+and permutationvector.ts:38 — a matrix is two permutation vectors (rows,
+cols), each a merge-tree whose segments carry runs of storage handles, plus
+an LWW cell table keyed (rowHandle, colHandle). TPU composition:
+
+  * rows / cols = two :class:`~fluidframework_tpu.ops.mergetree_kernel.
+    MergeState` tables. A segment's ``pool_start`` field holds the FIRST
+    handle of its run (runs are contiguous because sequenced inserts
+    allocate handles in document order — the deterministic allocation rule
+    of dds/matrix.py); splits inherit ``pool_start + offset`` for free.
+  * (row, col) → handle resolution = the same masked-prefix-sum position
+    lookup the merge kernel uses for its insert walk, evaluated in the
+    (refSeq, client) visibility frame — matrix.ts's adjustPosition.
+  * cells = a device table of (row_handle, col_handle, value, seq) rows
+    with first-match-or-append placement; sequenced order makes the LWW
+    fold a plain overwrite (matrix.ts isLatestPendingWrite collapses on
+    the server-side converged stream).
+  * one sequenced op = one lax.scan step over a mixed rows/cols/cell
+    stream (total order preserved *within* the document); documents batch
+    with vmap — the 10k-doc axis (BASELINE config 4).
+
+Differential tests feed live SharedMatrix op streams (tests/
+test_matrix_kernel.py) and assert the materialized grid matches every
+converged replica cell-for-cell.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import mergetree_kernel as mtk
+
+I32 = jnp.int32
+
+MX_ROWS = 0
+MX_COLS = 1
+MX_CELL = 2
+
+
+class MatrixState(NamedTuple):
+    """Per-document matrix state. rows/cols axes [B, S]; cells [B, C]."""
+
+    rows: mtk.MergeState
+    cols: mtk.MergeState
+    cell_rh: jax.Array     # i32[B, C] row handle (-1 empty)
+    cell_ch: jax.Array     # i32[B, C] col handle
+    cell_val: jax.Array    # i32[B, C] interned value id (0 = cleared)
+    cell_seq: jax.Array    # i32[B, C] seq of the winning write
+    cell_used: jax.Array   # bool[B, C]
+    cell_count: jax.Array  # i32[B]
+
+
+class MatrixOpBatch(NamedTuple):
+    """One tick of sequenced matrix ops, padded to K per doc. Axes [B, K]."""
+
+    valid: jax.Array        # bool
+    target: jax.Array       # i32 MX_*
+    kind: jax.Array         # i32 MT_INSERT/MT_REMOVE (vector ops)
+    pos: jax.Array          # i32 vector position / range start
+    end: jax.Array          # i32 range end (remove)
+    count: jax.Array        # i32 inserted run length
+    handle_base: jax.Array  # i32 first handle of an inserted run
+    row: jax.Array          # i32 (cell)
+    col: jax.Array          # i32 (cell)
+    value: jax.Array        # i32 interned value id (cell)
+    seq: jax.Array          # i32
+    ref_seq: jax.Array      # i32
+    client: jax.Array       # i32 client slot
+
+
+class _VecOp(NamedTuple):
+    """Adapter to the merge-tree kernel's per-op field names."""
+
+    valid: jax.Array
+    kind: jax.Array
+    pos: jax.Array
+    end: jax.Array
+    seq: jax.Array
+    ref_seq: jax.Array
+    client: jax.Array
+    pool_start: jax.Array
+    text_len: jax.Array
+    prop_key: jax.Array
+    prop_val: jax.Array
+
+
+def init_state(num_docs: int, vec_slots: int = 64, cell_slots: int = 256
+               ) -> MatrixState:
+    b, c = num_docs, cell_slots
+    return MatrixState(
+        rows=mtk.init_state(b, vec_slots, num_props=1),
+        cols=mtk.init_state(b, vec_slots, num_props=1),
+        cell_rh=jnp.full((b, c), -1, I32),
+        cell_ch=jnp.full((b, c), -1, I32),
+        cell_val=jnp.zeros((b, c), I32),
+        cell_seq=jnp.zeros((b, c), I32),
+        cell_used=jnp.zeros((b, c), jnp.bool_),
+        cell_count=jnp.zeros((b,), I32),
+    )
+
+
+def _handle_at(s: mtk.MergeState, pos, ref_seq, client):
+    """Storage handle at visible position pos in the (refSeq, client) frame
+    (PermutationVector.handle_at / matrix adjustPosition). -1 = no handle."""
+    vis = mtk._vis_len(s, ref_seq, client)
+    cum = jnp.cumsum(vis) - vis
+    inside = (cum <= pos) & (pos < cum + vis)
+    found = jnp.any(inside)
+    idx = jnp.argmax(inside)
+    return jnp.where(found, s.pool_start[idx] + pos - cum[idx], -1)
+
+
+def _vec_op(op) -> _VecOp:
+    return _VecOp(
+        valid=op.valid, kind=op.kind, pos=op.pos, end=op.end, seq=op.seq,
+        ref_seq=op.ref_seq, client=op.client, pool_start=op.handle_base,
+        text_len=op.count, prop_key=jnp.zeros_like(op.kind),
+        prop_val=jnp.zeros_like(op.kind))
+
+
+def _apply_matrix_op(s: MatrixState, op) -> MatrixState:
+    def do_rows(st: MatrixState) -> MatrixState:
+        return st._replace(rows=mtk._apply_op(st.rows, _vec_op(op)))
+
+    def do_cols(st: MatrixState) -> MatrixState:
+        return st._replace(cols=mtk._apply_op(st.cols, _vec_op(op)))
+
+    def do_cell(st: MatrixState) -> MatrixState:
+        rh = _handle_at(st.rows, op.row, op.ref_seq, op.client)
+        ch = _handle_at(st.cols, op.col, op.ref_seq, op.client)
+        # A write whose row/col died concurrently resolves to no handle and
+        # drops — matrix.ts:547 processCore's None-handle guard.
+        ok = (rh >= 0) & (ch >= 0)
+        match = st.cell_used & (st.cell_rh == rh) & (st.cell_ch == ch)
+        exists = jnp.any(match)
+        capacity = st.cell_used.shape[0]
+        idx = jnp.where(exists, jnp.argmax(match),
+                        jnp.minimum(st.cell_count, capacity - 1))
+        write = ok
+
+        def upd(field, value):
+            return field.at[idx].set(jnp.where(write, value, field[idx]))
+
+        return st._replace(
+            cell_rh=upd(st.cell_rh, rh),
+            cell_ch=upd(st.cell_ch, ch),
+            cell_val=upd(st.cell_val, op.value),
+            cell_seq=upd(st.cell_seq, op.seq),
+            cell_used=upd(st.cell_used, True),
+            cell_count=st.cell_count
+            + jnp.where(write & ~exists, 1, 0).astype(I32),
+        )
+
+    applied = jax.lax.switch(jnp.clip(op.target, 0, 2),
+                             [do_rows, do_cols, do_cell], s)
+    return jax.tree.map(
+        lambda new, old: jnp.where(op.valid, new, old), applied, s)
+
+
+def _step(state: MatrixState, op):
+    return _apply_matrix_op(state, op), ()
+
+
+def _process_doc(state: MatrixState, ops: MatrixOpBatch):
+    final, _ = jax.lax.scan(_step, state, ops)
+    return final
+
+
+@jax.jit
+def apply_tick(state: MatrixState, ops: MatrixOpBatch) -> MatrixState:
+    """Apply one tick of sequenced matrix ops for every document."""
+    return jax.vmap(_process_doc)(state, ops)
+
+
+def capacity_margin(state: MatrixState) -> dict[str, np.ndarray]:
+    """Free slots per document per table. Vector ops consume up to 2 vector
+    slots; a cell set consumes up to 1 cell slot. Overflow is silent — the
+    serving host must check and compact/grow/route-to-scalar, exactly as
+    for the merge-tree kernel."""
+    return {
+        "rows": mtk.capacity_margin(state.rows),
+        "cols": mtk.capacity_margin(state.cols),
+        "cells": np.asarray(state.cell_used.shape[1] - state.cell_count),
+    }
+
+
+# -- host-side encode / materialize -------------------------------------------
+
+
+class HandleAllocator:
+    """Per-document sequential handle allocation for an axis — mirrors the
+    deterministic in-sequence-order rule of dds/matrix.py so device handle
+    runs match every scalar replica."""
+
+    def __init__(self, num_docs: int) -> None:
+        self.next = [0] * num_docs
+
+    def alloc(self, doc: int, count: int) -> int:
+        base = self.next[doc]
+        self.next[doc] += count
+        return base
+
+
+def make_matrix_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
+                         k: int) -> MatrixOpBatch:
+    fields = {name: np.zeros((num_docs, k), np.int32)
+              for name in ("target", "kind", "pos", "end", "count",
+                           "handle_base", "row", "col", "value", "seq",
+                           "ref_seq", "client")}
+    valid = np.zeros((num_docs, k), np.bool_)
+    for d, doc_ops in enumerate(ops_per_doc):
+        assert len(doc_ops) <= k, f"tick overflow: {len(doc_ops)} > {k}"
+        for i, op in enumerate(doc_ops):
+            valid[d, i] = True
+            for name in fields:
+                fields[name][d, i] = op.get(name, 0)
+    return MatrixOpBatch(valid=jnp.asarray(valid),
+                         **{n: jnp.asarray(v) for n, v in fields.items()})
+
+
+def encode_matrix_log(messages, doc: int, rows: HandleAllocator,
+                      cols: HandleAllocator, client_slots: dict,
+                      val_ids: dict) -> list[dict]:
+    """Sequenced OPERATION messages of one matrix channel → kernel op dicts.
+
+    ``val_ids`` interns cell values (id 0 reserved for None/cleared); the
+    caller keeps the reverse table for materialization.
+    """
+    from ..protocol.messages import MessageType
+
+    out = []
+    for m in messages:
+        if m.type != MessageType.OPERATION:
+            continue
+        channel_op = m.contents["contents"]["contents"]
+        slot = client_slots.setdefault(m.client_id, len(client_slots))
+        base = dict(seq=m.sequence_number,
+                    ref_seq=m.reference_sequence_number, client=slot)
+        target = channel_op["target"]
+        if target in ("rows", "cols"):
+            axis = rows if target == "rows" else cols
+            tcode = MX_ROWS if target == "rows" else MX_COLS
+            if channel_op["type"] == "insert":
+                count = channel_op["count"]
+                out.append(dict(base, target=tcode, kind=mtk.MT_INSERT,
+                                pos=channel_op["pos"], count=count,
+                                handle_base=axis.alloc(doc, count)))
+            elif channel_op["type"] == "removeGroup":
+                for start, end in channel_op["ranges"]:
+                    out.append(dict(base, target=tcode, kind=mtk.MT_REMOVE,
+                                    pos=start, end=end))
+            else:
+                out.append(dict(base, target=tcode, kind=mtk.MT_REMOVE,
+                                pos=channel_op["start"],
+                                end=channel_op["end"]))
+        else:  # cell set
+            value = channel_op["value"]
+            vid = 0 if value is None else val_ids.setdefault(
+                repr(value), len(val_ids) + 1)
+            out.append(dict(base, target=MX_CELL, row=channel_op["row"],
+                            col=channel_op["col"], value=vid))
+    return out
+
+
+def _axis_handles(s: mtk.MergeState, doc: int) -> list[int]:
+    """Live handles of one axis in document order (acked view)."""
+    valid = np.asarray(s.valid[doc])
+    length = np.asarray(s.length[doc])
+    rem = np.asarray(s.rem_seq[doc])
+    start = np.asarray(s.pool_start[doc])
+    handles: list[int] = []
+    for i in range(valid.shape[0]):
+        if valid[i] and rem[i] == mtk.NONE_SEQ and length[i] > 0:
+            handles.extend(range(int(start[i]), int(start[i] + length[i])))
+    return handles
+
+
+def materialize_grid(state: MatrixState, doc: int,
+                     val_rev: list) -> list[list]:
+    """Converged dense grid of one document (None = unset cell)."""
+    row_handles = _axis_handles(state.rows, doc)
+    col_handles = _axis_handles(state.cols, doc)
+    used = np.asarray(state.cell_used[doc])
+    rh = np.asarray(state.cell_rh[doc])
+    ch = np.asarray(state.cell_ch[doc])
+    val = np.asarray(state.cell_val[doc])
+    cells = {(int(rh[i]), int(ch[i])): int(val[i])
+             for i in range(used.shape[0]) if used[i]}
+    return [[val_rev[cells[(r, c)]] if (r, c) in cells else None
+             for c in col_handles] for r in row_handles]

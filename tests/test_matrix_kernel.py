@@ -1,0 +1,154 @@
+"""Batched matrix kernel: differential tests against live SharedMatrix
+op streams (BASELINE config 4 — matrix.ts:547 processCore,
+permutationvector.ts:38 row/col OT, byte-identical converged cells)."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.ops import matrix_kernel as mxk
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from tests.test_matrix import get_matrix, grid_of
+
+
+def make_empty_matrix_doc(server, doc_id):
+    """Attach empty so EVERY edit rides the sequenced stream (a detached
+    matrix ships its initial rows via snapshot, invisible to a replay)."""
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    container.runtime.create_datastore("default").create_channel(
+        "grid", SharedMatrix.channel_type)
+    container.attach()
+    return container
+
+
+def random_matrix_edit(rng, matrix: SharedMatrix):
+    r = rng.random()
+    if r < 0.55 and matrix.row_count and matrix.col_count:
+        matrix.set_cell(rng.randrange(matrix.row_count),
+                        rng.randrange(matrix.col_count),
+                        rng.choice(["a", "b", "c", 1, 2.5]))
+    elif r < 0.70:
+        matrix.insert_rows(rng.randint(0, matrix.row_count),
+                           rng.randint(1, 3))
+    elif r < 0.85:
+        matrix.insert_cols(rng.randint(0, matrix.col_count),
+                           rng.randint(1, 3))
+    elif r < 0.93 and matrix.row_count:
+        pos = rng.randrange(matrix.row_count)
+        matrix.remove_rows(pos, min(rng.randint(1, 2),
+                                    matrix.row_count - pos))
+    elif matrix.col_count:
+        pos = rng.randrange(matrix.col_count)
+        matrix.remove_cols(pos, min(rng.randint(1, 2),
+                                    matrix.col_count - pos))
+
+
+def replay_through_kernel(server, doc_ids, vec_slots=256, cell_slots=512):
+    n = len(doc_ids)
+    rows = mxk.HandleAllocator(n)
+    cols = mxk.HandleAllocator(n)
+    client_slots: dict = {}
+    val_ids: dict = {}
+    streams = [mxk.encode_matrix_log(server.get_deltas(doc, 0), d, rows,
+                                     cols, client_slots, val_ids)
+               for d, doc in enumerate(doc_ids)]
+    val_rev: list = [None] + [None] * len(val_ids)
+    for rep, vid in val_ids.items():
+        val_rev[vid] = eval(rep)  # repr of simple literals round-trips
+    state = mxk.init_state(n, vec_slots=vec_slots, cell_slots=cell_slots)
+    k = 16
+    longest = max((len(s) for s in streams), default=0)
+    for start in range(0, longest, k):
+        chunk = [s[start:start + k] for s in streams]
+        state = mxk.apply_tick(
+            state, mxk.make_matrix_op_batch(chunk, n, k))
+    margins = mxk.capacity_margin(state)
+    assert (margins["rows"] >= 0).all() and (margins["cells"] > 0).all()
+    return state, val_rev
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_matrix_kernel_matches_replicas(seed):
+    rng = random.Random(seed)
+    n_docs = 2
+    server = LocalCollabServer()
+    docs = []
+    for d in range(n_docs):
+        c1 = make_empty_matrix_doc(server, f"doc{d}")
+        others = [Container.load(LocalDocumentService(server, f"doc{d}"))
+                  for _ in range(2)]
+        docs.append([c1] + others)
+        get_matrix(c1).insert_rows(0, 2)
+        get_matrix(c1).insert_cols(0, 2)
+
+    for _round in range(4):
+        for containers in docs:
+            paused = [c for c in containers if rng.random() < 0.3]
+            for c in paused:
+                c.inbound.pause()
+            for _ in range(rng.randrange(3, 7)):
+                random_matrix_edit(rng, get_matrix(
+                    containers[rng.randrange(len(containers))]))
+            for c in paused:
+                c.inbound.resume()
+
+    expected = []
+    for containers in docs:
+        grids = [grid_of(get_matrix(c)) for c in containers]
+        assert all(g == grids[0] for g in grids)
+        expected.append(grids[0])
+
+    state, val_rev = replay_through_kernel(
+        server, [f"doc{d}" for d in range(n_docs)])
+    for d in range(n_docs):
+        got = mxk.materialize_grid(state, d, val_rev)
+        assert got == expected[d], (seed, d, got, expected[d])
+
+
+def test_matrix_kernel_concurrent_row_insert_shifts_cells():
+    """A cell write whose refSeq predates a concurrent row insert resolves
+    in its submitter's frame (the row it addressed, not the shifted one)."""
+    server = LocalCollabServer()
+    c1 = make_empty_matrix_doc(server, "doc")
+    m1 = get_matrix(c1)
+    m1.insert_rows(0, 2)
+    m1.insert_cols(0, 1)
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    m2 = get_matrix(c2)
+
+    c1.inbound.pause()
+    c2.inbound.pause()
+    m1.insert_rows(0, 1)     # shifts rows down for everyone once sequenced
+    m2.set_cell(1, 0, "x")   # addressed pre-shift row index 1
+    c1.inbound.resume()
+    c2.inbound.resume()
+
+    assert grid_of(m1) == grid_of(m2)
+    state, val_rev = replay_through_kernel(server, ["doc"])
+    assert mxk.materialize_grid(state, 0, val_rev) == grid_of(m1)
+
+
+def test_matrix_kernel_write_to_removed_row_drops():
+    server = LocalCollabServer()
+    c1 = make_empty_matrix_doc(server, "doc")
+    m1 = get_matrix(c1)
+    m1.insert_rows(0, 2)
+    m1.insert_cols(0, 1)
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    m2 = get_matrix(c2)
+
+    c1.inbound.pause()
+    c2.inbound.pause()
+    m1.remove_rows(0, 1)
+    m2.set_cell(0, 0, "dead")  # lands on the removed row's handle
+    c1.inbound.resume()
+    c2.inbound.resume()
+
+    assert grid_of(m1) == grid_of(m2)
+    state, val_rev = replay_through_kernel(server, ["doc"])
+    assert mxk.materialize_grid(state, 0, val_rev) == grid_of(m1)
