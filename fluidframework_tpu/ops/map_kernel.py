@@ -129,6 +129,28 @@ def apply_tick_packed(state: MapState, kind_slot: jax.Array,
     return jax.vmap(_apply_doc)(state, ops)
 
 
+@jax.jit
+def apply_tick_words(state: MapState, words: jax.Array, counts: jax.Array,
+                     base_seq: jax.Array) -> MapState:
+    """Minimum-wire entry: 4 bytes/op. ``words`` is u32/i32[B, K] packing
+    kind(2) | slot(10) | value(20); seq derives on device as base_seq + op
+    index. The host→device link is the op-storm bottleneck (a tunnel or
+    DCN hop runs at O(100MB/s)), so bytes-per-op is the throughput knob;
+    hosts whose interned value ids outgrow 20 bits (or key slots 10 bits)
+    fall back to apply_tick_packed / apply_tick."""
+    k = words.shape[1]
+    words = words.astype(jnp.uint32)
+    iota = jnp.arange(k, dtype=I32)[None, :]
+    ops = MapOpBatch(
+        valid=iota < counts[:, None],
+        kind=(words & 3).astype(I32),
+        slot=((words >> 2) & 0x3FF).astype(I32),
+        value=((words >> 12) & 0xFFFFF).astype(I32),
+        seq=base_seq[:, None] + iota + 1,
+    )
+    return jax.vmap(_apply_doc)(state, ops)
+
+
 def make_map_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
                       k: int) -> MapOpBatch:
     """Encode python op dicts {kind, slot, value, seq} into padded arrays."""

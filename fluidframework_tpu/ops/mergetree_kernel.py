@@ -213,22 +213,26 @@ def _annotate_range(s: MergeState, op) -> MergeState:
 
 
 def _apply_op(s: MergeState, op) -> MergeState:
-    def do_insert(state):
-        state = _split_at(state, op.pos, op.ref_seq, op.client)
-        return _place_segment(state, op)
+    # Unified dataflow instead of lax.switch branches: under vmap every
+    # switch branch executes for every op, so the branchy form pays ~5
+    # shift phases per op. Here every op runs exactly 2 splits (the second
+    # is a no-op for inserts via pos=-1) + one place, and the cheap
+    # mark/annotate writes select by kind at the end.
+    is_insert = op.kind == MT_INSERT
+    is_remove = op.kind == MT_REMOVE
 
-    def do_remove(state):
-        state = _split_at(state, op.pos, op.ref_seq, op.client)
-        state = _split_at(state, op.end, op.ref_seq, op.client)
-        return _mark_range(state, op)
+    split = _split_at(s, op.pos, op.ref_seq, op.client)
+    split = _split_at(split, jnp.where(is_insert, I32(-1), op.end),
+                      op.ref_seq, op.client)
 
-    def do_annotate(state):
-        state = _split_at(state, op.pos, op.ref_seq, op.client)
-        state = _split_at(state, op.end, op.ref_seq, op.client)
-        return _annotate_range(state, op)
+    placed = _place_segment(split, op)
+    marked = _mark_range(split, op)
+    annotated = _annotate_range(split, op)
 
-    applied = jax.lax.switch(jnp.clip(op.kind, 0, 2),
-                             [do_insert, do_remove, do_annotate], s)
+    applied = jax.tree.map(
+        lambda p, m, a: jnp.where(
+            is_insert, p, jnp.where(is_remove, m, a)),
+        placed, marked, annotated)
     return jax.tree.map(
         lambda new, old: jnp.where(op.valid, new, old), applied, s)
 

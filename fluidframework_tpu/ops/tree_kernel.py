@@ -35,7 +35,12 @@ TREE_SET_VALUE = 0
 TREE_DETACH = 1
 TREE_INSERT = 2
 
-MAX_DEPTH_PASSES = 16  # supports trees up to depth 2^16 via doubling
+# Detach propagates removal down the tree one level per pass, so trees up
+# to this depth converge; the serving host routes deeper docs to the scalar
+# path. Linear passes of a one-hot parent matvec beat pointer-doubling
+# gathers on TPU: XLA lowers 1-D dynamic gathers to slow serial loads,
+# while the [N, N] one-hot contraction rides the MXU.
+MAX_DEPTH_PASSES = 32
 
 
 class TreeState(NamedTuple):
@@ -80,21 +85,28 @@ def _apply_op(s: TreeState, op):
     target = lanes == node
     payload = jnp.where(target & ok & is_set, op.payload, s.payload)
 
-    # detach: drop node + all descendants. True pointer-doubling: each pass
-    # both ORs in ancestors' removal AND squares the ancestor jump, so
-    # MAX_DEPTH_PASSES passes cover depth 2^MAX_DEPTH_PASSES.
-    def drop_subtree(exists):
-        def body(_i, carry):
-            removed, anc = carry
-            has_anc = anc >= 0
-            safe = jnp.clip(anc, 0, None)
-            removed = removed | (removed[safe] & has_anc)
-            anc = jnp.where(has_anc, anc[safe], -1)
-            return removed, anc
-        removed, _ = jax.lax.fori_loop(
-            0, MAX_DEPTH_PASSES, body, (target, s.parent))
-        return exists & ~removed
-    exists = jnp.where(ok & is_detach, drop_subtree(s.exists), s.exists)
+    # detach: drop node + all descendants. Each pass marks children of
+    # already-marked nodes via a one-hot parent matvec on the MXU:
+    # hit[i] = removed[parent[i]] = (parent[i] == j) . removed[j].
+    # The while_loop exits as soon as the removal set stops growing, so a
+    # non-detach op (empty seed) costs one pass and a detach costs
+    # subtree-depth passes — not the worst-case bound.
+    parent_onehot = (s.parent[:, None] == lanes[None, :]).astype(jnp.bfloat16)
+    seed = target & ok & is_detach
+
+    def not_converged(carry):
+        _removed, changed, passes = carry
+        return changed & (passes < MAX_DEPTH_PASSES)
+
+    def grow(carry):
+        removed, _, passes = carry
+        hit = (parent_onehot @ removed.astype(jnp.bfloat16)) > 0
+        new = removed | hit
+        return new, jnp.any(new != removed), passes + 1
+
+    removed, _, _ = jax.lax.while_loop(
+        not_converged, grow, (seed, jnp.any(seed), 0))
+    exists = s.exists & ~removed
 
     # insert
     exists = jnp.where(target & ok & is_insert, True, exists)

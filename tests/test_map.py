@@ -203,3 +203,35 @@ def test_map_snapshot_roundtrip():
     b = MapData.load(a.snapshot())
     assert contents(b) == contents(a)
     assert b.snapshot() == a.snapshot()
+
+
+def test_map_kernel_words_path_matches_full_batch():
+    """The fused 4-byte/op wire entry must produce the same state as the
+    explicit MapOpBatch path for the same op stream."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    num_docs, k, num_slots, ticks = 16, 32, 32, 4
+    state_a = mk.init_state(num_docs, num_slots)
+    state_b = mk.init_state(num_docs, num_slots)
+    for t in range(ticks):
+        kinds = rng.choice([mk.MAP_SET, mk.MAP_DELETE, mk.MAP_CLEAR],
+                           p=[0.7, 0.2, 0.1],
+                           size=(num_docs, k)).astype(np.uint32)
+        slots = rng.integers(0, num_slots, (num_docs, k)).astype(np.uint32)
+        values = rng.integers(1, 1 << 20, (num_docs, k)).astype(np.uint32)
+        words = kinds | (slots << 2) | (values << 12)
+        counts = np.full((num_docs,), k, np.int32)
+        base_seq = np.full((num_docs,), t * k, np.int32)
+        state_a = mk.apply_tick_words(state_a, words, counts, base_seq)
+
+        ops_per_doc = [
+            [dict(kind=int(kinds[d, i]), slot=int(slots[d, i]),
+                  value=int(values[d, i]), seq=t * k + i + 1)
+             for i in range(k)]
+            for d in range(num_docs)]
+        state_b = mk.apply_tick(
+            state_b, mk.make_map_op_batch(ops_per_doc, num_docs, k))
+
+    for field_a, field_b in zip(state_a, state_b):
+        assert (np.asarray(field_a) == np.asarray(field_b)).all()
