@@ -1,0 +1,200 @@
+"""Network driver — reaches an alfred front door over TCP.
+
+Reference parity: packages/drivers/routerlicious-driver (socket ordering
+connection documentDeltaConnection.ts:61, REST delta/storage reads
+deltaStorageService.ts:24, documentStorageService.ts:36) over the
+driver-base connection machinery (documentDeltaConnection.ts:35). One
+socket multiplexes the live delta connection and the storage RPCs, framed
+by protocol.codec.
+
+Threading model: the reference client is single-threaded (JS event loop);
+here a background reader thread receives pushed events. All inbound
+callbacks (ops/nack/signal) are invoked holding ``dispatch_lock`` — a host
+driving local edits from another thread takes the same lock around them
+(the e2e tests do), which serializes the container stack exactly like the
+reference's event loop does.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Callable
+
+from ..protocol.codec import MAX_FRAME, decode_body, encode_frame
+from ..protocol.messages import DocumentMessage, NackMessage, SequencedDocumentMessage
+from ..utils.events import TypedEventEmitter
+from .base import IncomingHandler
+
+_LEN = struct.Struct(">I")
+
+
+class _NetworkConnection:
+    """DeltaConnection over the shared socket."""
+
+    def __init__(self, service: "NetworkDocumentService",
+                 client_id: str) -> None:
+        self._service = service
+        self.client_id = client_id
+        self.open = True
+
+    def submit(self, messages: list[DocumentMessage]) -> None:
+        assert self.open, "submit on closed connection"
+        self._service._request({"op": "submit", "messages": messages})
+
+    def signal(self, content: Any) -> None:
+        assert self.open, "signal on closed connection"
+        self._service._request({"op": "signal", "content": content})
+
+    def close(self) -> None:
+        if self.open:
+            self.open = False
+            self._service._request({"op": "disconnect"})
+
+
+class _NetworkSnapshotStorage:
+    def __init__(self, service: "NetworkDocumentService") -> None:
+        self._service = service
+
+    def get_latest_snapshot(self) -> dict | None:
+        return self._service._request({"op": "get_latest_snapshot"})[
+            "snapshot"]
+
+    def upload_snapshot(self, snapshot: dict) -> str:
+        return self._service._request({"op": "upload_snapshot",
+                                       "snapshot": snapshot})["handle"]
+
+
+class _NetworkDeltaStorage:
+    def __init__(self, service: "NetworkDocumentService") -> None:
+        self._service = service
+
+    def get_deltas(self, from_seq: int, to_seq: int | None = None
+                   ) -> list[SequencedDocumentMessage]:
+        return self._service._request({"op": "get_deltas",
+                                       "from_seq": from_seq,
+                                       "to_seq": to_seq})["messages"]
+
+
+class NetworkDocumentService:
+    """IDocumentService over a TCP alfred."""
+
+    def __init__(self, host: str, port: int, doc_id: str,
+                 scopes=None, timeout: float = 30.0) -> None:
+        self.doc_id = doc_id
+        self.storage = _NetworkSnapshotStorage(self)
+        self.delta_storage = _NetworkDeltaStorage(self)
+        self._scopes = scopes
+        self._timeout = timeout
+        self.dispatch_lock = threading.RLock()
+        self.events = TypedEventEmitter()  # "disconnect" on socket loss
+
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._send_lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: dict[int, queue.Queue] = {}
+        self._handlers: dict[str, Callable] = {}
+        self._closed = False
+        # The reader thread must never block on dispatch_lock (a caller may
+        # hold it while awaiting an RPC response only the reader can
+        # deliver), so pushed events drain through a separate dispatcher
+        # thread; RPC responses route directly from the reader.
+        self._events: queue.Queue = queue.Queue()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True)
+        self._dispatcher.start()
+
+    # -- framing --------------------------------------------------------------
+
+    def _send(self, payload: dict) -> None:
+        data = encode_frame(payload)
+        with self._send_lock:
+            self._sock.sendall(data)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("socket closed")
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                header = self._recv_exact(4)
+                length = _LEN.unpack(header)[0]
+                if length > MAX_FRAME:
+                    raise ConnectionError(f"oversized frame: {length}")
+                payload = decode_body(self._recv_exact(length))
+                self._dispatch(payload)
+        except (ConnectionError, OSError):
+            self._closed = True
+            for q in self._pending.values():
+                q.put_nowait(ConnectionError("connection lost"))
+            self._events.put({"event": "__disconnect__"})
+
+    def _dispatch(self, payload: dict) -> None:
+        rid = payload.get("rid")
+        if rid is not None:
+            q = self._pending.pop(rid, None)
+            if q is not None:
+                q.put_nowait(payload)
+            return
+        self._events.put(payload)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            payload = self._events.get()
+            if payload.get("event") == "__disconnect__":
+                with self.dispatch_lock:
+                    self.events.emit("disconnect")
+                return
+            handler = self._handlers.get(payload.get("event"))
+            if handler is not None:
+                with self.dispatch_lock:
+                    handler(payload)
+
+    def _request(self, req: dict) -> dict:
+        if self._closed:
+            raise ConnectionError("connection lost")
+        rid = next(self._rid)
+        q: queue.Queue = queue.Queue()
+        self._pending[rid] = q
+        self._send({**req, "rid": rid, "doc_id": self.doc_id})
+        resp = q.get(timeout=self._timeout)
+        if isinstance(resp, Exception):
+            raise resp
+        if "error" in resp:
+            raise RuntimeError(f"alfred error: {resp['error']}")
+        return resp
+
+    # -- IDocumentService ------------------------------------------------------
+
+    def connect(self, handler: IncomingHandler,
+                on_nack: Callable[[NackMessage], None] | None = None,
+                on_signal: Callable[[Any], None] | None = None,
+                mode: str = "write") -> _NetworkConnection:
+        self._handlers["ops"] = lambda p: handler(p["messages"])
+        if on_nack is not None:
+            self._handlers["nack"] = lambda p: on_nack(p["nack"])
+        if on_signal is not None:
+            self._handlers["signal"] = lambda p: on_signal(p["signal"])
+        req: dict = {"op": "connect", "mode": mode}
+        if self._scopes is not None:
+            req["scopes"] = list(self._scopes)
+        resp = self._request(req)
+        return _NetworkConnection(self, resp["client_id"])
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

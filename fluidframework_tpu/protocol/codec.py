@@ -1,0 +1,124 @@
+"""Wire codec for protocol messages — JSON-framed, type-tagged.
+
+Reference parity: the socket.io JSON payloads of the reference's delta
+connection (driver-base/documentDeltaConnection.ts:35, alfred
+index.ts:343-427). Dataclasses are tagged with ``_t`` so both ends of the
+DCN hop rebuild the exact protocol types; op ``contents`` pass through as
+plain JSON (tuples canonicalize to lists on the wire — DDS load paths
+accept either).
+
+Frames on the socket are ``4-byte big-endian length + utf-8 JSON``
+(see server.alfred / drivers.network_driver).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+from .messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    NackErrorType,
+    SequencedDocumentMessage,
+    Trace,
+)
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def to_wire(obj: Any) -> Any:
+    """Recursively convert protocol objects into JSON-able structures."""
+    if isinstance(obj, SequencedDocumentMessage):
+        return {"_t": "seq", "client_id": obj.client_id,
+                "sequence_number": obj.sequence_number,
+                "minimum_sequence_number": obj.minimum_sequence_number,
+                "client_sequence_number": obj.client_sequence_number,
+                "reference_sequence_number": obj.reference_sequence_number,
+                "type": int(obj.type), "contents": to_wire(obj.contents),
+                "metadata": to_wire(obj.metadata),
+                "server_metadata": to_wire(obj.server_metadata),
+                "traces": [to_wire(t) for t in obj.traces],
+                "timestamp": obj.timestamp, "data": to_wire(obj.data)}
+    if isinstance(obj, DocumentMessage):
+        return {"_t": "doc",
+                "client_sequence_number": obj.client_sequence_number,
+                "reference_sequence_number": obj.reference_sequence_number,
+                "type": int(obj.type), "contents": to_wire(obj.contents),
+                "metadata": to_wire(obj.metadata),
+                "server_metadata": to_wire(obj.server_metadata),
+                "traces": [to_wire(t) for t in obj.traces]}
+    if isinstance(obj, NackMessage):
+        return {"_t": "nack", "operation": to_wire(obj.operation),
+                "sequence_number": obj.sequence_number, "code": obj.code,
+                "error_type": int(obj.error_type), "message": obj.message,
+                "retry_after_s": obj.retry_after_s}
+    if isinstance(obj, Trace):
+        return {"_t": "trace", "service": obj.service, "action": obj.action,
+                "timestamp": obj.timestamp}
+    if isinstance(obj, ClientDetail):
+        return {"_t": "cd", "client_id": obj.client_id, "mode": obj.mode,
+                "scopes": list(obj.scopes), "user": obj.user}
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v) for v in obj]
+    return obj
+
+
+def from_wire(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        tag = obj.get("_t")
+        if tag == "seq":
+            return SequencedDocumentMessage(
+                client_id=obj["client_id"],
+                sequence_number=obj["sequence_number"],
+                minimum_sequence_number=obj["minimum_sequence_number"],
+                client_sequence_number=obj["client_sequence_number"],
+                reference_sequence_number=obj["reference_sequence_number"],
+                type=MessageType(obj["type"]),
+                contents=from_wire(obj["contents"]),
+                metadata=from_wire(obj["metadata"]),
+                server_metadata=from_wire(obj["server_metadata"]),
+                traces=tuple(from_wire(t) for t in obj["traces"]),
+                timestamp=obj["timestamp"], data=from_wire(obj["data"]))
+        if tag == "doc":
+            return DocumentMessage(
+                client_sequence_number=obj["client_sequence_number"],
+                reference_sequence_number=obj["reference_sequence_number"],
+                type=MessageType(obj["type"]),
+                contents=from_wire(obj["contents"]),
+                metadata=from_wire(obj["metadata"]),
+                server_metadata=from_wire(obj["server_metadata"]),
+                traces=tuple(from_wire(t) for t in obj["traces"]))
+        if tag == "nack":
+            return NackMessage(
+                operation=from_wire(obj["operation"]),
+                sequence_number=obj["sequence_number"], code=obj["code"],
+                error_type=NackErrorType(obj["error_type"]),
+                message=obj["message"],
+                retry_after_s=obj["retry_after_s"])
+        if tag == "trace":
+            return Trace(service=obj["service"], action=obj["action"],
+                         timestamp=obj["timestamp"])
+        if tag == "cd":
+            return ClientDetail(client_id=obj["client_id"], mode=obj["mode"],
+                                scopes=tuple(obj["scopes"]), user=obj["user"])
+        return {k: from_wire(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [from_wire(v) for v in obj]
+    return obj
+
+
+def encode_frame(payload: Any) -> bytes:
+    body = json.dumps(to_wire(payload), separators=(",", ":")).encode()
+    assert len(body) <= MAX_FRAME, f"frame too large: {len(body)}"
+    return _LEN.pack(len(body)) + body
+
+
+def decode_body(body: bytes) -> Any:
+    return from_wire(json.loads(body.decode()))

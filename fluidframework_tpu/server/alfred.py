@@ -1,0 +1,204 @@
+"""Alfred — the network front door: a socket server over the ordering
+service.
+
+Reference parity: server/routerlicious/packages/lambdas/src/alfred/
+index.ts:140-477 — the socket handler exposing ``connect_document``
+(→ :343), ``submitOp`` (→ :367-385), ``submitSignal`` (→ :427) plus the
+REST-ish storage/delta reads (routerlicious-base alfred app). Transport is
+length-prefixed JSON over TCP (asyncio) instead of socket.io — the DCN hop
+of SURVEY.md §5.8; the ordering service behind it is unchanged
+(RouterliciousService or LocalCollabServer, duck-typed).
+
+Wire protocol (all frames = 4-byte BE length + JSON, protocol.codec):
+  client→server requests carry ``rid``; the response echoes it:
+    {rid, op: "connect", doc_id, mode, scopes?}     → {rid, client_id}
+    {rid, op: "submit", messages: [DocumentMessage]} → {rid, ok}
+    {rid, op: "signal", content}                     → {rid, ok}
+    {rid, op: "get_deltas", from_seq, to_seq}        → {rid, messages}
+    {rid, op: "upload_snapshot", snapshot}           → {rid, handle}
+    {rid, op: "get_latest_snapshot"}                 → {rid, snapshot}
+    {rid, op: "disconnect"}                          → {rid, ok}
+  server→client events (no rid):
+    {event: "ops", messages: [SequencedDocumentMessage]}
+    {event: "nack", nack: NackMessage}
+    {event: "signal", signal}
+
+Run standalone (the tinylicious analog):
+    python -m fluidframework_tpu.server.alfred --port 7070
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from typing import Any
+
+from ..protocol.codec import MAX_FRAME, decode_body, encode_frame
+from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    length = int.from_bytes(header, "big")
+    if length > MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {length}")
+    return decode_body(await reader.readexactly(length))
+
+
+class _ClientSession:
+    """One socket = one (doc, client) session, mirroring the reference's
+    per-socket connection state (alfred index.ts:278)."""
+
+    def __init__(self, server: "AlfredServer",
+                 writer: asyncio.StreamWriter) -> None:
+        self.server = server
+        self.writer = writer
+        self.outbox: asyncio.Queue = asyncio.Queue()
+        self.connection = None  # service-side live connection
+        self.doc_id: str | None = None
+
+    def push(self, payload: dict) -> None:
+        self.outbox.put_nowait(payload)
+
+    async def writer_loop(self) -> None:
+        while True:
+            payload = await self.outbox.get()
+            if payload is None:
+                break
+            self.writer.write(encode_frame(payload))
+            await self.writer.drain()
+
+    def handle_request(self, req: dict) -> dict:
+        """Dispatch one request synchronously against the service."""
+        service = self.server.service
+        op = req["op"]
+        rid = req.get("rid")
+        if op == "connect":
+            assert self.connection is None, "already connected"
+            self.doc_id = req["doc_id"]
+            kwargs: dict = {"mode": req.get("mode", "write")}
+            if req.get("scopes") is not None:
+                kwargs["scopes"] = tuple(req["scopes"])
+            self.connection = service.connect(
+                self.doc_id,
+                lambda msgs: self.push({"event": "ops", "messages": msgs}),
+                on_nack=lambda n: self.push({"event": "nack", "nack": n}),
+                on_signal=lambda s: self.push({"event": "signal",
+                                              "signal": s}),
+                **kwargs)
+            self.server.metrics.counter("alfred.connects").inc()
+            return {"rid": rid, "client_id": self.connection.client_id}
+        if op == "submit":
+            self.connection.submit(req["messages"])
+            return {"rid": rid, "ok": True}
+        if op == "signal":
+            self.connection.signal(req["content"])
+            return {"rid": rid, "ok": True}
+        if op == "get_deltas":
+            doc = req.get("doc_id", self.doc_id)
+            return {"rid": rid, "messages": service.get_deltas(
+                doc, req["from_seq"], req.get("to_seq"))}
+        if op == "upload_snapshot":
+            doc = req.get("doc_id", self.doc_id)
+            return {"rid": rid,
+                    "handle": service.upload_snapshot(doc, req["snapshot"])}
+        if op == "get_latest_snapshot":
+            doc = req.get("doc_id", self.doc_id)
+            return {"rid": rid, "snapshot": service.get_latest_snapshot(doc)}
+        if op == "disconnect":
+            if self.connection is not None:
+                self.connection.close()
+                self.connection = None
+            return {"rid": rid, "ok": True}
+        return {"rid": rid, "error": f"unknown op {op!r}"}
+
+
+class AlfredServer:
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 logger: TelemetryLogger | None = None,
+                 metrics: MetricsRegistry | None = None) -> None:
+        self.service = service
+        self.host = host
+        self.port = port
+        self.logger = logger if logger is not None else NullLogger()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.logger.send_event("AlfredListening", port=self.port)
+        return self.port
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        session = _ClientSession(self, writer)
+        writer_task = asyncio.create_task(session.writer_loop())
+        try:
+            while True:
+                try:
+                    req = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                try:
+                    resp = session.handle_request(req)
+                except Exception as err:  # report, keep the socket alive
+                    self.logger.send_error("AlfredRequestFailed", err,
+                                           op=req.get("op"))
+                    resp = {"rid": req.get("rid"), "error": repr(err)}
+                session.push(resp)
+        finally:
+            if session.connection is not None:
+                session.connection.close()
+            session.push(None)
+            await writer_task
+            writer.close()
+
+
+def build_default_service():
+    """Standalone assembly: routerlicious lambdas + device merge host."""
+    from .merge_host import KernelMergeHost
+    from .routerlicious import RouterliciousService
+    return RouterliciousService(merge_host=KernelMergeHost())
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7070)
+    parser.add_argument("--no-merge-host", action="store_true",
+                        help="skip the device kernel host (CPU-only box)")
+    args = parser.parse_args(argv)
+
+    if args.no_merge_host:
+        from .routerlicious import RouterliciousService
+        service = RouterliciousService()
+    else:
+        service = build_default_service()
+
+    async def run() -> None:
+        server = AlfredServer(service, args.host, args.port)
+        port = await server.start()
+        print(f"READY {port}", flush=True)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
