@@ -30,6 +30,18 @@ from .messages import (
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
 
+# Extension registry: layers above the protocol (e.g. the server's
+# RawOperation) register their own tagged types without the protocol layer
+# importing them. to_fn(obj) -> JSON-able dict body; from_fn(body) -> obj.
+_EXT_BY_TYPE: dict[type, tuple[str, Any]] = {}
+_EXT_BY_TAG: dict[str, Any] = {}
+
+
+def register_codec(tag: str, cls: type, to_fn, from_fn) -> None:
+    assert tag not in _EXT_BY_TAG or _EXT_BY_TAG[tag] is from_fn
+    _EXT_BY_TYPE[cls] = (tag, to_fn)
+    _EXT_BY_TAG[tag] = from_fn
+
 
 def to_wire(obj: Any) -> Any:
     """Recursively convert protocol objects into JSON-able structures."""
@@ -63,6 +75,10 @@ def to_wire(obj: Any) -> Any:
     if isinstance(obj, ClientDetail):
         return {"_t": "cd", "client_id": obj.client_id, "mode": obj.mode,
                 "scopes": list(obj.scopes), "user": obj.user}
+    ext = _EXT_BY_TYPE.get(type(obj))
+    if ext is not None:
+        tag, to_fn = ext
+        return {"_t": tag, **to_wire(to_fn(obj))}
     if isinstance(obj, dict):
         return {k: to_wire(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -108,6 +124,9 @@ def from_wire(obj: Any) -> Any:
         if tag == "cd":
             return ClientDetail(client_id=obj["client_id"], mode=obj["mode"],
                                 scopes=tuple(obj["scopes"]), user=obj["user"])
+        if tag in _EXT_BY_TAG:
+            body = {k: from_wire(v) for k, v in obj.items() if k != "_t"}
+            return _EXT_BY_TAG[tag](body)
         return {k: from_wire(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [from_wire(v) for v in obj]

@@ -167,11 +167,21 @@ class AlfredServer:
             writer.close()
 
 
-def build_default_service():
-    """Standalone assembly: routerlicious lambdas + device merge host."""
-    from .merge_host import KernelMergeHost
+def build_default_service(data_dir: str | None = None, merge_host=True):
+    """Standalone assembly: routerlicious lambdas (+ device merge host,
+    + durable file-backed storage when ``data_dir`` is given)."""
     from .routerlicious import RouterliciousService
-    return RouterliciousService(merge_host=KernelMergeHost())
+    kwargs: dict = {}
+    if merge_host:
+        from .merge_host import KernelMergeHost
+        kwargs["merge_host"] = KernelMergeHost()
+    if data_dir is not None:
+        from .durable_store import (
+            DurableMessageBus, FileStateStore, GitSnapshotStore)
+        kwargs["bus"] = DurableMessageBus(f"{data_dir}/bus")
+        kwargs["store"] = FileStateStore(f"{data_dir}/state")
+        kwargs["snapshots"] = GitSnapshotStore(f"{data_dir}/git")
+    return RouterliciousService(**kwargs)
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -180,13 +190,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--port", type=int, default=7070)
     parser.add_argument("--no-merge-host", action="store_true",
                         help="skip the device kernel host (CPU-only box)")
+    parser.add_argument("--data-dir", default=None,
+                        help="directory for durable bus/state/snapshots; "
+                             "omitted = in-memory (tinylicious mode)")
     args = parser.parse_args(argv)
 
-    if args.no_merge_host:
-        from .routerlicious import RouterliciousService
-        service = RouterliciousService()
-    else:
-        service = build_default_service()
+    service = build_default_service(args.data_dir,
+                                    merge_host=not args.no_merge_host)
 
     async def run() -> None:
         server = AlfredServer(service, args.host, args.port)

@@ -40,6 +40,33 @@ RAWDELTAS = "rawdeltas"
 DELTAS = "deltas"
 
 
+class StoreSnapshotBackend:
+    """Default snapshot backend over the StateStore (in-memory historian).
+    The durable content-addressed alternative is
+    server.durable_store.GitSnapshotStore — same four-method surface."""
+
+    def __init__(self, store: StateStore) -> None:
+        self._store = store
+
+    def upload(self, doc_id: str, snapshot: dict) -> str:
+        snapshots: dict = self._store.get(f"snapshots/{doc_id}", {})
+        handle = f"{doc_id}/snapshots/{len(snapshots)}"
+        snapshots[handle] = snapshot
+        self._store.put(f"snapshots/{doc_id}", snapshots)
+        return handle
+
+    def get(self, doc_id: str, handle: str | None) -> dict | None:
+        if handle is None:
+            return None
+        return self._store.get(f"snapshots/{doc_id}", {}).get(handle)
+
+    def head(self, doc_id: str) -> str | None:
+        return self._store.get(f"summary_head/{doc_id}")
+
+    def set_head(self, doc_id: str, handle: str) -> None:
+        self._store.put(f"summary_head/{doc_id}", handle)
+
+
 # -- deli ---------------------------------------------------------------------
 
 
@@ -305,11 +332,12 @@ class ScribeDocumentLambda:
     loop the reference uses (scribe → deli → deltas)."""
 
     def __init__(self, doc_id: str, store: StateStore, bus: MessageBus,
-                 clock: Callable[[], int]) -> None:
+                 clock: Callable[[], int], snapshots) -> None:
         self.doc_id = doc_id
         self._store = store
         self._bus = bus
         self._clock = clock
+        self._snapshots = snapshots
         self._handled_seq = int(
             self._store.get(f"scribe/{self.doc_id}", {}).get("seq", 0))
 
@@ -326,10 +354,9 @@ class ScribeDocumentLambda:
         handle = (op.contents or {}).get("handle")
         proposal = {"summary_proposal": {
             "summary_sequence_number": op.sequence_number}}
-        snapshots = self._store.get(f"snapshots/{self.doc_id}", {})
-        offered = snapshots.get(handle)
-        acked_handle = self._store.get(f"summary_head/{self.doc_id}")
-        current = snapshots.get(acked_handle) if acked_handle else None
+        offered = self._snapshots.get(self.doc_id, handle)
+        current = self._snapshots.get(self.doc_id,
+                                      self._snapshots.head(self.doc_id))
         offered_seq = (offered or {}).get("sequence_number")
 
         def produce_raw(mtype: MessageType, contents: dict) -> None:
@@ -352,7 +379,7 @@ class ScribeDocumentLambda:
                            f"current {current['sequence_number']}",
                 "handle": handle, **proposal})
         else:
-            self._store.put(f"summary_head/{self.doc_id}", handle)
+            self._snapshots.set_head(self.doc_id, handle)
             produce_raw(MessageType.SUMMARY_ACK,
                         {"handle": handle, **proposal})
 
@@ -362,12 +389,13 @@ class ScribeDocumentLambda:
 
 class _ScribeFactory:
     def __init__(self, store: StateStore, bus: MessageBus,
-                 clock: Callable[[], int]) -> None:
+                 clock: Callable[[], int], snapshots) -> None:
         self._store, self._bus, self._clock = store, bus, clock
+        self._snapshots = snapshots
 
     def create(self, doc_id: str) -> ScribeDocumentLambda:
         return ScribeDocumentLambda(doc_id, self._store, self._bus,
-                                    self._clock)
+                                    self._clock, self._snapshots)
 
 
 # -- service assembly ---------------------------------------------------------
@@ -388,7 +416,8 @@ class RouterliciousService:
                  sequencer_factory: Callable[[], DocumentSequencer]
                  = DocumentSequencer, merge_host=None,
                  logger: TelemetryLogger | None = None,
-                 metrics: MetricsRegistry | None = None) -> None:
+                 metrics: MetricsRegistry | None = None,
+                 snapshots=None) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
         self.logger = logger if logger is not None else NullLogger()
@@ -399,6 +428,8 @@ class RouterliciousService:
             # psum aggregation sees merge-host counters too).
             merge_host.metrics = self.metrics
         self.store = store if store is not None else StateStore()
+        self.snapshots = snapshots if snapshots is not None \
+            else StoreSnapshotBackend(self.store)
         self.bus.create_topic(RAWDELTAS, num_partitions)
         self.bus.create_topic(DELTAS, num_partitions)
         self._connections: dict[str, dict[str, _LiveConnection]] = {}
@@ -421,7 +452,8 @@ class RouterliciousService:
             self.bus, DELTAS, "broadcaster", _BroadcasterFactory(self))
         self._scribe = PartitionManager(
             self.bus, DELTAS, "scribe",
-            _ScribeFactory(self.store, self.bus, self._clock))
+            _ScribeFactory(self.store, self.bus, self._clock,
+                           self.snapshots))
         self._merger = (PartitionManager(
             self.bus, DELTAS, "merger",
             _MergerFactory(merge_host, self.store))
@@ -533,16 +565,10 @@ class RouterliciousService:
                 and (to_seq is None or m.sequence_number <= to_seq)]
 
     def upload_snapshot(self, doc_id: str, snapshot: dict) -> str:
-        snapshots: dict = self.store.get(f"snapshots/{doc_id}", {})
-        handle = f"{doc_id}/snapshots/{len(snapshots)}"
-        snapshots[handle] = snapshot
-        self.store.put(f"snapshots/{doc_id}", snapshots)
-        if self.store.get(f"summary_head/{doc_id}") is None:
-            self.store.put(f"summary_head/{doc_id}", handle)
+        handle = self.snapshots.upload(doc_id, snapshot)
+        if self.snapshots.head(doc_id) is None:
+            self.snapshots.set_head(doc_id, handle)
         return handle
 
     def get_latest_snapshot(self, doc_id: str) -> dict | None:
-        head = self.store.get(f"summary_head/{doc_id}")
-        if head is None:
-            return None
-        return self.store.get(f"snapshots/{doc_id}", {}).get(head)
+        return self.snapshots.get(doc_id, self.snapshots.head(doc_id))
