@@ -39,10 +39,16 @@ def _load_library() -> ctypes.CDLL | None:
             if (not _LIB.exists()
                     or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
                 _BUILD_DIR.mkdir(exist_ok=True)
+                # Build to a process-unique temp path and publish
+                # atomically: a concurrent process must never CDLL a
+                # half-written .so (which would also poison the mtime
+                # check forever).
+                tmp = _BUILD_DIR / f"liboplog.{os.getpid()}.tmp.so"
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", str(_SRC),
-                     "-o", str(_LIB), "-lz"],
+                     "-o", str(tmp), "-lz"],
                     check=True, capture_output=True, timeout=120)
+                tmp.replace(_LIB)
             lib = ctypes.CDLL(str(_LIB))
         except (OSError, subprocess.SubprocessError):
             _lib_failed = True

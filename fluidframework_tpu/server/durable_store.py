@@ -24,6 +24,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import re
 from pathlib import Path
 from typing import Any
 
@@ -69,8 +70,11 @@ class _DurablePartition:
 
     def append(self, key: str, value: Any) -> int:
         offset = len(self.log)
-        self._oplog.append(_dump([key, value]))
-        self.log.append(BusMessage(offset, key, value))
+        data = _dump([key, value])
+        self._oplog.append(data)
+        # Keep the codec-decoded copy in memory so consumers see identical
+        # shapes (tuples→lists etc.) before and after a restart replay.
+        self.log.append(BusMessage(offset, key, _load(data)[1]))
         return offset
 
     def close(self) -> None:
@@ -93,19 +97,36 @@ class DurableMessageBus(MessageBus):
     (kafka-service/checkpointManager.ts:24).
     """
 
+    OFFSET_COMPACT_THRESHOLD = 4096
+
     def __init__(self, root: str | os.PathLike) -> None:
         super().__init__()
         self._root = Path(root)
         self._root.mkdir(parents=True, exist_ok=True)
-        self._offset_log = OpLog(self._root / "offsets.log")
+        # Topic metadata journal: partition counts are fixed at creation
+        # (the Kafka rule) — reopening always uses the recorded count, so a
+        # caller passing a different num_partitions can never orphan logs
+        # or remap keys.
+        self._meta_log = OpLog(self._root / "topics.log")
+        self._topic_partitions: dict[str, int] = {}
+        for i in range(len(self._meta_log)):
+            name, count = _load(self._meta_log.read(i))
+            self._topic_partitions[name] = count
+        self._offset_path = self._root / "offsets.log"
+        self._offset_log = OpLog(self._offset_path)
         for i in range(len(self._offset_log)):
             topic, group, partition, nxt = _load(self._offset_log.read(i))
             self._offsets[(topic, group, partition)] = nxt
+        self._offset_records = len(self._offset_log)
 
     def create_topic(self, name: str, num_partitions: int = 4) -> Topic:
         if name not in self._topics:
-            self._topics[name] = _DurableTopic(name, num_partitions,
-                                               self._root)
+            recorded = self._topic_partitions.get(name)
+            if recorded is None:
+                self._meta_log.append(_dump([name, num_partitions]))
+                self._topic_partitions[name] = num_partitions
+                recorded = num_partitions
+            self._topics[name] = _DurableTopic(name, recorded, self._root)
         return self._topics[name]
 
     def commit(self, topic: str, group: str, partition: int,
@@ -114,8 +135,26 @@ class DurableMessageBus(MessageBus):
             return
         super().commit(topic, group, partition, next_offset)
         self._offset_log.append(_dump([topic, group, partition, next_offset]))
+        self._offset_records += 1
+        if self._offset_records > max(self.OFFSET_COMPACT_THRESHOLD,
+                                      4 * len(self._offsets)):
+            self._compact_offsets()
+
+    def _compact_offsets(self) -> None:
+        self._offset_log.close()
+        tmp = self._offset_path.with_suffix(".compact")
+        tmp.unlink(missing_ok=True)
+        fresh = OpLog(tmp)
+        for (topic, group, partition), nxt in sorted(self._offsets.items()):
+            fresh.append(_dump([topic, group, partition, nxt]))
+        fresh.sync()
+        fresh.close()
+        tmp.replace(self._offset_path)
+        self._offset_log = OpLog(self._offset_path)
+        self._offset_records = len(self._offset_log)
 
     def close(self) -> None:
+        self._meta_log.close()
         self._offset_log.close()
         for topic in self._topics.values():
             for part in topic.partitions:
@@ -131,6 +170,8 @@ class FileStateStore:
     replays the journal into memory. ``compact()`` rewrites the journal as
     one snapshot record per key (the Mongo-compaction analog)."""
 
+    COMPACT_THRESHOLD = 8192
+
     def __init__(self, root: str | os.PathLike) -> None:
         self._root = Path(root)
         self._root.mkdir(parents=True, exist_ok=True)
@@ -143,20 +184,31 @@ class FileStateStore:
                 self._data[key] = value
             else:
                 self._data.setdefault(key, []).extend(value)
+        self._records = len(self._journal)
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._data.get(key, default)
 
     def put(self, key: str, value: Any) -> None:
-        self._journal.append(_dump(["put", key, value]))
-        # Decode through the codec so in-memory state is identical to a
+        data = _dump(["put", key, value])
+        self._journal.append(data)
+        # Keep the codec-decoded copy so in-memory state is identical to a
         # post-restart replay (tuples become lists etc.) — no dual-shape
-        # bugs between first run and recovery.
-        self._data[key] = _load(_dump(value))
+        # bugs between first run and recovery. One serialization pass: the
+        # journal bytes are the source.
+        self._data[key] = _load(data)[2]
+        self._bump()
 
     def append(self, key: str, items: list) -> None:
-        self._journal.append(_dump(["append", key, items]))
-        self._data.setdefault(key, []).extend(_load(_dump(items)))
+        data = _dump(["append", key, items])
+        self._journal.append(data)
+        self._data.setdefault(key, []).extend(_load(data)[2])
+        self._bump()
+
+    def _bump(self) -> None:
+        self._records += 1
+        if self._records > max(self.COMPACT_THRESHOLD, 8 * len(self._data)):
+            self.compact()
 
     def keys(self, prefix: str = "") -> list[str]:
         return sorted(k for k in self._data if k.startswith(prefix))
@@ -175,6 +227,7 @@ class FileStateStore:
         fresh.close()
         tmp.replace(self._path)
         self._journal = OpLog(self._path)
+        self._records = len(self._journal)
 
     def close(self) -> None:
         self._journal.close()
@@ -203,7 +256,13 @@ class GitSnapshotStore:
 
     # -- object plumbing ------------------------------------------------------
 
+    _SHA_RE = re.compile(r"[0-9a-f]{64}")
+
     def _object_path(self, sha: str) -> Path:
+        # Handles arrive from clients (SUMMARIZE op contents) — a malformed
+        # one must never touch the filesystem (path traversal).
+        if not isinstance(sha, str) or not self._SHA_RE.fullmatch(sha):
+            raise KeyError(f"invalid object id {sha!r}")
         return self._root / "objects" / sha[:2] / sha[2:]
 
     def put_object(self, data: bytes) -> str:
@@ -235,7 +294,7 @@ class GitSnapshotStore:
         try:
             tree = json.loads(self.get_object(handle).decode())
             body = b"".join(self.get_object(c) for c in tree["chunks"])
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, KeyError, TypeError):
             return None
         return from_wire(json.loads(body.decode()))
 

@@ -106,6 +106,31 @@ class TestDurableBus:
         committed = [bus.committed("t", "g", p) for p in range(2)]
         assert committed == [1, 1]
 
+    def test_partition_count_pinned_at_creation(self, tmp_path):
+        bus = DurableMessageBus(tmp_path)
+        bus.create_topic("t", 8)
+        for i in range(16):
+            bus.produce("t", f"doc-{i}", i)
+        bus.close()
+        # Reopen asking for a different count: the recorded count wins, so
+        # no partition log is orphaned and keys keep their partitions.
+        bus = DurableMessageBus(tmp_path)
+        topic = bus.create_topic("t", 4)
+        assert topic.num_partitions == 8
+        values = sorted(m.value for p in range(8) for m in topic.read(p, 0))
+        assert values == list(range(16))
+
+    def test_offset_log_compacts(self, tmp_path):
+        bus = DurableMessageBus(tmp_path)
+        bus.OFFSET_COMPACT_THRESHOLD = 8
+        bus.create_topic("t", 1)
+        for i in range(200):
+            bus.commit("t", "g", 0, i + 1)
+        assert len(bus._offset_log) < 50
+        bus.close()
+        bus = DurableMessageBus(tmp_path)
+        assert bus.committed("t", "g", 0) == 200
+
 
 class TestFileStateStore:
     def test_put_append_reopen_compact(self, tmp_path):
@@ -140,6 +165,24 @@ class TestGitSnapshotStore:
         git.set_head("doc", h1)
         assert git.head("doc") == h1
         assert git.get("doc", "0" * 64) is None
+
+    def test_traversal_handles_rejected(self, tmp_path):
+        git = GitSnapshotStore(tmp_path)
+        outside = tmp_path.parent / "secret.json"
+        outside.write_text('{"chunks": []}')
+        for evil in ("../secret.json", "../../etc/passwd", "a/b",
+                     "A" * 64, "", None, 5):
+            assert git.get("doc", evil) is None
+
+    def test_state_store_auto_compacts(self, tmp_path):
+        store = FileStateStore(tmp_path)
+        store.COMPACT_THRESHOLD = 16
+        for i in range(500):
+            store.put("clock", i)
+        assert len(store._journal) < 100
+        store.close()
+        store = FileStateStore(tmp_path)
+        assert store.get("clock") == 499
 
 
 _PHASE_A = textwrap.dedent("""
