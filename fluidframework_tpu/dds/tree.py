@@ -35,6 +35,10 @@ class SharedTree(SharedObject):
         self._edit_counter = itertools.count(1)
         # seq -> snapshot BEFORE that sequenced edit (undo support, bounded).
         self._history: dict[str, TreeSnapshot] = {}
+        # Edit ids from the summary we loaded (EditLog.getEditLogSummary
+        # parity): keeps the summarized id window identical whether a
+        # replica replayed the full log or resumed from a snapshot.
+        self._prior_edit_ids: list[str] = []
 
     # -- views ----------------------------------------------------------------
 
@@ -137,17 +141,21 @@ class SharedTree(SharedObject):
         self._sequenced_snapshot = view
         self.log = EditLog()
         self._view = view
+        self._prior_edit_ids = []
 
     def summarize_core(self) -> dict:
+        ids = self._prior_edit_ids + [e.edit["id"]
+                                      for e in self.log.sequenced]
         return {
             "tree": self._sequenced_snapshot.serialize(),
-            "edit_ids": [e.edit["id"] for e in self.log.sequenced][-64:],
+            "edit_ids": ids[-64:],
         }
 
     def load_core(self, content: dict) -> None:
         self._sequenced_snapshot = TreeSnapshot.load(content["tree"])
         self._view = self._sequenced_snapshot
         self.log = EditLog()
+        self._prior_edit_ids = list(content.get("edit_ids", []))
 
     def apply_stashed_op(self, contents: Any) -> Any:
         self.log.add_local(contents["edit"])

@@ -1,0 +1,6 @@
+"""Developer tools: replay/golden-snapshot harness, golden corpus
+generator, service load driver.
+
+Reference parity: packages/tools (replay-tool, merge-tree-client-replay)
+and packages/test/snapshots / service-load-test.
+"""

@@ -1,0 +1,30 @@
+"""Golden-snapshot regression: replay checked-in op logs through the full
+stack and compare summaries byte-for-byte (replayMultipleFiles.ts:83-92
+Compare + Stress modes). These goldens anchor the wire format and summary
+format across rounds — a diff here means a format break, not a flake."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from fluidframework_tpu.tools.replay import verify_corpus, verify_golden
+
+GOLDENS = Path(__file__).parent / "goldens"
+
+
+@pytest.mark.parametrize("name", sorted(p.name for p in GOLDENS.iterdir()
+                                        if p.is_dir()))
+def test_golden_compare(name):
+    verify_golden(GOLDENS / name)
+
+
+@pytest.mark.parametrize("name", sorted(p.name for p in GOLDENS.iterdir()
+                                        if p.is_dir()))
+def test_golden_stress_snapshot_boundaries(name):
+    verify_golden(GOLDENS / name, stress=True)
+
+
+def test_corpus_is_nonempty():
+    assert len(verify_corpus(GOLDENS)) >= 5
