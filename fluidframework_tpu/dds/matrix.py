@@ -454,7 +454,42 @@ class SharedMatrix(SharedObject):
         self.cells = {tuple(key): value for key, value in content["cells"]}
 
     def apply_stashed_op(self, contents: Any) -> Any:
-        raise NotImplementedError("matrix stashed ops: use resubmit path")
+        """Re-apply a stashed local op (offline resume — the reference's
+        SharedMatrix applyStashedOp path, matrix.ts). Mutates local state
+        exactly as the original submit did and returns the metadata the
+        ack/resubmit paths expect; no message is sent (the pending-state
+        loader owns submission)."""
+        self._bind_client()
+        target = contents["target"]
+        if target in ("rows", "cols"):
+            vector = self.rows if target == "rows" else self.cols
+            if contents["type"] == "insert":
+                _op, local_seq, _temps = vector.insert_local(
+                    contents["pos"], contents["count"])
+            elif contents["type"] == "removeGroup":
+                local_seq = None
+                for start, end in contents["ranges"]:
+                    _op, local_seq = vector.remove_local(start, end - start)
+            else:
+                _op, local_seq = vector.remove_local(
+                    contents["start"], contents["end"] - contents["start"])
+            return ("vector", target, local_seq)
+        # Cell set: the local mutation of set_cell without the submit.
+        row_handle = self.rows.handle_at(contents["row"])
+        col_handle = self.cols.handle_at(contents["col"])
+        if row_handle is None or col_handle is None:
+            return None  # the row/col died before the stash resumed
+        key = (row_handle, col_handle)
+        self._local_seq += 1
+        pending = self._pending_cells.get(key)
+        if pending is None:
+            self._pending_cells[key] = [self._local_seq,
+                                        self.cells.get(key, _MISSING)]
+        else:
+            pending[0] = self._local_seq
+        self.cells[key] = contents["value"]
+        return ("cell", row_handle, col_handle, self._local_seq,
+                self.rows.local_seq_horizon(), self.cols.local_seq_horizon())
 
 
 class SharedMatrixFactory(ChannelFactory):

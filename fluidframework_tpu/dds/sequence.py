@@ -135,7 +135,14 @@ class SharedString(SharedObject):
             self.engine.update_min_seq(message.minimum_sequence_number)
             return
         if local:
-            self.engine.ack(message.sequence_number)
+            # A stashed "group" op spans several engine groups; all ack at
+            # this message's seq (the same frame a remote applier uses).
+            acks = (len(local_op_metadata[1])
+                    if isinstance(local_op_metadata, tuple)
+                    and local_op_metadata
+                    and local_op_metadata[0] == "stashed_group" else 1)
+            for _ in range(acks):
+                self.engine.ack(message.sequence_number)
         else:
             contents = message.contents
             ops = (contents["ops"] if contents["type"] == "group"
@@ -177,12 +184,30 @@ class SharedString(SharedObject):
                  "end": collection._resolve_at(interval.end, horizon),
                  "props": dict(interval.props)}, metadata)
             return
+        if isinstance(metadata, tuple) and metadata \
+                and metadata[0] == "stashed_group":
+            # A stashed group op: regenerate every surviving engine group
+            # into one combined group message (same metadata, re-entrant).
+            subops = []
+            for local_seq in metadata[1]:
+                subops.extend(self._regenerate_group_subops(local_seq))
+            self.submit_local_message({"type": "group", "ops": subops},
+                                      metadata)
+            return
         # metadata = the original op's localSeq; re-entrant acks may have
         # already popped earlier groups, so look the group up, not index it.
-        group = next((g for g in self.engine.pending_groups
-                      if g.local_seq == metadata), None)
-        if group is None:
+        if next((g for g in self.engine.pending_groups
+                 if g.local_seq == metadata), None) is None:
             return  # already acked through an earlier replay round
+        self.submit_local_message(
+            {"type": "group",
+             "ops": self._regenerate_group_subops(metadata)}, metadata)
+
+    def _regenerate_group_subops(self, local_seq) -> list[dict]:
+        group = next((g for g in self.engine.pending_groups
+                      if g.local_seq == local_seq), None)
+        if group is None:
+            return []  # already acked through an earlier replay round
         # Positions are computed in the view as of this op's localSeq —
         # later local pending ops must not shift them (the remote applier
         # won't have seen those yet when this op sequences).
@@ -222,8 +247,7 @@ class SharedString(SharedObject):
                          for k in group.props_keys}
                 subops.append({"type": "annotate", "start": pos,
                                "end": pos + seg.length, "props": props})
-        self.submit_local_message({"type": "group", "ops": subops},
-                                  group.local_seq)
+        return subops
 
     def on_attach(self) -> None:
         self.engine.normalize_detached()
@@ -275,6 +299,7 @@ class SharedString(SharedObject):
             return ("interval", contents["label"], interval_id, pending_id,
                     self.engine._local_seq_counter)
         ops = (contents["ops"] if contents["type"] == "group" else [contents])
+        local_seqs = []
         for op in ops:
             if op["type"] == "insert":
                 content = (op["text"] if "text" in op
@@ -286,7 +311,13 @@ class SharedString(SharedObject):
             else:
                 self.engine.annotate_local(op["start"], op["end"],
                                            op["props"])
-        return None
+            local_seqs.append(self.engine.pending_groups[-1].local_seq)
+        # The metadata the ack/resubmit paths expect: the created group's
+        # localSeq (a stashed "group" op spans several engine groups that
+        # must regenerate together into one message).
+        if len(local_seqs) == 1:
+            return local_seqs[0]
+        return ("stashed_group", local_seqs)
 
 
 class SharedStringFactory(ChannelFactory):

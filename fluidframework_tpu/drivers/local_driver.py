@@ -24,6 +24,12 @@ class _LocalSnapshotStorage:
     def upload_snapshot(self, snapshot: dict) -> str:
         return self._server.upload_snapshot(self._doc_id, snapshot)
 
+    def create_blob(self, blob_id: str, data: bytes) -> str:
+        return self._server.create_blob(self._doc_id, blob_id, data)
+
+    def read_blob(self, blob_id: str) -> bytes:
+        return self._server.read_blob(self._doc_id, blob_id)
+
 
 class _LocalDeltaStorage:
     def __init__(self, server: LocalCollabServer, doc_id: str) -> None:

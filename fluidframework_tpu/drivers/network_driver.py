@@ -67,6 +67,17 @@ class _NetworkSnapshotStorage:
         return self._service._request({"op": "upload_snapshot",
                                        "snapshot": snapshot})["handle"]
 
+    def create_blob(self, blob_id: str, data: bytes) -> str:
+        import base64
+        return self._service._request({
+            "op": "create_blob", "blob_id": blob_id,
+            "data": base64.b64encode(data).decode()})["blob_id"]
+
+    def read_blob(self, blob_id: str) -> bytes:
+        import base64
+        return base64.b64decode(self._service._request(
+            {"op": "read_blob", "blob_id": blob_id})["data"])
+
 
 class _NetworkDeltaStorage:
     def __init__(self, service: "NetworkDocumentService") -> None:

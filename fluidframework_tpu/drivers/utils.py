@@ -1,0 +1,76 @@
+"""Driver plumbing shared by all backends: error classification and
+retry/backoff.
+
+Reference parity: packages/loader/driver-utils — ``NetworkErrorBasic`` /
+error classification (networkUtils.ts) and ``runWithRetry`` with
+exponential backoff (runWithRetry.ts). The reference retries anything the
+driver marks ``canRetry``; deli's clientSeqNumber dedup makes re-sent ops
+idempotent, so retrying submits is safe.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class DriverError(Exception):
+    """Base driver error. ``can_retry`` drives runWithRetry;``retry_after_s``
+    is the server-suggested delay (throttling NACKs)."""
+
+    def __init__(self, message: str, can_retry: bool = False,
+                 retry_after_s: float | None = None) -> None:
+        super().__init__(message)
+        self.can_retry = can_retry
+        self.retry_after_s = retry_after_s
+
+
+class NetworkError(DriverError):
+    """Transient transport failure — always retriable."""
+
+    def __init__(self, message: str,
+                 retry_after_s: float | None = None) -> None:
+        super().__init__(message, can_retry=True,
+                         retry_after_s=retry_after_s)
+
+
+class AuthorizationError(DriverError):
+    """401/403 — never retriable without a new token."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, can_retry=False)
+
+
+class ThrottlingError(DriverError):
+    """429 — retriable after the server-given delay."""
+
+    def __init__(self, message: str, retry_after_s: float) -> None:
+        super().__init__(message, can_retry=True,
+                         retry_after_s=retry_after_s)
+
+
+def run_with_retry(fn: Callable[[], T], *, max_retries: int = 5,
+                   base_delay_s: float = 0.05, max_delay_s: float = 8.0,
+                   retriable: tuple[type[BaseException], ...]
+                   = (ConnectionError, OSError, TimeoutError),
+                   sleep: Callable[[float], Any] = time.sleep) -> T:
+    """Exponential backoff around a transient-failure-prone call
+    (driver-utils runWithRetry). DriverError honors can_retry and
+    retry_after_s; the listed exception types always retry."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except DriverError as err:
+            if not err.can_retry or attempt >= max_retries:
+                raise
+            delay = err.retry_after_s if err.retry_after_s is not None \
+                else min(max_delay_s, base_delay_s * (2 ** attempt))
+        except retriable:
+            if attempt >= max_retries:
+                raise
+            delay = min(max_delay_s, base_delay_s * (2 ** attempt))
+        attempt += 1
+        sleep(delay)

@@ -41,6 +41,7 @@ class MessageType(IntEnum):
     ROUND_TRIP = 12   # latency probe
     CONTROL = 13      # service-internal control; never sequenced
     ATTACH = 14       # a data store created post-attach (carries snapshot)
+    CHUNKED_OP = 15   # one piece of an oversized op (containerRuntime.ts:1652)
 
 
 class ScopeType:

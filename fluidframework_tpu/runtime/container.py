@@ -50,13 +50,25 @@ class Container:
         # Service rejections of our ops (never silent — tests assert empty).
         self.nacks: list[Any] = []
         self.on_nack: list[Callable[[Any], None]] = []
+        # Offline resume: the previous session's client id while its
+        # stashed ops may still arrive sequenced (cleared after catch-up).
+        self._stashed_client_id: str | None = None
 
     # -- load -----------------------------------------------------------------
 
     @classmethod
     def load(cls, document_service: DocumentService, registry=None,
-             mode: str = "write") -> "Container":
-        """Open an existing document: snapshot + trailing deltas + connect."""
+             mode: str = "write", pending_state: dict | None = None
+             ) -> "Container":
+        """Open an existing document: snapshot + trailing deltas + connect.
+
+        ``pending_state`` (from :meth:`close_and_get_pending_state`)
+        resumes an offline session: stashed unacked ops re-apply locally
+        via each channel's ``apply_stashed_op`` before catch-up; ops the
+        old connection DID get sequenced ack against the stash during
+        catch-up (matched by the stashed client id + clientSeq, the
+        pendingStateManager.ts stashed-ops flow), and the remainder
+        resubmits after connect."""
         container = cls(document_service, registry)
         snapshot = document_service.storage.get_latest_snapshot()
         if snapshot is not None:
@@ -68,7 +80,22 @@ class Container:
             container.delta_manager.last_queued_seq = \
                 snapshot["sequence_number"]
         container.attached = True
+        if pending_state is not None:
+            # Stashed ops re-apply against the exact state the dead session
+            # last saw: catch up to its refSeq first, then apply, then go
+            # live (the rest of catch-up delivers any sequenced stashed ops
+            # as acks against the stash).
+            ref = pending_state["reference_sequence_number"]
+            if snapshot is not None and snapshot["sequence_number"] > ref:
+                raise ValueError(
+                    "stash predates the latest snapshot; resume requires "
+                    "deltas from the stash's reference point")
+            container.delta_manager.catch_up_to(ref)
+            container._apply_stashed_state(pending_state)
         container.connect(mode)
+        if pending_state is not None:
+            container._stashed_client_id = None
+            container.runtime.replay_pending()
         return container
 
     @classmethod
@@ -180,15 +207,63 @@ class Container:
         for cb in self.on_signal:
             cb(signal)
 
+    def _apply_stashed_state(self, pending_state: dict) -> None:
+        """Re-apply stashed unacked ops locally and re-register them as
+        pending under their ORIGINAL clientSeqNumbers."""
+        self._stashed_client_id = pending_state.get("client_id")
+        for item in pending_state.get("pending", []):
+            envelope = item["contents"]
+            if envelope.get("type") == "attach":
+                if envelope["id"] not in self.runtime.datastores:
+                    from .datastore import DataStoreRuntime
+                    datastore = DataStoreRuntime(
+                        envelope["id"], self.runtime, self.runtime.registry)
+                    self.runtime.datastores[envelope["id"]] = datastore
+                    datastore.load(envelope["snapshot"])
+                    if envelope.get("root"):
+                        self.runtime.root_datastores.add(envelope["id"])
+                self.runtime.pending.on_submit(item["client_seq"],
+                                               envelope, None)
+                continue
+            datastore = self.runtime.datastores[envelope["address"]]
+            channel = datastore.get_channel(envelope["contents"]["address"])
+            metadata = channel.apply_stashed_op(
+                envelope["contents"]["contents"])
+            self.runtime.pending.on_submit(item["client_seq"], envelope,
+                                           metadata)
+
+    def close_and_get_pending_state(self) -> dict:
+        """Serialize unacked local ops for offline resume
+        (container.ts closeAndGetPendingLocalState): pass the result to
+        :meth:`load` as ``pending_state``. Closes the container."""
+        state = {
+            "client_id": self.client_id,
+            "reference_sequence_number": self.last_processed_seq,
+            "pending": [{"client_seq": item.client_seq,
+                         "contents": item.contents}
+                        for item in self.runtime.pending.drain_for_replay()],
+        }
+        self.close()
+        return state
+
     def _process_remote_message(self, message: SequencedDocumentMessage) -> None:
         local = (
             self.client_id is not None and message.client_id == self.client_id
         )
+        if (not local and self._stashed_client_id is not None
+                and message.client_id == self._stashed_client_id
+                and self.runtime.pending.has_pending):
+            # An op our PREVIOUS session got sequenced before dying: ack it
+            # against the re-applied stash (sequenced stashed ops are a
+            # FIFO prefix of the stash — the server orders clientSeqs).
+            local = True
         result = self.protocol.process_message(message, local)
         if message.type == MessageType.OPERATION:
             self.runtime.process(message, local)
         elif message.type == MessageType.ATTACH:
             self.runtime.process_attach(message, local)
+        elif message.type == MessageType.CHUNKED_OP:
+            self.runtime.process_chunk(message, local)
         for cb in self.on_op_processed:
             cb(message)
         if result["immediate_noop"] and self.connected:

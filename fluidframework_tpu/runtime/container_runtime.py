@@ -8,16 +8,22 @@ dataStores.ts:274.
 
 from __future__ import annotations
 
+import json
 from dataclasses import replace
 from typing import TYPE_CHECKING, Any
 
 from ..dds.shared_object import ChannelRegistry, default_registry
 from ..protocol.messages import MessageType, SequencedDocumentMessage
+from .blob_manager import BlobManager
 from .datastore import DataStoreRuntime
 from .pending_state import PendingStateManager
 
 if TYPE_CHECKING:  # pragma: no cover
     from .container import Container
+
+# Ops above this serialized size split into CHUNKED_OP pieces — the
+# reference's 16KB alfred cap (config.json:38, containerRuntime.ts:1652).
+MAX_OP_BYTES = 16 * 1024
 
 
 class ContainerRuntime:
@@ -30,6 +36,11 @@ class ContainerRuntime:
         # stored handle to them (containerRuntime.ts createRootDataStore).
         self.root_datastores: set[str] = set()
         self.pending = PendingStateManager()
+        self.blobs = BlobManager(self)
+        self.max_op_bytes = MAX_OP_BYTES
+        # In-flight chunked-op reassembly, keyed by sender client id
+        # (one chunked op in flight per client, containerRuntime.ts rule).
+        self._chunks: dict[str, list[str]] = {}
         # Client seqs of ops voided by a lost concurrent-create race: their
         # echoes apply as REMOTE ops (the local state they referenced was
         # replaced by the winner's snapshot) — see process_attach.
@@ -86,6 +97,10 @@ class ContainerRuntime:
         if not self.container.attached:
             return  # detached edits ship via the attach-time snapshot
         envelope = {"address": datastore_id, "contents": contents}
+        serialized = json.dumps(envelope, default=list)
+        if len(serialized) > self.max_op_bytes:
+            self._submit_chunked(envelope, serialized, local_op_metadata)
+            return
         # Pending is recorded BEFORE the send: the in-proc server acks
         # re-entrantly. client_seq None = disconnected: the op stays pending
         # (never sent) and is replayed on reconnect (pendingStateManager.ts:56).
@@ -94,6 +109,42 @@ class ContainerRuntime:
         if client_seq is not None:
             self.container.send_message(
                 MessageType.OPERATION, envelope, client_seq)
+
+    def _submit_chunked(self, envelope: dict, serialized: str,
+                        local_op_metadata: Any) -> None:
+        """Split an oversized op into CHUNKED_OP pieces
+        (containerRuntime.ts submitChunkedMessage :1652). Only the FINAL
+        chunk carries the pending entry: its ack is the op's ack, and a
+        reconnect replays the op whole (re-chunking on the way out)."""
+        pieces = [serialized[i:i + self.max_op_bytes]
+                  for i in range(0, len(serialized), self.max_op_bytes)]
+        total = len(pieces)
+        for index, piece in enumerate(pieces):
+            final = index == total - 1
+            client_seq = self.container.allocate_client_seq()
+            if final:
+                self.pending.on_submit(client_seq, envelope,
+                                       local_op_metadata)
+            if client_seq is not None:
+                self.container.send_message(
+                    MessageType.CHUNKED_OP,
+                    {"index": index, "total": total, "data": piece},
+                    client_seq)
+
+    def process_chunk(self, message: SequencedDocumentMessage,
+                      local: bool) -> None:
+        """Reassemble CHUNKED_OP pieces; the final piece processes as a
+        normal OPERATION at the final chunk's sequence number."""
+        contents = message.contents
+        assert message.client_id is not None
+        buffer = self._chunks.setdefault(message.client_id, [])
+        assert contents["index"] == len(buffer), "chunk disorder"
+        buffer.append(contents["data"])
+        if len(buffer) < contents["total"]:
+            return
+        envelope = json.loads("".join(self._chunks.pop(message.client_id)))
+        self.process(replace(message, type=MessageType.OPERATION,
+                             contents=envelope), local)
 
     def _submit_attach(self, datastore: DataStoreRuntime,
                        snapshot: dict | None = None) -> None:
@@ -225,6 +276,7 @@ class ContainerRuntime:
         for datastore in self.datastores.values():
             for channel in datastore.channels.values():
                 channel.on_attach()
+        self.blobs.on_attach()
 
     # -- summary --------------------------------------------------------------
 
@@ -237,6 +289,7 @@ class ContainerRuntime:
         return {
             "datastores": datastores,
             "roots": sorted(self.root_datastores),
+            "blobs": self.blobs.summarize(),
             # GC state rides the summary (containerRuntime.ts:1383-1430);
             # unreferenced nodes are reported, not yet swept.
             "gc": {"unreferenced": gc.deleted},
@@ -249,3 +302,4 @@ class ContainerRuntime:
             datastore.load(datastore_snapshot)
         self.root_datastores = set(
             snapshot.get("roots", snapshot["datastores"].keys()))
+        self.blobs.load(snapshot.get("blobs"))

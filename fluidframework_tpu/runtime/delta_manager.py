@@ -24,7 +24,7 @@ from ..protocol.messages import (
     MessageType,
     SequencedDocumentMessage,
 )
-from .delta_queue import DeltaQueue
+from .delta_queue import DeltaQueue, DeltaScheduler
 
 
 class DataCorruptionError(Exception):
@@ -59,8 +59,11 @@ class DeltaManager:
         self._fetching = False
         self._read_mode = False
 
+        # Long inbound catch-ups yield through the scheduler
+        # (deltaScheduler.ts:25): hosts register on_yield callbacks.
+        self.scheduler = DeltaScheduler()
         self.inbound: DeltaQueue[SequencedDocumentMessage] = DeltaQueue(
-            self._process_inbound)
+            self._process_inbound, scheduler=self.scheduler)
         self.outbound: DeltaQueue[list[DocumentMessage]] = DeltaQueue(
             self._send_batch)
         self.inbound_signal: DeltaQueue[Any] = DeltaQueue(
@@ -78,6 +81,17 @@ class DeltaManager:
     @property
     def readonly(self) -> bool:
         return self._read_mode
+
+    def catch_up_to(self, to_seq: int) -> None:
+        """Process stored deltas up to ``to_seq`` while still offline —
+        the staging step of offline-resume: stashed ops re-apply at their
+        original reference point, between this and connect()."""
+        assert self._connection is None, "already connected"
+        for message in self._service.delta_storage.get_deltas(
+                self.last_queued_seq, to_seq):
+            self._accept(message)
+        self.inbound.resume()  # drain exactly what was accepted
+        self.inbound.pause()
 
     def connect(self, mode: str = "write") -> str:
         """Catch up from delta storage, then go live. Returns the client id.

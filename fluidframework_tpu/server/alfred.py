@@ -106,6 +106,17 @@ class _ClientSession:
         if op == "get_latest_snapshot":
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid, "snapshot": service.get_latest_snapshot(doc)}
+        if op == "create_blob":
+            import base64
+            doc = req.get("doc_id", self.doc_id)
+            blob_id = service.create_blob(
+                doc, req["blob_id"], base64.b64decode(req["data"]))
+            return {"rid": rid, "blob_id": blob_id}
+        if op == "read_blob":
+            import base64
+            doc = req.get("doc_id", self.doc_id)
+            data = service.read_blob(doc, req["blob_id"])
+            return {"rid": rid, "data": base64.b64encode(data).decode()}
         if op == "disconnect":
             if self.connection is not None:
                 self.connection.close()

@@ -65,6 +65,7 @@ class _Document:
     log: list[SequencedDocumentMessage] = field(default_factory=list)
     connections: dict[str, _Connection] = field(default_factory=dict)
     snapshots: dict[str, dict] = field(default_factory=dict)
+    blobs: dict[str, bytes] = field(default_factory=dict)
     # Only ACKED summaries are load-visible (scribe writes the git commit
     # before emitting summaryAck); the attach-time base upload is implicitly
     # acked as the document's root.
@@ -285,3 +286,11 @@ class LocalCollabServer:
         if document.acked_snapshot is None:
             return None
         return document.snapshots[document.acked_snapshot]
+
+    def create_blob(self, doc_id: str, blob_id: str, data: bytes) -> str:
+        """Attachment-blob storage (blobManager.ts:51 upload path)."""
+        self._document(doc_id).blobs[blob_id] = bytes(data)
+        return blob_id
+
+    def read_blob(self, doc_id: str, blob_id: str) -> bytes:
+        return self._document(doc_id).blobs[blob_id]

@@ -572,3 +572,16 @@ class RouterliciousService:
 
     def get_latest_snapshot(self, doc_id: str) -> dict | None:
         return self.snapshots.get(doc_id, self.snapshots.head(doc_id))
+
+    def create_blob(self, doc_id: str, blob_id: str, data: bytes) -> str:
+        """Attachment-blob storage (blobManager.ts upload; stored base64 so
+        the durable journal stays JSON)."""
+        import base64
+        blobs: dict = self.store.get(f"blobs/{doc_id}", {})
+        blobs[blob_id] = base64.b64encode(bytes(data)).decode()
+        self.store.put(f"blobs/{doc_id}", blobs)
+        return blob_id
+
+    def read_blob(self, doc_id: str, blob_id: str) -> bytes:
+        import base64
+        return base64.b64decode(self.store.get(f"blobs/{doc_id}", {})[blob_id])

@@ -1,0 +1,234 @@
+"""Runtime hardening: op chunking, blobs, delta scheduler, offline-resume
+stash, driver retry/backoff.
+
+Reference parity: containerRuntime.ts:1652 (submitChunkedMessage),
+blobManager.ts:51, deltaScheduler.ts:25, pendingStateManager.ts stashed
+ops / container.ts closeAndGetPendingLocalState, driver-utils
+runWithRetry.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.drivers.utils import (
+    NetworkError,
+    ThrottlingError,
+    run_with_retry,
+)
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+def make_doc(server, doc_id="doc", channels=(("root", SharedMap.channel_type),)):
+    container = Container.create_detached(
+        LocalDocumentService(server, doc_id))
+    datastore = container.runtime.create_datastore("default")
+    for name, channel_type in channels:
+        datastore.create_channel(name, channel_type)
+    container.attach()
+    return container
+
+
+def chan(container, name="root"):
+    return container.runtime.get_datastore("default").get_channel(name)
+
+
+class TestOpChunking:
+    def test_oversized_op_chunks_and_converges(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        c1.runtime.max_op_bytes = 512  # force chunking at toy sizes
+        big = "x" * 5000
+        chan(c1).set("big", big)
+        chan(c2).set("small", 1)
+
+        assert chan(c2).get("big") == big
+        assert dict(chan(c1).items()) == dict(chan(c2).items())
+        assert c1.summarize() == c2.summarize()
+        kinds = [m.type for m in server.get_deltas("doc", 0)]
+        assert kinds.count(MessageType.CHUNKED_OP) >= 10
+        assert not c1.nacks and not c2.nacks
+
+    def test_chunked_op_replays_whole_after_offline_submit(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        c1.runtime.max_op_bytes = 512
+        c1.disconnect()
+        big = "y" * 4000
+        chan(c1).set("offline-big", big)
+        c1.reconnect()
+        assert chan(c2).get("offline-big") == big
+        assert c1.summarize() == c2.summarize()
+
+    def test_late_joiner_reassembles_chunks(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c1.runtime.max_op_bytes = 256
+        chan(c1).set("big", "z" * 3000)
+        c3 = Container.load(LocalDocumentService(server, "doc"))
+        assert chan(c3).get("big") == "z" * 3000
+
+
+class TestBlobs:
+    def test_upload_and_read_cross_client(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        handle = c1.runtime.blobs.upload_blob(b"\x00\x01binary payload")
+        chan(c1).set("attachment", handle.absolute_path)
+
+        path = chan(c2).get("attachment")
+        blob_id = path.rsplit("/", 1)[1]
+        assert c2.runtime.blobs.read(blob_id) == b"\x00\x01binary payload"
+
+    def test_detached_blobs_upload_at_attach(self):
+        server = LocalCollabServer()
+        container = Container.create_detached(
+            LocalDocumentService(server, "doc"))
+        datastore = container.runtime.create_datastore("default")
+        datastore.create_channel("root", SharedMap.channel_type)
+        handle = container.runtime.blobs.upload_blob(b"early")
+        assert handle.get() == b"early"  # readable pre-attach
+        container.attach()
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        assert c2.runtime.blobs.read(handle.blob_id) == b"early"
+        # The redirect table rides the summary.
+        assert handle.blob_id in c2.summarize()["runtime"]["blobs"]["ids"]
+
+
+class TestDeltaScheduler:
+    def test_long_catchup_yields(self):
+        server = LocalCollabServer()
+        # Attach empty so the whole document (datastore included) arrives
+        # as catch-up OPS — the manual container below loads no snapshot.
+        c1 = Container.create_detached(LocalDocumentService(server, "doc"))
+        c1.attach()
+        c1.runtime.create_datastore("default").create_channel(
+            "root", SharedMap.channel_type)
+        for i in range(300):
+            chan(c1).set(f"k{i % 10}", i)
+
+        service = LocalDocumentService(server, "doc")
+        c2 = Container(service)
+        c2.attached = True
+        yields = []
+        c2.delta_manager.scheduler.on_yield.append(
+            lambda done, left: yields.append((done, left)))
+        c2.connect()
+        assert yields, "no yields during a 300-op catch-up"
+        assert c2.delta_manager.scheduler.catch_up_drains >= 1
+        assert dict(chan(c2).items()) == dict(chan(c1).items())
+
+
+class TestStashedPendingState:
+    def test_offline_edits_resume_via_stash(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, channels=(
+            ("root", SharedMap.channel_type),
+            ("text", SharedString.channel_type)))
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        chan(c2, "text").insert_text(0, "base")
+
+        c1.disconnect()
+        chan(c1).set("offline", 1)
+        chan(c1, "text").insert_text(0, "mine: ")
+        stash = c1.close_and_get_pending_state()
+        assert len(stash["pending"]) == 2
+
+        c3 = Container.load(LocalDocumentService(server, "doc"),
+                            pending_state=stash)
+        assert chan(c3).get("offline") == 1
+        assert chan(c3, "text").get_text() == chan(c2, "text").get_text()
+        assert "mine: " in chan(c3, "text").get_text()
+        assert c3.summarize() == c2.summarize()
+
+    def test_sequenced_stashed_ops_ack_against_stash(self):
+        """Ops the dead session DID get sequenced must not double-apply."""
+        server = LocalCollabServer()
+        c1 = make_doc(server, channels=(
+            ("text", SharedString.channel_type),))
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        c1.inbound.pause()  # acks queue up unprocessed
+        chan(c1, "text").insert_text(0, "sequenced!")
+        stash = c1.close_and_get_pending_state()
+        assert stash["pending"], "op should still be unacked"
+
+        c3 = Container.load(LocalDocumentService(server, "doc"),
+                            pending_state=stash)
+        assert chan(c3, "text").get_text() == "sequenced!"  # not doubled
+        assert chan(c2, "text").get_text() == "sequenced!"
+        chan(c3, "text").insert_text(0, "go: ")
+        assert chan(c2, "text").get_text() == "go: sequenced!"
+
+    def test_matrix_stashed_ops(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server, channels=(("grid", SharedMatrix.channel_type),))
+        m1 = chan(c1, "grid")
+        m1.insert_rows(0, 2)
+        m1.insert_cols(0, 2)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+
+        c1.disconnect()
+        m1.set_cell(0, 0, "stashed")
+        m1.insert_rows(2, 1)
+        stash = c1.close_and_get_pending_state()
+
+        c3 = Container.load(LocalDocumentService(server, "doc"),
+                            pending_state=stash)
+        m2, m3 = chan(c2, "grid"), chan(c3, "grid")
+        assert m3.row_count == m2.row_count == 3
+        assert m2.get_cell(0, 0) == m3.get_cell(0, 0) == "stashed"
+        assert c3.summarize() == c2.summarize()
+
+
+class TestRetry:
+    def test_retries_then_succeeds(self):
+        calls = {"n": 0}
+        delays = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        assert run_with_retry(flaky, sleep=delays.append) == "ok"
+        assert calls["n"] == 3
+        assert delays == [0.05, 0.1]  # exponential
+
+    def test_non_retriable_raises_immediately(self):
+        from fluidframework_tpu.drivers.utils import AuthorizationError
+
+        def denied():
+            raise AuthorizationError("401")
+
+        with pytest.raises(AuthorizationError):
+            run_with_retry(denied, sleep=lambda _d: None)
+
+    def test_throttling_honors_retry_after(self):
+        delays = []
+        calls = {"n": 0}
+
+        def throttled():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ThrottlingError("429", retry_after_s=1.5)
+            return "ok"
+
+        assert run_with_retry(throttled, sleep=delays.append) == "ok"
+        assert delays == [1.5]
+
+    def test_gives_up_after_max_retries(self):
+        def always():
+            raise NetworkError("down")
+
+        with pytest.raises(NetworkError):
+            run_with_retry(always, max_retries=2, sleep=lambda _d: None)
