@@ -94,8 +94,10 @@ class NetworkDocumentService:
     """IDocumentService over a TCP alfred."""
 
     def __init__(self, host: str, port: int, doc_id: str,
-                 scopes=None, timeout: float = 30.0) -> None:
+                 scopes=None, timeout: float = 30.0,
+                 token: str | None = None) -> None:
         self.doc_id = doc_id
+        self._token = token
         self.storage = _NetworkSnapshotStorage(self)
         self.delta_storage = _NetworkDeltaStorage(self)
         self._scopes = scopes
@@ -183,6 +185,10 @@ class NetworkDocumentService:
         if isinstance(resp, Exception):
             raise resp
         if "error" in resp:
+            if resp["error"] == "throttled":
+                from .utils import ThrottlingError
+                raise ThrottlingError("throttled by alfred",
+                                      retry_after_s=resp["retry_after_s"])
             raise RuntimeError(f"alfred error: {resp['error']}")
         return resp
 
@@ -200,6 +206,8 @@ class NetworkDocumentService:
         req: dict = {"op": "connect", "mode": mode}
         if self._scopes is not None:
             req["scopes"] = list(self._scopes)
+        if self._token is not None:
+            req["token"] = self._token
         resp = self._request(req)
         return _NetworkConnection(self, resp["client_id"])
 

@@ -163,6 +163,15 @@ class Quorum:
 
     # -- values -------------------------------------------------------------
 
+    def set_local_value(self, key: str, value: Any) -> None:
+        """Seed a committed value on a DETACHED document (the reference
+        commits the initial \"code\" proposal into the attach-time quorum
+        snapshot, container.ts detached create). Never valid once live —
+        live changes go through propose→approve→commit."""
+        self._values[key] = CommittedProposal(
+            key=key, value=value, sequence_number=0,
+            approval_sequence_number=0, commit_sequence_number=0)
+
     def get(self, key: str) -> Any:
         committed = self._values.get(key)
         return None if committed is None else committed.value

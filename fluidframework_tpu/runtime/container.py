@@ -58,8 +58,8 @@ class Container:
 
     @classmethod
     def load(cls, document_service: DocumentService, registry=None,
-             mode: str = "write", pending_state: dict | None = None
-             ) -> "Container":
+             mode: str = "write", pending_state: dict | None = None,
+             code_loader=None) -> "Container":
         """Open an existing document: snapshot + trailing deltas + connect.
 
         ``pending_state`` (from :meth:`close_and_get_pending_state`)
@@ -74,6 +74,13 @@ class Container:
         if snapshot is not None:
             container.protocol = ProtocolOpHandler.load(snapshot["protocol"])
             container._wire_quorum()
+            if code_loader is not None:
+                # The quorum's committed "code" value picks the runtime
+                # factory BEFORE any channel instantiates
+                # (container.ts:1700-1835 instantiateRuntime).
+                factory = code_loader.load(
+                    container.protocol.quorum.get("code"))
+                factory.instantiate(container)
             container.runtime.load(snapshot["runtime"])
             container.delta_manager.last_processed_seq = \
                 snapshot["sequence_number"]

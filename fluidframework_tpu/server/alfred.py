@@ -78,8 +78,25 @@ class _ClientSession:
             assert self.connection is None, "already connected"
             self.doc_id = req["doc_id"]
             kwargs: dict = {"mode": req.get("mode", "write")}
-            if req.get("scopes") is not None:
+            if self.server.tenants is not None:
+                # Auth-enabled front door (alfred index.ts:343): the token
+                # is the ONLY source of scopes; client-requested scopes are
+                # ignored.
+                from .riddler import AuthError
+                token = req.get("token")
+                if not token:
+                    raise AuthError("connect requires a token")
+                claims = self.server.tenants.validate_token(
+                    token, document_id=self.doc_id)
+                kwargs["scopes"] = tuple(claims["scopes"])
+            elif req.get("scopes") is not None:
                 kwargs["scopes"] = tuple(req["scopes"])
+            if self.server.throttler is not None:
+                retry = self.server.throttler.try_consume(
+                    f"connect/{self.doc_id}")
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
             self.connection = service.connect(
                 self.doc_id,
                 lambda msgs: self.push({"event": "ops", "messages": msgs}),
@@ -90,6 +107,13 @@ class _ClientSession:
             self.server.metrics.counter("alfred.connects").inc()
             return {"rid": rid, "client_id": self.connection.client_id}
         if op == "submit":
+            if self.server.throttler is not None:
+                retry = self.server.throttler.try_consume(
+                    f"submit/{self.connection.client_id}",
+                    weight=len(req["messages"]))
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
             self.connection.submit(req["messages"])
             return {"rid": rid, "ok": True}
         if op == "signal":
@@ -128,12 +152,17 @@ class _ClientSession:
 class AlfredServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
                  logger: TelemetryLogger | None = None,
-                 metrics: MetricsRegistry | None = None) -> None:
+                 metrics: MetricsRegistry | None = None,
+                 tenants=None, throttler=None) -> None:
         self.service = service
         self.host = host
         self.port = port
         self.logger = logger if logger is not None else NullLogger()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # Optional riddler integration: a TenantManager enforces token auth
+        # on connect; a Throttler rate-limits connects/submits.
+        self.tenants = tenants
+        self.throttler = throttler
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:

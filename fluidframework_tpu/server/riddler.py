@@ -1,0 +1,145 @@
+"""Riddler — tenant management, token auth, throttling.
+
+Reference parity: server/routerlicious-base's riddler tenant/auth service
+and alfred's JWT validation at the socket front door
+(alfred/index.ts:343: ``connect_document`` verifies a tenant-signed JWT
+carrying scopes; services-core IThrottler / ITenantManager seams).
+Tokens are HS256 JWTs (header.payload.signature, base64url) signed with
+the tenant secret — dependency-free via hmac/hashlib.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets
+import time
+from dataclasses import dataclass, field
+
+
+class AuthError(Exception):
+    pass
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64url(text: str) -> bytes:
+    pad = "=" * (-len(text) % 4)
+    return base64.urlsafe_b64decode(text + pad)
+
+
+def sign_token(tenant_id: str, secret: str, document_id: str,
+               scopes: list[str], user: str = "",
+               lifetime_s: float = 3600.0,
+               now: float | None = None) -> str:
+    """Mint an HS256 access token (services-client generateToken)."""
+    now = time.time() if now is None else now
+    header = {"alg": "HS256", "typ": "JWT"}
+    claims = {"tenantId": tenant_id, "documentId": document_id,
+              "scopes": list(scopes), "user": user,
+              "iat": now, "exp": now + lifetime_s}
+    signing_input = (_b64url(json.dumps(header, sort_keys=True).encode())
+                     + "." +
+                     _b64url(json.dumps(claims, sort_keys=True).encode()))
+    signature = hmac.new(secret.encode(), signing_input.encode(),
+                         hashlib.sha256).digest()
+    return signing_input + "." + _b64url(signature)
+
+
+@dataclass
+class Tenant:
+    tenant_id: str
+    secret: str
+
+
+class TenantManager:
+    """Tenant registry + token validation (riddler's core; tenants persist
+    in the given store so a restarted service honors old tokens)."""
+
+    STORE_KEY = "riddler/tenants"
+
+    def __init__(self, store=None) -> None:
+        self._store = store
+        self._tenants: dict[str, Tenant] = {}
+        if store is not None:
+            for tenant_id, secret in (store.get(self.STORE_KEY) or {}).items():
+                self._tenants[tenant_id] = Tenant(tenant_id, secret)
+
+    def create_tenant(self, tenant_id: str,
+                      secret: str | None = None) -> Tenant:
+        if tenant_id in self._tenants:
+            raise ValueError(f"tenant {tenant_id!r} exists")
+        tenant = Tenant(tenant_id, secret or secrets.token_hex(16))
+        self._tenants[tenant_id] = tenant
+        self._persist()
+        return tenant
+
+    def get_tenant(self, tenant_id: str) -> Tenant:
+        if tenant_id not in self._tenants:
+            raise AuthError(f"unknown tenant {tenant_id!r}")
+        return self._tenants[tenant_id]
+
+    def _persist(self) -> None:
+        if self._store is not None:
+            self._store.put(self.STORE_KEY, {
+                t.tenant_id: t.secret for t in self._tenants.values()})
+
+    def validate_token(self, token: str, document_id: str | None = None,
+                       now: float | None = None) -> dict:
+        """Verify signature, expiry and (optionally) the document binding;
+        returns the claims. Raises AuthError on any failure."""
+        now = time.time() if now is None else now
+        try:
+            header_b64, claims_b64, signature_b64 = token.split(".")
+            claims = json.loads(_unb64url(claims_b64))
+            given = _unb64url(signature_b64)
+        except (ValueError, json.JSONDecodeError) as err:
+            raise AuthError(f"malformed token: {err}") from err
+        tenant = self.get_tenant(claims.get("tenantId", ""))
+        expected = hmac.new(tenant.secret.encode(),
+                            f"{header_b64}.{claims_b64}".encode(),
+                            hashlib.sha256).digest()
+        if not hmac.compare_digest(given, expected):
+            raise AuthError("bad signature")
+        if claims.get("exp", 0) < now:
+            raise AuthError("token expired")
+        if document_id is not None and claims.get("documentId") != document_id:
+            raise AuthError(
+                f"token bound to {claims.get('documentId')!r}, "
+                f"not {document_id!r}")
+        return claims
+
+
+@dataclass
+class _Window:
+    start: float
+    used: float = 0.0
+
+
+class Throttler:
+    """Fixed-window rate limiter (services-core IThrottler; alfred
+    throttles connects and submits per tenant/client). ``try_consume``
+    returns None when allowed, else seconds until the window resets."""
+
+    def __init__(self, rate_per_interval: float = 1_000_000,
+                 interval_s: float = 1.0,
+                 clock=time.monotonic) -> None:
+        self.rate = rate_per_interval
+        self.interval = interval_s
+        self._clock = clock
+        self._windows: dict[str, _Window] = {}
+
+    def try_consume(self, key: str, weight: float = 1.0) -> float | None:
+        now = self._clock()
+        window = self._windows.get(key)
+        if window is None or now - window.start >= self.interval:
+            window = _Window(start=now)
+            self._windows[key] = window
+        if window.used + weight > self.rate:
+            return max(0.0, window.start + self.interval - now)
+        window.used += weight
+        return None

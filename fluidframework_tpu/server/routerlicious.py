@@ -323,6 +323,87 @@ class _MergerFactory:
         return MergerDocumentLambda(doc_id, self._host, self._store)
 
 
+# -- copier -------------------------------------------------------------------
+
+
+class CopierDocumentLambda:
+    """Raw-op archival (copier/lambda.ts): every RAWDELTAS message lands in
+    a durable per-document raw log before sequencing touches it — the
+    forensic/replay trail for debugging sequencer behavior. Idempotent on
+    replay via the stored high-water offset."""
+
+    def __init__(self, doc_id: str, store: StateStore) -> None:
+        self.doc_id = doc_id
+        self._store = store
+        self._archived_offset = int(
+            self._store.get(f"copier_offset/{doc_id}", -1))
+
+    def handler(self, message: BusMessage) -> None:
+        if message.offset <= self._archived_offset:
+            return
+        self._archived_offset = message.offset
+        self._store.append(f"rawops/{self.doc_id}", [message.value])
+
+    def checkpoint(self, next_offset: int) -> None:
+        self._store.put(f"copier_offset/{self.doc_id}",
+                        self._archived_offset)
+
+
+class _CopierFactory:
+    def __init__(self, store: StateStore) -> None:
+        self._store = store
+
+    def create(self, doc_id: str) -> CopierDocumentLambda:
+        return CopierDocumentLambda(doc_id, self._store)
+
+
+# -- foreman ------------------------------------------------------------------
+
+
+class ForemanDocumentLambda:
+    """Background help-task assignment (foreman/lambda.ts): REMOTE_HELP
+    ops request agent work (spellcheck, intelligence...); the foreman
+    assigns each task to a registered agent pool round-robin and records
+    the assignment durably. Idempotent per sequence number."""
+
+    def __init__(self, doc_id: str, store: StateStore,
+                 agents: list[str]) -> None:
+        self.doc_id = doc_id
+        self._store = store
+        self._agents = agents or ["default-agent"]
+        self._assigned_seq = int(
+            self._store.get(f"foreman_seq/{doc_id}", 0))
+
+    def handler(self, message: BusMessage) -> None:
+        if message.value.get("kind") != "op":
+            return
+        op: SequencedDocumentMessage = message.value["message"]
+        if op.type != MessageType.REMOTE_HELP:
+            return
+        if op.sequence_number <= self._assigned_seq:
+            return
+        self._assigned_seq = op.sequence_number
+        tasks = (op.contents or {}).get("tasks", [])
+        assignments = self._store.get(f"help/{self.doc_id}", [])
+        for i, task in enumerate(tasks):
+            agent = self._agents[(len(assignments) + i) % len(self._agents)]
+            self._store.append(f"help/{self.doc_id}", [{
+                "task": task, "agent": agent,
+                "client_id": op.client_id,
+                "sequence_number": op.sequence_number}])
+
+    def checkpoint(self, next_offset: int) -> None:
+        self._store.put(f"foreman_seq/{self.doc_id}", self._assigned_seq)
+
+
+class _ForemanFactory:
+    def __init__(self, store: StateStore, agents: list[str]) -> None:
+        self._store, self._agents = store, agents
+
+    def create(self, doc_id: str) -> ForemanDocumentLambda:
+        return ForemanDocumentLambda(doc_id, self._store, self._agents)
+
+
 # -- scribe -------------------------------------------------------------------
 
 
@@ -417,7 +498,8 @@ class RouterliciousService:
                  = DocumentSequencer, merge_host=None,
                  logger: TelemetryLogger | None = None,
                  metrics: MetricsRegistry | None = None,
-                 snapshots=None) -> None:
+                 snapshots=None,
+                 help_agents: list[str] | None = None) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
         self.logger = logger if logger is not None else NullLogger()
@@ -458,6 +540,11 @@ class RouterliciousService:
             self.bus, DELTAS, "merger",
             _MergerFactory(merge_host, self.store))
             if merge_host is not None else None)
+        self._copier = PartitionManager(
+            self.bus, RAWDELTAS, "copier", _CopierFactory(self.store))
+        self._foreman = PartitionManager(
+            self.bus, DELTAS, "foreman",
+            _ForemanFactory(self.store, list(help_agents or [])))
 
     # -- internals -------------------------------------------------------------
 
@@ -480,6 +567,8 @@ class RouterliciousService:
                 moved += self._scriptorium.pump()
                 moved += self._scribe.pump()
                 moved += self._broadcaster.pump()
+                moved += self._copier.pump()
+                moved += self._foreman.pump()
                 if self._merger is not None:
                     moved += self._merger.pump()
                 if moved == 0:

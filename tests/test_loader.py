@@ -1,0 +1,85 @@
+"""Loader + code-proposal-driven runtime instantiation
+(loader.ts:103 Loader.resolve, container.ts:1700-1835 quorum "code" →
+instantiateRuntime, web-code-loader)."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.counter import SharedCounterFactory
+from fluidframework_tpu.dds.map import SharedMap, SharedMapFactory
+from fluidframework_tpu.dds.shared_object import ChannelRegistry
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.loader import (
+    CodeLoader,
+    Loader,
+    StaticRuntimeFactory,
+)
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+def make_loader(server):
+    code_loader = CodeLoader()
+    registry = ChannelRegistry([SharedMapFactory(), SharedCounterFactory()])
+    code_loader.register("@demo/clicker", StaticRuntimeFactory(registry))
+    return Loader(lambda doc_id: LocalDocumentService(server, doc_id),
+                  code_loader)
+
+
+class TestLoader:
+    def test_create_then_resolve_by_code_proposal(self):
+        server = LocalCollabServer()
+        loader = make_loader(server)
+        c1 = loader.create_detached({"package": "@demo/clicker"},
+                                    "fluid://localhost/doc1")
+        ds = c1.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        c1.attach()
+        ds.get_channel("root").set("k", 1)
+
+        # The attach snapshot carries the committed code value; resolve
+        # picks the factory from the quorum, NOT from a passed registry.
+        c2 = loader.resolve("fluid://localhost/doc1")
+        assert c2.protocol.quorum.get("code") == {"package": "@demo/clicker"}
+        root2 = c2.runtime.get_datastore("default").get_channel("root")
+        assert root2.get("k") == 1
+        root2.set("j", 2)
+        assert ds.get_channel("root").get("j") == 2
+
+    def test_resolve_unregistered_code_fails(self):
+        server = LocalCollabServer()
+        loader = make_loader(server)
+        c1 = loader.create_detached({"package": "@demo/clicker"},
+                                    "fluid://localhost/doc2")
+        c1.runtime.create_datastore("default").create_channel(
+            "root", SharedMap.channel_type)
+        c1.attach()
+
+        empty = Loader(lambda d: LocalDocumentService(server, d),
+                       CodeLoader())
+        with pytest.raises(KeyError):
+            empty.resolve("fluid://localhost/doc2")
+
+    def test_create_unknown_package_fails(self):
+        server = LocalCollabServer()
+        loader = make_loader(server)
+        with pytest.raises(KeyError):
+            loader.create_detached({"package": "@nope/missing"},
+                                   "fluid://localhost/doc3")
+
+    def test_url_parsing(self):
+        assert Loader._doc_id("fluid://host:8080/my-doc") == "my-doc"
+        assert Loader._doc_id("plain-doc-id") == "plain-doc-id"
+        with pytest.raises(ValueError):
+            Loader._doc_id("fluid://host-only/")
+
+    def test_version_selection(self):
+        server = LocalCollabServer()
+        code_loader = CodeLoader()
+        v1 = StaticRuntimeFactory(ChannelRegistry([SharedMapFactory()]))
+        v2 = StaticRuntimeFactory(ChannelRegistry([SharedMapFactory()]))
+        code_loader.register("@demo/app", v1, version="1.0.0")
+        code_loader.register("@demo/app", v2, version="2.0.0")
+        assert code_loader.load(
+            {"package": "@demo/app", "version": "2.0.0"}) is v2
+        assert code_loader.load({"package": "@demo/app"}) is v1

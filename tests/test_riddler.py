@@ -1,0 +1,226 @@
+"""Riddler auth/tenancy/throttling + foreman/copier lambdas.
+
+Reference parity: alfred's JWT gate (alfred/index.ts:343), riddler tenant
+service, services-core IThrottler; foreman/lambda.ts help-task
+assignment; copier raw-op archival.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.network_driver import NetworkDocumentService
+from fluidframework_tpu.drivers.utils import ThrottlingError
+from fluidframework_tpu.protocol.messages import MessageType, ScopeType
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.riddler import (
+    AuthError,
+    TenantManager,
+    Throttler,
+    sign_token,
+)
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+
+class TestTokens:
+    def test_sign_validate_roundtrip(self):
+        tenants = TenantManager()
+        tenant = tenants.create_tenant("acme")
+        token = sign_token("acme", tenant.secret, "doc1",
+                           list(ScopeType.ALL), user="alice")
+        claims = tenants.validate_token(token, document_id="doc1")
+        assert claims["scopes"] == list(ScopeType.ALL)
+        assert claims["user"] == "alice"
+
+    def test_tampered_token_rejected(self):
+        import json
+
+        from fluidframework_tpu.server.riddler import _b64url, _unb64url
+
+        tenants = TenantManager()
+        tenant = tenants.create_tenant("acme")
+        token = sign_token("acme", tenant.secret, "doc1", ["doc:read"])
+        header, claims_b64, sig = token.split(".")
+        claims = json.loads(_unb64url(claims_b64))
+        claims["scopes"] = ["doc:write", "summary:write"]  # escalate
+        evil = _b64url(json.dumps(claims, sort_keys=True).encode())
+        with pytest.raises(AuthError):
+            tenants.validate_token(f"{header}.{evil}.{sig}")
+
+    def test_expired_token_rejected(self):
+        tenants = TenantManager()
+        tenant = tenants.create_tenant("acme")
+        token = sign_token("acme", tenant.secret, "doc1", ["doc:read"],
+                           lifetime_s=10, now=1000.0)
+        tenants.validate_token(token, now=1005.0)
+        with pytest.raises(AuthError):
+            tenants.validate_token(token, now=1011.0)
+
+    def test_wrong_document_rejected(self):
+        tenants = TenantManager()
+        tenant = tenants.create_tenant("acme")
+        token = sign_token("acme", tenant.secret, "doc1", ["doc:read"])
+        with pytest.raises(AuthError):
+            tenants.validate_token(token, document_id="other")
+
+    def test_unknown_tenant_and_wrong_secret(self):
+        tenants = TenantManager()
+        tenants.create_tenant("acme")
+        with pytest.raises(AuthError):
+            tenants.validate_token(
+                sign_token("ghost", "s", "doc1", []))
+        with pytest.raises(AuthError):
+            tenants.validate_token(
+                sign_token("acme", "wrong-secret", "doc1", []))
+
+    def test_tenants_persist_in_store(self):
+        from fluidframework_tpu.server.bus import StateStore
+        store = StateStore()
+        tenant = TenantManager(store).create_tenant("acme")
+        reopened = TenantManager(store)
+        token = sign_token("acme", tenant.secret, "doc1", ["doc:read"])
+        assert reopened.validate_token(token)["tenantId"] == "acme"
+
+
+class TestThrottler:
+    def test_window_limits_and_resets(self):
+        clock = {"t": 0.0}
+        throttler = Throttler(rate_per_interval=3, interval_s=1.0,
+                              clock=lambda: clock["t"])
+        assert throttler.try_consume("k") is None
+        assert throttler.try_consume("k", weight=2) is None
+        retry = throttler.try_consume("k")
+        assert retry is not None and 0 < retry <= 1.0
+        clock["t"] = 1.1  # window rolls
+        assert throttler.try_consume("k") is None
+
+    def test_keys_are_independent(self):
+        throttler = Throttler(rate_per_interval=1, interval_s=60)
+        assert throttler.try_consume("a") is None
+        assert throttler.try_consume("b") is None
+        assert throttler.try_consume("a") is not None
+
+
+@pytest.fixture()
+def secure_alfred():
+    """In-process AlfredServer with auth + tight throttling on a loop
+    thread; yields (port, tenant)."""
+    from fluidframework_tpu.server.alfred import AlfredServer
+
+    tenants = TenantManager()
+    tenant = tenants.create_tenant("acme")
+    service = RouterliciousService()
+    server = AlfredServer(service, tenants=tenants,
+                          throttler=Throttler(rate_per_interval=50,
+                                              interval_s=60.0))
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+
+    thread = threading.Thread(target=lambda: (
+        loop.run_until_complete(run()), loop.run_forever()), daemon=True)
+    thread.start()
+    assert started.wait(10)
+    try:
+        yield server.port, tenant
+    finally:
+        # Best-effort teardown: stop listening, stop the loop. Connection
+        # handler tasks die with the daemon thread (py3.12's wait_closed
+        # would block on any handler still parked in a read).
+        loop.call_soon_threadsafe(
+            lambda: server._server is not None and server._server.close())
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(10)
+
+
+class TestSecureFrontDoor:
+    def test_valid_token_connects_and_edits(self, secure_alfred):
+        port, tenant = secure_alfred
+        token = sign_token("acme", tenant.secret, "doc",
+                           list(ScopeType.ALL))
+        svc = NetworkDocumentService("127.0.0.1", port, "doc", token=token)
+        c1 = Container.create_detached(svc)
+        c1.runtime.create_datastore("default").create_channel(
+            "root", SharedMap.channel_type)
+        with svc.dispatch_lock:
+            c1.attach()
+            c1.runtime.get_datastore("default").get_channel(
+                "root").set("k", 1)
+        svc.close()
+
+    def test_missing_and_invalid_token_rejected(self, secure_alfred):
+        port, tenant = secure_alfred
+        svc = NetworkDocumentService("127.0.0.1", port, "doc2")
+        with pytest.raises(RuntimeError, match="token"):
+            svc.connect(lambda ms: None)
+        svc.close()
+
+        bad = sign_token("acme", "not-the-secret", "doc2", ["doc:read"])
+        svc = NetworkDocumentService("127.0.0.1", port, "doc2", token=bad)
+        with pytest.raises(RuntimeError, match="signature"):
+            svc.connect(lambda ms: None)
+        svc.close()
+
+    def test_token_for_other_document_rejected(self, secure_alfred):
+        port, tenant = secure_alfred
+        token = sign_token("acme", tenant.secret, "doc-A", ["doc:read"])
+        svc = NetworkDocumentService("127.0.0.1", port, "doc-B", token=token)
+        with pytest.raises(RuntimeError, match="bound"):
+            svc.connect(lambda ms: None)
+        svc.close()
+
+    def test_submit_throttled(self, secure_alfred):
+        port, tenant = secure_alfred
+        token = sign_token("acme", tenant.secret, "tdoc",
+                           list(ScopeType.ALL))
+        svc = NetworkDocumentService("127.0.0.1", port, "tdoc", token=token)
+        from fluidframework_tpu.protocol.messages import DocumentMessage
+        conn = svc.connect(lambda ms: None)
+        msg = DocumentMessage(client_sequence_number=1,
+                              reference_sequence_number=1,
+                              type=MessageType.NOOP, contents="")
+        with pytest.raises(ThrottlingError) as err:
+            for i in range(200):
+                conn.submit([msg])
+        assert err.value.retry_after_s > 0
+        svc.close()
+
+
+class TestForemanCopier:
+    def test_copier_archives_raw_ops(self):
+        service = RouterliciousService()
+        conn = service.connect("doc", lambda ms: None)
+        conn.submit([_doc_msg(1, MessageType.OPERATION, {"x": 1})])
+        raw = service.store.get("rawops/doc")
+        assert raw, "copier wrote nothing"
+        kinds = [r.type for r in raw]
+        assert MessageType.CLIENT_JOIN in kinds
+        assert MessageType.OPERATION in kinds
+
+    def test_foreman_assigns_help_tasks_round_robin(self):
+        service = RouterliciousService(help_agents=["agent-a", "agent-b"])
+        conn = service.connect("doc", lambda ms: None)
+        conn.submit([_doc_msg(1, MessageType.REMOTE_HELP,
+                              {"tasks": ["spell", "translate", "ocr"]})])
+        assignments = service.store.get("help/doc")
+        assert [a["task"] for a in assignments] == \
+            ["spell", "translate", "ocr"]
+        assert [a["agent"] for a in assignments] == \
+            ["agent-a", "agent-b", "agent-a"]
+        # Replayed/duplicate ops don't double-assign.
+        conn.submit([_doc_msg(2, MessageType.NOOP, "")])
+        assert len(service.store.get("help/doc")) == 3
+
+
+def _doc_msg(client_seq, mtype, contents):
+    from fluidframework_tpu.protocol.messages import DocumentMessage
+    return DocumentMessage(client_sequence_number=client_seq,
+                           reference_sequence_number=1,
+                           type=mtype, contents=contents)
