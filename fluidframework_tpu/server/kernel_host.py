@@ -93,6 +93,11 @@ class KernelSequencerHost:
         self._timeout_ms: list[int] = [
             self.DEFAULT_TIMEOUT_MS] * self._capacity
         self._doc_counter = 0
+        # Tickets produced by an internal flush (a sync sequence() call may
+        # not jump the total order, so it flushes pending ops first) buffer
+        # here until the next flush() caller collects them — nothing is
+        # ever sequenced-and-dropped.
+        self._ready: dict[str, list[Ticket]] = {}
 
     @property
     def _ghost(self) -> int:
@@ -255,8 +260,9 @@ class KernelSequencerHost:
         row = self._row(doc_id)
         if self._pending[row]:
             # Ops queued for the batched path must sequence first — a sync
-            # call may not jump the document's total order.
-            self.flush()
+            # call may not jump the document's total order. Their tickets
+            # stay buffered in _ready for the next flush() caller.
+            self._flush_pending()
         fresh: set[str] = set()
         enc = self._encode(row, raw, fresh)
         ops = seqk.make_op_batch([[enc]], 1, 1)
@@ -270,10 +276,17 @@ class KernelSequencerHost:
         self._pending[self._row(doc_id)].append(raw)
 
     def flush(self) -> dict[str, list[Ticket]]:
-        """Sequence every document's pending ops in one device call."""
+        """Sequence every document's pending ops in one device call and
+        return them, together with any tickets buffered by an internal
+        flush since the last call."""
+        self._flush_pending()
+        out, self._ready = self._ready, {}
+        return out
+
+    def _flush_pending(self) -> None:
         doc_ids = [d for d in self._rows if self._pending[self._rows[d]]]
         if not doc_ids:
-            return {}
+            return
         per_doc_ops = [[] for _ in range(self._capacity)]
         fresh_by_doc: dict[str, set[str]] = {}
         max_k = 1
@@ -287,14 +300,12 @@ class KernelSequencerHost:
         ops = seqk.make_op_batch(per_doc_ops, self._capacity,
                                  _next_pow2(max_k))
         self._state, out = seqk.process_batch(self._state, ops)
-        results: dict[str, list[Ticket]] = {}
         for doc_id in doc_ids:
             row = self._rows[doc_id]
-            results[doc_id] = self._decode_doc(
+            self._ready.setdefault(doc_id, []).extend(self._decode_doc(
                 row, self._pending[row], per_doc_ops[row], out, row,
-                fresh_by_doc[doc_id])
+                fresh_by_doc[doc_id]))
             self._pending[row] = []
-        return results
 
     # -- idle ejection (deli checkIdleClients) ---------------------------------
 
@@ -349,12 +360,17 @@ class KernelSequencerHost:
         )
 
     def restore(self, doc_id: str, cp: SequencerCheckpoint) -> None:
-        """Load a checkpoint into a (fresh) document row. Writes only the
-        target row on device (no full-state round-trip)."""
+        """Load a checkpoint into a document row, OVERWRITING any live row
+        for the document: the checkpoint + committed bus offset are the
+        consistent pair, and a stale row from a prior service life (its
+        post-checkpoint ops will replay from the bus) must not survive.
+        Writes only the target row on device (no full-state round-trip)."""
         if len(cp.clients) > self._alloc_slots:
             self._grow_slots(len(cp.clients))
         row = self._row(doc_id)
-        assert not self._slots[row], f"row for {doc_id} already live"
+        self._slots[row] = {}
+        self._pending[row] = []
+        self._ready.pop(doc_id, None)
         self._timeout_ms[row] = cp.client_timeout_ms
         lanes = self._alloc_slots + 1
         vals = dict(

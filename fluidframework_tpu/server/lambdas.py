@@ -56,23 +56,34 @@ class PartitionManager:
         return self._docs[doc_id]
 
     def pump(self) -> int:
-        """Drain every partition once; returns messages processed."""
+        """Drain every partition; returns messages processed.
+
+        Each round polls ONE batch from EVERY partition and runs all
+        handlers before any checkpoint, so a lambda factory that batches
+        across documents (the device deli) sees one global tick per round
+        instead of one per partition. Documents are partition-sticky, so
+        per-document ordering is unaffected by the interleaving.
+        """
         processed = 0
-        for partition in range(self._consumer.num_partitions):
-            while True:
+        while True:
+            round_batches = []
+            for partition in range(self._consumer.num_partitions):
                 batch = self._consumer.poll(partition, self._batch_size)
-                if not batch:
-                    break
-                touched: dict[str, None] = {}
+                if batch:
+                    round_batches.append((partition, batch))
+            if not round_batches:
+                return processed
+            touched: dict[str, int] = {}
+            for _, batch in round_batches:
+                next_offset = batch[-1].offset + 1
                 for message in batch:
                     self._lambda_for(message.key).handler(message)
-                    touched[message.key] = None
-                next_offset = batch[-1].offset + 1
-                # Checkpoint order matters: lambda state FIRST, offset commit
-                # SECOND — a crash between them replays messages the state
-                # already saw (dedup guards), never skips unseen ones.
-                for doc_id in touched:
-                    self._docs[doc_id].checkpoint(next_offset)
-                self._consumer.commit(partition, next_offset)
+                    touched[message.key] = next_offset
                 processed += len(batch)
-        return processed
+            # Checkpoint order matters: lambda state FIRST, offset commit
+            # SECOND — a crash between them replays messages the state
+            # already saw (dedup guards), never skips unseen ones.
+            for doc_id, next_offset in touched.items():
+                self._docs[doc_id].checkpoint(next_offset)
+            for partition, batch in round_batches:
+                self._consumer.commit(partition, batch[-1].offset + 1)

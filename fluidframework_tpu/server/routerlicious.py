@@ -79,25 +79,40 @@ class DeliDocumentLambda:
         self.doc_id = doc_id
         self._store = store
         self._bus = bus
+        self._sequencer_factory = sequencer_factory
         self._metrics = metrics if metrics is not None else MetricsRegistry()
         cp = store.get(f"deli/{doc_id}")
         if cp is not None:
             cp = dict(cp)
             self._summary_responded = cp.pop("summary_responded", 0)
-            self.sequencer = DocumentSequencer.restore(
-                SequencerCheckpoint(**cp))
             self._last_offset = cp["log_offset"]
+            self.sequencer = self._make_sequencer(SequencerCheckpoint(**cp))
         else:
             self._summary_responded = 0
-            self.sequencer = sequencer_factory()
             self._last_offset = -1
+            self.sequencer = self._make_sequencer(None)
+
+    def _make_sequencer(self, cp: SequencerCheckpoint | None):
+        """Build the document sequencer, from a checkpoint if one exists.
+        Subclasses override to route state into a shared device host."""
+        if cp is not None:
+            return DocumentSequencer.restore(cp)
+        return self._sequencer_factory()
 
     def handler(self, message: BusMessage) -> None:
+        raw = self._admit(message)
+        if raw is None:
+            return
+        trace_start = Trace("deli", "start")  # stamped at receipt, pre-ticket
+        ticket = self.sequencer.ticket(raw)
+        self._emit(raw, ticket, trace_start)
+
+    def _admit(self, message: BusMessage) -> RawOperation | None:
+        """Offset + summary-response dedup; None = silently dropped."""
         if message.offset <= self._last_offset:
-            return  # replayed below our checkpoint (deli/lambda.ts:148-151)
+            return None  # replayed below our checkpoint (lambda.ts:148-151)
         self._last_offset = message.offset
         raw: RawOperation = message.value
-        trace_start = Trace("deli", "start")
         if raw.client_id is None and raw.type in (MessageType.SUMMARY_ACK,
                                                   MessageType.SUMMARY_NACK):
             # Scribe crash-replay can re-produce its response to the same
@@ -110,9 +125,12 @@ class DeliDocumentLambda:
             sseq = (raw.contents or {}).get(
                 "summary_proposal", {}).get("summary_sequence_number", 0)
             if sseq <= self._summary_responded:
-                return
+                return None
             self._summary_responded = sseq
-        ticket = self.sequencer.ticket(raw)
+        return raw
+
+    def _emit(self, raw: RawOperation, ticket,
+              trace_start: Trace) -> None:
         if ticket.kind == oc.OUT_NACK:
             self._metrics.counter("deli.nacks").inc()
             self._bus.produce(DELTAS, self.doc_id, {
@@ -167,6 +185,76 @@ class _DeliFactory:
     def create(self, doc_id: str) -> DeliDocumentLambda:
         return DeliDocumentLambda(doc_id, self._store, self._bus,
                                   self._sequencer_factory, self._metrics)
+
+
+class BatchedDeliDocumentLambda(DeliDocumentLambda):
+    """Deli over the device sequencer's BATCH path: admitted raw ops buffer
+    in the KernelSequencerHost during the pump and sequence in ONE device
+    call at checkpoint — the lambda batch is the device tick (the
+    throughput shape of BASELINE.json; contrast the base class's
+    per-op ticket()). Cross-document batching happens in the host: every
+    document's lambda shares one flush."""
+
+    def __init__(self, doc_id: str, store: StateStore, bus: MessageBus,
+                 factory: "_BatchedDeliFactory",
+                 metrics: MetricsRegistry | None = None) -> None:
+        self._factory = factory
+        self._inflight: list[tuple[RawOperation, Trace]] = []
+        super().__init__(doc_id, store, bus, sequencer_factory=None,
+                         metrics=metrics)
+
+    def _make_sequencer(self, cp: SequencerCheckpoint | None):
+        from .kernel_host import KernelDocumentSequencer
+        if cp is not None:
+            # Route checkpointed state into the device host. restore()
+            # overwrites any live row — the checkpoint + committed offset
+            # are the consistent pair; a stale row from a prior service
+            # life must not survive (its post-checkpoint ops replay from
+            # the bus).
+            self._factory.host.restore(self.doc_id, cp)
+        return KernelDocumentSequencer(self._factory.host, self.doc_id)
+
+    def handler(self, message: BusMessage) -> None:
+        raw = self._admit(message)
+        if raw is None:
+            return
+        self._inflight.append((raw, Trace("deli", "start")))
+        self._factory.host.submit(self.doc_id, raw)
+
+    def checkpoint(self, next_offset: int) -> None:
+        self._factory.flush_ready()
+        tickets = self._factory.take_ready(self.doc_id)
+        if len(tickets) != len(self._inflight):
+            raise RuntimeError(
+                f"deli/{self.doc_id}: {len(self._inflight)} inflight ops but "
+                f"{len(tickets)} tickets — the shared sequencer host was "
+                "flushed outside the lambda pump")
+        for (raw, trace_start), ticket in zip(self._inflight, tickets):
+            self._emit(raw, ticket, trace_start)
+        self._inflight = []
+        super().checkpoint(next_offset)
+
+
+class _BatchedDeliFactory:
+    def __init__(self, store: StateStore, bus: MessageBus, host,
+                 metrics: MetricsRegistry | None = None) -> None:
+        self._store, self._bus = store, bus
+        self.host = host
+        self._metrics = metrics
+        self._ready: dict[str, list] = {}
+
+    def create(self, doc_id: str) -> BatchedDeliDocumentLambda:
+        return BatchedDeliDocumentLambda(doc_id, self._store, self._bus,
+                                         self, self._metrics)
+
+    def flush_ready(self) -> None:
+        """One host flush distributes tickets to every document's lambda
+        (first checkpointing lambda pays; the rest just collect)."""
+        for doc_id, tickets in self.host.flush().items():
+            self._ready.setdefault(doc_id, []).extend(tickets)
+
+    def take_ready(self, doc_id: str) -> list:
+        return self._ready.pop(doc_id, [])
 
 
 # -- scriptorium --------------------------------------------------------------
@@ -499,7 +587,9 @@ class RouterliciousService:
                  logger: TelemetryLogger | None = None,
                  metrics: MetricsRegistry | None = None,
                  snapshots=None,
-                 help_agents: list[str] | None = None) -> None:
+                 help_agents: list[str] | None = None,
+                 batched_deli_host=None,
+                 auto_pump: bool = True) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
         self.logger = logger if logger is not None else NullLogger()
@@ -524,10 +614,18 @@ class RouterliciousService:
         self._clock_iter = itertools.count(clock_start + 1)
         self._pumping = False
 
-        self._deli = PartitionManager(
-            self.bus, RAWDELTAS, "deli",
-            _DeliFactory(self.store, self.bus, sequencer_factory,
-                         self.metrics))
+        # auto_pump=False is the batched-cadence mode: submits only produce
+        # to the bus; the operator (or load harness) pumps on its own tick,
+        # so lambda batches — and the device sequencer tick, when
+        # batched_deli_host is given — span many ops/documents.
+        self._auto_pump = auto_pump
+        deli_factory = (_BatchedDeliFactory(self.store, self.bus,
+                                            batched_deli_host, self.metrics)
+                        if batched_deli_host is not None else
+                        _DeliFactory(self.store, self.bus,
+                                     sequencer_factory, self.metrics))
+        self._deli = PartitionManager(self.bus, RAWDELTAS, "deli",
+                                      deli_factory)
         self._scriptorium = PartitionManager(
             self.bus, DELTAS, "scriptorium", _ScriptoriumFactory(self.store))
         self._broadcaster = PartitionManager(
@@ -555,6 +653,12 @@ class RouterliciousService:
 
     def _connections_for(self, doc_id: str) -> dict[str, _LiveConnection]:
         return self._connections.setdefault(doc_id, {})
+
+    def _maybe_pump(self) -> None:
+        """Front-door writes pump inline only in auto mode; batched-cadence
+        deployments pump on their own tick (the load harness / operator)."""
+        if self._auto_pump:
+            self.pump()
 
     def pump(self) -> None:
         """Drain every lambda until quiescent (scribe may feed deli)."""
@@ -604,7 +708,7 @@ class RouterliciousService:
                 timestamp=self._clock(),
                 can_summarize=ScopeType.SUMMARY_WRITE in scopes,
             ))
-            self.pump()
+            self._maybe_pump()
         return connection
 
     def disconnect(self, doc_id: str, client_id: str) -> None:
@@ -619,7 +723,7 @@ class RouterliciousService:
             data=client_id,
             timestamp=self._clock(),
         ))
-        self.pump()
+        self._maybe_pump()
 
     def submit(self, doc_id: str, client_id: str,
                messages: list[DocumentMessage]) -> None:
@@ -634,7 +738,7 @@ class RouterliciousService:
                 contents=message.contents,
                 traces=tuple(message.traces) + (Trace("alfred", "submit"),),
             ))
-        self.pump()
+        self._maybe_pump()
 
     def signal(self, doc_id: str, client_id: str, content: Any) -> None:
         for connection in list(self._connections_for(doc_id).values()):

@@ -1,0 +1,110 @@
+"""Service load harness — drive the assembled ordering service end-to-end.
+
+Reference parity: packages/test/service-load-test/src/nodeStressTest.ts +
+testConfig.json profiles (ci: 120 clients × 10 op/min; full: 240 clients,
+10M ops) and loadTestDataStore.ts:43-56 (per-client seen/sent rates). The
+TPU twist: the service runs in BATCHED-CADENCE mode (auto_pump off,
+device sequencer host batching every document's ops into one tick per
+pump) — the throughput shape the kernels are built for.
+
+Run:  python -m fluidframework_tpu.tools.load_test ci
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from ..dds.counter import SharedCounter
+from ..dds.map import SharedMap
+from ..drivers.local_driver import LocalDocumentService
+from ..runtime.container import Container
+from ..server.routerlicious import RouterliciousService
+
+PROFILES = {
+    # Scaled-down analog of the reference's testConfig.json shapes: every
+    # client writes a map key + bumps a shared counter per round.
+    "smoke": {"docs": 2, "clients_per_doc": 3, "rounds": 10,
+              "ops_per_round": 2},
+    "ci": {"docs": 8, "clients_per_doc": 4, "rounds": 25,
+           "ops_per_round": 4},
+    "full": {"docs": 32, "clients_per_doc": 8, "rounds": 50,
+             "ops_per_round": 8},
+}
+
+
+def run_load(profile: str = "ci", use_device_sequencer: bool = True,
+             pump_every_rounds: int = 1) -> dict:
+    config = PROFILES[profile]
+    kwargs: dict = {"auto_pump": False}
+    if use_device_sequencer:
+        from ..server.kernel_host import KernelSequencerHost
+        kwargs["batched_deli_host"] = KernelSequencerHost()
+    service = RouterliciousService(**kwargs)
+
+    docs = []
+    for d in range(config["docs"]):
+        doc_id = f"load-{d}"
+        c1 = Container.create_detached(LocalDocumentService(service, doc_id))
+        datastore = c1.runtime.create_datastore("default")
+        datastore.create_channel("root", SharedMap.channel_type)
+        datastore.create_channel("clicks", SharedCounter.channel_type)
+        c1.attach()
+        service.pump()
+        clients = [c1] + [
+            Container.load(LocalDocumentService(service, doc_id))
+            for _ in range(config["clients_per_doc"] - 1)]
+        service.pump()
+        docs.append(clients)
+
+    sent = 0
+    start = time.perf_counter()
+    for round_index in range(config["rounds"]):
+        for clients in docs:
+            for ci, client in enumerate(clients):
+                datastore = client.runtime.get_datastore("default")
+                for k in range(config["ops_per_round"]):
+                    if k % 2 == 0:
+                        datastore.get_channel("root").set(
+                            f"k{ci}-{k}", round_index)
+                    else:
+                        datastore.get_channel("clicks").increment()
+                    sent += 1
+        if (round_index + 1) % pump_every_rounds == 0:
+            service.pump()  # the batched cadence: one device tick per pump
+    service.pump()
+    elapsed = time.perf_counter() - start
+
+    # Convergence + seen-rate accounting (loadTestDataStore.ts:43-56).
+    converged = True
+    seen = 0
+    expected_clicks = (config["rounds"] * config["ops_per_round"] // 2
+                       * config["clients_per_doc"])
+    for clients in docs:
+        summaries = [c.summarize() for c in clients]
+        converged &= all(s == summaries[0] for s in summaries)
+        converged &= (clients[0].runtime.get_datastore("default")
+                      .get_channel("clicks").value == expected_clicks)
+        seen += sum(c.last_processed_seq for c in clients)
+
+    report = {
+        "profile": profile,
+        "device_sequencer": use_device_sequencer,
+        "clients": config["docs"] * config["clients_per_doc"],
+        "docs": config["docs"],
+        "ops_sent": sent,
+        "ops_seen_total": seen,
+        "elapsed_s": round(elapsed, 3),
+        "merged_ops_per_sec": round(sent / elapsed, 1),
+        "converged": converged,
+        "sequenced_ops": service.metrics.snapshot().get(
+            "deli.sequenced_ops", 0),
+    }
+    assert converged, "replicas diverged under load"
+    return report
+
+
+if __name__ == "__main__":
+    name = sys.argv[1] if len(sys.argv) > 1 else "ci"
+    print(json.dumps(run_load(name), indent=1))
