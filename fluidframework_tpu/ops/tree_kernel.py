@@ -1,24 +1,38 @@
 """Batched SharedTree rebase kernel — edit apply + validity across documents.
 
 Reference parity target: the rebase hot loop of experimental/dds/tree
-(Transaction apply over snapshots, re-validating anchors) batched across
-documents (BASELINE config 5: 1k docs batched rebase).
+(Transaction apply over snapshots, re-validating anchors — Transaction.ts:40,
+Checkout.ts:172) batched across documents (BASELINE config 5: 1k docs
+batched rebase).
 
 Device encoding: a document's tree = a fixed-capacity node table
-(SoA over [B, N]): exists mask, parent slot, payload id. One edit op per
-scan step, vmapped over documents:
+(SoA over [B, N]): exists mask, parent slot, trait id, sibling order key
+(rank), payload id. One edit op per scan step, vmapped over documents:
 
-  * set_value(node, payload)   — valid iff the node exists;
-  * detach(node)               — removes the whole subtree (parent-pointer
-                                 mask propagation, log-depth passes);
-  * insert(slot, parent, payload) — activates a free slot under a parent,
-                                 valid iff the parent exists and slot free.
+  * set_value(node, payload)      — valid iff the node exists;
+  * detach(node)                  — removes the whole subtree (one-hot
+                                    parent matvec propagation);
+  * insert(slot, parent, trait)   — append at the END of a trait;
+  * insert_start(slot, parent, trait) — prepend at trait START;
+  * insert_before/after(slot, sibling) — sibling-relative placement, the
+                                    StablePlace referenceSibling semantics;
+  * constraint_exists(node)       — TreeConstraint: anchor still resolves;
+  * constraint_count(parent, trait, n) — TreeConstraint: trait child count.
 
-Outputs per op: applied/invalid flags — the *validity masking* that the
-scalar Transaction computes sequentially (invalid edits drop whole).
-Sibling ordering inside traits is host-side state in this round (ordering
-does not affect validity or payload/topology convergence here); the
-merge-tree kernel's order machinery is the planned device path for it.
+Sibling ordering is DEVICE-side: each node carries an i32 ``rank``; order
+within a (parent, trait) pair is rank-ascending. Placement computes the new
+rank with masked max/min reductions over the node table (the same
+prefix-reduction shape as the merge-tree kernel's order machinery):
+append = max+GAP, prepend = min-GAP, before/after = midpoint between the
+sibling and its neighbour. A midpoint that collides (gap exhausted after
+~16 splits between a pair) or an append past the i32 safe range does NOT
+apply; it raises the op's ``overflow`` output flag so the serving host can
+re-rank the trait host-side and retry (the overflow-to-scalar route,
+mirroring the merge host's capacity_margin contract).
+
+Outputs per op: ``applied`` and ``overflow`` flags — the *validity masking*
+the scalar Transaction computes sequentially (invalid edits drop whole;
+edit-level grouping of multi-change edits stays host-side).
 """
 
 from __future__ import annotations
@@ -33,7 +47,18 @@ I32 = jnp.int32
 
 TREE_SET_VALUE = 0
 TREE_DETACH = 1
-TREE_INSERT = 2
+TREE_INSERT = 2          # append at trait end (op.parent, op.trait)
+TREE_INSERT_BEFORE = 3   # op.parent = reference sibling slot
+TREE_INSERT_AFTER = 4    # op.parent = reference sibling slot
+TREE_INSERT_START = 5    # prepend at trait start (op.parent, op.trait)
+TREE_CONSTRAINT_EXISTS = 6  # valid iff op.node exists; no mutation
+TREE_CONSTRAINT_COUNT = 7   # valid iff |children(op.parent, op.trait)| == op.payload
+
+# Rank spacing for appends/prepends; midpoint inserts between two adjacent
+# ranks survive log2(GAP)=16 splits before the host must re-rank.
+RANK_GAP = 1 << 16
+# Appends past this magnitude flag overflow instead of risking i32 wrap.
+RANK_LIMIT = 1 << 30
 
 # Detach propagates removal down the tree one level per pass, so trees up
 # to this depth converge; the serving host routes deeper docs to the scalar
@@ -46,6 +71,8 @@ MAX_DEPTH_PASSES = 32
 class TreeState(NamedTuple):
     exists: jax.Array   # bool[B, N] (slot 0 = root, always exists)
     parent: jax.Array   # i32[B, N] parent slot (-1 for root)
+    trait: jax.Array    # i32[B, N] interned trait label under the parent
+    rank: jax.Array     # i32[B, N] sibling order key within (parent, trait)
     payload: jax.Array  # i32[B, N] interned payload id
 
 
@@ -53,8 +80,14 @@ class TreeOpBatch(NamedTuple):
     valid: jax.Array    # bool[B, K]
     kind: jax.Array     # i32[B, K]
     node: jax.Array     # i32[B, K] target slot
-    parent: jax.Array   # i32[B, K] (insert)
-    payload: jax.Array  # i32[B, K]
+    parent: jax.Array   # i32[B, K] parent slot, or reference sibling slot
+    trait: jax.Array    # i32[B, K] trait label id
+    payload: jax.Array  # i32[B, K] payload id / expected count
+
+
+class TreeOpOut(NamedTuple):
+    applied: jax.Array   # bool[B, K]
+    overflow: jax.Array  # bool[B, K] — rank space exhausted, host must re-rank
 
 
 def init_state(num_docs: int, num_slots: int) -> TreeState:
@@ -62,26 +95,83 @@ def init_state(num_docs: int, num_slots: int) -> TreeState:
     return TreeState(
         exists=exists,
         parent=jnp.full((num_docs, num_slots), -1, I32),
+        trait=jnp.zeros((num_docs, num_slots), I32),
+        rank=jnp.zeros((num_docs, num_slots), I32),
         payload=jnp.zeros((num_docs, num_slots), I32),
     )
 
 
 def _apply_op(s: TreeState, op):
-    node = jnp.clip(op.node, 0, s.exists.shape[0] - 1)
-    parent = jnp.clip(op.parent, 0, s.exists.shape[0] - 1)
+    n = s.exists.shape[0]
+    lanes = jnp.arange(n)
+    node = jnp.clip(op.node, 0, n - 1)
+    anchor = jnp.clip(op.parent, 0, n - 1)  # parent slot OR reference sibling
     node_exists = s.exists[node]
-    parent_exists = s.exists[parent]
 
     is_set = op.kind == TREE_SET_VALUE
     is_detach = op.kind == TREE_DETACH
-    is_insert = op.kind == TREE_INSERT
+    is_end = op.kind == TREE_INSERT
+    is_before = op.kind == TREE_INSERT_BEFORE
+    is_after = op.kind == TREE_INSERT_AFTER
+    is_start = op.kind == TREE_INSERT_START
+    is_cexists = op.kind == TREE_CONSTRAINT_EXISTS
+    is_ccount = op.kind == TREE_CONSTRAINT_COUNT
+    is_sibling_rel = is_before | is_after
+    is_insert = is_end | is_before | is_after | is_start
 
+    # Resolve the destination (parent, trait): sibling-relative placements
+    # inherit the sibling's, the rest name it directly.
+    ins_parent = jnp.where(is_sibling_rel, s.parent[anchor], op.parent)
+    ins_trait = jnp.where(is_sibling_rel, s.trait[anchor], op.trait)
+    parent_exists = s.exists[jnp.clip(ins_parent, 0, n - 1)] \
+        & (ins_parent >= 0) & (ins_parent < n)
+
+    # Sibling set of the destination trait (also the CONSTRAINT_COUNT set).
+    sibs = s.exists & (s.parent == ins_parent) & (s.trait == ins_trait)
+    sib_count = jnp.sum(sibs.astype(I32))
+    has_sibs = sib_count > 0
+    max_r = jnp.max(jnp.where(sibs, s.rank, -RANK_LIMIT))
+    min_r = jnp.min(jnp.where(sibs, s.rank, RANK_LIMIT))
+
+    # Rank for each placement flavour + its gap/overflow check.
+    r_s = s.rank[anchor]
+    prev_r = jnp.max(jnp.where(sibs & (s.rank < r_s), s.rank,
+                               r_s - 2 * RANK_GAP))
+    next_r = jnp.min(jnp.where(sibs & (s.rank > r_s), s.rank,
+                               r_s + 2 * RANK_GAP))
+    end_rank = jnp.where(has_sibs, max_r + RANK_GAP, 0)
+    start_rank = jnp.where(has_sibs, min_r - RANK_GAP, 0)
+    before_rank = (prev_r + r_s) // 2
+    after_rank = (r_s + next_r) // 2
+    new_rank = jnp.where(is_end, end_rank,
+                         jnp.where(is_start, start_rank,
+                                   jnp.where(is_before, before_rank,
+                                             after_rank)))
+    gap_ok = (jnp.abs(new_rank) < RANK_LIMIT) & jnp.where(
+        is_before, (before_rank > prev_r) & (before_rank < r_s),
+        jnp.where(is_after, (after_rank > r_s) & (after_rank < next_r),
+                  True))
+
+    sib_exists = s.exists[anchor] & (op.parent > 0) & (op.parent < n)
+    anchor_ok = jnp.where(is_sibling_rel, sib_exists, parent_exists)
+    insert_would = op.valid & is_insert & anchor_ok & ~node_exists \
+        & (op.node != 0) & (op.node >= 0) & (op.node < n)
+    insert_ok = insert_would & gap_ok
+    overflow = insert_would & ~gap_ok
+
+    ccount_ok = parent_exists & (sib_count == op.payload)
+    # Unknown slots must be rejected, not clip-aliased onto slot n-1; and
+    # the root is not a valid constraint anchor (scalar _resolve_place
+    # rejects referenceSibling == ROOT_ID).
+    node_ok = node_exists & (op.node >= 0) & (op.node < n)
     ok = op.valid & jnp.where(
-        is_insert, parent_exists & ~node_exists & (op.node != 0),
-        node_exists & jnp.where(is_detach, op.node != 0, True))
+        is_insert, insert_ok,
+        jnp.where(is_cexists, node_ok & (op.node != 0),
+                  jnp.where(is_ccount, ccount_ok,
+                            node_ok & jnp.where(is_detach,
+                                                op.node != 0, True))))
 
     # set_value
-    lanes = jnp.arange(s.exists.shape[0])
     target = lanes == node
     payload = jnp.where(target & ok & is_set, op.payload, s.payload)
 
@@ -108,12 +198,17 @@ def _apply_op(s: TreeState, op):
         not_converged, grow, (seed, jnp.any(seed), 0))
     exists = s.exists & ~removed
 
-    # insert
-    exists = jnp.where(target & ok & is_insert, True, exists)
-    parent_arr = jnp.where(target & ok & is_insert, parent, s.parent)
-    payload = jnp.where(target & ok & is_insert, op.payload, payload)
+    # insert (any flavour)
+    do_insert = target & ok & is_insert
+    exists = jnp.where(do_insert, True, exists)
+    parent_arr = jnp.where(do_insert, ins_parent, s.parent)
+    trait_arr = jnp.where(do_insert, ins_trait, s.trait)
+    rank_arr = jnp.where(do_insert, new_rank, s.rank)
+    payload = jnp.where(do_insert, op.payload, payload)
 
-    return TreeState(exists=exists, parent=parent_arr, payload=payload), ok
+    return (TreeState(exists=exists, parent=parent_arr, trait=trait_arr,
+                      rank=rank_arr, payload=payload),
+            TreeOpOut(applied=ok, overflow=overflow))
 
 
 def _process_doc(state: TreeState, ops: TreeOpBatch):
@@ -122,14 +217,27 @@ def _process_doc(state: TreeState, ops: TreeOpBatch):
 
 @jax.jit
 def apply_tick(state: TreeState, ops: TreeOpBatch):
-    """(state', applied_mask[B, K]) for one tick of tree edits."""
+    """(state', TreeOpOut[B, K]) for one tick of tree edits."""
     return jax.vmap(_process_doc)(state, ops)
+
+
+def trait_order(state: TreeState, doc: int, parent: int,
+                trait: int) -> list[int]:
+    """Host-side read-back: the sibling order of one trait (rank-ascending,
+    slot index breaks exact-rank ties deterministically)."""
+    exists = np.asarray(state.exists[doc])
+    parents = np.asarray(state.parent[doc])
+    traits = np.asarray(state.trait[doc])
+    ranks = np.asarray(state.rank[doc])
+    slots = [i for i in range(exists.shape[0])
+             if exists[i] and parents[i] == parent and traits[i] == trait]
+    return sorted(slots, key=lambda i: (int(ranks[i]), i))
 
 
 def make_tree_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
                        k: int) -> TreeOpBatch:
     fields = {name: np.zeros((num_docs, k), np.int32)
-              for name in ("kind", "node", "parent", "payload")}
+              for name in ("kind", "node", "parent", "trait", "payload")}
     valid = np.zeros((num_docs, k), np.bool_)
     for d, doc_ops in enumerate(ops_per_doc):
         assert len(doc_ops) <= k

@@ -1,4 +1,5 @@
-"""Differential test: batched tree kernel vs scalar Transaction semantics."""
+"""Differential test: batched tree kernel vs scalar Transaction semantics,
+including device-side sibling ordering and constraint validation."""
 
 import random
 
@@ -11,29 +12,54 @@ from fluidframework_tpu.dds.tree_core import (
 from fluidframework_tpu.ops import tree_kernel as tk
 
 
+def _trait_label(op):
+    return f"t{op.get('trait', 0)}"
+
+
 def scalar_apply(snapshot, op_dicts, slot_names):
     """Apply kernel-shaped ops through the scalar Transaction; returns
     (snapshot, applied flags)."""
     applied = []
     for op in op_dicts:
-        name = slot_names[op["node"]]
-        if op["kind"] == tk.TREE_SET_VALUE:
+        name = slot_names[op.get("node", 0)]
+        kind = op["kind"]
+        if kind == tk.TREE_SET_VALUE:
             changes = [{"type": "set_value", "node": name,
                         "payload": op["payload"]}]
-        elif op["kind"] == tk.TREE_DETACH:
+        elif kind == tk.TREE_DETACH:
             changes = [{"type": "detach", "source": {
                 "start": {"referenceSibling": name, "side": "before"},
                 "end": {"referenceSibling": name, "side": "after"}}}]
-        else:
+        elif kind == tk.TREE_CONSTRAINT_EXISTS:
+            changes = [{"type": "constraint", "range": {
+                "start": {"referenceSibling": name, "side": "before"},
+                "end": {"referenceSibling": name, "side": "after"}}}]
+        elif kind == tk.TREE_CONSTRAINT_COUNT:
+            # Scalar analog computed directly: trait child count equality.
             parent = slot_names[op["parent"]]
+            count = (len(snapshot.get(parent).traits.get(_trait_label(op),
+                                                         ()))
+                     if snapshot.has(parent) else None)
+            applied.append(count is not None and count == op["payload"])
+            continue
+        else:
+            if kind in (tk.TREE_INSERT_BEFORE, tk.TREE_INSERT_AFTER):
+                place = {"referenceSibling": slot_names[op["parent"]],
+                         "side": "before" if kind == tk.TREE_INSERT_BEFORE
+                         else "after"}
+            else:
+                place = {"referenceTrait": {
+                    "parent": slot_names[op["parent"]],
+                    "label": _trait_label(op)},
+                    "side": "start" if kind == tk.TREE_INSERT_START
+                    else "end"}
             changes = [
                 {"type": "build",
                  "source": [{"id": name, "definition": "n",
                              "payload": op["payload"]}],
                  "destination": f"b-{name}-{len(applied)}"},
                 {"type": "insert", "source": f"b-{name}-{len(applied)}",
-                 "destination": {"referenceTrait": {
-                     "parent": parent, "label": "c"}, "side": "end"}},
+                 "destination": place},
             ]
         txn = Transaction(snapshot)
         ok = txn.apply_edit({"id": "e", "changes": changes}) == VALID
@@ -41,6 +67,33 @@ def scalar_apply(snapshot, op_dicts, slot_names):
             snapshot = txn.snapshot
         applied.append(ok)
     return snapshot, applied
+
+
+def assert_state_matches(state, d, snapshot, slot_names, ctx):
+    """Topology, payload AND sibling order equality vs the scalar."""
+    n_slots = state.exists.shape[1]
+    exists = np.asarray(state.exists[d])
+    payload = np.asarray(state.payload[d])
+    parent = np.asarray(state.parent[d])
+    trait = np.asarray(state.trait[d])
+    for slot in range(n_slots):
+        name = slot_names[slot]
+        assert bool(exists[slot]) == snapshot.has(name), (*ctx, slot)
+        if exists[slot] and slot != 0:
+            node = snapshot.get(name)
+            assert node.payload == int(payload[slot]) or (
+                node.payload is None and payload[slot] == 0)
+            assert slot_names[int(parent[slot])] == node.parent[0]
+            assert f"t{int(trait[slot])}" == node.parent[1]
+    # Sibling order within every live (parent, trait) pair.
+    for slot in range(n_slots):
+        if not exists[slot]:
+            continue
+        node = snapshot.get(slot_names[slot])
+        for label, children in node.traits.items():
+            got = tk.trait_order(state, d, slot, int(label[1:]))
+            assert [slot_names[s] for s in got] == children, \
+                (*ctx, slot, label)
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -74,42 +127,143 @@ def test_tree_kernel_matches_scalar(seed):
                                     node=rng.randrange(n_slots)))
             ops_per_doc.append(ops)
 
-        state, ok = tk.apply_tick(
+        state, out = tk.apply_tick(
             state, tk.make_tree_op_batch(ops_per_doc, n_docs, k))
         for d in range(n_docs):
             snapshots[d], applied = scalar_apply(
                 snapshots[d], ops_per_doc[d], slot_names)
             all_applied_scalar[d].extend(applied)
             all_applied_kernel[d].extend(
-                np.asarray(ok[d][:len(ops_per_doc[d])]).tolist())
+                np.asarray(out.applied[d][:len(ops_per_doc[d])]).tolist())
 
     for d in range(n_docs):
         assert all_applied_kernel[d] == all_applied_scalar[d], (seed, d)
-        # Topology + payload equality (order is host-side by design).
-        exists = np.asarray(state.exists[d])
-        payload = np.asarray(state.payload[d])
-        parent = np.asarray(state.parent[d])
-        for slot in range(n_slots):
-            name = slot_names[slot]
-            assert bool(exists[slot]) == snapshots[d].has(name), (seed, d, slot)
-            if exists[slot] and slot != 0:
-                node = snapshots[d].get(name)
-                assert node.payload == int(payload[slot]) or (
-                    node.payload is None and payload[slot] == 0)
-                assert slot_names[int(parent[slot])] == node.parent[0]
+        assert_state_matches(state, d, snapshots[d], slot_names, (seed, d))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tree_kernel_sibling_order_fuzz(seed):
+    """before/after/start/end placements + traits + constraints must keep
+    device sibling order byte-identical to the scalar Transaction."""
+    rng = random.Random(1000 + seed)
+    n_docs, n_slots, k, ticks = 2, 32, 10, 5
+    slot_names = {0: ROOT_ID, **{i: f"s{i}" for i in range(1, n_slots)}}
+
+    state = tk.init_state(n_docs, n_slots)
+    snapshots = [TreeSnapshot() for _ in range(n_docs)]
+
+    for tick in range(ticks):
+        ops_per_doc = []
+        for d in range(n_docs):
+            ops = []
+            for _ in range(rng.randrange(k + 1)):
+                r = rng.random()
+                if r < 0.55:
+                    kind = rng.choice([
+                        tk.TREE_INSERT, tk.TREE_INSERT_START,
+                        tk.TREE_INSERT_BEFORE, tk.TREE_INSERT_AFTER])
+                    ops.append(dict(kind=kind,
+                                    node=rng.randrange(1, n_slots),
+                                    parent=rng.randrange(n_slots),
+                                    trait=rng.randrange(2),
+                                    payload=rng.randrange(1, 100)))
+                elif r < 0.7:
+                    ops.append(dict(kind=tk.TREE_DETACH,
+                                    node=rng.randrange(1, n_slots)))
+                elif r < 0.85:
+                    ops.append(dict(kind=tk.TREE_CONSTRAINT_EXISTS,
+                                    node=rng.randrange(1, n_slots)))
+                else:
+                    ops.append(dict(kind=tk.TREE_CONSTRAINT_COUNT,
+                                    parent=rng.randrange(n_slots),
+                                    trait=rng.randrange(2),
+                                    payload=rng.randrange(4)))
+            ops_per_doc.append(ops)
+
+        state, out = tk.apply_tick(
+            state, tk.make_tree_op_batch(ops_per_doc, n_docs, k))
+        assert not bool(np.asarray(out.overflow).any()), (seed, tick)
+        for d in range(n_docs):
+            snapshots[d], applied = scalar_apply(
+                snapshots[d], ops_per_doc[d], slot_names)
+            got = np.asarray(out.applied[d][:len(ops_per_doc[d])]).tolist()
+            assert got == applied, (seed, tick, d)
+            assert_state_matches(state, d, snapshots[d], slot_names,
+                                 (seed, tick, d))
+
+
+def test_tree_kernel_order_before_after_chain():
+    # Deterministic shape: root -> [s3, s1, s4] in trait t0, s2 in t1.
+    state = tk.init_state(1, 8)
+    ops = [
+        dict(kind=tk.TREE_INSERT, node=1, parent=0, trait=0, payload=1),
+        dict(kind=tk.TREE_INSERT_BEFORE, node=3, parent=1, payload=3),
+        dict(kind=tk.TREE_INSERT_AFTER, node=4, parent=1, payload=4),
+        dict(kind=tk.TREE_INSERT, node=2, parent=0, trait=1, payload=2),
+    ]
+    state, out = tk.apply_tick(state, tk.make_tree_op_batch([ops], 1, 4))
+    assert np.asarray(out.applied).all()
+    assert tk.trait_order(state, 0, 0, 0) == [3, 1, 4]
+    assert tk.trait_order(state, 0, 0, 1) == [2]
+
+
+def test_tree_kernel_rank_overflow_flags():
+    # Repeated inserts immediately before a FIXED sibling land between it
+    # and an ever-closer left neighbour, halving the rank gap each time;
+    # once exhausted the op must flag overflow, not corrupt order.
+    n = 64
+    state = tk.init_state(1, n)
+    state, out = tk.apply_tick(state, tk.make_tree_op_batch(
+        [[dict(kind=tk.TREE_INSERT, node=1, parent=0, payload=1),
+          dict(kind=tk.TREE_INSERT, node=2, parent=0, payload=2)]], 1, 2))
+    anchor = 2  # every insert goes between the current left run and slot 2
+    overflowed = False
+    for slot in range(3, 40):
+        state, out = tk.apply_tick(state, tk.make_tree_op_batch(
+            [[dict(kind=tk.TREE_INSERT_BEFORE, node=slot, parent=anchor,
+                   payload=slot)]], 1, 1))
+        if bool(np.asarray(out.overflow)[0, 0]):
+            overflowed = True
+            assert not bool(np.asarray(out.applied)[0, 0])
+            assert not bool(np.asarray(state.exists)[0, slot])
+            break
+    assert overflowed, "gap never exhausted — overflow path untested"
+    # Order of everything that did apply is still strictly maintained.
+    order = tk.trait_order(state, 0, 0, 0)
+    assert order[0] == 1 and order[-1] == 2
+    assert len(order) == len(set(order))
+
+
+def test_tree_kernel_constraint_count_detach_interplay():
+    state = tk.init_state(1, 8)
+    ops = [
+        dict(kind=tk.TREE_INSERT, node=1, parent=0, payload=1),
+        dict(kind=tk.TREE_INSERT, node=2, parent=0, payload=2),
+        dict(kind=tk.TREE_CONSTRAINT_COUNT, parent=0, trait=0, payload=2),
+        dict(kind=tk.TREE_DETACH, node=1),
+        dict(kind=tk.TREE_CONSTRAINT_COUNT, parent=0, trait=0, payload=2),
+        dict(kind=tk.TREE_CONSTRAINT_COUNT, parent=0, trait=0, payload=1),
+        dict(kind=tk.TREE_CONSTRAINT_EXISTS, node=2),
+        dict(kind=tk.TREE_CONSTRAINT_EXISTS, node=1),
+        dict(kind=tk.TREE_CONSTRAINT_EXISTS, node=0),  # root: scalar-invalid
+        dict(kind=tk.TREE_CONSTRAINT_EXISTS, node=100),  # out of range
+    ]
+    state, out = tk.apply_tick(state, tk.make_tree_op_batch([ops], 1, 10))
+    assert np.asarray(out.applied)[0].tolist() == [
+        True, True, True, True, False, True, True, False, False, False]
 
 
 def test_tree_kernel_detach_deep_chain():
-    # Regression: pointer-doubling must remove descendants deeper than the
-    # number of passes (chain of 20 > 16 passes).
+    # Regression: propagation must remove descendants deeper than a few
+    # passes (chain of 20).
     depth = 20
     state = tk.init_state(1, depth + 2)
     ops = [dict(kind=tk.TREE_INSERT, node=i, parent=i - 1, payload=i)
            for i in range(1, depth + 1)]
-    state, ok = tk.apply_tick(
+    state, out = tk.apply_tick(
         state, tk.make_tree_op_batch([ops], 1, depth + 2))
-    assert bool(np.asarray(ok)[0, :depth].all())
-    state, ok = tk.apply_tick(
+    assert bool(np.asarray(out.applied)[0, :depth].all())
+    state, out = tk.apply_tick(
         state, tk.make_tree_op_batch([[dict(kind=tk.TREE_DETACH, node=1)]],
                                      1, 2))
     exists = np.asarray(state.exists[0])
@@ -125,7 +279,8 @@ def test_tree_kernel_detach_removes_descendants():
         dict(kind=tk.TREE_DETACH, node=1),
         dict(kind=tk.TREE_SET_VALUE, node=3, payload=9),  # invalid: gone
     ]
-    state, ok = tk.apply_tick(state, tk.make_tree_op_batch([ops], 1, 8))
+    state, out = tk.apply_tick(state, tk.make_tree_op_batch([ops], 1, 8))
     assert np.asarray(state.exists[0]).tolist()[:4] == [True, False, False,
                                                         False]
-    assert np.asarray(ok[0]).tolist()[:5] == [True, True, True, True, False]
+    assert np.asarray(out.applied[0]).tolist()[:5] == [True, True, True,
+                                                       True, False]
