@@ -210,17 +210,21 @@ class AlfredServer:
 def build_default_service(data_dir: str | None = None, merge_host=True):
     """Standalone assembly: routerlicious lambdas (+ device merge host,
     + durable file-backed storage when ``data_dir`` is given)."""
+    from ..utils import MetricsRegistry
     from .routerlicious import RouterliciousService
-    kwargs: dict = {}
+    metrics = MetricsRegistry()  # one registry spans the whole assembly
+    kwargs: dict = {"metrics": metrics}
     if merge_host:
         from .merge_host import KernelMergeHost
         kwargs["merge_host"] = KernelMergeHost()
     if data_dir is not None:
         from .durable_store import (
             DurableMessageBus, FileStateStore, GitSnapshotStore)
+        from .historian import Historian
         kwargs["bus"] = DurableMessageBus(f"{data_dir}/bus")
         kwargs["store"] = FileStateStore(f"{data_dir}/state")
-        kwargs["snapshots"] = GitSnapshotStore(f"{data_dir}/git")
+        kwargs["snapshots"] = Historian(GitSnapshotStore(f"{data_dir}/git"),
+                                        metrics=metrics)
     return RouterliciousService(**kwargs)
 
 

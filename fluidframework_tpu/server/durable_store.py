@@ -280,20 +280,27 @@ class GitSnapshotStore:
 
     # -- snapshot surface -----------------------------------------------------
 
-    def upload(self, doc_id: str, snapshot: dict) -> str:
+    def upload(self, doc_id: str, snapshot: dict, put_object=None) -> str:
+        """``put_object`` lets a caching front (historian) write through
+        itself so freshly-uploaded chunks are served hot."""
+        put = put_object if put_object is not None else self.put_object
         body = json.dumps(to_wire(snapshot), sort_keys=True,
                           separators=(",", ":")).encode()
-        chunks = [self.put_object(body[i:i + CHUNK_BYTES])
+        chunks = [put(body[i:i + CHUNK_BYTES])
                   for i in range(0, max(len(body), 1), CHUNK_BYTES)]
         tree = json.dumps({"chunks": chunks, "doc": doc_id}).encode()
-        return self.put_object(tree)
+        return put(tree)
 
-    def get(self, doc_id: str, handle: str | None) -> dict | None:
+    def get(self, doc_id: str, handle: str | None,
+            read_object=None) -> dict | None:
+        """``read_object`` lets a caching front substitute its cached
+        reader; the tree/chunk format is parsed in exactly one place."""
+        read = read_object if read_object is not None else self.get_object
         if handle is None:
             return None
         try:
-            tree = json.loads(self.get_object(handle).decode())
-            body = b"".join(self.get_object(c) for c in tree["chunks"])
+            tree = json.loads(read(handle).decode())
+            body = b"".join(read(c) for c in tree["chunks"])
         except (OSError, ValueError, KeyError, TypeError):
             return None
         return from_wire(json.loads(body.decode()))
