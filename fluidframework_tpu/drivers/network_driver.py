@@ -8,11 +8,18 @@ socket multiplexes the live delta connection and the storage RPCs, framed
 by protocol.codec.
 
 Threading model: the reference client is single-threaded (JS event loop);
-here a background reader thread receives pushed events. All inbound
-callbacks (ops/nack/signal) are invoked holding ``dispatch_lock`` — a host
-driving local edits from another thread takes the same lock around them
-(the e2e tests do), which serializes the container stack exactly like the
-reference's event loop does.
+here a background reader thread receives pushed events. Two dispatch
+modes:
+
+  * ``auto_dispatch=True`` (default): a dispatcher thread invokes inbound
+    callbacks (ops/nack/signal) holding ``dispatch_lock`` — a host driving
+    local edits from another thread takes the same lock around them (the
+    e2e tests do), which serializes the container stack exactly like the
+    reference's event loop does.
+  * ``auto_dispatch=False``: pushed events queue until the host calls
+    :meth:`NetworkDocumentService.pump_events` — every callback then runs
+    on the CALLER's thread, so a single-threaded host (the examples) needs
+    no locking at all. This is the DeltaQueue pause/resume shape.
 """
 
 from __future__ import annotations
@@ -95,7 +102,8 @@ class NetworkDocumentService:
 
     def __init__(self, host: str, port: int, doc_id: str,
                  scopes=None, timeout: float = 30.0,
-                 token: str | None = None) -> None:
+                 token: str | None = None,
+                 auto_dispatch: bool = True) -> None:
         self.doc_id = doc_id
         self._token = token
         self.storage = _NetworkSnapshotStorage(self)
@@ -118,9 +126,11 @@ class NetworkDocumentService:
         self._events: queue.Queue = queue.Queue()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
-        self._dispatcher = threading.Thread(target=self._dispatch_loop,
-                                            daemon=True)
-        self._dispatcher.start()
+        self._dispatcher = None
+        if auto_dispatch:
+            self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                                daemon=True)
+            self._dispatcher.start()
 
     # -- framing --------------------------------------------------------------
 
@@ -162,17 +172,36 @@ class NetworkDocumentService:
             return
         self._events.put(payload)
 
+    def _deliver(self, payload: dict) -> bool:
+        """Run one pushed event's handler; False once disconnected."""
+        if payload.get("event") == "__disconnect__":
+            with self.dispatch_lock:
+                self.events.emit("disconnect")
+            return False
+        handler = self._handlers.get(payload.get("event"))
+        if handler is not None:
+            with self.dispatch_lock:
+                handler(payload)
+        return True
+
     def _dispatch_loop(self) -> None:
         while True:
-            payload = self._events.get()
-            if payload.get("event") == "__disconnect__":
-                with self.dispatch_lock:
-                    self.events.emit("disconnect")
+            if not self._deliver(self._events.get()):
                 return
-            handler = self._handlers.get(payload.get("event"))
-            if handler is not None:
-                with self.dispatch_lock:
-                    handler(payload)
+
+    def pump_events(self) -> int:
+        """auto_dispatch=False mode: drain queued pushed events on the
+        calling thread; returns the number delivered."""
+        assert self._dispatcher is None, \
+            "pump_events requires auto_dispatch=False"
+        delivered = 0
+        while True:
+            try:
+                payload = self._events.get_nowait()
+            except queue.Empty:
+                return delivered
+            self._deliver(payload)
+            delivered += 1
 
     def _request(self, req: dict) -> dict:
         if self._closed:

@@ -1,0 +1,83 @@
+"""Example apps (SURVEY layer 6) driven end-to-end through the host's
+code-proposal boundary."""
+
+import argparse
+
+import pytest
+
+from fluidframework_tpu.examples import clicker, collab_text, host, task_board
+
+
+def _args(**overrides):
+    namespace = argparse.Namespace(host="127.0.0.1", port=None, doc=None)
+    for key, value in overrides.items():
+        setattr(namespace, key, value)
+    return namespace
+
+
+class TestExamples:
+    def test_clicker_main(self, capsys):
+        clicker.main([])
+        assert "creator sees 10" in capsys.readouterr().out
+
+    def test_collab_text_main(self, capsys):
+        collab_text.main([])
+        out = capsys.readouterr().out
+        assert "'doc: hello world'" in out
+        assert "greeting" in out
+
+    def test_task_board_main(self, capsys):
+        task_board.main([])
+        assert "'done': True" in capsys.readouterr().out
+
+    def test_exactly_once_claiming_under_race(self):
+        with host.open_document("task-board", _args()) as (
+                creator, joiner, settle):
+            for i in range(6):
+                creator.add_task(f"t{i}", f"task {i}")
+            settle()
+            # Both clients greedily try to claim everything.
+            for _ in range(6):
+                creator.claim_next()
+                joiner.claim_next()
+            settle()
+            claimed_tasks = (list(creator.claimed().values())
+                             + list(joiner.claimed().values()))
+            assert sorted(claimed_tasks) == [f"t{i}" for i in range(6)]
+
+    def test_host_routes_by_quorum_code(self):
+        # A document's package comes from ITS quorum, not the opener.
+        from fluidframework_tpu.drivers.local_driver import (
+            LocalDocumentService)
+        from fluidframework_tpu.runtime.loader import Loader
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService)
+
+        service = RouterliciousService()
+        loader = Loader(lambda doc: LocalDocumentService(service, doc),
+                        host.build_code_loader())
+        host.create_document(loader, "@examples/clicker",
+                             "fluid://localhost/doc-a")
+        host.create_document(loader, "@examples/collab-text",
+                             "fluid://localhost/doc-b",
+                             props={"initial_text": "hi"})
+
+        _, obj_a = host.open_existing(loader, "fluid://localhost/doc-a")
+        _, obj_b = host.open_existing(loader, "fluid://localhost/doc-b")
+        assert isinstance(obj_a, clicker.Clicker)
+        assert isinstance(obj_b, collab_text.CollabText)
+        assert obj_b.read() == "hi"
+
+    def test_unknown_package_rejected(self):
+        from fluidframework_tpu.drivers.local_driver import (
+            LocalDocumentService)
+        from fluidframework_tpu.runtime.loader import Loader
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService)
+
+        service = RouterliciousService()
+        loader = Loader(lambda doc: LocalDocumentService(service, doc),
+                        host.build_code_loader())
+        with pytest.raises(KeyError):
+            host.create_document(loader, "@examples/nope",
+                                 "fluid://localhost/doc-x")
