@@ -209,7 +209,9 @@ class NetworkDocumentService:
         rid = next(self._rid)
         q: queue.Queue = queue.Queue()
         self._pending[rid] = q
-        self._send({**req, "rid": rid, "doc_id": self.doc_id})
+        # Default the session's document, but let an explicit doc_id in the
+        # request through (e.g. get_help's all-documents None).
+        self._send({"doc_id": self.doc_id, **req, "rid": rid})
         resp = q.get(timeout=self._timeout)
         if isinstance(resp, Exception):
             raise resp
@@ -239,6 +241,20 @@ class NetworkDocumentService:
             req["token"] = self._token
         resp = self._request(req)
         return _NetworkConnection(self, resp["client_id"])
+
+    # -- agent control surface (headless runner ↔ foreman over the wire) -------
+
+    def help_tasks(self, doc_id: str | None = None) -> list[dict]:
+        req: dict = {"op": "get_help", "doc_id": doc_id}
+        if self._token is not None:
+            req["token"] = self._token
+        return self._request(req)["tasks"]
+
+    def complete_help(self, key: str) -> None:
+        req: dict = {"op": "complete_help", "key": key}
+        if self._token is not None:
+            req["token"] = self._token
+        self._request(req)
 
     def close(self) -> None:
         self._closed = True

@@ -50,6 +50,7 @@ class ScopeType:
     READ = "doc:read"
     WRITE = "doc:write"
     SUMMARY_WRITE = "summary:write"
+    AGENT = "agent:run"  # claim/complete foreman help assignments
 
     ALL = (READ, WRITE, SUMMARY_WRITE)
 

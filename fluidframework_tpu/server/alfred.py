@@ -141,12 +141,36 @@ class _ClientSession:
             doc = req.get("doc_id", self.doc_id)
             data = service.read_blob(doc, req["blob_id"])
             return {"rid": rid, "data": base64.b64encode(data).decode()}
+        if op == "get_help":
+            # Headless agent runners poll assignments; doc_id None spans
+            # all documents (the agent-pool discovery shape). With auth
+            # enabled this is privileged: assignment records expose doc and
+            # client ids across tenants, so an agent-scoped token gates it.
+            self._require_agent_scope(req)
+            return {"rid": rid,
+                    "tasks": service.help_tasks(req.get("doc_id"))}
+        if op == "complete_help":
+            self._require_agent_scope(req)
+            service.complete_help(req["key"])
+            return {"rid": rid, "ok": True}
         if op == "disconnect":
             if self.connection is not None:
                 self.connection.close()
                 self.connection = None
             return {"rid": rid, "ok": True}
         return {"rid": rid, "error": f"unknown op {op!r}"}
+
+    def _require_agent_scope(self, req: dict) -> None:
+        if self.server.tenants is None:
+            return
+        from ..protocol.messages import ScopeType
+        from .riddler import AuthError
+        token = req.get("token")
+        if not token:
+            raise AuthError("agent control requires a token")
+        claims = self.server.tenants.validate_token(token)
+        if ScopeType.AGENT not in claims.get("scopes", ()):
+            raise AuthError("agent scope required")
 
 
 class AlfredServer:

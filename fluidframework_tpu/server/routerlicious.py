@@ -778,3 +778,28 @@ class RouterliciousService:
     def read_blob(self, doc_id: str, blob_id: str) -> bytes:
         import base64
         return base64.b64decode(self.store.get(f"blobs/{doc_id}", {})[blob_id])
+
+    # -- agent control surface (headless-agent ↔ foreman) ----------------------
+
+    def help_tasks(self, doc_id: str | None = None) -> list[dict]:
+        """Pending foreman assignments with stable claim keys;
+        doc_id None = across all documents (agent-pool discovery)."""
+        keys = ([f"help/{doc_id}"] if doc_id is not None
+                else self.store.keys("help/"))
+        out = []
+        for key in keys:
+            doc = key[len("help/"):]
+            done = set(self.store.get(f"help_done/{doc}", []))
+            for index, assignment in enumerate(self.store.get(key, [])):
+                task_key = f"{doc}#{index}"
+                if task_key not in done:
+                    out.append({**assignment, "doc_id": doc,
+                                "key": task_key})
+        return out
+
+    def complete_help(self, task_key: str) -> None:
+        """Durably mark one assignment done (idempotent)."""
+        doc = task_key.rsplit("#", 1)[0]
+        done = self.store.get(f"help_done/{doc}", [])
+        if task_key not in done:
+            self.store.put(f"help_done/{doc}", done + [task_key])

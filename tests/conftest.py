@@ -32,3 +32,43 @@ def cpu_mesh_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices
+
+
+@pytest.fixture()
+def secure_alfred():
+    """In-process AlfredServer with auth + tight throttling on a loop
+    thread; yields (port, tenant)."""
+    import asyncio
+    import threading
+
+    from fluidframework_tpu.server.alfred import AlfredServer
+    from fluidframework_tpu.server.riddler import TenantManager, Throttler
+    from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+    tenants = TenantManager()
+    tenant = tenants.create_tenant("acme")
+    service = RouterliciousService()
+    server = AlfredServer(service, tenants=tenants,
+                          throttler=Throttler(rate_per_interval=50,
+                                              interval_s=60.0))
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+
+    thread = threading.Thread(target=lambda: (
+        loop.run_until_complete(run()), loop.run_forever()), daemon=True)
+    thread.start()
+    assert started.wait(10)
+    try:
+        yield server.port, tenant
+    finally:
+        # Best-effort teardown: stop listening, stop the loop. Connection
+        # handler tasks die with the daemon thread (py3.12's wait_closed
+        # would block on any handler still parked in a read).
+        loop.call_soon_threadsafe(
+            lambda: server._server is not None and server._server.close())
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(10)

@@ -1,0 +1,182 @@
+"""Headless agents: foreman assignments → agent runs → insights in-doc.
+
+Reference parity: server/headless-agent + packages/agents/
+intelligence-runner-agent; foreman/lambda.ts help assignment flow.
+"""
+
+import pytest
+
+from fluidframework_tpu.agents import (
+    HeadlessAgentRunner,
+    INSIGHTS_CHANNEL,
+    SpellCheckerAgent,
+    TextAnalyticsAgent,
+)
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+
+def _make_text_doc(service, doc_id, text):
+    container = Container.create_detached(
+        LocalDocumentService(service, doc_id))
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("body", SharedString.channel_type)
+    container.attach()
+    datastore.get_channel("body").insert_text(0, text)
+    return container
+
+
+def _request_help(container, tasks):
+    container.delta_manager.submit(MessageType.REMOTE_HELP,
+                                   {"tasks": tasks},
+                                   container.allocate_client_seq())
+
+
+class TestHeadlessAgents:
+    def test_intelligence_flow_end_to_end(self):
+        service = RouterliciousService(help_agents=["runner-1"])
+        author = _make_text_doc(service, "doc", "hello world hello again")
+        _request_help(author, ["intelligence", "spell"])
+
+        runner = HeadlessAgentRunner(
+            service, lambda doc: LocalDocumentService(service, doc),
+            [TextAnalyticsAgent(), SpellCheckerAgent()])
+        assert runner.run_once() == 2
+        assert runner.run_once() == 0  # completed durably, not re-claimed
+
+        # The author sees the insights as ordinary converged state.
+        insights = (author.runtime.get_datastore("default")
+                    .get_channel(INSIGHTS_CHANNEL))
+        analysis = insights.get("intelligence")
+        assert analysis["word_count"] == 4
+        assert analysis["top_words"][0] == "hello"
+        assert insights.get("spell")["misspelled"] == ["again"]
+
+    def test_runner_claims_only_its_assignments(self):
+        service = RouterliciousService(help_agents=["a", "b"])
+        author = _make_text_doc(service, "doc", "text")
+        _request_help(author, ["intelligence", "intelligence"])
+
+        runner_a = HeadlessAgentRunner(
+            service, lambda doc: LocalDocumentService(service, doc),
+            [TextAnalyticsAgent()], agent_name="a")
+        assert runner_a.run_once() == 1  # round-robin gave one to "b"
+        assert len(service.help_tasks()) == 1
+        assert service.help_tasks()[0]["agent"] == "b"
+
+    def test_unknown_task_left_pending(self):
+        service = RouterliciousService()
+        author = _make_text_doc(service, "doc", "text")
+        _request_help(author, ["translate"])
+        runner = HeadlessAgentRunner(
+            service, lambda doc: LocalDocumentService(service, doc),
+            [TextAnalyticsAgent()])
+        assert runner.run_once() == 0
+        assert len(service.help_tasks()) == 1
+
+    def test_multi_document_discovery(self):
+        service = RouterliciousService()
+        a = _make_text_doc(service, "doc-a", "alpha words")
+        b = _make_text_doc(service, "doc-b", "beta words words")
+        _request_help(a, ["intelligence"])
+        _request_help(b, ["intelligence"])
+        runner = HeadlessAgentRunner(
+            service, lambda doc: LocalDocumentService(service, doc),
+            [TextAnalyticsAgent()])
+        assert runner.run_once() == 2  # doc_id=None spans all documents
+        for container, count in ((a, 2), (b, 3)):
+            insights = (container.runtime.get_datastore("default")
+                        .get_channel(INSIGHTS_CHANNEL))
+            assert insights.get("intelligence")["word_count"] == count
+
+
+class TestAgentControlAuth:
+    def test_agent_control_requires_agent_scope(self, secure_alfred):
+        from fluidframework_tpu.drivers.network_driver import (
+            NetworkDocumentService)
+        from fluidframework_tpu.protocol.messages import ScopeType
+        from fluidframework_tpu.server.riddler import sign_token
+
+        port, tenant = secure_alfred
+        # No token → rejected; write-scoped token → rejected.
+        bare = NetworkDocumentService("127.0.0.1", port, "_agent")
+        try:
+            with pytest.raises(RuntimeError, match="token"):
+                bare.help_tasks()
+        finally:
+            bare.close()
+        writer_token = sign_token("acme", tenant.secret, "_agent",
+                                  [ScopeType.WRITE])
+        writer = NetworkDocumentService("127.0.0.1", port, "_agent",
+                                        token=writer_token)
+        try:
+            with pytest.raises(RuntimeError, match="scope"):
+                writer.help_tasks()
+        finally:
+            writer.close()
+        # Agent-scoped token → allowed.
+        agent_token = sign_token("acme", tenant.secret, "_agent",
+                                 [ScopeType.AGENT])
+        agent = NetworkDocumentService("127.0.0.1", port, "_agent",
+                                       token=agent_token)
+        try:
+            assert agent.help_tasks() == []
+        finally:
+            agent.close()
+
+
+class TestAgentsOverNetwork:
+    def test_network_control_surface(self, tmp_path):
+        import subprocess
+        import sys
+        import time
+
+        from fluidframework_tpu.drivers.network_driver import (
+            NetworkDocumentService)
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "fluidframework_tpu.server.alfred",
+             "--port", "0", "--no-merge-host"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("READY "), (line, proc.stderr.read())
+            port = int(line.split()[1])
+
+            author_svc = NetworkDocumentService("127.0.0.1", port, "doc")
+            author = Container.create_detached(author_svc)
+            datastore = author.runtime.create_datastore("default")
+            datastore.create_channel("body", SharedString.channel_type)
+            author.attach()
+            with author_svc.dispatch_lock:
+                datastore.get_channel("body").insert_text(0, "hello net")
+                _request_help(author, ["intelligence"])
+
+            control = NetworkDocumentService("127.0.0.1", port, "_agent")
+            deadline = time.monotonic() + 15
+            while not control.help_tasks() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            runner = HeadlessAgentRunner(
+                control,
+                lambda doc: NetworkDocumentService("127.0.0.1", port, doc),
+                [TextAnalyticsAgent()])
+            assert runner.run_once() == 1
+            assert control.help_tasks() == []
+
+            # Author converges on the insights written over the wire.
+            def insight():
+                with author_svc.dispatch_lock:
+                    channel = (author.runtime.get_datastore("default")
+                               .channels.get(INSIGHTS_CHANNEL))
+                    return channel.get("intelligence") if channel else None
+            while insight() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert insight()["word_count"] == 2
+            author_svc.close()
+            control.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

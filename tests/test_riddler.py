@@ -105,41 +105,6 @@ class TestThrottler:
         assert throttler.try_consume("a") is not None
 
 
-@pytest.fixture()
-def secure_alfred():
-    """In-process AlfredServer with auth + tight throttling on a loop
-    thread; yields (port, tenant)."""
-    from fluidframework_tpu.server.alfred import AlfredServer
-
-    tenants = TenantManager()
-    tenant = tenants.create_tenant("acme")
-    service = RouterliciousService()
-    server = AlfredServer(service, tenants=tenants,
-                          throttler=Throttler(rate_per_interval=50,
-                                              interval_s=60.0))
-    loop = asyncio.new_event_loop()
-    started = threading.Event()
-
-    async def run():
-        await server.start()
-        started.set()
-
-    thread = threading.Thread(target=lambda: (
-        loop.run_until_complete(run()), loop.run_forever()), daemon=True)
-    thread.start()
-    assert started.wait(10)
-    try:
-        yield server.port, tenant
-    finally:
-        # Best-effort teardown: stop listening, stop the loop. Connection
-        # handler tasks die with the daemon thread (py3.12's wait_closed
-        # would block on any handler still parked in a read).
-        loop.call_soon_threadsafe(
-            lambda: server._server is not None and server._server.close())
-        loop.call_soon_threadsafe(loop.stop)
-        thread.join(10)
-
-
 class TestSecureFrontDoor:
     def test_valid_token_connects_and_edits(self, secure_alfred):
         port, tenant = secure_alfred
