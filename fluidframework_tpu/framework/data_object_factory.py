@@ -43,16 +43,24 @@ class DataObjectFactory:
                                      SharedDirectory.channel_type)
         obj.initializing_first_time(props)
         obj.has_initialized()
+        datastore._data_object = obj  # later get()s return the creator's
         return obj
 
     # -- load -----------------------------------------------------------------
 
     def get(self, datastore: DataStoreRuntime) -> PureDataObject:
-        """Wrap an existing (loaded) data store of this factory's type."""
+        """Wrap an existing (loaded) data store of this factory's type.
+        Cached per data store: repeated gets (every routed request) must
+        not re-run the initialize lifecycle — hooks that subscribe
+        listeners would stack one copy per call."""
         assert datastore.attributes.get("type") == self.type, (
             f"data store {datastore.id!r} is "
             f"{datastore.attributes.get('type')!r}, not {self.type!r}")
+        cached = getattr(datastore, "_data_object", None)
+        if isinstance(cached, self.data_object_cls):
+            return cached
         obj = self.data_object_cls(datastore)
         obj.initializing_from_existing()
         obj.has_initialized()
+        datastore._data_object = obj
         return obj

@@ -26,6 +26,7 @@ class ContainerRuntimeFactoryWithDefaultDataStore:
         self.registry: dict[str, DataObjectFactory] = {
             f.type: f for f in (registry_entries or [])}
         self.registry.setdefault(default_factory.type, default_factory)
+        self._router = None  # built lazily, reused across requests
 
     # -- document lifecycle ---------------------------------------------------
 
@@ -50,14 +51,18 @@ class ContainerRuntimeFactoryWithDefaultDataStore:
 
     def get_object(self, container: Container,
                    datastore_id: str) -> PureDataObject:
-        """Resolve a data store id to its typed DataObject via the factory
-        registry (request-handler equivalent)."""
-        datastore = container.runtime.get_datastore(datastore_id)
-        object_type = datastore.attributes.get("type")
-        if object_type not in self.registry:
-            raise KeyError(
-                f"no data object factory registered for {object_type!r}")
-        return self.registry[object_type].get(datastore)
+        """Resolve a data store id to its typed DataObject. Type→factory
+        resolution lives in data_object_request_handler (one code path);
+        this adds the raising contract."""
+        from .request_handler import (
+            RequestParser, data_object_request_handler)
+        response = data_object_request_handler(self.registry)(
+            RequestParser(f"/{datastore_id}"), container.runtime)
+        if response is None:
+            datastore = container.runtime.get_datastore(datastore_id)
+            raise KeyError("no data object factory registered for "
+                           f"{datastore.attributes.get('type')!r}")
+        return response.value
 
     def create_object(self, container: Container, factory_type: str,
                       props: Any = None) -> PureDataObject:
@@ -65,3 +70,35 @@ class ContainerRuntimeFactoryWithDefaultDataStore:
         handle somewhere reachable or GC will report it unreferenced."""
         return self.registry[factory_type].create(
             container.runtime, props=props)
+
+    # -- request routing (request-handler chain) ------------------------------
+
+    def make_router(self):
+        """The assembled handler chain this factory serves: "/" rewrites to
+        the default store, then "/<id>" → typed object with "/<id>" and
+        "/<id>/<channel>" raw fallbacks (buildRuntimeRequestHandler
+        composition). Built once per factory — the chain is immutable."""
+        from .request_handler import (
+            RequestParser,
+            RuntimeRequestRouter,
+            data_object_request_handler,
+            datastore_request_handler,
+        )
+        typed = data_object_request_handler(self.registry)
+
+        def root_handler(parser, runtime):
+            # "/" IS "/<default>": rewrite (headers preserved) and reuse
+            # the exact same handlers so there is one code path per route.
+            if parser.path_parts:
+                return None
+            rewritten = RequestParser(f"/{self.DEFAULT_ID}", parser.headers)
+            return (typed(rewritten, runtime)
+                    or datastore_request_handler(rewritten, runtime))
+
+        return RuntimeRequestRouter(
+            [root_handler, typed, datastore_request_handler])
+
+    def request(self, container: Container, url: str):
+        if self._router is None:
+            self._router = self.make_router()
+        return self._router.request(container.runtime, url)

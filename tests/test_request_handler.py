@@ -1,0 +1,143 @@
+"""Request routing chain + dependency synthesizer
+(packages/framework/request-handler, packages/framework/synthesize)."""
+
+import pytest
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.framework import (
+    ContainerRuntimeFactoryWithDefaultDataStore,
+    DataObjectFactory,
+    DependencyContainer,
+    DependencyError,
+    RuntimeRequestRouter,
+    datastore_request_handler,
+    default_route_handler,
+)
+from fluidframework_tpu.framework.data_object import DataObject
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+class _Note(DataObject):
+    def initializing_first_time(self, props=None) -> None:
+        counter = self.runtime.create_channel(
+            "count", SharedCounter.channel_type)
+        self.root.set("count", counter.handle)
+
+
+def _make_doc():
+    factory = ContainerRuntimeFactoryWithDefaultDataStore(
+        DataObjectFactory("note", _Note))
+    server = LocalCollabServer()
+    container, obj = factory.create_document(
+        LocalDocumentService(server, "doc"))
+    container.attach()
+    return factory, container, obj
+
+
+class TestRequestRouting:
+    def test_root_resolves_typed_default_object(self):
+        factory, container, _ = _make_doc()
+        response = factory.request(container, "/")
+        assert response.ok
+        assert isinstance(response.value, _Note)
+
+    def test_datastore_and_channel_paths(self):
+        factory, container, _ = _make_doc()
+        by_id = factory.request(container, "/default")
+        assert by_id.ok and isinstance(by_id.value, _Note)
+        channel = factory.request(container, "/default/root")
+        assert channel.ok
+        from fluidframework_tpu.dds.directory import SharedDirectory
+        assert isinstance(channel.value, SharedDirectory)
+
+    def test_unknown_route_404(self):
+        factory, container, _ = _make_doc()
+        assert factory.request(container, "/nope").status == 404
+        assert factory.request(container, "/default/nope").status == 404
+        assert factory.request(container, "/a/b/c").status == 404
+
+    def test_handler_chain_order_first_wins(self):
+        calls = []
+
+        def probe(parser, runtime):
+            calls.append(parser.url)
+            return None  # decline; next handler runs
+
+        factory, container, _ = _make_doc()
+        router = RuntimeRequestRouter([probe,
+                                       default_route_handler("default"),
+                                       datastore_request_handler])
+        response = router.request(container.runtime, "/")
+        assert response.ok and calls == ["/"]
+
+    def test_repeated_requests_return_cached_object_once_initialized(self):
+        # Lifecycle hooks must not re-run per request — a has_initialized
+        # that subscribes listeners would stack one copy per call.
+        inits = []
+
+        class Counting(DataObject):
+            def initializing_first_time(self, props=None):
+                pass
+
+            def has_initialized(self):
+                inits.append(1)
+
+        factory = ContainerRuntimeFactoryWithDefaultDataStore(
+            DataObjectFactory("counting", Counting))
+        server = LocalCollabServer()
+        container, created = factory.create_document(
+            LocalDocumentService(server, "doc-cache"))
+        container.attach()
+        first = factory.request(container, "/").value
+        second = factory.request(container, "/").value
+        assert first is second is created
+        assert inits == [1]  # only the create-time run
+
+    def test_untyped_datastore_still_routes_raw(self):
+        factory, container, _ = _make_doc()
+        untyped = container.runtime.create_datastore("plain")
+        untyped.create_channel("m", SharedMap.channel_type)
+        response = factory.request(container, "/plain/m")
+        assert response.ok and isinstance(response.value, SharedMap)
+
+
+class TestSynthesize:
+    def test_required_and_optional(self):
+        deps = DependencyContainer()
+        deps.register("ILogger", value="logger-instance")
+        scope = deps.synthesize(required=["ILogger"],
+                                optional=["IMissing"])
+        assert scope.ILogger == "logger-instance"
+        assert scope.IMissing is None
+
+    def test_missing_required_raises(self):
+        with pytest.raises(DependencyError):
+            DependencyContainer().synthesize(required=["INope"])
+
+    def test_factory_providers_are_lazy_singletons(self):
+        built = []
+        deps = DependencyContainer()
+        deps.register("IThing", factory=lambda: built.append(1) or object())
+        assert built == []
+        first = deps.resolve("IThing")
+        second = deps.resolve("IThing")
+        assert first is second and built == [1]
+
+    def test_parent_chaining_and_shadowing(self):
+        parent = DependencyContainer()
+        parent.register("IA", value="from-parent")
+        parent.register("IB", value="parent-b")
+        child = DependencyContainer(parent)
+        child.register("IB", value="child-b")
+        assert child.resolve("IA") == "from-parent"
+        assert child.resolve("IB") == "child-b"
+        assert parent.resolve("IB") == "parent-b"
+
+    def test_register_validates_arguments(self):
+        deps = DependencyContainer()
+        with pytest.raises(ValueError):
+            deps.register("IX")
+        with pytest.raises(ValueError):
+            deps.register("IX", value=1, factory=lambda: 2)
