@@ -32,11 +32,15 @@ Core rules mirrored exactly:
 
 from __future__ import annotations
 
+import itertools
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Iterable
 
 UNASSIGNED = -1  # reference UnassignedSequenceNumber (pending local op)
+# Segments per snapshot chunk (snapshotChunks.ts parity): documents above
+# this split their segment table into chunks; loaders stream them.
+SNAPSHOT_CHUNK_SEGMENTS = 256
 
 # Non-text segment content: a marker (reference Marker, refType + optional id
 # + props). Markers have visible length 1 in position space.
@@ -618,8 +622,21 @@ class MergeEngine:
                 prev[mergeable_key] += entry[mergeable_key]
                 continue
             segs.append(entry)
+        if len(segs) <= SNAPSHOT_CHUNK_SEGMENTS:
+            return {"seq": self.current_seq, "min_seq": self.min_seq,
+                    "segments": segs}
+        # Chunked form (snapshotChunks.ts / snapshotV1 header+body parity):
+        # big documents split the segment table so loaders can process one
+        # chunk at a time (bounded peak memory) and blob-level storage
+        # dedups unchanged chunks across summaries. Small documents keep
+        # the flat form — formats are distinguished by the "header" key.
+        chunks = [segs[i:i + SNAPSHOT_CHUNK_SEGMENTS]
+                  for i in range(0, len(segs), SNAPSHOT_CHUNK_SEGMENTS)]
         return {"seq": self.current_seq, "min_seq": self.min_seq,
-                "segments": segs}
+                "header": {"total_segments": len(segs),
+                           "chunk_count": len(chunks)},
+                "segments": chunks[0],
+                "extra_chunks": chunks[1:]}
 
     @classmethod
     def load(cls, snapshot: dict, local_client: str | None = None
@@ -627,7 +644,13 @@ class MergeEngine:
         engine = cls(local_client)
         engine.current_seq = snapshot["seq"]
         engine.min_seq = snapshot["min_seq"]
-        for entry in snapshot["segments"]:
+        entries = snapshot["segments"]
+        if "header" in snapshot:
+            # Chunked form: consume chunk-by-chunk (itertools.chain keeps
+            # peak memory at one chunk beyond the segment list itself).
+            entries = itertools.chain(
+                entries, *snapshot.get("extra_chunks", ()))
+        for entry in entries:
             content: str | tuple | Marker
             if "marker" in entry:
                 content = Marker(ref_type=entry["marker"]["ref_type"],

@@ -65,11 +65,12 @@ class ChannelKey(NamedTuple):
 
 
 class _MergeRow:
-    __slots__ = ("row", "client_slots", "key_slots", "pending", "raw_log",
-                 "scalar", "min_seq", "last_seq", "markers")
+    __slots__ = ("pool", "row", "client_slots", "key_slots", "pending",
+                 "raw_log", "scalar", "min_seq", "last_seq", "markers")
 
-    def __init__(self, row: int) -> None:
-        self.row = row
+    def __init__(self) -> None:
+        self.pool: "_MergePool | None" = None
+        self.row = -1
         self.client_slots: dict[str, int] = {}
         self.key_slots: dict[str, int] = {}
         self.pending: list[dict] = []
@@ -105,6 +106,83 @@ _MERGE_FILL = dict(valid=False, length=0, ins_seq=0, ins_client=-1,
 _MAP_FILL = dict(present=False, value=0, vseq=-1, cleared_seq=-1)
 
 
+class _MergePool:
+    """One device MergeState for channels in the same segment-size bucket.
+
+    Bucketed ragged batching (SURVEY §5.7): documents vary wildly in
+    segment count, and a single [B, S] table pads EVERY row to the largest
+    document's S. Buckets keyed by pow2 slot count bound the padding waste
+    to 2×: a channel lives in the smallest bucket that fits it and
+    migrates up (host round-trip, rare — doubling) when compaction can no
+    longer make room. Each flush issues one apply_tick per dirty bucket.
+    """
+
+    def __init__(self, slots: int, num_props: int,
+                 row_capacity: int = 8) -> None:
+        self.slots = slots
+        self.num_props = num_props
+        self.capacity = max(1, row_capacity)
+        self.state = mtk.init_state(self.capacity, slots, num_props)
+        self.text = mtk.TextPool(self.capacity)
+        self.members: list[_MergeRow | None] = []
+        self.free: list[int] = []
+
+    def alloc(self, mrow: _MergeRow) -> None:
+        if self.free:
+            row = self.free.pop()
+            self.members[row] = mrow
+        else:
+            row = len(self.members)
+            if row >= self.capacity:
+                self._grow_rows()
+            self.members.append(mrow)
+        mrow.pool, mrow.row = self, row
+
+    def release(self, row: int) -> None:
+        """Blank a device row and recycle its index."""
+        self.members[row] = None
+        self.state = mtk.MergeState(**{
+            f: (getattr(self.state, f).at[row].set(
+                _MERGE_FILL[f]) if f != "prop_val"
+                else self.state.prop_val.at[row].set(0))
+            for f in mtk.MergeState._fields})
+        self.text.chunks[row] = []
+        self.text.used[row] = 0
+        self.free.append(row)
+
+    def _grow_rows(self) -> None:
+        old = self.capacity
+        self.capacity = old * 2
+        self.state = jax.device_put(mtk.MergeState(**{
+            f: _pad_axis(getattr(self.state, f), 0, old, _MERGE_FILL[f])
+            for f in mtk.MergeState._fields}))
+        self.text.chunks += [[] for _ in range(old)]
+        self.text.used += [0] * old
+        # members stays shorter than capacity; alloc() grows it by append
+
+    def grow_props(self, need: int) -> None:
+        new = self.num_props
+        while new < need:
+            new *= 2
+        if new == self.num_props:
+            return
+        extra = new - self.num_props
+        self.state = self.state._replace(prop_val=jnp.asarray(
+            _pad_axis(self.state.prop_val, 2, extra, 0)))
+        self.num_props = new
+
+    def row_arrays(self, row: int) -> dict[str, np.ndarray]:
+        """Host copies of one row's planes (migration source)."""
+        return {f: np.asarray(getattr(self.state, f)[row])
+                for f in mtk.MergeState._fields}
+
+    def write_row(self, row: int, arrays: dict[str, np.ndarray]) -> None:
+        """Install planes (padded by the caller) into a row."""
+        self.state = mtk.MergeState(**{
+            f: getattr(self.state, f).at[row].set(arrays[f])
+            for f in mtk.MergeState._fields})
+
+
 class KernelMergeHost:
     """Batched device host for the merge-tree and map apply kernels."""
 
@@ -113,17 +191,17 @@ class KernelMergeHost:
                  flush_threshold: int = 256, metrics=None) -> None:
         from ..utils import MetricsRegistry
         self.metrics = metrics if metrics is not None else MetricsRegistry()
-        self._merge_capacity = max(1, row_capacity)
+        self._row_capacity = max(1, row_capacity)
         self._map_capacity = max(1, row_capacity)
-        self._merge_slots = max(8, merge_slots)
+        self._merge_slots = max(8, merge_slots)  # smallest bucket size
         self._map_slots = max(4, map_slots)
         self._num_props = max(1, num_props)
         self.flush_threshold = flush_threshold
 
-        self._mstate = mtk.init_state(self._merge_capacity, self._merge_slots,
-                                      self._num_props)
+        # Merge channels live in pow2-bucketed pools (bucketed ragged
+        # batching); maps are uniform-small and keep one state.
+        self._merge_pools: dict[int, _MergePool] = {}
         self._xstate = mk.init_state(self._map_capacity, self._map_slots)
-        self._pool = mtk.TextPool(self._merge_capacity)
 
         self._merge_rows: dict[ChannelKey, _MergeRow] = {}
         self._map_rows: dict[ChannelKey, _MapRow] = {}
@@ -135,7 +213,8 @@ class KernelMergeHost:
         # Counters surfaced by the telemetry layer (ops served by the
         # device path vs routed to the scalar fallback).
         self.stats = {"device_ops": 0, "scalar_ops": 0, "flushes": 0,
-                      "compactions": 0, "overflow_routed": 0}
+                      "compactions": 0, "overflow_routed": 0,
+                      "migrations": 0}
 
     # -- interning -------------------------------------------------------------
 
@@ -152,15 +231,49 @@ class KernelMergeHost:
 
     # -- row allocation / growth -----------------------------------------------
 
+    def _pool_for(self, slots: int) -> _MergePool:
+        slots = max(_next_pow2(slots), self._merge_slots)
+        pool = self._merge_pools.get(slots)
+        if pool is None:
+            pool = _MergePool(slots, self._num_props, self._row_capacity)
+            self._merge_pools[slots] = pool
+        return pool
+
     def _merge_row(self, key: ChannelKey) -> _MergeRow:
         state = self._merge_rows.get(key)
         if state is None:
-            row = len(self._merge_rows)
-            if row >= self._merge_capacity:
-                self._grow_merge_rows()
-            state = _MergeRow(row)
+            state = _MergeRow()
+            self._pool_for(self._merge_slots).alloc(state)
             self._merge_rows[key] = state
         return state
+
+    def _migrate_merge_row(self, mrow: _MergeRow, target_slots: int) -> None:
+        """Move a channel to a bigger bucket (its segment table no longer
+        fits even after compaction). One host round-trip per migration;
+        doubling makes them geometrically rare."""
+        src_pool, src_row = mrow.pool, mrow.row
+        dst_pool = self._pool_for(target_slots)
+        assert dst_pool is not src_pool
+        if src_pool.num_props > dst_pool.num_props:
+            dst_pool.grow_props(src_pool.num_props)
+        arrays = src_pool.row_arrays(src_row)
+        pad_s = dst_pool.slots - src_pool.slots
+        out: dict[str, np.ndarray] = {}
+        for f, a in arrays.items():
+            if f == "count":
+                out[f] = a
+            elif f == "prop_val":
+                padded = _pad_axis(a, 0, pad_s, 0)
+                out[f] = _pad_axis(padded, 1,
+                                   dst_pool.num_props - a.shape[1], 0)
+            else:
+                out[f] = _pad_axis(a, 0, pad_s, _MERGE_FILL[f])
+        dst_pool.alloc(mrow)
+        dst_pool.write_row(mrow.row, out)
+        dst_pool.text.chunks[mrow.row] = src_pool.text.chunks[src_row]
+        dst_pool.text.used[mrow.row] = src_pool.text.used[src_row]
+        src_pool.release(src_row)
+        self.stats["migrations"] += 1
 
     def _map_row(self, key: ChannelKey) -> _MapRow:
         state = self._map_rows.get(key)
@@ -172,41 +285,12 @@ class KernelMergeHost:
             self._map_rows[key] = state
         return state
 
-    def _grow_merge_rows(self) -> None:
-        old = self._merge_capacity
-        self._merge_capacity = old * 2
-        self._mstate = jax.device_put(mtk.MergeState(**{
-            f: _pad_axis(getattr(self._mstate, f), 0, old, _MERGE_FILL[f])
-            for f in mtk.MergeState._fields}))
-        self._pool.chunks += [[] for _ in range(old)]
-        self._pool.used += [0] * old
-
     def _grow_map_rows(self) -> None:
         old = self._map_capacity
         self._map_capacity = old * 2
         self._xstate = jax.device_put(mk.MapState(**{
             f: _pad_axis(getattr(self._xstate, f), 0, old, _MAP_FILL[f])
             for f in mk.MapState._fields}))
-
-    def _grow_merge_slots(self, need: int) -> None:
-        new = self._merge_slots
-        while new < need:
-            new *= 2
-        extra = new - self._merge_slots
-        self._mstate = jax.device_put(mtk.MergeState(**{
-            f: (_pad_axis(getattr(self._mstate, f), 1, extra, _MERGE_FILL[f])
-                if f != "count" else np.asarray(self._mstate.count))
-            for f in mtk.MergeState._fields}))
-        self._merge_slots = new
-
-    def _grow_props(self, need: int) -> None:
-        new = self._num_props
-        while new < need:
-            new *= 2
-        extra = new - self._num_props
-        self._mstate = self._mstate._replace(prop_val=jnp.asarray(
-            _pad_axis(self._mstate.prop_val, 2, extra, 0)))
-        self._num_props = new
 
     def _grow_map_slots(self, need: int) -> None:
         new = self._map_slots
@@ -285,7 +369,7 @@ class KernelMergeHost:
                     text = _MARKER_CHAR
                     row.markers += 1
                 enc = dict(base, kind=mtk.MT_INSERT, pos=op["pos"],
-                           pool_start=self._pool.append(row.row, text),
+                           pool_start=row.pool.text.append(row.row, text),
                            text_len=len(text))
                 row.pending.append(enc)
                 self._pending_ops += 1
@@ -322,13 +406,10 @@ class KernelMergeHost:
         row.scalar = engine
         self._pending_ops -= len(row.pending)
         row.pending = []
-        # Release the abandoned device row: zeroing its valid mask keeps
+        # Release the abandoned device row: blanking its valid mask keeps
         # later apply_tick/compact passes from dragging stale segments.
-        self._mstate = mtk.MergeState(**{
-            f: (getattr(self._mstate, f).at[row.row].set(
-                _MERGE_FILL[f]) if f != "prop_val"
-                else self._mstate.prop_val.at[row.row].set(0))
-            for f in mtk.MergeState._fields})
+        row.pool.release(row.row)
+        row.pool, row.row = None, -1
         self.stats["overflow_routed"] += 1
 
     def _ingest_map(self, key: ChannelKey, channel_op: dict,
@@ -373,42 +454,61 @@ class KernelMergeHost:
         rows = [r for r in self._merge_rows.values() if r.pending]
         if not rows:
             return
-        # Prop-plane growth before batch encode (key slots are global per
-        # channel but the plane axis is shared).
-        max_props = max((len(r.key_slots) for r in rows), default=0)
-        if max_props > self._num_props:
-            self._grow_props(max_props)
-
         # Capacity: each op can consume up to 2 fresh slots (split+place /
-        # split+split). Compact rows under pressure; grow if still short.
-        margins = mtk.capacity_margin(self._mstate)
-        need = np.zeros(self._merge_capacity, np.int64)
-        min_seq = np.full(self._merge_capacity, -1, np.int32)
-        for r in rows:
-            need[r.row] = 2 * len(r.pending) + 2
-        short = need > margins
-        if short.any():
-            for r in self._merge_rows.values():
-                if short[r.row]:
-                    min_seq[r.row] = r.min_seq
-            self._mstate = mtk.compact(self._mstate, jnp.asarray(min_seq))
-            self.stats["compactions"] += 1
-            margins = mtk.capacity_margin(self._mstate)
-            still = need > margins
-            if still.any():
-                worst = int((need - margins)[still].max())
-                self._grow_merge_slots(self._merge_slots + _next_pow2(worst))
+        # split+split). Compact rows under pressure; rows that STILL don't
+        # fit migrate to the next bucket — only they pay for the growth.
+        for _ in range(32):  # bounded: each pass doubles the short rows
+            short_rows: list[tuple[_MergeRow, int]] = []
+            for pool, pool_rows in self._rows_by_pool(rows).items():
+                margins = mtk.capacity_margin(pool.state)
+                need = np.zeros(pool.capacity, np.int64)
+                for r in pool_rows:
+                    need[r.row] = 2 * len(r.pending) + 2
+                short = need > margins
+                if not short.any():
+                    continue
+                min_seq = np.full(pool.capacity, -1, np.int32)
+                for r in pool.members:
+                    if r is not None and short[r.row]:
+                        min_seq[r.row] = r.min_seq
+                pool.state = mtk.compact(pool.state, jnp.asarray(min_seq))
+                self.stats["compactions"] += 1
+                still = need > mtk.capacity_margin(pool.state)
+                for r in pool_rows:
+                    if still[r.row]:
+                        short_rows.append((r, int(need[r.row])))
+            if not short_rows:
+                break
+            for r, n in short_rows:
+                live = int(np.asarray(r.pool.state.count[r.row]))
+                self._migrate_merge_row(
+                    r, max(_next_pow2(live + n), r.pool.slots * 2))
 
-        k = _next_pow2(max(len(r.pending) for r in rows))
-        per_doc = [[] for _ in range(self._merge_capacity)]
-        for r in rows:
-            per_doc[r.row] = r.pending
-        batch = mtk.make_merge_op_batch(per_doc, self._merge_capacity, k)
-        self._mstate = mtk.apply_tick(self._mstate, batch)
-        self.stats["device_ops"] += sum(len(r.pending) for r in rows)
+        # One apply_tick per dirty bucket; prop planes grow per pool.
+        for pool, pool_rows in self._rows_by_pool(rows).items():
+            max_props = max(len(r.key_slots) for r in pool_rows)
+            if max_props > pool.num_props:
+                pool.grow_props(max_props)
+            k = _next_pow2(max(len(r.pending) for r in pool_rows))
+            per_doc = [[] for _ in range(pool.capacity)]
+            for r in pool_rows:
+                per_doc[r.row] = r.pending
+            batch = mtk.make_merge_op_batch(per_doc, pool.capacity, k)
+            pool.state = mtk.apply_tick(pool.state, batch)
+            self.stats["device_ops"] += sum(
+                len(r.pending) for r in pool_rows)
+            for r in pool_rows:
+                r.pending = []
         self.stats["flushes"] += 1
+
+    @staticmethod
+    def _rows_by_pool(rows: list[_MergeRow]
+                      ) -> dict[_MergePool, list[_MergeRow]]:
+        grouped: dict[_MergePool, list[_MergeRow]] = {}
         for r in rows:
-            r.pending = []
+            if r.pending and r.pool is not None:
+                grouped.setdefault(r.pool, []).append(r)
+        return grouped
 
     def _flush_map(self) -> None:
         rows = [r for r in self._map_rows.values() if r.pending]
@@ -446,7 +546,7 @@ class KernelMergeHost:
                 seg.content for seg in row.scalar.segments
                 if seg.removed_seq is None and not seg.is_marker
                 and isinstance(seg.content, str))
-        text = mtk.materialize(self._mstate, self._pool, row.row)
+        text = mtk.materialize(row.pool.state, row.pool.text, row.row)
         return text.replace(_MARKER_CHAR, "")
 
     def rich_text(self, doc_id: str, datastore: str,
@@ -464,12 +564,13 @@ class KernelMergeHost:
                     for seg in row.scalar.segments
                     if seg.removed_seq is None and seg.length > 0]
         key_rev = {slot: name for name, slot in row.key_slots.items()}
-        valid = np.asarray(self._mstate.valid[row.row])
-        length = np.asarray(self._mstate.length[row.row])
-        rem = np.asarray(self._mstate.rem_seq[row.row])
-        start = np.asarray(self._mstate.pool_start[row.row])
-        pvals = np.asarray(self._mstate.prop_val[row.row])
-        buffer = self._pool.buffer(row.row)
+        state = row.pool.state
+        valid = np.asarray(state.valid[row.row])
+        length = np.asarray(state.length[row.row])
+        rem = np.asarray(state.rem_seq[row.row])
+        start = np.asarray(state.pool_start[row.row])
+        pvals = np.asarray(state.prop_val[row.row])
+        buffer = row.pool.text.buffer(row.row)
         out = []
         for i in range(valid.shape[0]):
             if not (valid[i] and rem[i] == mtk.NONE_SEQ and length[i] > 0):

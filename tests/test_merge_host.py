@@ -138,8 +138,40 @@ def test_capacity_pressure_compacts_and_grows():
     assert t1.get_text() == t2.get_text()
     assert host.text("doc", "default", "text") == t1.get_text()
     assert host.map_entries("doc", "default", "root") == dict(m1.data.items())
-    assert host.stats["compactions"] > 0 or host._merge_slots > 8
+    assert (host.stats["compactions"] > 0
+            or host.stats["migrations"] > 0
+            or any(p.slots > 8 for p in host._merge_pools.values()))
     assert host._map_slots > 4  # 12 keys forced map slot growth
+
+
+def test_bucketed_pools_isolate_large_documents():
+    """Ragged batching: one hot channel migrating to a bigger bucket must
+    not widen the small channels' segment table (SURVEY §5.7)."""
+    host = KernelMergeHost(merge_slots=8, num_props=1, flush_threshold=8)
+    server = LocalCollabServer(merge_host=host)
+    big = make_doc(server, "big")
+    small = make_doc(server, "small")
+    big_text, _ = get_parts(big)
+    small_text, _ = get_parts(small)
+    small_text.insert_text(0, "tiny")
+    # Interleave positions so zamboni can't fully pack the big doc; msn
+    # pinned low by a second (idle) client would also work, but distinct
+    # inserts at position 0 keep every segment live anyway.
+    for i in range(80):
+        big_text.insert_text(i % max(len(big_text.get_text()), 1), "xy")
+    host.flush()
+    assert host.text("big", "default", "text") == big_text.get_text()
+    assert host.text("small", "default", "text") == "tiny"
+    big_row = host._merge_rows[("big", "default", "text")]
+    small_row = host._merge_rows[("small", "default", "text")]
+    assert big_row.pool is not small_row.pool
+    assert small_row.pool.slots == 8  # small docs still pay the small bill
+    assert big_row.pool.slots > 8
+    assert host.stats["migrations"] > 0
+    # And the migrated row keeps converging.
+    big_text.insert_text(0, "Z")
+    host.flush()
+    assert host.text("big", "default", "text") == big_text.get_text()
 
 
 def _op_message(seq, ref_seq, client_id, channel_op, msn=0):
