@@ -231,9 +231,11 @@ class AlfredServer:
             writer.close()
 
 
-def build_default_service(data_dir: str | None = None, merge_host=True):
+def build_default_service(data_dir: str | None = None, merge_host=True,
+                          native_bus: bool = False):
     """Standalone assembly: routerlicious lambdas (+ device merge host,
-    + durable file-backed storage when ``data_dir`` is given)."""
+    + durable file-backed storage when ``data_dir`` is given, + the C++
+    shuttle bus with ``native_bus`` in in-memory mode)."""
     from ..utils import MetricsRegistry
     from .routerlicious import RouterliciousService
     metrics = MetricsRegistry()  # one registry spans the whole assembly
@@ -241,6 +243,9 @@ def build_default_service(data_dir: str | None = None, merge_host=True):
     if merge_host:
         from .merge_host import KernelMergeHost
         kwargs["merge_host"] = KernelMergeHost()
+    if native_bus and data_dir is None:
+        from .native_bus import make_message_bus
+        kwargs["bus"] = make_message_bus()
     if data_dir is not None:
         from .durable_store import (
             DurableMessageBus, FileStateStore, GitSnapshotStore)
@@ -261,10 +266,16 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--data-dir", default=None,
                         help="directory for durable bus/state/snapshots; "
                              "omitted = in-memory (tinylicious mode)")
+    parser.add_argument("--native-bus", action="store_true",
+                        help="run the in-memory bus on the C++ shuttle")
     args = parser.parse_args(argv)
+    if args.native_bus and args.data_dir is not None:
+        parser.error("--native-bus is in-memory only; it cannot be "
+                     "combined with --data-dir (the durable bus)")
 
     service = build_default_service(args.data_dir,
-                                    merge_host=not args.no_merge_host)
+                                    merge_host=not args.no_merge_host,
+                                    native_bus=args.native_bus)
 
     async def run() -> None:
         server = AlfredServer(service, args.host, args.port)

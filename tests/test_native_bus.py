@@ -1,0 +1,95 @@
+"""C++ shuttle bus: differential vs the Python MessageBus, and the full
+service running over it (services-ordering-rdkafka parity)."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.native.shuttle import shuttle_available
+from fluidframework_tpu.server.bus import (
+    Consumer,
+    MessageBus,
+    partition_for,
+)
+from fluidframework_tpu.server.native_bus import (
+    NativeMessageBus,
+    make_message_bus,
+)
+
+pytestmark = pytest.mark.skipif(not shuttle_available(),
+                                reason="no native toolchain")
+
+
+class TestShuttleBus:
+    def test_differential_against_python_bus(self):
+        rng = random.Random(0)
+        native = NativeMessageBus()
+        python = MessageBus()
+        for bus in (native, python):
+            bus.create_topic("t", num_partitions=4)
+        keys = [f"doc-{i}" for i in range(10)]
+        for step in range(300):
+            key = rng.choice(keys)
+            value = {"step": step, "payload": rng.randrange(1000)}
+            assert native.produce("t", key, value) == \
+                python.produce("t", key, value)
+        for partition in range(4):
+            got = native.topic("t").read(partition, 0)
+            want = python.topic("t").read(partition, 0)
+            assert [(m.offset, m.key, m.value) for m in got] == \
+                [(m.offset, m.key, m.value) for m in want]
+
+    def test_partitioner_matches_crc32(self):
+        bus = NativeMessageBus()
+        bus.create_topic("t", num_partitions=8)
+        for key in ("a", "doc-123", "ü-unicode", ""):
+            pid, _ = bus.produce("t", key, {"v": 1})
+            assert pid == partition_for(key, 8)
+
+    def test_consumer_group_offsets_independent(self):
+        bus = NativeMessageBus()
+        bus.create_topic("t", num_partitions=1)
+        for i in range(5):
+            bus.produce("t", "k", i)
+        a = Consumer(bus, "t", "group-a")
+        b = Consumer(bus, "t", "group-b")
+        assert [m.value for m in a.poll(0)] == [0, 1, 2, 3, 4]
+        a.commit(0, 3)
+        assert [m.value for m in a.poll(0)] == [3, 4]
+        assert [m.value for m in b.poll(0)] == [0, 1, 2, 3, 4]  # fan-out
+        assert [m.value for m in a.poll(0, max_messages=1)] == [3]
+
+    def test_wire_codec_roundtrips_protocol_objects(self):
+        from fluidframework_tpu.server.sequencer import RawOperation
+        from fluidframework_tpu.protocol.messages import MessageType
+
+        bus = NativeMessageBus()
+        bus.create_topic("t", num_partitions=2)
+        raw = RawOperation(client_id="c1", type=MessageType.OPERATION,
+                           client_seq=1, ref_seq=0, timestamp=5,
+                           contents={"x": [1, 2]})
+        pid, _ = bus.produce("t", "doc", raw)
+        message = bus.topic("t").read(pid, 0)[0]
+        assert message.value == raw
+
+    def test_service_end_to_end_on_native_bus(self):
+        from fluidframework_tpu.dds.map import SharedMap
+        from fluidframework_tpu.drivers.local_driver import (
+            LocalDocumentService)
+        from fluidframework_tpu.runtime.container import Container
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService)
+
+        service = RouterliciousService(bus=make_message_bus())
+        c1 = Container.create_detached(LocalDocumentService(service, "doc"))
+        ds = c1.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        c1.attach()
+        c2 = Container.load(LocalDocumentService(service, "doc"))
+        ds.get_channel("root").set("a", 1)
+        c2.runtime.get_datastore("default").get_channel("root").set("b", 2)
+        root1 = ds.get_channel("root")
+        root2 = c2.runtime.get_datastore("default").get_channel("root")
+        assert dict(root1.items()) == dict(root2.items()) == \
+            {"a": 1, "b": 2}
+        assert c1.summarize() == c2.summarize()
