@@ -224,6 +224,32 @@ def make_matrix_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
                          **{n: jnp.asarray(v) for n, v in fields.items()})
 
 
+def encode_matrix_op(channel_op: dict, base: dict, alloc_rows, alloc_cols,
+                     intern) -> list[dict]:
+    """ONE wire op → kernel op dicts — the single wire-format decoder
+    shared by the replay harness (encode_matrix_log) and the serving host
+    (merge_host._ingest_matrix). ``alloc_rows``/``alloc_cols`` are
+    count→handle_base callables; ``intern`` maps a cell value to its id
+    (0 reserved for None/cleared)."""
+    target = channel_op["target"]
+    if target in ("rows", "cols"):
+        alloc = alloc_rows if target == "rows" else alloc_cols
+        tcode = MX_ROWS if target == "rows" else MX_COLS
+        if channel_op["type"] == "insert":
+            count = channel_op["count"]
+            return [dict(base, target=tcode, kind=mtk.MT_INSERT,
+                         pos=channel_op["pos"], count=count,
+                         handle_base=alloc(count))]
+        if channel_op["type"] == "removeGroup":
+            return [dict(base, target=tcode, kind=mtk.MT_REMOVE,
+                         pos=start, end=end)
+                    for start, end in channel_op["ranges"]]
+        return [dict(base, target=tcode, kind=mtk.MT_REMOVE,
+                     pos=channel_op["start"], end=channel_op["end"])]
+    return [dict(base, target=MX_CELL, row=channel_op["row"],
+                 col=channel_op["col"], value=intern(channel_op["value"]))]
+
+
 def encode_matrix_log(messages, doc: int, rows: HandleAllocator,
                       cols: HandleAllocator, client_slots: dict,
                       val_ids: dict) -> list[dict]:
@@ -234,6 +260,10 @@ def encode_matrix_log(messages, doc: int, rows: HandleAllocator,
     """
     from ..protocol.messages import MessageType
 
+    def intern(value):
+        return 0 if value is None else val_ids.setdefault(
+            repr(value), len(val_ids) + 1)
+
     out = []
     for m in messages:
         if m.type != MessageType.OPERATION:
@@ -242,29 +272,10 @@ def encode_matrix_log(messages, doc: int, rows: HandleAllocator,
         slot = client_slots.setdefault(m.client_id, len(client_slots))
         base = dict(seq=m.sequence_number,
                     ref_seq=m.reference_sequence_number, client=slot)
-        target = channel_op["target"]
-        if target in ("rows", "cols"):
-            axis = rows if target == "rows" else cols
-            tcode = MX_ROWS if target == "rows" else MX_COLS
-            if channel_op["type"] == "insert":
-                count = channel_op["count"]
-                out.append(dict(base, target=tcode, kind=mtk.MT_INSERT,
-                                pos=channel_op["pos"], count=count,
-                                handle_base=axis.alloc(doc, count)))
-            elif channel_op["type"] == "removeGroup":
-                for start, end in channel_op["ranges"]:
-                    out.append(dict(base, target=tcode, kind=mtk.MT_REMOVE,
-                                    pos=start, end=end))
-            else:
-                out.append(dict(base, target=tcode, kind=mtk.MT_REMOVE,
-                                pos=channel_op["start"],
-                                end=channel_op["end"]))
-        else:  # cell set
-            value = channel_op["value"]
-            vid = 0 if value is None else val_ids.setdefault(
-                repr(value), len(val_ids) + 1)
-            out.append(dict(base, target=MX_CELL, row=channel_op["row"],
-                            col=channel_op["col"], value=vid))
+        out.extend(encode_matrix_op(
+            channel_op, base,
+            lambda count: rows.alloc(doc, count),
+            lambda count: cols.alloc(doc, count), intern))
     return out
 
 

@@ -46,6 +46,7 @@ import numpy as np
 
 from ..dds.mergetree import MergeEngine
 from ..ops import map_kernel as mk
+from ..ops import matrix_kernel as mxk
 from ..ops import mergetree_kernel as mtk
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from .kernel_host import _next_pow2
@@ -91,6 +92,24 @@ class _MapRow:
         self.key_slots: dict[str, int] = {}
         self.pending: list[dict] = []
         self.last_seq = 0
+
+
+class _MatrixRow:
+    __slots__ = ("row", "client_slots", "pending", "raw_log", "scalar",
+                 "last_seq", "min_seq", "next_row_handle",
+                 "next_col_handle")
+
+    def __init__(self, row: int) -> None:
+        self.row = row
+        self.client_slots: dict[str, int] = {}
+        self.pending: list[dict] = []
+        # (channel_op, seq, ref_seq, client) — scalar-fallback replay source.
+        self.raw_log: list[tuple[dict, int, int, str]] = []
+        self.scalar: tuple | None = None  # (rows vec, cols vec, cells dict)
+        self.last_seq = 0
+        self.min_seq = 0
+        self.next_row_handle = 0
+        self.next_col_handle = 0
 
 
 def _pad_axis(a, axis: int, extra: int, fill):
@@ -199,9 +218,15 @@ class KernelMergeHost:
         self.flush_threshold = flush_threshold
 
         # Merge channels live in pow2-bucketed pools (bucketed ragged
-        # batching); maps are uniform-small and keep one state.
+        # batching); maps are uniform-small and keep one state; matrices
+        # (two embedded merge states + a cell table) lazily allocate one.
         self._merge_pools: dict[int, _MergePool] = {}
         self._xstate = mk.init_state(self._map_capacity, self._map_slots)
+        self._matrix_state: mxk.MatrixState | None = None
+        self._matrix_capacity = max(1, row_capacity)
+        self._matrix_vec_slots = 64
+        self._matrix_cell_slots = 256
+        self._matrix_rows: dict[ChannelKey, _MatrixRow] = {}
 
         self._merge_rows: dict[ChannelKey, _MergeRow] = {}
         self._map_rows: dict[ChannelKey, _MapRow] = {}
@@ -321,7 +346,11 @@ class KernelMergeHost:
             return
         key = ChannelKey(doc_id, envelope["address"], inner["address"])
         kind = channel_op["type"]
-        if kind in _MERGE_OPS:
+        if "target" in channel_op:
+            # Matrix ops carry a target axis/cell and reuse type names the
+            # merge/map sets also use — route by shape FIRST.
+            self._ingest_matrix(key, channel_op, message)
+        elif kind in _MERGE_OPS:
             self._ingest_merge(key, channel_op, message)
         elif kind in _MAP_OPS:
             self._ingest_map(key, channel_op, message)
@@ -340,13 +369,14 @@ class KernelMergeHost:
         client = message.client_id
         subops = (channel_op["ops"] if channel_op["type"] == "group"
                   else [channel_op])
-        for op in subops:
-            row.raw_log.append((op, seq, ref_seq, client))
         if row.scalar is not None:
+            # Scalar-served: the engine is the state now; no log needed.
             for op in subops:
                 row.scalar.apply_remote(op, seq, ref_seq, client)
             self.stats["scalar_ops"] += len(subops)
             return
+        for op in subops:
+            row.raw_log.append((op, seq, ref_seq, client))
         if (client not in row.client_slots
                 and len(row.client_slots) >= mtk.MAX_CLIENT_SLOTS):
             self._route_to_scalar(key, row)
@@ -404,6 +434,7 @@ class KernelMergeHost:
         for op, seq, ref_seq, client in row.raw_log:
             engine.apply_remote(op, seq, ref_seq, client)
         row.scalar = engine
+        row.raw_log = []  # the engine IS the state from here on
         self._pending_ops -= len(row.pending)
         row.pending = []
         # Release the abandoned device row: blanking its valid mask keeps
@@ -411,6 +442,192 @@ class KernelMergeHost:
         row.pool.release(row.row)
         row.pool, row.row = None, -1
         self.stats["overflow_routed"] += 1
+
+    # -- matrix channels (matrix.ts:547 behind the service) --------------------
+
+    def _matrix_row(self, key: ChannelKey) -> _MatrixRow:
+        state = self._matrix_rows.get(key)
+        if state is None:
+            row = len(self._matrix_rows)
+            if row >= self._matrix_capacity:
+                self._grow_matrix_rows()
+            state = _MatrixRow(row)
+            self._matrix_rows[key] = state
+        return state
+
+    def _ingest_matrix(self, key: ChannelKey, channel_op: dict,
+                       message: SequencedDocumentMessage) -> None:
+        row = self._matrix_row(key)
+        seq = message.sequence_number
+        if seq <= row.last_seq:
+            return  # bus replay
+        row.last_seq = seq
+        row.min_seq = message.minimum_sequence_number
+        ref_seq = message.reference_sequence_number
+        client = message.client_id
+        if row.scalar is not None:
+            # Scalar-served: no device state to rebuild later, no log.
+            self._matrix_scalar_apply(row, channel_op, seq, ref_seq, client)
+            self.stats["scalar_ops"] += 1
+            return
+        row.raw_log.append((channel_op, seq, ref_seq, client))
+        if (client not in row.client_slots
+                and len(row.client_slots) >= mtk.MAX_CLIENT_SLOTS):
+            self._route_matrix_to_scalar(row)
+            self.stats["scalar_ops"] += 1
+            return
+        slot = row.client_slots.setdefault(client, len(row.client_slots))
+
+        def alloc(axis):
+            def inner(count):
+                base = getattr(row, axis)
+                setattr(row, axis, base + count)
+                return base
+            return inner
+
+        encoded = mxk.encode_matrix_op(
+            channel_op, dict(seq=seq, ref_seq=ref_seq, client=slot),
+            alloc("next_row_handle"), alloc("next_col_handle"),
+            self._intern)
+        row.pending.extend(encoded)
+        self._pending_ops += len(encoded)
+
+    def _route_matrix_to_scalar(self, row: _MatrixRow) -> None:
+        """Client-slot bitmask exhausted: replay through scalar permutation
+        vectors + an LWW cell fold and serve host-side from now on."""
+        from ..dds.matrix import PermutationVector
+        rows_vec = PermutationVector(None)
+        cols_vec = PermutationVector(None)
+        cells: dict[tuple[int, int], Any] = {}
+        row.scalar = (rows_vec, cols_vec, cells)
+        self._pending_ops -= len(row.pending)
+        row.pending = []
+        for op, seq, ref_seq, client in row.raw_log:
+            self._matrix_scalar_apply(row, op, seq, ref_seq, client)
+        row.raw_log = []  # the scalar vectors ARE the state from here on
+        if self._matrix_state is not None:
+            self._matrix_state = self._blank_matrix_device_row(row.row)
+        self.stats["overflow_routed"] += 1
+
+    def _matrix_scalar_apply(self, row: _MatrixRow, op: dict, seq: int,
+                             ref_seq: int, client: str) -> None:
+        rows_vec, cols_vec, cells = row.scalar
+        target = op["target"]
+        if target in ("rows", "cols"):
+            (rows_vec if target == "rows" else cols_vec).apply_remote(
+                op, seq, ref_seq, client)
+        else:
+            rh = rows_vec.handle_at(op["row"], ref_seq, client)
+            ch = cols_vec.handle_at(op["col"], ref_seq, client)
+            if rh is not None and ch is not None:
+                cells[(rh, ch)] = op["value"]
+
+    def _blank_matrix_device_row(self, row: int) -> mxk.MatrixState:
+        s = self._matrix_state
+
+        def blank_merge(ms: mtk.MergeState) -> mtk.MergeState:
+            return mtk.MergeState(**{
+                f: (getattr(ms, f).at[row].set(_MERGE_FILL[f])
+                    if f != "prop_val" else ms.prop_val.at[row].set(0))
+                for f in mtk.MergeState._fields})
+
+        return s._replace(
+            rows=blank_merge(s.rows), cols=blank_merge(s.cols),
+            cell_used=s.cell_used.at[row].set(False),
+            cell_count=s.cell_count.at[row].set(0))
+
+    def _ensure_matrix_state(self) -> None:
+        if self._matrix_state is None:
+            self._matrix_state = mxk.init_state(
+                self._matrix_capacity, self._matrix_vec_slots,
+                self._matrix_cell_slots)
+
+    def _grow_matrix_rows(self) -> None:
+        old = self._matrix_capacity
+        self._matrix_capacity = old * 2
+        if self._matrix_state is not None:
+            self._matrix_state = jax.device_put(
+                self._pad_matrix_state(self._matrix_state, rows_extra=old))
+
+    @staticmethod
+    def _pad_matrix_state(s: mxk.MatrixState, rows_extra: int = 0,
+                          vec_extra: int = 0,
+                          cell_extra: int = 0) -> mxk.MatrixState:
+        def pad_merge(ms: mtk.MergeState) -> mtk.MergeState:
+            out = {}
+            for f in mtk.MergeState._fields:
+                a = _pad_axis(getattr(ms, f), 0, rows_extra, _MERGE_FILL[f])
+                if f != "count" and vec_extra:
+                    a = _pad_axis(a, 1, vec_extra, _MERGE_FILL[f])
+                out[f] = a
+            return mtk.MergeState(**out)
+
+        cell_fill = dict(cell_rh=-1, cell_ch=-1, cell_val=0, cell_seq=0,
+                         cell_used=False)
+        cells = {}
+        for f, fill in cell_fill.items():
+            a = _pad_axis(getattr(s, f), 0, rows_extra, fill)
+            if cell_extra:
+                a = _pad_axis(a, 1, cell_extra, fill)
+            cells[f] = a
+        return mxk.MatrixState(
+            rows=pad_merge(s.rows), cols=pad_merge(s.cols),
+            cell_count=_pad_axis(s.cell_count, 0, rows_extra, 0), **cells)
+
+    def _matrix_vec_shortfall(self, rows: list[_MatrixRow]
+                              ) -> tuple[int, int]:
+        """(vec_extra, cell_extra) pow2 growth needed for the dirty rows
+        (each vector op can consume 2 slots; each cell op 1 cell slot)."""
+        margins = mxk.capacity_margin(self._matrix_state)
+        vec_extra = cell_extra = 0
+        for r in rows:
+            vec_need = 2 * len(r.pending) + 2
+            cell_need = len(r.pending) + 1
+            worst_vec = min(int(margins["rows"][r.row]),
+                            int(margins["cols"][r.row]))
+            if vec_need > worst_vec:
+                vec_extra = max(vec_extra,
+                                _next_pow2(vec_need - worst_vec))
+            cell_margin = int(margins["cells"][r.row])
+            if cell_need > cell_margin:
+                cell_extra = max(cell_extra,
+                                 _next_pow2(cell_need - cell_margin))
+        return vec_extra, cell_extra
+
+    def _flush_matrix(self) -> None:
+        rows = [r for r in self._matrix_rows.values() if r.pending]
+        if not rows:
+            return
+        self._ensure_matrix_state()
+        vec_extra, cell_extra = self._matrix_vec_shortfall(rows)
+        if vec_extra:
+            # Zamboni the permutation vectors before paying for growth —
+            # tombstoned row/col segments below the window pack away.
+            min_seq = np.full(self._matrix_capacity, -1, np.int32)
+            for r in self._matrix_rows.values():
+                min_seq[r.row] = r.min_seq
+            ms = jnp.asarray(min_seq)
+            self._matrix_state = self._matrix_state._replace(
+                rows=mtk.compact(self._matrix_state.rows, ms),
+                cols=mtk.compact(self._matrix_state.cols, ms))
+            self.stats["compactions"] += 1
+            vec_extra, cell_extra = self._matrix_vec_shortfall(rows)
+        if vec_extra or cell_extra:
+            self._matrix_state = jax.device_put(self._pad_matrix_state(
+                self._matrix_state, vec_extra=vec_extra,
+                cell_extra=cell_extra))
+            self._matrix_vec_slots += vec_extra
+            self._matrix_cell_slots += cell_extra
+        k = _next_pow2(max(len(r.pending) for r in rows))
+        per_doc = [[] for _ in range(self._matrix_capacity)]
+        for r in rows:
+            per_doc[r.row] = r.pending
+        batch = mxk.make_matrix_op_batch(per_doc, self._matrix_capacity, k)
+        self._matrix_state = mxk.apply_tick(self._matrix_state, batch)
+        self.stats["device_ops"] += sum(len(r.pending) for r in rows)
+        self.stats["flushes"] += 1
+        for r in rows:
+            r.pending = []
 
     def _ingest_map(self, key: ChannelKey, channel_op: dict,
                     message: SequencedDocumentMessage) -> None:
@@ -443,6 +660,7 @@ class KernelMergeHost:
         start = _time.perf_counter()
         self._flush_merge()
         self._flush_map()
+        self._flush_matrix()
         if self._pending_ops:
             self.metrics.histogram("merge_host.tick_seconds").observe(
                 _time.perf_counter() - start)
@@ -533,7 +751,29 @@ class KernelMergeHost:
     def channels(self, doc_id: str) -> list[ChannelKey]:
         return sorted(
             [k for k in self._merge_rows if k.doc_id == doc_id]
-            + [k for k in self._map_rows if k.doc_id == doc_id])
+            + [k for k in self._map_rows if k.doc_id == doc_id]
+            + [k for k in self._matrix_rows if k.doc_id == doc_id])
+
+    def matrix_grid(self, doc_id: str, datastore: str,
+                    channel: str) -> list[list]:
+        """Converged dense grid of a matrix channel (None = unset)."""
+        key = ChannelKey(doc_id, datastore, channel)
+        row = self._matrix_rows[key]
+        if row.pending:
+            self.flush()
+        if row.scalar is not None:
+            rows_vec, cols_vec, cells = row.scalar
+            row_handles = [h for seg in rows_vec.engine.segments
+                           if seg.removed_seq is None
+                           for h in seg.content]
+            col_handles = [h for seg in cols_vec.engine.segments
+                           if seg.removed_seq is None
+                           for h in seg.content]
+            return [[cells.get((r, c)) for c in col_handles]
+                    for r in row_handles]
+        grid = mxk.materialize_grid(self._matrix_state, row.row,
+                                    self._val_rev)
+        return grid
 
     def text(self, doc_id: str, datastore: str, channel: str) -> str:
         """Converged text of a string channel (markers stripped)."""
@@ -606,6 +846,11 @@ class KernelMergeHost:
                     "kind": "mergeTree",
                     "content": self.rich_text(*key),
                 }
+            elif key in self._matrix_rows:
+                channels[key.channel] = {
+                    "kind": "matrix",
+                    "grid": self.matrix_grid(*key),
+                }
             else:
                 channels[key.channel] = {
                     "kind": "map",
@@ -614,6 +859,8 @@ class KernelMergeHost:
         seqs = [r.last_seq for k, r in self._merge_rows.items()
                 if k.doc_id == doc_id]
         seqs += [r.last_seq for k, r in self._map_rows.items()
+                 if k.doc_id == doc_id]
+        seqs += [r.last_seq for k, r in self._matrix_rows.items()
                  if k.doc_id == doc_id]
         return {"datastores": datastores,
                 "sequence_number": max(seqs, default=0)}

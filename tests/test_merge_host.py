@@ -12,6 +12,7 @@ import random
 import pytest
 
 from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.matrix import SharedMatrix
 from fluidframework_tpu.dds.sequence import SharedString
 from fluidframework_tpu.drivers.local_driver import LocalDocumentService
 from fluidframework_tpu.ops import mergetree_kernel as mtk
@@ -23,6 +24,7 @@ from fluidframework_tpu.runtime.container import Container
 from fluidframework_tpu.server.merge_host import KernelMergeHost
 from fluidframework_tpu.server.local_server import LocalCollabServer
 from fluidframework_tpu.server.routerlicious import RouterliciousService
+from tests.test_matrix import get_matrix, grid_of
 from tests.test_mergetree import random_edit
 
 
@@ -172,6 +174,59 @@ def test_bucketed_pools_isolate_large_documents():
     big_text.insert_text(0, "Z")
     host.flush()
     assert host.text("big", "default", "text") == big_text.get_text()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_matrix_channels_served_by_device_kernel(seed):
+    """SharedMatrix docs behind the service: device grid == every replica
+    (matrix.ts:547 hosted — the remaining north-star processCore path)."""
+    from tests.test_matrix_kernel import random_matrix_edit
+
+    host = KernelMergeHost(flush_threshold=16)
+    server = LocalCollabServer(merge_host=host)
+    rng = random.Random(seed)
+    c1 = Container.create_detached(LocalDocumentService(server, "doc"))
+    c1.runtime.create_datastore("default").create_channel(
+        "grid", SharedMatrix.channel_type)
+    c1.attach()
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    m1 = get_matrix(c1)
+    m2 = get_matrix(c2)
+    m1.insert_rows(0, 2)
+    m1.insert_cols(0, 2)
+    for _ in range(60):
+        random_matrix_edit(rng, m1 if rng.random() < 0.5 else m2)
+    assert grid_of(m1) == grid_of(m2)
+    assert host.matrix_grid("doc", "default", "grid") == grid_of(m1)
+    summary = host.summarize("doc")
+    assert summary["datastores"]["default"]["grid"]["kind"] == "matrix"
+    assert summary["datastores"]["default"]["grid"]["grid"] == grid_of(m1)
+
+
+def test_matrix_client_overflow_routes_to_scalar():
+    from fluidframework_tpu.ops import mergetree_kernel as mtk_mod
+
+    host = KernelMergeHost(flush_threshold=4)
+    server = LocalCollabServer(merge_host=host)
+    c1 = Container.create_detached(LocalDocumentService(server, "doc"))
+    c1.runtime.create_datastore("default").create_channel(
+        "grid", SharedMatrix.channel_type)
+    c1.attach()
+    m1 = get_matrix(c1)
+    m1.insert_rows(0, 1)
+    m1.insert_cols(0, 1)
+    # More clients than the device bitmask supports → scalar rerouting.
+    replicas = [Container.load(LocalDocumentService(server, "doc"))
+                for _ in range(mtk_mod.MAX_CLIENT_SLOTS + 1)]
+    for i, c in enumerate(replicas):
+        get_matrix(c).set_cell(0, 0, i)
+    assert host.stats["overflow_routed"] > 0
+    assert grid_of(m1) == grid_of(get_matrix(replicas[-1]))
+    assert host.matrix_grid("doc", "default", "grid") == grid_of(m1)
+    # The scalar-served channel keeps tracking later edits.
+    m1.insert_cols(1, 1)
+    m1.set_cell(0, 1, "post")
+    assert host.matrix_grid("doc", "default", "grid") == grid_of(m1)
 
 
 def _op_message(seq, ref_seq, client_id, channel_op, msn=0):
