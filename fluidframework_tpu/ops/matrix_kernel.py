@@ -125,42 +125,50 @@ def _vec_op(op) -> _VecOp:
 
 
 def _apply_matrix_op(s: MatrixState, op) -> MatrixState:
-    def do_rows(st: MatrixState) -> MatrixState:
-        return st._replace(rows=mtk._apply_op(st.rows, _vec_op(op)))
+    # Under vmap the op target is a traced value, so every branch of a
+    # switch would execute anyway — and the merge-tree walk is by far the
+    # dominant cost. Run ONE walk on the select-merged axis state instead
+    # of one per axis: ops touch exactly one of rows/cols/cell, so the
+    # un-targeted axis just keeps its old planes.
+    is_rows = op.target == MX_ROWS
+    is_cols = op.target == MX_COLS
+    is_cell = op.target == MX_CELL
 
-    def do_cols(st: MatrixState) -> MatrixState:
-        return st._replace(cols=mtk._apply_op(st.cols, _vec_op(op)))
+    sel = jax.tree.map(lambda r, c: jnp.where(is_rows, r, c),
+                       s.rows, s.cols)
+    walked = mtk._apply_op(sel, _vec_op(op))
+    rows = jax.tree.map(
+        lambda new, old: jnp.where(op.valid & is_rows, new, old),
+        walked, s.rows)
+    cols = jax.tree.map(
+        lambda new, old: jnp.where(op.valid & is_cols, new, old),
+        walked, s.cols)
 
-    def do_cell(st: MatrixState) -> MatrixState:
-        rh = _handle_at(st.rows, op.row, op.ref_seq, op.client)
-        ch = _handle_at(st.cols, op.col, op.ref_seq, op.client)
-        # A write whose row/col died concurrently resolves to no handle and
-        # drops — matrix.ts:547 processCore's None-handle guard.
-        ok = (rh >= 0) & (ch >= 0)
-        match = st.cell_used & (st.cell_rh == rh) & (st.cell_ch == ch)
-        exists = jnp.any(match)
-        capacity = st.cell_used.shape[0]
-        idx = jnp.where(exists, jnp.argmax(match),
-                        jnp.minimum(st.cell_count, capacity - 1))
-        write = ok
+    # Cell LWW write (computed every step, masked unless this IS a cell op).
+    rh = _handle_at(s.rows, op.row, op.ref_seq, op.client)
+    ch = _handle_at(s.cols, op.col, op.ref_seq, op.client)
+    # A write whose row/col died concurrently resolves to no handle and
+    # drops — matrix.ts:547 processCore's None-handle guard.
+    write = op.valid & is_cell & (rh >= 0) & (ch >= 0)
+    match = s.cell_used & (s.cell_rh == rh) & (s.cell_ch == ch)
+    exists = jnp.any(match)
+    capacity = s.cell_used.shape[0]
+    idx = jnp.where(exists, jnp.argmax(match),
+                    jnp.minimum(s.cell_count, capacity - 1))
 
-        def upd(field, value):
-            return field.at[idx].set(jnp.where(write, value, field[idx]))
+    def upd(field, value):
+        return field.at[idx].set(jnp.where(write, value, field[idx]))
 
-        return st._replace(
-            cell_rh=upd(st.cell_rh, rh),
-            cell_ch=upd(st.cell_ch, ch),
-            cell_val=upd(st.cell_val, op.value),
-            cell_seq=upd(st.cell_seq, op.seq),
-            cell_used=upd(st.cell_used, True),
-            cell_count=st.cell_count
-            + jnp.where(write & ~exists, 1, 0).astype(I32),
-        )
-
-    applied = jax.lax.switch(jnp.clip(op.target, 0, 2),
-                             [do_rows, do_cols, do_cell], s)
-    return jax.tree.map(
-        lambda new, old: jnp.where(op.valid, new, old), applied, s)
+    return MatrixState(
+        rows=rows, cols=cols,
+        cell_rh=upd(s.cell_rh, rh),
+        cell_ch=upd(s.cell_ch, ch),
+        cell_val=upd(s.cell_val, op.value),
+        cell_seq=upd(s.cell_seq, op.seq),
+        cell_used=upd(s.cell_used, True),
+        cell_count=s.cell_count
+        + jnp.where(write & ~exists, 1, 0).astype(I32),
+    )
 
 
 def _step(state: MatrixState, op):

@@ -212,18 +212,84 @@ def _annotate_range(s: MergeState, op) -> MergeState:
         prop_val=jnp.where(write, op.prop_val, s.prop_val))
 
 
+def _double_split(s: MergeState, p1, p2, ref_seq, client) -> MergeState:
+    """Boundaries at visible positions p1 and p2 (p1 <= p2; -1 = skip) in
+    ONE gather pass — equivalent to two sequential _split_at calls but
+    with a single data-movement phase over the segment planes (the per-op
+    hot cost; see _apply_op)."""
+    vis = _vis_len(s, ref_seq, client)
+    cum = jnp.cumsum(vis) - vis
+    in1 = (cum < p1) & (p1 < cum + vis)
+    # p2 == p1 would hit the boundary the FIRST split just created, which
+    # a sequential second _split_at would not split again.
+    in2 = (cum < p2) & (p2 < cum + vis) & (p2 != p1)
+    has1 = jnp.any(in1)
+    has2 = jnp.any(in2)
+    i1 = jnp.argmax(in1)
+    i2 = jnp.argmax(in2)
+    o1 = p1 - cum[i1]
+    o2 = p2 - cum[i2]
+    same = has1 & has2 & (i1 == i2)
+
+    num_slots = s.valid.shape[0]
+    iota = jnp.arange(num_slots)
+    # Output indices of the created tails (p1 <= p2 ⇒ i1 <= i2 when both
+    # split, so split1's inserted slot sits at or before split2's).
+    t1 = i1 + 1
+    t2 = i2 + 1 + jnp.where(has1 & (i1 <= i2), 1, 0)
+    shift = ((has1 & (iota >= t1)).astype(I32)
+             + (has2 & (iota >= t2)).astype(I32))
+
+    # out[j] = field[j - shift[j]] with shift ∈ {0, 1, 2}, realized as
+    # selects over rolled copies — NEVER a dynamic gather (XLA lowers 1-D
+    # dynamic gathers to serial loads on TPU; the 130× regression says so).
+    def shifted(field):
+        r1 = jnp.roll(field, 1, axis=0)
+        r2 = jnp.roll(r1, 1, axis=0)
+        return jnp.where((shift == 0) if field.ndim == 1
+                         else (shift == 0)[:, None], field,
+                         jnp.where((shift == 1) if field.ndim == 1
+                                   else (shift == 1)[:, None], r1, r2))
+
+    is_tail1 = has1 & (iota == t1)
+    is_tail2 = has2 & (iota == t2)
+    is_head1 = has1 & (iota == i1)
+    head2_out = i2 + jnp.where(has1 & (i1 < i2), 1, 0)
+    is_head2 = has2 & ~same & (iota == head2_out)
+
+    start_off = jnp.where(is_tail2, o2, jnp.where(is_tail1, o1, 0))
+    full_len = shifted(s.length)
+    end_off = jnp.where(
+        is_head1, o1,
+        jnp.where(same & is_tail1, o2,
+                  jnp.where(is_head2, o2, full_len)))
+
+    return MergeState(
+        valid=shifted(s.valid),
+        length=end_off - start_off,
+        ins_seq=shifted(s.ins_seq),
+        ins_client=shifted(s.ins_client),
+        rem_seq=shifted(s.rem_seq),
+        rem_client=shifted(s.rem_client),
+        rem_overlap=shifted(s.rem_overlap),
+        pool_start=shifted(s.pool_start) + start_off,
+        prop_val=shifted(s.prop_val),
+        count=s.count + has1.astype(I32) + has2.astype(I32),
+    )
+
+
 def _apply_op(s: MergeState, op) -> MergeState:
     # Unified dataflow instead of lax.switch branches: under vmap every
     # switch branch executes for every op, so the branchy form pays ~5
-    # shift phases per op. Here every op runs exactly 2 splits (the second
-    # is a no-op for inserts via pos=-1) + one place, and the cheap
-    # mark/annotate writes select by kind at the end.
+    # shift phases per op. Here every op runs ONE fused double-split
+    # gather (the second boundary position is -1 for inserts, a no-op) +
+    # one place, and the cheap mark/annotate writes select by kind.
     is_insert = op.kind == MT_INSERT
     is_remove = op.kind == MT_REMOVE
 
-    split = _split_at(s, op.pos, op.ref_seq, op.client)
-    split = _split_at(split, jnp.where(is_insert, I32(-1), op.end),
-                      op.ref_seq, op.client)
+    split = _double_split(s, op.pos,
+                          jnp.where(is_insert, I32(-1), op.end),
+                          op.ref_seq, op.client)
 
     placed = _place_segment(split, op)
     marked = _mark_range(split, op)
