@@ -232,10 +232,14 @@ class AlfredServer:
 
 
 def build_default_service(data_dir: str | None = None, merge_host=True,
-                          native_bus: bool = False):
+                          native_bus: bool = False,
+                          batched_cadence: bool = False):
     """Standalone assembly: routerlicious lambdas (+ device merge host,
     + durable file-backed storage when ``data_dir`` is given, + the C++
-    shuttle bus with ``native_bus`` in in-memory mode)."""
+    shuttle bus with ``native_bus`` in in-memory mode). With
+    ``batched_cadence`` the service never pumps inline — the operator
+    ticks it (alfred --cadence-ms runs the tick loop) and deli sequences
+    through the device-batched host, the BASELINE throughput shape."""
     from ..utils import MetricsRegistry
     from .routerlicious import RouterliciousService
     metrics = MetricsRegistry()  # one registry spans the whole assembly
@@ -243,6 +247,10 @@ def build_default_service(data_dir: str | None = None, merge_host=True,
     if merge_host:
         from .merge_host import KernelMergeHost
         kwargs["merge_host"] = KernelMergeHost()
+    if batched_cadence:
+        from .kernel_host import KernelSequencerHost
+        kwargs["auto_pump"] = False
+        kwargs["batched_deli_host"] = KernelSequencerHost()
     if native_bus and data_dir is None:
         from .native_bus import make_message_bus
         kwargs["bus"] = make_message_bus()
@@ -268,18 +276,39 @@ def main(argv: list[str] | None = None) -> None:
                              "omitted = in-memory (tinylicious mode)")
     parser.add_argument("--native-bus", action="store_true",
                         help="run the in-memory bus on the C++ shuttle")
+    parser.add_argument("--cadence-ms", type=int, default=None,
+                        help="batched-cadence mode: sequence through the "
+                             "device host on this tick interval instead "
+                             "of inline per submit")
     args = parser.parse_args(argv)
     if args.native_bus and args.data_dir is not None:
         parser.error("--native-bus is in-memory only; it cannot be "
                      "combined with --data-dir (the durable bus)")
+    if args.cadence_ms is not None and args.cadence_ms <= 0:
+        parser.error("--cadence-ms must be a positive interval")
 
     service = build_default_service(args.data_dir,
                                     merge_host=not args.no_merge_host,
-                                    native_bus=args.native_bus)
+                                    native_bus=args.native_bus,
+                                    batched_cadence=args.cadence_ms
+                                    is not None)
 
     async def run() -> None:
         server = AlfredServer(service, args.host, args.port)
         port = await server.start()
+        if args.cadence_ms is not None:
+            async def tick_loop() -> None:
+                while True:
+                    await asyncio.sleep(args.cadence_ms / 1000)
+                    try:
+                        service.pump()  # one batched device tick
+                    except Exception as err:  # a dead loop halts ALL
+                        print(f"TICK ERROR {err!r}",  # sequencing
+                              file=sys.stderr, flush=True)
+            # The loop keeps only a weak reference to tasks; anchor it on
+            # the server so GC can never silently stop the tick loop.
+            server._tick_task = asyncio.get_running_loop().create_task(
+                tick_loop())
         print(f"READY {port}", flush=True)
         await server.serve_forever()
 

@@ -36,7 +36,7 @@ from .bus import BusMessage, MessageBus, StateStore
 from .lambdas import PartitionManager
 from .sequencer import DocumentSequencer, RawOperation, SequencerCheckpoint
 
-RAWDELTAS = "rawdeltas"
+from .orderer import RAWDELTAS  # single source of the topic name
 DELTAS = "deltas"
 
 
@@ -604,6 +604,10 @@ class RouterliciousService:
             else StoreSnapshotBackend(self.store)
         self.bus.create_topic(RAWDELTAS, num_partitions)
         self.bus.create_topic(DELTAS, num_partitions)
+        # The producer boundary (kafka-orderer seam): front-door writes
+        # reach deli only through the orderer, never the bus directly.
+        from .orderer import BusOrderer
+        self.orderer = BusOrderer(self.bus, RAWDELTAS)
         self._connections: dict[str, dict[str, _LiveConnection]] = {}
         # Client ids must never repeat across service restarts (a reused id
         # would make old ops look local to a new client), so the counter is
@@ -700,7 +704,7 @@ class RouterliciousService:
         self.logger.send_event("ClientConnect", docId=doc_id,
                                clientId=client_id, mode=mode)
         if mode != "read":
-            self.bus.produce(RAWDELTAS, doc_id, RawOperation(
+            self.orderer.order_system(doc_id, RawOperation(
                 client_id=None,
                 type=MessageType.CLIENT_JOIN,
                 data=ClientDetail(client_id=client_id, mode=mode,
@@ -717,7 +721,7 @@ class RouterliciousService:
                                clientId=client_id)
         if connection is not None and connection.mode == "read":
             return
-        self.bus.produce(RAWDELTAS, doc_id, RawOperation(
+        self.orderer.order_system(doc_id, RawOperation(
             client_id=None,
             type=MessageType.CLIENT_LEAVE,
             data=client_id,
@@ -728,8 +732,8 @@ class RouterliciousService:
     def submit(self, doc_id: str, client_id: str,
                messages: list[DocumentMessage]) -> None:
         self.metrics.counter("alfred.submitted_ops").inc(len(messages))
-        for message in messages:
-            self.bus.produce(RAWDELTAS, doc_id, RawOperation(
+        self.orderer.connect(doc_id, client_id).order([
+            RawOperation(
                 client_id=client_id,
                 type=message.type,
                 client_seq=message.client_sequence_number,
@@ -737,7 +741,7 @@ class RouterliciousService:
                 timestamp=self._clock(),
                 contents=message.contents,
                 traces=tuple(message.traces) + (Trace("alfred", "submit"),),
-            ))
+            ) for message in messages])
         self._maybe_pump()
 
     def signal(self, doc_id: str, client_id: str, content: Any) -> None:
@@ -750,7 +754,10 @@ class RouterliciousService:
 
     def get_deltas(self, doc_id: str, from_seq: int,
                    to_seq: int | None = None) -> list[SequencedDocumentMessage]:
-        self.pump()
+        # Batched-cadence mode must not let readers force a device tick
+        # out of cadence; a reader that misses in-flight ops catches up on
+        # the next broadcast (gap fetch retries).
+        self._maybe_pump()
         log: list[SequencedDocumentMessage] = self.store.get(
             f"ops/{doc_id}", [])
         return [m for m in log
