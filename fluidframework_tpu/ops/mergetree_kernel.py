@@ -212,16 +212,45 @@ def _annotate_range(s: MergeState, op) -> MergeState:
         prop_val=jnp.where(write, op.prop_val, s.prop_val))
 
 
-def _double_split(s: MergeState, p1, p2, ref_seq, client) -> MergeState:
-    """Boundaries at visible positions p1 and p2 (p1 <= p2; -1 = skip) in
-    ONE gather pass — equivalent to two sequential _split_at calls but
-    with a single data-movement phase over the segment planes (the per-op
-    hot cost; see _apply_op)."""
-    vis = _vis_len(s, ref_seq, client)
+def _apply_op_spec(s: MergeState, op) -> MergeState:
+    """Executable spec: sequential split/split/place composition. The
+    fused _apply_op is pinned to this by differential test."""
+    is_insert = op.kind == MT_INSERT
+    is_remove = op.kind == MT_REMOVE
+    split = _split_at(s, op.pos, op.ref_seq, op.client)
+    split = _split_at(split, jnp.where(is_insert, I32(-1), op.end),
+                      op.ref_seq, op.client)
+    placed = _place_segment(split, op)
+    marked = _mark_range(split, op)
+    annotated = _annotate_range(split, op)
+    applied = jax.tree.map(
+        lambda p, m, a: jnp.where(
+            is_insert, p, jnp.where(is_remove, m, a)),
+        placed, marked, annotated)
+    return jax.tree.map(
+        lambda new, old: jnp.where(op.valid, new, old), applied, s)
+
+
+def _apply_op(s: MergeState, op) -> MergeState:
+    # ONE fused data-movement phase per op. An op inserts at most two new
+    # slots — split tail + placed segment (insert), or two split tails
+    # (remove/annotate) — so a single shift∈{0,1,2} roll-select pass over
+    # the planes covers every kind (NEVER a dynamic gather: XLA serializes
+    # 1-D gathers on TPU). The cheap mark/annotate writes select by kind
+    # at the end. Pinned to _apply_op_spec by differential test.
+    is_insert = op.kind == MT_INSERT
+    is_remove = op.kind == MT_REMOVE
+
+    vis = _vis_len(s, op.ref_seq, op.client)
     cum = jnp.cumsum(vis) - vis
+    num_slots = s.valid.shape[0]
+    iota = jnp.arange(num_slots)
+
+    p1 = op.pos
+    p2 = jnp.where(is_insert, I32(-1), op.end)
     in1 = (cum < p1) & (p1 < cum + vis)
-    # p2 == p1 would hit the boundary the FIRST split just created, which
-    # a sequential second _split_at would not split again.
+    # p2 == p1 would hit the boundary the first split just created, which
+    # a sequential second split would not split again.
     in2 = (cum < p2) & (p2 < cum + vis) & (p2 != p1)
     has1 = jnp.any(in1)
     has2 = jnp.any(in2)
@@ -230,32 +259,53 @@ def _double_split(s: MergeState, p1, p2, ref_seq, client) -> MergeState:
     o1 = p1 - cum[i1]
     o2 = p2 - cum[i2]
     same = has1 & has2 & (i1 == i2)
-
-    num_slots = s.valid.shape[0]
-    iota = jnp.arange(num_slots)
-    # Output indices of the created tails (p1 <= p2 ⇒ i1 <= i2 when both
-    # split, so split1's inserted slot sits at or before split2's).
     t1 = i1 + 1
     t2 = i2 + 1 + jnp.where(has1 & (i1 <= i2), 1, 0)
-    shift = ((has1 & (iota >= t1)).astype(I32)
-             + (has2 & (iota >= t2)).astype(I32))
 
-    # out[j] = field[j - shift[j]] with shift ∈ {0, 1, 2}, realized as
-    # selects over rolled copies — NEVER a dynamic gather (XLA lowers 1-D
-    # dynamic gathers to serial loads on TPU; the 130× regression says so).
+    # Placement index (breakTie candidate scan) evaluated on the
+    # CONCEPTUAL post-split table: derived vis'/skip' via a one-step
+    # shift, never materializing the intermediate planes.
+    shift1 = has1 & (iota >= t1)
+
+    def sh1(field):
+        return jnp.where(shift1, jnp.roll(field, 1, axis=0), field)
+
+    skip = ~s.valid | ((s.rem_seq != NONE_SEQ) & (s.rem_seq <= op.ref_seq))
+    vis_post = sh1(vis)
+    vis_post = jnp.where(has1 & (iota == i1), o1,
+                         jnp.where(has1 & (iota == t1),
+                                   vis[i1] - o1, vis_post))
+    cum_post = jnp.cumsum(vis_post) - vis_post
+    candidate = (cum_post == p1) & ~sh1(skip)
+    has_cand = jnp.any(candidate)
+    count_post = s.count + has1.astype(I32)
+    tp = jnp.where(has_cand, jnp.argmax(candidate), count_post)
+
+    # Final-coordinate insertion points. With an interior split, the tail
+    # starts AT p1, so tp >= t1 — placing at tp == t1 pushes the tail
+    # right by one.
+    placedf = tp
+    t1f = jnp.where(is_insert & (tp <= t1), t1 + 1, t1)
+    point_b = jnp.where(is_insert, placedf, t2)
+    gate_b = is_insert | has2
+    shift = ((has1 & (iota >= t1f)).astype(I32)
+             + (gate_b & (iota >= point_b)).astype(I32))
+
     def shifted(field):
         r1 = jnp.roll(field, 1, axis=0)
         r2 = jnp.roll(r1, 1, axis=0)
-        return jnp.where((shift == 0) if field.ndim == 1
-                         else (shift == 0)[:, None], field,
-                         jnp.where((shift == 1) if field.ndim == 1
-                                   else (shift == 1)[:, None], r1, r2))
+        cond0 = shift == 0
+        cond1 = shift == 1
+        if field.ndim > 1:
+            cond0, cond1 = cond0[:, None], cond1[:, None]
+        return jnp.where(cond0, field, jnp.where(cond1, r1, r2))
 
-    is_tail1 = has1 & (iota == t1)
-    is_tail2 = has2 & (iota == t2)
+    is_tail1 = has1 & (iota == t1f)
+    is_tail2 = ~is_insert & has2 & (iota == point_b)
     is_head1 = has1 & (iota == i1)
     head2_out = i2 + jnp.where(has1 & (i1 < i2), 1, 0)
-    is_head2 = has2 & ~same & (iota == head2_out)
+    is_head2 = ~is_insert & has2 & ~same & (iota == head2_out)
+    is_placed = is_insert & (iota == placedf)
 
     start_off = jnp.where(is_tail2, o2, jnp.where(is_tail1, o1, 0))
     full_len = shifted(s.length)
@@ -264,41 +314,27 @@ def _double_split(s: MergeState, p1, p2, ref_seq, client) -> MergeState:
         jnp.where(same & is_tail1, o2,
                   jnp.where(is_head2, o2, full_len)))
 
-    return MergeState(
-        valid=shifted(s.valid),
-        length=end_off - start_off,
-        ins_seq=shifted(s.ins_seq),
-        ins_client=shifted(s.ins_client),
-        rem_seq=shifted(s.rem_seq),
-        rem_client=shifted(s.rem_client),
-        rem_overlap=shifted(s.rem_overlap),
-        pool_start=shifted(s.pool_start) + start_off,
-        prop_val=shifted(s.prop_val),
-        count=s.count + has1.astype(I32) + has2.astype(I32),
+    moved = MergeState(
+        valid=jnp.where(is_placed, True, shifted(s.valid)),
+        length=jnp.where(is_placed, op.text_len, end_off - start_off),
+        ins_seq=jnp.where(is_placed, op.seq, shifted(s.ins_seq)),
+        ins_client=jnp.where(is_placed, op.client, shifted(s.ins_client)),
+        rem_seq=jnp.where(is_placed, NONE_SEQ, shifted(s.rem_seq)),
+        rem_client=jnp.where(is_placed, -1, shifted(s.rem_client)),
+        rem_overlap=jnp.where(is_placed, 0, shifted(s.rem_overlap)),
+        pool_start=jnp.where(is_placed, op.pool_start,
+                             shifted(s.pool_start) + start_off),
+        prop_val=jnp.where(is_placed[:, None], 0, shifted(s.prop_val)),
+        count=s.count + has1.astype(I32)
+        + jnp.where(is_insert, 1, has2.astype(I32)),
     )
 
-
-def _apply_op(s: MergeState, op) -> MergeState:
-    # Unified dataflow instead of lax.switch branches: under vmap every
-    # switch branch executes for every op, so the branchy form pays ~5
-    # shift phases per op. Here every op runs ONE fused double-split
-    # gather (the second boundary position is -1 for inserts, a no-op) +
-    # one place, and the cheap mark/annotate writes select by kind.
-    is_insert = op.kind == MT_INSERT
-    is_remove = op.kind == MT_REMOVE
-
-    split = _double_split(s, op.pos,
-                          jnp.where(is_insert, I32(-1), op.end),
-                          op.ref_seq, op.client)
-
-    placed = _place_segment(split, op)
-    marked = _mark_range(split, op)
-    annotated = _annotate_range(split, op)
-
+    marked = _mark_range(moved, op)
+    annotated = _annotate_range(moved, op)
     applied = jax.tree.map(
         lambda p, m, a: jnp.where(
             is_insert, p, jnp.where(is_remove, m, a)),
-        placed, marked, annotated)
+        moved, marked, annotated)
     return jax.tree.map(
         lambda new, old: jnp.where(op.valid, new, old), applied, s)
 

@@ -109,52 +109,54 @@ def test_kernel_matches_replicas(seed):
         assert got == expected[d], (seed, d, got, expected[d])
 
 
-@pytest.mark.parametrize("seed", range(3))
-def test_double_split_matches_sequential_splits(seed):
-    """_split_at is the executable spec: the fused one-pass _double_split
-    must equal two sequential single splits on every plane, including the
-    tricky cases (same-segment double split, p2==p1, boundary positions,
-    skipped -1)."""
-    import jax.numpy as jnp
-
+@pytest.mark.parametrize("seed", range(4))
+def test_fused_apply_op_matches_sequential_spec(seed):
+    """_apply_op_spec (sequential split/split/place composition) is the
+    executable spec; the fused single-phase _apply_op must equal it on
+    every plane for random op streams — including same-segment double
+    splits, placements at fresh boundaries, tie-breaks over tombstones,
+    and concurrent-window visibility."""
     rng = random.Random(7000 + seed)
-    for trial in range(40):
-        n_slots = 16
-        state = mtk.init_state(1, n_slots, 2)
-        # Build a random live table via sequenced inserts/removes.
+    for trial in range(12):
+        n_slots = 24
+        n_ops = rng.randrange(4, 12)
         ops = []
         length = 0
-        for seq in range(1, rng.randrange(3, 9)):
-            if length > 4 and rng.random() < 0.3:
+        for seq in range(1, n_ops + 1):
+            client = rng.randrange(4)
+            ref_seq = rng.randrange(max(seq - 3, 0), seq)
+            if length > 4 and rng.random() < 0.4:
                 start = rng.randrange(length - 2)
-                ops.append(dict(kind=mtk.MT_REMOVE, pos=start,
-                                end=start + rng.randint(1, 2), seq=seq,
-                                ref_seq=seq - 1, client=rng.randrange(4)))
-                length -= 1
+                # end == start occasionally: the empty-range case must not
+                # trigger a second split (the p2 == p1 guard).
+                end = start + rng.randint(0, min(3, length - start))
+                kind = rng.choice([mtk.MT_REMOVE, mtk.MT_ANNOTATE])
+                op = dict(kind=kind, pos=start, end=end, seq=seq,
+                          ref_seq=ref_seq, client=client)
+                if kind == mtk.MT_ANNOTATE:
+                    op.update(prop_key=rng.randrange(2),
+                              prop_val=rng.randrange(1, 5))
+                else:
+                    length -= end - start
+                ops.append(op)
             else:
-                tlen = rng.randint(1, 5)
+                tlen = rng.randint(1, 4)
                 ops.append(dict(kind=mtk.MT_INSERT,
                                 pos=rng.randint(0, length), seq=seq,
-                                ref_seq=seq - 1, client=rng.randrange(4),
+                                ref_seq=ref_seq, client=client,
                                 pool_start=seq * 10, text_len=tlen))
                 length += tlen
-        state = mtk.apply_tick(state, mtk.make_merge_op_batch(
-            [ops], 1, len(ops)))
-        doc = jax.tree.map(lambda a: a[0], state)
-        ref_seq = jnp.int32(len(ops))
-        client = jnp.int32(rng.randrange(4))
-        total = int(np.asarray(mtk._vis_len(doc, ref_seq, client)).sum())
-        choices = [-1] + list(range(total + 1))
-        p1 = jnp.int32(rng.choice(choices))
-        p2 = jnp.int32(rng.choice([int(p1)]
-                                  + [c for c in choices if c >= int(p1)]))
-        fused = mtk._double_split(doc, p1, p2, ref_seq, client)
-        sequential = mtk._split_at(
-            mtk._split_at(doc, p1, ref_seq, client), p2, ref_seq, client)
-        for field in mtk.MergeState._fields:
-            assert np.array_equal(np.asarray(getattr(fused, field)),
-                                  np.asarray(getattr(sequential, field))), \
-                (seed, trial, field, int(p1), int(p2))
+        batch = mtk.make_merge_op_batch([ops], 1, n_ops)
+        fused = jax.tree.map(lambda a: a[0], mtk.init_state(1, n_slots, 2))
+        spec = fused
+        for k in range(n_ops):
+            one = jax.tree.map(lambda a: a[0, k], batch)
+            fused = mtk._apply_op(fused, one)
+            spec = mtk._apply_op_spec(spec, one)
+            for field in mtk.MergeState._fields:
+                assert np.array_equal(np.asarray(getattr(fused, field)),
+                                      np.asarray(getattr(spec, field))), \
+                    (seed, trial, k, field, ops[k])
 
 
 def test_kernel_basic_concurrent_insert_order():
