@@ -226,9 +226,12 @@ class AlfredServer:
         finally:
             if session.connection is not None:
                 session.connection.close()
-            session.push(None)
-            await writer_task
-            writer.close()
+            try:
+                session.push(None)
+                await writer_task
+                writer.close()
+            except RuntimeError:
+                pass  # event loop already torn down mid-disconnect
 
 
 def build_default_service(data_dir: str | None = None, merge_host=True,
