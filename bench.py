@@ -195,6 +195,7 @@ def bench_mergetree(num_docs: int = 8192, k: int = 32, ticks: int = 6,
     import jax.numpy as jnp
 
     from fluidframework_tpu.ops import mergetree_kernel as mtk
+    from fluidframework_tpu.ops import mergetree_pallas as mtp
 
     rng = random.Random(0)
     stream = _gen_merge_stream(rng, k * ticks)
@@ -206,8 +207,11 @@ def bench_mergetree(num_docs: int = 8192, k: int = 32, ticks: int = 6,
         batches.append(mtk.MergeOpBatch(
             *[jnp.asarray(_tile(np.asarray(f), num_docs)) for f in one]))
 
-    out = _run_device(mtk.apply_tick, mtk.init_state(num_docs, num_slots),
+    out = _run_device(mtp.apply_tick_best,
+                      mtk.init_state(num_docs, num_slots),
                       batches, num_docs * k)
+    out["kernel_path"] = ("xla_scan" if mtp.default_interpret()
+                          else "pallas_vmem")
 
     # Scalar baseline: the same stream through the scalar MergeEngine.
     from fluidframework_tpu.dds.mergetree import MergeEngine

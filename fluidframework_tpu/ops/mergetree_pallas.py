@@ -1,0 +1,333 @@
+"""Pallas TPU tick kernel for the batched merge-tree — VMEM-resident apply.
+
+The XLA path (:mod:`mergetree_kernel`) applies one op per ``lax.scan`` step;
+every step sweeps the whole [B, S] segment table through HBM, so a K-op tick
+costs K full-table round trips. This kernel restructures the tick the TPU
+way: the grid partitions documents into blocks of ``block_docs``; each
+program DMAs its block's planes into VMEM ONCE, applies all K sequenced ops
+with VPU-vectorized passes (per-doc scalars ride the sublane axis), and
+writes the planes back ONCE — HBM traffic drops from O(K·B·S) to O(B·S).
+
+Semantics are pinned to :func:`mergetree_kernel._apply_op` (itself pinned to
+the sequential split/place spec) by differential test
+``tests/test_mergetree_pallas.py`` — byte-identical planes on live client
+op streams. Reference parity therefore transits the same citations:
+mergeTree.ts insertingWalk/breakTie:2363/2267, markRangeRemoved:2626,
+annotateRange:2584.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+  * all planes are int32 — i32 tiles are (8, 128); ``block_docs`` rides the
+    sublane axis, slots ride lanes (S should be a multiple of 128; the
+    wrapper pads and padding slots are plain invalid slots);
+  * exclusive prefix sums use a log-shift scan (`pltpu.roll` + mask) — no
+    MXU needed, lengths stay exact in int32;
+  * "first true index" = min-reduce over a masked lane iota (argmax is not
+    relied on inside the kernel);
+  * the post-split prefix table is derived from the pre-split one with a
+    single roll-compose instead of a second scan (cum' = cum shifted around
+    the split point, with the tail boundary landing exactly at p1);
+  * state planes are aliased input→output, so the tick is in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .mergetree_kernel import (
+    MT_INSERT,
+    MT_REMOVE,
+    MergeOpBatch,
+    MergeState,
+    NONE_SEQ,
+)
+
+I32 = jnp.int32
+
+_PLANES = ("valid", "length", "ins_seq", "ins_client", "rem_seq",
+           "rem_client", "rem_overlap", "pool_start")
+_OPS = ("valid", "kind", "pos", "end", "seq", "ref_seq", "client",
+        "pool_start", "text_len", "prop_key", "prop_val")
+
+
+def _excl_cumsum(x: jax.Array) -> jax.Array:
+    """Exclusive prefix sum along lanes (log-shift scan)."""
+    lanes = x.shape[-1]
+    lane = jax.lax.broadcasted_iota(I32, x.shape, x.ndim - 1)
+    total = x
+    shift = 1
+    while shift < lanes:
+        total = total + jnp.where(lane >= shift,
+                                  pltpu.roll(total, shift=shift, axis=total.ndim - 1), 0)
+        shift *= 2
+    return total - x
+
+
+def _first_true(mask: jax.Array) -> jax.Array:
+    """Index of the first True along lanes; S when none. Shape [D, 1]."""
+    lanes = mask.shape[-1]
+    lane = jax.lax.broadcasted_iota(I32, mask.shape, mask.ndim - 1)
+    return jnp.min(jnp.where(mask, lane, lanes), axis=-1, keepdims=True)
+
+
+def _gather_lane(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x[d, idx[d]] per doc (0 when idx == S). Shape [D, 1]."""
+    lane = jax.lax.broadcasted_iota(I32, x.shape, x.ndim - 1)
+    return jnp.sum(jnp.where(lane == idx, x, 0), axis=-1, keepdims=True)
+
+
+def _vis_len(p: dict, ref_seq, client):
+    validb = p["valid"] != 0
+    ins_vis = validb & ((p["ins_seq"] <= ref_seq)
+                        | (p["ins_client"] == client))
+    overlap_bit = (p["rem_overlap"] >> jnp.clip(client, 0, 30)) & 1
+    removed_vis = ((p["rem_seq"] != NONE_SEQ)
+                   & ((p["rem_seq"] <= ref_seq)
+                      | (p["rem_client"] == client) | (overlap_bit == 1)))
+    return jnp.where(ins_vis & ~removed_vis, p["length"], 0)
+
+
+def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict):
+    """One sequenced op per doc, vectorized over the doc (sublane) axis.
+
+    ``p`` maps plane name → [D, S] i32; ``prop`` is [P, D, S]; ``count`` is
+    [D, 1]; op fields are [D, 1]. Mirrors mergetree_kernel._apply_op with
+    per-doc scalars as [D, 1] columns. Returns (planes', prop', count').
+    """
+    lane = jax.lax.broadcasted_iota(I32, p["length"].shape, 1)
+    opvalid = op["valid"] != 0
+    is_insert = op["kind"] == MT_INSERT
+    is_remove = op["kind"] == MT_REMOVE
+
+    vis = _vis_len(p, op["ref_seq"], op["client"])
+    cum = _excl_cumsum(vis)
+
+    p1 = op["pos"]
+    p2 = jnp.where(is_insert, I32(-1), op["end"])
+    in1 = (cum < p1) & (p1 < cum + vis)
+    in2 = (cum < p2) & (p2 < cum + vis) & (p2 != p1)
+    i1 = _first_true(in1)
+    i2 = _first_true(in2)
+    has1 = jnp.any(in1, axis=-1, keepdims=True)
+    has2 = jnp.any(in2, axis=-1, keepdims=True)
+    o1 = p1 - _gather_lane(cum, i1)
+    o2 = p2 - _gather_lane(cum, i2)
+    same = has1 & has2 & (i1 == i2)
+    t1 = i1 + 1
+    t2 = i2 + 1 + jnp.where(has1 & (i1 <= i2), 1, 0)
+
+    # Post-split visibility frame, derived without re-scanning: the split
+    # keeps cum for lanes <= i1, lands the tail boundary exactly at p1,
+    # and shifts the rest right by one.
+    shift1 = has1 & (lane >= t1)
+
+    def sh1(field):
+        return jnp.where(shift1, pltpu.roll(field, shift=1, axis=field.ndim - 1), field)
+
+    # Mosaic only rotates 32-bit lanes, so the skip mask rolls as int32.
+    skip = ((p["valid"] == 0) | ((p["rem_seq"] != NONE_SEQ)
+                                 & (p["rem_seq"] <= op["ref_seq"])))
+    cum_post = jnp.where(has1 & (lane == t1), p1, sh1(cum))
+    candidate = (cum_post == p1) & (sh1(skip.astype(I32)) == 0)
+    has_cand = jnp.any(candidate, axis=-1, keepdims=True)
+    count_post = count + has1.astype(I32)
+    tp = jnp.where(has_cand, _first_true(candidate), count_post)
+
+    placedf = tp
+    t1f = jnp.where(is_insert & (tp <= t1), t1 + 1, t1)
+    point_b = jnp.where(is_insert, placedf, t2)
+    gate_b = is_insert | has2
+    shift = ((has1 & (lane >= t1f)).astype(I32)
+             + (gate_b & (lane >= point_b)).astype(I32))
+
+    def shifted(field):
+        r1 = pltpu.roll(field, shift=1, axis=field.ndim - 1)
+        r2 = pltpu.roll(field, shift=2, axis=field.ndim - 1)
+        cond0 = shift == 0
+        cond1 = shift == 1
+        if field.ndim == 3:  # [P, D, S] prop planes
+            cond0, cond1 = cond0[None], cond1[None]
+        return jnp.where(cond0, field, jnp.where(cond1, r1, r2))
+
+    is_tail1 = has1 & (lane == t1f)
+    is_tail2 = ~is_insert & has2 & (lane == point_b)
+    is_head1 = has1 & (lane == i1)
+    head2_out = i2 + jnp.where(has1 & (i1 < i2), 1, 0)
+    is_head2 = ~is_insert & has2 & ~same & (lane == head2_out)
+    is_placed = is_insert & (lane == placedf)
+
+    start_off = jnp.where(is_tail2, o2, jnp.where(is_tail1, o1, 0))
+    full_len = shifted(p["length"])
+    end_off = jnp.where(
+        is_head1, o1,
+        jnp.where(same & is_tail1, o2,
+                  jnp.where(is_head2, o2, full_len)))
+
+    moved = {
+        "valid": jnp.where(is_placed, 1, shifted(p["valid"])),
+        "length": jnp.where(is_placed, op["text_len"], end_off - start_off),
+        "ins_seq": jnp.where(is_placed, op["seq"], shifted(p["ins_seq"])),
+        "ins_client": jnp.where(is_placed, op["client"],
+                                shifted(p["ins_client"])),
+        "rem_seq": jnp.where(is_placed, NONE_SEQ, shifted(p["rem_seq"])),
+        "rem_client": jnp.where(is_placed, -1, shifted(p["rem_client"])),
+        "rem_overlap": jnp.where(is_placed, 0, shifted(p["rem_overlap"])),
+        "pool_start": jnp.where(is_placed, op["pool_start"],
+                                shifted(p["pool_start"]) + start_off),
+    }
+    moved_prop = jnp.where(is_placed[None], 0, shifted(prop))
+    moved_count = (count + has1.astype(I32)
+                   + jnp.where(is_insert, 1, has2.astype(I32)))
+
+    # Mark / annotate phase over the moved table (fresh visibility frame).
+    vis2 = _vis_len(moved, op["ref_seq"], op["client"])
+    cum2 = _excl_cumsum(vis2)
+    in_range = (vis2 > 0) & (cum2 >= op["pos"]) & (cum2 < op["end"])
+    fresh = in_range & (moved["rem_seq"] == NONE_SEQ)
+    again = in_range & (moved["rem_seq"] != NONE_SEQ)
+    bit = I32(1) << jnp.clip(op["client"], 0, 30)
+
+    do_rem = ~is_insert & is_remove
+    moved["rem_seq"] = jnp.where(do_rem & fresh, op["seq"],
+                                 moved["rem_seq"])
+    moved["rem_client"] = jnp.where(do_rem & fresh, op["client"],
+                                    moved["rem_client"])
+    moved["rem_overlap"] = jnp.where(do_rem & again,
+                                     moved["rem_overlap"] | bit,
+                                     moved["rem_overlap"])
+    is_annot = ~is_insert & ~is_remove
+    num_props = prop.shape[0]
+    plane_ids = jax.lax.broadcasted_iota(I32, moved_prop.shape, 0)
+    annot_write = (is_annot & in_range)[None] & (plane_ids == op["prop_key"])
+    moved_prop = jnp.where(annot_write, op["prop_val"][None], moved_prop)
+
+    # An insert never marks/annotates; the movement already excluded the
+    # second split for inserts (p2 = -1), so moved IS the final table.
+    out = {name: jnp.where(opvalid, moved[name], p[name])
+           for name in _PLANES}
+    out_prop = jnp.where(opvalid[None], moved_prop, prop)
+    out_count = jnp.where(opvalid, moved_count, count)
+    return out, out_prop, out_count
+
+
+def _tick_kernel(*refs, num_ops: int):
+    plane_refs = refs[:8]
+    prop_ref, count_ref = refs[8], refs[9]
+    op_refs = refs[10:21]
+    out_plane_refs = refs[21:29]
+    out_prop_ref, out_count_ref = refs[29], refs[30]
+
+    planes = {name: ref[:] for name, ref in zip(_PLANES, plane_refs)}
+    prop = prop_ref[:]
+    count = count_ref[:]
+    # Mosaic requires 128-aligned dynamic lane slices, so column k of the
+    # op block is selected with a masked reduction instead of a load.
+    op_vals = {name: ref[:] for name, ref in zip(_OPS, op_refs)}
+    op_lane = jax.lax.broadcasted_iota(I32, next(iter(op_vals.values())).shape,
+                                       1)
+
+    def body(k, carry):
+        planes, prop, count = carry
+        op = {name: jnp.sum(jnp.where(op_lane == k, v, 0),
+                            axis=1, keepdims=True)
+              for name, v in op_vals.items()}
+        return merge_apply_vec(planes, prop, count, op)
+
+    planes, prop, count = jax.lax.fori_loop(
+        0, num_ops, body, (planes, prop, count))
+    for name, ref in zip(_PLANES, out_plane_refs):
+        ref[:] = planes[name]
+    out_prop_ref[:] = prop
+    out_count_ref[:] = count
+
+
+def _pad_to(x: jax.Array, axis: int, size: int, fill):
+    if x.shape[axis] == size:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, size - x.shape[axis])
+    return jnp.pad(x, pads, constant_values=fill)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_docs", "interpret"))
+def apply_tick_pallas(state: MergeState, ops: MergeOpBatch,
+                      block_docs: int = 32,
+                      interpret: bool = False) -> MergeState:
+    """Drop-in replacement for :func:`mergetree_kernel.apply_tick`."""
+    b, s = state.length.shape
+    k = ops.kind.shape[1]
+    p = state.prop_val.shape[2]
+    d = min(block_docs, max(8, b))
+    bp = -(-b // d) * d  # pad docs to a block multiple
+    sp = -(-s // 128) * 128  # pad slots to the lane tile
+
+    plane_fill = {"valid": 0, "length": 0, "ins_seq": 0, "ins_client": -1,
+                  "rem_seq": int(NONE_SEQ), "rem_client": -1,
+                  "rem_overlap": 0, "pool_start": 0}
+    planes = []
+    for name in _PLANES:
+        arr = getattr(state, name).astype(I32)
+        arr = _pad_to(arr, 0, bp, plane_fill[name])
+        planes.append(_pad_to(arr, 1, sp, plane_fill[name]))
+    prop = jnp.transpose(state.prop_val, (2, 0, 1))  # [P, B, S]
+    prop = _pad_to(_pad_to(prop, 1, bp, 0), 2, sp, 0)
+    count = _pad_to(state.count[:, None], 0, bp, 0)
+    op_arrays = [_pad_to(getattr(ops, name).astype(I32), 0, bp, 0)
+                 for name in _OPS]
+
+    grid = (bp // d,)
+    plane_spec = pl.BlockSpec((d, sp), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
+                             memory_space=pltpu.VMEM)
+    count_spec = pl.BlockSpec((d, 1), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    op_spec = pl.BlockSpec((d, k), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        functools.partial(_tick_kernel, num_ops=k),
+        grid=grid,
+        in_specs=[plane_spec] * 8 + [prop_spec, count_spec] + [op_spec] * 11,
+        out_specs=[plane_spec] * 8 + [prop_spec, count_spec],
+        out_shape=(
+            [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 8
+            + [jax.ShapeDtypeStruct((p, bp, sp), jnp.int32),
+               jax.ShapeDtypeStruct((bp, 1), jnp.int32)]),
+        input_output_aliases={i: i for i in range(10)},
+        interpret=interpret,
+    )(*planes, prop, count, *op_arrays)
+
+    new_planes = {name: arr[:b, :s] for name, arr in zip(_PLANES, out[:8])}
+    return MergeState(
+        valid=new_planes["valid"] != 0,
+        length=new_planes["length"],
+        ins_seq=new_planes["ins_seq"],
+        ins_client=new_planes["ins_client"],
+        rem_seq=new_planes["rem_seq"],
+        rem_client=new_planes["rem_client"],
+        rem_overlap=new_planes["rem_overlap"],
+        pool_start=new_planes["pool_start"],
+        prop_val=jnp.transpose(out[8], (1, 2, 0))[:b, :s],
+        count=out[9][:b, 0],
+    )
+
+
+def default_interpret() -> bool:
+    """Pallas TPU kernels need a real TPU; elsewhere run interpreted."""
+    return jax.default_backend() != "tpu"
+
+
+def apply_tick_best(state: MergeState, ops: MergeOpBatch) -> MergeState:
+    """Fastest correct tick for the current backend: the Pallas VMEM
+    kernel on TPU, the XLA scan path everywhere else (interpret-mode
+    Pallas is only for differential tests — far too slow to serve)."""
+    from .mergetree_kernel import apply_tick
+    if default_interpret():
+        return apply_tick(state, ops)
+    return apply_tick_pallas(state, ops)

@@ -229,9 +229,10 @@ class AlfredServer:
             try:
                 session.push(None)
                 await writer_task
-                writer.close()
             except RuntimeError:
                 pass  # event loop already torn down mid-disconnect
+            finally:
+                writer.close()
 
 
 def build_default_service(data_dir: str | None = None, merge_host=True,

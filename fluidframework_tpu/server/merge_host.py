@@ -48,6 +48,7 @@ from ..dds.mergetree import MergeEngine
 from ..ops import map_kernel as mk
 from ..ops import matrix_kernel as mxk
 from ..ops import mergetree_kernel as mtk
+from ..ops import mergetree_pallas as mtp
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from .kernel_host import _next_pow2
 
@@ -712,7 +713,7 @@ class KernelMergeHost:
             for r in pool_rows:
                 per_doc[r.row] = r.pending
             batch = mtk.make_merge_op_batch(per_doc, pool.capacity, k)
-            pool.state = mtk.apply_tick(pool.state, batch)
+            pool.state = mtp.apply_tick_best(pool.state, batch)
             self.stats["device_ops"] += sum(
                 len(r.pending) for r in pool_rows)
             for r in pool_rows:
