@@ -282,6 +282,7 @@ def bench_matrix(num_docs: int = 4096, k: int = 32, ticks: int = 6) -> dict:
     import jax.numpy as jnp
 
     from fluidframework_tpu.ops import matrix_kernel as mxk
+    from fluidframework_tpu.ops import matrix_pallas as mxp
 
     rng = random.Random(0)
     stream = _gen_matrix_stream(rng, k * ticks)
@@ -291,9 +292,11 @@ def bench_matrix(num_docs: int = 4096, k: int = 32, ticks: int = 6) -> dict:
         batches.append(mxk.MatrixOpBatch(
             *[jnp.asarray(_tile(np.asarray(f), num_docs)) for f in one]))
 
-    out = _run_device(mxk.apply_tick,
+    out = _run_device(mxp.apply_tick_best,
                       mxk.init_state(num_docs, vec_slots=256, cell_slots=256),
                       batches, num_docs * k)
+    out["kernel_path"] = ("xla_scan" if mxp.default_interpret()
+                          else "pallas_vmem")
 
     # Scalar baseline: PermutationVectors + LWW cell dict (scalar engine).
     from fluidframework_tpu.dds.matrix import PermutationVector

@@ -1,0 +1,307 @@
+"""Pallas TPU tick kernel for the batched SharedMatrix — VMEM-resident.
+
+Same restructuring as :mod:`mergetree_pallas`, applied to the composed
+matrix kernel (:mod:`matrix_kernel`): each grid program holds one doc
+block's row/col permutation tables AND its cell table in VMEM across the
+whole tick, so a K-op tick costs one HBM round trip instead of K.
+
+Per sequenced op (vectorized over the doc sublane axis):
+  * the merge-tree walk runs ONCE on the select-merged rows/cols planes
+    (an op targets exactly one axis), via
+    :func:`mergetree_pallas.merge_apply_vec`;
+  * (row, col) → storage-handle resolution for cell writes = the same
+    masked-prefix-sum position lookup, evaluated on the PRE-op axis
+    tables (matrix.ts adjustPosition);
+  * the cell LWW write is a first-match-or-append lane scatter on the
+    [D, C] cell planes.
+
+Semantics are pinned to :func:`matrix_kernel.apply_tick` by differential
+test (tests/test_matrix_pallas.py) on live SharedMatrix op streams.
+Reference parity transits matrix.ts:547 (processCore) and
+permutationvector.ts:38.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .matrix_kernel import MX_CELL, MX_COLS, MX_ROWS, MatrixOpBatch, MatrixState
+from .mergetree_kernel import NONE_SEQ, MergeState
+from .mergetree_pallas import (
+    _PLANES,
+    _excl_cumsum,
+    _first_true,
+    _gather_lane,
+    _pad_to,
+    _vis_len,
+    default_interpret,
+    merge_apply_vec,
+)
+
+I32 = jnp.int32
+
+_CELLS = ("cell_rh", "cell_ch", "cell_val", "cell_seq", "cell_used")
+_MX_OPS = ("valid", "target", "kind", "pos", "end", "count", "handle_base",
+           "row", "col", "value", "seq", "ref_seq", "client")
+
+
+def _handle_at_vec(p: dict, pos, ref_seq, client):
+    """Storage handle at visible position pos, per doc ([D, 1]); -1 none."""
+    vis = _vis_len(p, ref_seq, client)
+    cum = _excl_cumsum(vis)
+    inside = (cum <= pos) & (pos < cum + vis)
+    found = jnp.any(inside, axis=-1, keepdims=True)
+    idx = _first_true(inside)
+    base = _gather_lane(p["pool_start"], idx)
+    off = pos - _gather_lane(cum, idx)
+    return jnp.where(found, base + off, -1)
+
+
+def _matrix_apply_vec(rows, rows_prop, rows_count, cols, cols_prop,
+                      cols_count, cells, cell_count, op, num_cells: int):
+    opvalid = op["valid"] != 0
+    is_rows = op["target"] == MX_ROWS
+    is_cols = op["target"] == MX_COLS
+    is_cell = op["target"] == MX_CELL
+
+    # An op targets exactly one of {rows, cols, cell}, and real ticks are
+    # often phase-homogeneous across a doc block at a given step (or sparse
+    # — padded-invalid). Skipping a dead phase with lax.cond saves its full
+    # vector cost; when a block mixes phases both branches run as before.
+    any_vec = jnp.any(opvalid & ~is_cell)
+    any_cell = jnp.any(opvalid & is_cell)
+
+    def vec_phase(carry):
+        rows, rows_prop, rows_count, cols, cols_prop, cols_count = carry
+        # ONE merge walk on the select-merged axis
+        # (matrix_kernel._apply_matrix_op).
+        sel = {name: jnp.where(is_rows, rows[name], cols[name])
+               for name in _PLANES}
+        sel_prop = jnp.where(is_rows[None], rows_prop, cols_prop)
+        sel_count = jnp.where(is_rows, rows_count, cols_count)
+        zeros = jnp.zeros_like(op["kind"])
+        vec_op = {"valid": op["valid"], "kind": op["kind"],
+                  "pos": op["pos"], "end": op["end"], "seq": op["seq"],
+                  "ref_seq": op["ref_seq"], "client": op["client"],
+                  "pool_start": op["handle_base"], "text_len": op["count"],
+                  "prop_key": zeros, "prop_val": zeros}
+        walked, walked_prop, walked_count = merge_apply_vec(
+            sel, sel_prop, sel_count, vec_op)
+        gate_r = opvalid & is_rows
+        gate_c = opvalid & is_cols
+        return (
+            {n: jnp.where(gate_r, walked[n], rows[n]) for n in _PLANES},
+            jnp.where(gate_r[None], walked_prop, rows_prop),
+            jnp.where(gate_r, walked_count, rows_count),
+            {n: jnp.where(gate_c, walked[n], cols[n]) for n in _PLANES},
+            jnp.where(gate_c[None], walked_prop, cols_prop),
+            jnp.where(gate_c, walked_count, cols_count),
+        )
+
+    (new_rows, new_rows_prop, new_rows_count, new_cols, new_cols_prop,
+     new_cols_count) = jax.lax.cond(
+        any_vec, vec_phase, lambda carry: carry,
+        (rows, rows_prop, rows_count, cols, cols_prop, cols_count))
+
+    def cell_phase(carry):
+        cells, cell_count = carry
+        # Cell LWW write against the PRE-op axis tables.
+        rh = _handle_at_vec(rows, op["row"], op["ref_seq"], op["client"])
+        ch = _handle_at_vec(cols, op["col"], op["ref_seq"], op["client"])
+        write = opvalid & is_cell & (rh >= 0) & (ch >= 0)
+        match = ((cells["cell_used"] != 0) & (cells["cell_rh"] == rh)
+                 & (cells["cell_ch"] == ch))
+        exists = jnp.any(match, axis=-1, keepdims=True)
+        # Clamp overflow to the LOGICAL capacity (matrix_kernel parity):
+        # the padded lanes beyond num_cells are sliced off by the wrapper,
+        # so an overflow write must land at num_cells - 1 as the XLA path's
+        # does, not vanish into padding.
+        idx = jnp.where(exists, _first_true(match),
+                        jnp.minimum(cell_count, num_cells - 1))
+        lane_c = jax.lax.broadcasted_iota(I32, cells["cell_used"].shape, 1)
+        at = write & (lane_c == idx)
+        return ({
+            "cell_rh": jnp.where(at, rh, cells["cell_rh"]),
+            "cell_ch": jnp.where(at, ch, cells["cell_ch"]),
+            "cell_val": jnp.where(at, op["value"], cells["cell_val"]),
+            "cell_seq": jnp.where(at, op["seq"], cells["cell_seq"]),
+            "cell_used": jnp.where(at, 1, cells["cell_used"]),
+        }, cell_count + (write & ~exists).astype(I32))
+
+    new_cells, new_cell_count = jax.lax.cond(
+        any_cell, cell_phase, lambda carry: carry, (cells, cell_count))
+    return (new_rows, new_rows_prop, new_rows_count, new_cols,
+            new_cols_prop, new_cols_count, new_cells, new_cell_count)
+
+
+def _tick_kernel(*refs, num_ops: int, num_cells: int):
+    i = 0
+
+    def take(n):
+        nonlocal i
+        out = refs[i:i + n]
+        i += n
+        return out
+
+    rows_refs = take(8)
+    rows_prop_ref, rows_count_ref = take(2)
+    cols_refs = take(8)
+    cols_prop_ref, cols_count_ref = take(2)
+    cell_refs = take(5)
+    cell_count_ref, = take(1)
+    op_refs = take(13)
+    out_rows = take(8)
+    out_rows_prop, out_rows_count = take(2)
+    out_cols = take(8)
+    out_cols_prop, out_cols_count = take(2)
+    out_cells = take(5)
+    out_cell_count, = take(1)
+
+    rows = {n: r[:] for n, r in zip(_PLANES, rows_refs)}
+    cols = {n: r[:] for n, r in zip(_PLANES, cols_refs)}
+    cells = {n: r[:] for n, r in zip(_CELLS, cell_refs)}
+    carry = (rows, rows_prop_ref[:], rows_count_ref[:], cols,
+             cols_prop_ref[:], cols_count_ref[:], cells, cell_count_ref[:])
+    op_vals = {n: r[:] for n, r in zip(_MX_OPS, op_refs)}
+    op_lane = jax.lax.broadcasted_iota(
+        I32, next(iter(op_vals.values())).shape, 1)
+
+    def body(k, carry):
+        op = {n: jnp.sum(jnp.where(op_lane == k, v, 0),
+                         axis=1, keepdims=True)
+              for n, v in op_vals.items()}
+        return _matrix_apply_vec(*carry, op, num_cells)
+
+    # Dynamic trip count: skip trailing all-invalid steps (front-packed
+    # sparse ticks), mirroring mergetree_pallas.
+    last_valid = jnp.max(jnp.where(op_vals["valid"] != 0, op_lane + 1, 0))
+    (rows, rows_prop, rows_count, cols, cols_prop, cols_count, cells,
+     cell_count) = jax.lax.fori_loop(
+        0, jnp.minimum(last_valid, num_ops), body, carry)
+    for n, r in zip(_PLANES, out_rows):
+        r[:] = rows[n]
+    out_rows_prop[:] = rows_prop
+    out_rows_count[:] = rows_count
+    for n, r in zip(_PLANES, out_cols):
+        r[:] = cols[n]
+    out_cols_prop[:] = cols_prop
+    out_cols_count[:] = cols_count
+    for n, r in zip(_CELLS, out_cells):
+        r[:] = cells[n]
+    out_cell_count[:] = cell_count
+
+
+_VEC_FILL = {"valid": 0, "length": 0, "ins_seq": 0, "ins_client": -1,
+             "rem_seq": int(NONE_SEQ), "rem_client": -1,
+             "rem_overlap": 0, "pool_start": 0}
+_CELL_FILL = {"cell_rh": -1, "cell_ch": -1, "cell_val": 0, "cell_seq": 0,
+              "cell_used": 0}
+
+
+@functools.partial(jax.jit, static_argnames=("block_docs", "interpret"))
+def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
+                      block_docs: int = 32,
+                      interpret: bool = False) -> MatrixState:
+    """Drop-in replacement for :func:`matrix_kernel.apply_tick`."""
+    b, s = state.rows.length.shape
+    c = state.cell_used.shape[1]
+    k = ops.kind.shape[1]
+    p = state.rows.prop_val.shape[2]
+    d = min(block_docs, max(8, b))
+    bp = -(-b // d) * d
+    sp = -(-s // 128) * 128
+    cp = -(-c // 128) * 128
+
+    def vec_inputs(ms: MergeState):
+        planes = []
+        for name in _PLANES:
+            arr = getattr(ms, name).astype(I32)
+            arr = _pad_to(arr, 0, bp, _VEC_FILL[name])
+            planes.append(_pad_to(arr, 1, sp, _VEC_FILL[name]))
+        prop = jnp.transpose(ms.prop_val, (2, 0, 1))
+        prop = _pad_to(_pad_to(prop, 1, bp, 0), 2, sp, 0)
+        count = _pad_to(ms.count[:, None], 0, bp, 0)
+        return planes, prop, count
+
+    rows_planes, rows_prop, rows_count = vec_inputs(state.rows)
+    cols_planes, cols_prop, cols_count = vec_inputs(state.cols)
+    cell_planes = []
+    for name in _CELLS:
+        arr = getattr(state, name).astype(I32)
+        arr = _pad_to(arr, 0, bp, _CELL_FILL[name])
+        cell_planes.append(_pad_to(arr, 1, cp, _CELL_FILL[name]))
+    cell_count = _pad_to(state.cell_count[:, None], 0, bp, 0)
+    op_arrays = [_pad_to(getattr(ops, name).astype(I32), 0, bp, 0)
+                 for name in _MX_OPS]
+
+    grid = (bp // d,)
+    vec_spec = pl.BlockSpec((d, sp), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
+                             memory_space=pltpu.VMEM)
+    count_spec = pl.BlockSpec((d, 1), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    cell_spec = pl.BlockSpec((d, cp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    op_spec = pl.BlockSpec((d, k), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+
+    state_specs = ([vec_spec] * 8 + [prop_spec, count_spec]) * 2 \
+        + [cell_spec] * 5 + [count_spec]
+    state_shapes = (
+        [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 8
+        + [jax.ShapeDtypeStruct((p, bp, sp), jnp.int32),
+           jax.ShapeDtypeStruct((bp, 1), jnp.int32)]) * 2 \
+        + [jax.ShapeDtypeStruct((bp, cp), jnp.int32)] * 5 \
+        + [jax.ShapeDtypeStruct((bp, 1), jnp.int32)]
+
+    out = pl.pallas_call(
+        functools.partial(_tick_kernel, num_ops=k, num_cells=c),
+        grid=grid,
+        in_specs=state_specs + [op_spec] * 13,
+        out_specs=state_specs,
+        out_shape=state_shapes,
+        input_output_aliases={i: i for i in range(26)},
+        interpret=interpret,
+    )(*rows_planes, rows_prop, rows_count, *cols_planes, cols_prop,
+      cols_count, *cell_planes, cell_count, *op_arrays)
+
+    def vec_state(planes, prop, count) -> MergeState:
+        named = {n: a[:b, :s] for n, a in zip(_PLANES, planes)}
+        return MergeState(
+            valid=named["valid"] != 0,
+            length=named["length"],
+            ins_seq=named["ins_seq"],
+            ins_client=named["ins_client"],
+            rem_seq=named["rem_seq"],
+            rem_client=named["rem_client"],
+            rem_overlap=named["rem_overlap"],
+            pool_start=named["pool_start"],
+            prop_val=jnp.transpose(prop, (1, 2, 0))[:b, :s],
+            count=count[:b, 0],
+        )
+
+    cells = {n: a[:b, :c] for n, a in zip(_CELLS, out[20:25])}
+    return MatrixState(
+        rows=vec_state(out[0:8], out[8], out[9]),
+        cols=vec_state(out[10:18], out[18], out[19]),
+        cell_rh=cells["cell_rh"],
+        cell_ch=cells["cell_ch"],
+        cell_val=cells["cell_val"],
+        cell_seq=cells["cell_seq"],
+        cell_used=cells["cell_used"] != 0,
+        cell_count=out[25][:b, 0],
+    )
+
+
+def apply_tick_best(state: MatrixState, ops: MatrixOpBatch) -> MatrixState:
+    """Pallas VMEM kernel on TPU, XLA scan path elsewhere."""
+    from .matrix_kernel import apply_tick
+    if default_interpret():
+        return apply_tick(state, ops)
+    return apply_tick_pallas(state, ops)

@@ -183,9 +183,16 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict):
     moved_count = (count + has1.astype(I32)
                    + jnp.where(is_insert, 1, has2.astype(I32)))
 
-    # Mark / annotate phase over the moved table (fresh visibility frame).
-    vis2 = _vis_len(moved, op["ref_seq"], op["client"])
-    cum2 = _excl_cumsum(vis2)
+    # Mark / annotate phase over the moved table. Only reached for
+    # remove/annotate (the writes below are ~is_insert-gated), so the
+    # moved table is the doubly-split original: per-slot visibility flags
+    # just shift with the planes (split halves inherit the head's frame),
+    # and the post-split start table composes from cum with the two tail
+    # boundaries landing exactly at p1/p2 — no second scan, no re-derived
+    # visibility.
+    vis2 = jnp.where(shifted((vis > 0).astype(I32)) != 0,
+                     moved["length"], 0)
+    cum2 = jnp.where(is_tail1, p1, jnp.where(is_tail2, p2, shifted(cum)))
     in_range = (vis2 > 0) & (cum2 >= op["pos"]) & (cum2 < op["end"])
     fresh = in_range & (moved["rem_seq"] == NONE_SEQ)
     again = in_range & (moved["rem_seq"] != NONE_SEQ)
@@ -200,7 +207,6 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict):
                                      moved["rem_overlap"] | bit,
                                      moved["rem_overlap"])
     is_annot = ~is_insert & ~is_remove
-    num_props = prop.shape[0]
     plane_ids = jax.lax.broadcasted_iota(I32, moved_prop.shape, 0)
     annot_write = (is_annot & in_range)[None] & (plane_ids == op["prop_key"])
     moved_prop = jnp.where(annot_write, op["prop_val"][None], moved_prop)
@@ -237,8 +243,12 @@ def _tick_kernel(*refs, num_ops: int):
               for name, v in op_vals.items()}
         return merge_apply_vec(planes, prop, count, op)
 
+    # Serving flushes pad every doc to the bucket's max pending count and
+    # front-pack ops, so trailing steps are often invalid across the whole
+    # block — a dynamic trip count skips them at zero per-step cost.
+    last_valid = jnp.max(jnp.where(op_vals["valid"] != 0, op_lane + 1, 0))
     planes, prop, count = jax.lax.fori_loop(
-        0, num_ops, body, (planes, prop, count))
+        0, jnp.minimum(last_valid, num_ops), body, (planes, prop, count))
     for name, ref in zip(_PLANES, out_plane_refs):
         ref[:] = planes[name]
     out_prop_ref[:] = prop

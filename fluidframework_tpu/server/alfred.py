@@ -232,7 +232,10 @@ class AlfredServer:
             except RuntimeError:
                 pass  # event loop already torn down mid-disconnect
             finally:
-                writer.close()
+                try:
+                    writer.close()
+                except RuntimeError:
+                    pass  # transport.close on an already-closed loop
 
 
 def build_default_service(data_dir: str | None = None, merge_host=True,

@@ -47,6 +47,7 @@ import numpy as np
 from ..dds.mergetree import MergeEngine
 from ..ops import map_kernel as mk
 from ..ops import matrix_kernel as mxk
+from ..ops import matrix_pallas as mxp
 from ..ops import mergetree_kernel as mtk
 from ..ops import mergetree_pallas as mtp
 from ..protocol.messages import MessageType, SequencedDocumentMessage
@@ -624,7 +625,7 @@ class KernelMergeHost:
         for r in rows:
             per_doc[r.row] = r.pending
         batch = mxk.make_matrix_op_batch(per_doc, self._matrix_capacity, k)
-        self._matrix_state = mxk.apply_tick(self._matrix_state, batch)
+        self._matrix_state = mxp.apply_tick_best(self._matrix_state, batch)
         self.stats["device_ops"] += sum(len(r.pending) for r in rows)
         self.stats["flushes"] += 1
         for r in rows:

@@ -1,0 +1,111 @@
+"""Pallas matrix tick kernel: differential tests vs the XLA path.
+
+Mirrors tests/test_mergetree_pallas.py for the composed SharedMatrix
+kernel: live SharedMatrix op streams from the real client stack and
+synthetic mixed row/col/cell streams must produce identical state through
+matrix_pallas.apply_tick_pallas (interpret mode on CPU) and
+matrix_kernel.apply_tick.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.ops import matrix_kernel as mxk
+from fluidframework_tpu.ops import matrix_pallas as mxp
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.ops import mergetree_pallas as mtp
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from tests.test_matrix import get_matrix, grid_of
+from tests.test_matrix_kernel import make_empty_matrix_doc, random_matrix_edit
+
+
+def _assert_matrix_equal(a: mxk.MatrixState, b: mxk.MatrixState, ctx):
+    for axis in ("rows", "cols"):
+        for field in mtk.MergeState._fields:
+            fa = np.asarray(getattr(getattr(a, axis), field))
+            fb = np.asarray(getattr(getattr(b, axis), field))
+            assert np.array_equal(fa, fb), (ctx, axis, field)
+    for field in ("cell_rh", "cell_ch", "cell_val", "cell_seq",
+                  "cell_used", "cell_count"):
+        fa = np.asarray(getattr(a, field))
+        fb = np.asarray(getattr(b, field))
+        assert np.array_equal(fa, fb), (ctx, field)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_matrix_pallas_matches_xla_on_live_streams(seed):
+    rng = random.Random(seed)
+    n_docs = 2
+    server = LocalCollabServer()
+    docs = []
+    for d in range(n_docs):
+        c1 = make_empty_matrix_doc(server, f"doc{d}")
+        others = [Container.load(LocalDocumentService(server, f"doc{d}"))
+                  for _ in range(2)]
+        docs.append([c1] + others)
+        get_matrix(c1).insert_rows(0, 2)
+        get_matrix(c1).insert_cols(0, 2)
+
+    for _round in range(4):
+        for containers in docs:
+            paused = [c for c in containers if rng.random() < 0.3]
+            for c in paused:
+                c.inbound.pause()
+            for _ in range(rng.randrange(3, 7)):
+                random_matrix_edit(rng, get_matrix(
+                    containers[rng.randrange(len(containers))]))
+            for c in paused:
+                c.inbound.resume()
+
+    rows = mxk.HandleAllocator(n_docs)
+    cols = mxk.HandleAllocator(n_docs)
+    client_slots: dict = {}
+    val_ids: dict = {}
+    streams = [mxk.encode_matrix_log(server.get_deltas(f"doc{d}", 0), d,
+                                     rows, cols, client_slots, val_ids)
+               for d in range(n_docs)]
+    val_rev: list = [None] + [None] * len(val_ids)
+    for rep, vid in val_ids.items():
+        val_rev[vid] = eval(rep)
+    state_x = mxk.init_state(n_docs, vec_slots=128, cell_slots=256)
+    state_p = state_x
+    k = 16
+    longest = max(len(s) for s in streams)
+    for start in range(0, longest, k):
+        chunk = [s[start:start + k] for s in streams]
+        batch = mxk.make_matrix_op_batch(chunk, n_docs, k)
+        state_x = mxk.apply_tick(state_x, batch)
+        state_p = mxp.apply_tick_pallas(
+            state_p, batch, interpret=mtp.default_interpret())
+    _assert_matrix_equal(state_x, state_p, seed)
+
+    # The pallas-produced grid matches the converged replicas.
+    for d in range(n_docs):
+        expected = grid_of(get_matrix(docs[d][0]))
+        got = mxk.materialize_grid(state_p, d, val_rev)
+        assert got == expected, (seed, d)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_matrix_pallas_matches_xla_on_random_streams(seed):
+    from bench import _gen_matrix_stream
+
+    rng = random.Random(100 + seed)
+    n_docs = rng.choice([3, 9])
+    streams = [_gen_matrix_stream(rng, rng.randrange(10, 40))
+               for _ in range(n_docs)]
+    k = 8
+    state_x = mxk.init_state(n_docs, vec_slots=128, cell_slots=128)
+    state_p = state_x
+    longest = max(len(s) for s in streams)
+    for start in range(0, longest, k):
+        chunk = [s[start:start + k] for s in streams]
+        batch = mxk.make_matrix_op_batch(chunk, n_docs, k)
+        state_x = mxk.apply_tick(state_x, batch)
+        state_p = mxp.apply_tick_pallas(
+            state_p, batch, interpret=mtp.default_interpret())
+    _assert_matrix_equal(state_x, state_p, seed)
