@@ -1,0 +1,154 @@
+// Fan-out service — native pub/sub rooms with per-subscriber queues.
+//
+// Reference parity: the broadcast fan-out hop of the reference server —
+// Redis pub/sub + the socket.io Redis adapter
+// (server/routerlicious/packages/services-shared/src/
+// redisSocketIoAdapter.ts; services/package.json ioredis) — the native
+// (C) piece between the broadcaster lambda and the socket frontends
+// (SURVEY.md §2.9 row 3). Rooms are documents; a publish appends the
+// payload to every member's queue; frontends drain their subscriber
+// queue and write to their transport.
+//
+// Exposed as a C ABI for ctypes (fanout.py). All calls are thread-safe
+// behind one mutex — the workload is many small payloads, and the
+// Python callers hold the GIL around calls anyway; contention is nil.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <mutex>
+
+namespace {
+
+struct Fanout {
+    std::mutex mu;
+    int64_t next_sub = 1;
+    int64_t delivered = 0;
+    std::map<int64_t, std::deque<std::string>> queues;
+    std::map<std::string, std::set<int64_t>> rooms;
+    std::map<int64_t, std::set<std::string>> memberships;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* fanout_create() { return new Fanout(); }
+
+void fanout_destroy(void* handle) { delete static_cast<Fanout*>(handle); }
+
+int64_t fanout_connect(void* handle) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    int64_t sub = f->next_sub++;
+    f->queues[sub];  // create the queue
+    return sub;
+}
+
+int fanout_disconnect(void* handle, int64_t sub) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    auto queue_it = f->queues.find(sub);
+    if (queue_it == f->queues.end()) return -1;
+    auto member_it = f->memberships.find(sub);
+    if (member_it != f->memberships.end()) {
+        for (const std::string& room : member_it->second) {
+            auto room_it = f->rooms.find(room);
+            if (room_it != f->rooms.end()) {
+                room_it->second.erase(sub);
+                if (room_it->second.empty()) f->rooms.erase(room_it);
+            }
+        }
+        f->memberships.erase(member_it);
+    }
+    f->queues.erase(queue_it);
+    return 0;
+}
+
+int fanout_join(void* handle, int64_t sub, const char* room,
+                uint32_t room_len) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    if (f->queues.find(sub) == f->queues.end()) return -1;
+    std::string key(room, room_len);
+    f->rooms[key].insert(sub);
+    f->memberships[sub].insert(key);
+    return 0;
+}
+
+int fanout_leave(void* handle, int64_t sub, const char* room,
+                 uint32_t room_len) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    std::string key(room, room_len);
+    auto room_it = f->rooms.find(key);
+    if (room_it == f->rooms.end() || room_it->second.erase(sub) == 0)
+        return -1;
+    if (room_it->second.empty()) f->rooms.erase(room_it);
+    f->memberships[sub].erase(key);
+    return 0;
+}
+
+// Returns the number of subscriber queues the payload was appended to.
+int64_t fanout_publish(void* handle, const char* room, uint32_t room_len,
+                       const char* data, uint32_t data_len) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    auto room_it = f->rooms.find(std::string(room, room_len));
+    if (room_it == f->rooms.end()) return 0;
+    std::string payload(data, data_len);
+    int64_t count = 0;
+    for (int64_t sub : room_it->second) {
+        auto queue_it = f->queues.find(sub);
+        if (queue_it == f->queues.end()) continue;
+        queue_it->second.push_back(payload);
+        ++count;
+    }
+    f->delivered += count;
+    return count;
+}
+
+int64_t fanout_pending(void* handle, int64_t sub) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    auto queue_it = f->queues.find(sub);
+    if (queue_it == f->queues.end()) return -1;
+    return static_cast<int64_t>(queue_it->second.size());
+}
+
+// Size in bytes of the head message (0 = empty queue, -1 = unknown sub).
+int64_t fanout_next_size(void* handle, int64_t sub) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    auto queue_it = f->queues.find(sub);
+    if (queue_it == f->queues.end()) return -1;
+    if (queue_it->second.empty()) return 0;
+    return static_cast<int64_t>(queue_it->second.front().size());
+}
+
+// Pops the head message into buf. Returns bytes written, 0 on empty,
+// -1 on unknown sub, -2 if the buffer is too small (message stays).
+int64_t fanout_poll(void* handle, int64_t sub, char* buf, int64_t cap) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    auto queue_it = f->queues.find(sub);
+    if (queue_it == f->queues.end()) return -1;
+    if (queue_it->second.empty()) return 0;
+    const std::string& head = queue_it->second.front();
+    if (static_cast<int64_t>(head.size()) > cap) return -2;
+    std::memcpy(buf, head.data(), head.size());
+    int64_t written = static_cast<int64_t>(head.size());
+    queue_it->second.pop_front();
+    return written;
+}
+
+int64_t fanout_delivered_total(void* handle) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    return f->delivered;
+}
+
+}  // extern "C"

@@ -1,0 +1,192 @@
+"""ctypes binding for the C++ fan-out service (fanout.cpp).
+
+The fan-out is the broadcast hop between the broadcaster lambda and the
+connection frontends — the Redis-pub/sub + redisSocketIoAdapter analog
+(SURVEY.md §2.9 row 3). Rooms are documents; ``publish`` appends the
+payload to every room member's queue; each frontend drains its
+subscriber's queue. ``make_fanout`` returns the native implementation
+when the toolchain is available and falls back to a pure-Python twin
+with the identical surface otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections import deque
+from pathlib import Path
+
+_SRC = Path(__file__).parent / "fanout.cpp"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_LIB = _BUILD_DIR / "libfanout.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _load_library() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if (not _LIB.exists()
+                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+                _BUILD_DIR.mkdir(exist_ok=True)
+                tmp = _BUILD_DIR / f"libfanout.{os.getpid()}.tmp.so"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", str(_SRC),
+                     "-o", str(tmp)],
+                    check=True, capture_output=True, timeout=120)
+                tmp.replace(_LIB)
+            lib = ctypes.CDLL(str(_LIB))
+        except (OSError, subprocess.SubprocessError):
+            _lib_failed = True
+            return None
+        lib.fanout_create.restype = ctypes.c_void_p
+        lib.fanout_destroy.argtypes = [ctypes.c_void_p]
+        lib.fanout_connect.restype = ctypes.c_int64
+        lib.fanout_connect.argtypes = [ctypes.c_void_p]
+        lib.fanout_disconnect.restype = ctypes.c_int
+        lib.fanout_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        for name in ("fanout_join", "fanout_leave"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                           ctypes.c_char_p, ctypes.c_uint32]
+        lib.fanout_publish.restype = ctypes.c_int64
+        lib.fanout_publish.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32]
+        lib.fanout_pending.restype = ctypes.c_int64
+        lib.fanout_pending.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fanout_next_size.restype = ctypes.c_int64
+        lib.fanout_next_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fanout_poll.restype = ctypes.c_int64
+        lib.fanout_poll.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_char_p, ctypes.c_int64]
+        lib.fanout_delivered_total.restype = ctypes.c_int64
+        lib.fanout_delivered_total.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeFanout:
+    """Pub/sub rooms backed by the C++ library."""
+
+    is_native = True
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        self._handle = lib.fanout_create()
+
+    def __del__(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.fanout_destroy(handle)
+            self._handle = None
+
+    def connect(self) -> int:
+        return int(self._lib.fanout_connect(self._handle))
+
+    def disconnect(self, sub: int) -> None:
+        self._lib.fanout_disconnect(self._handle, sub)
+
+    def join(self, sub: int, room: str) -> None:
+        key = room.encode()
+        if self._lib.fanout_join(self._handle, sub, key, len(key)) != 0:
+            raise KeyError(f"unknown subscriber {sub}")
+
+    def leave(self, sub: int, room: str) -> None:
+        key = room.encode()
+        self._lib.fanout_leave(self._handle, sub, key, len(key))
+
+    def publish(self, room: str, payload: bytes) -> int:
+        key = room.encode()
+        return int(self._lib.fanout_publish(self._handle, key, len(key),
+                                            payload, len(payload)))
+
+    def pending(self, sub: int) -> int:
+        return max(0, int(self._lib.fanout_pending(self._handle, sub)))
+
+    def poll(self, sub: int) -> bytes | None:
+        size = self._lib.fanout_next_size(self._handle, sub)
+        if size <= 0:
+            return None
+        buf = ctypes.create_string_buffer(int(size))
+        written = self._lib.fanout_poll(self._handle, sub, buf, size)
+        if written <= 0:
+            return None
+        return buf.raw[:written]
+
+    def delivered_total(self) -> int:
+        return int(self._lib.fanout_delivered_total(self._handle))
+
+
+class PyFanout:
+    """Pure-Python twin (toolchain-free fallback; identical surface)."""
+
+    is_native = False
+
+    def __init__(self) -> None:
+        self._next = 1
+        self._queues: dict[int, deque[bytes]] = {}
+        self._rooms: dict[str, set[int]] = {}
+        self._memberships: dict[int, set[str]] = {}
+        self._delivered = 0
+
+    def connect(self) -> int:
+        sub = self._next
+        self._next += 1
+        self._queues[sub] = deque()
+        return sub
+
+    def disconnect(self, sub: int) -> None:
+        for room in self._memberships.pop(sub, set()):
+            members = self._rooms.get(room)
+            if members is not None:
+                members.discard(sub)
+                if not members:
+                    del self._rooms[room]
+        self._queues.pop(sub, None)
+
+    def join(self, sub: int, room: str) -> None:
+        if sub not in self._queues:
+            raise KeyError(f"unknown subscriber {sub}")
+        self._rooms.setdefault(room, set()).add(sub)
+        self._memberships.setdefault(sub, set()).add(room)
+
+    def leave(self, sub: int, room: str) -> None:
+        self._rooms.get(room, set()).discard(sub)
+        self._memberships.get(sub, set()).discard(room)
+
+    def publish(self, room: str, payload: bytes) -> int:
+        count = 0
+        for sub in self._rooms.get(room, ()):  # set order is fine: queues
+            self._queues[sub].append(payload)  # are per-subscriber FIFO
+            count += 1
+        self._delivered += count
+        return count
+
+    def pending(self, sub: int) -> int:
+        return len(self._queues.get(sub, ()))
+
+    def poll(self, sub: int) -> bytes | None:
+        queue = self._queues.get(sub)
+        if not queue:
+            return None
+        return queue.popleft()
+
+    def delivered_total(self) -> int:
+        return self._delivered
+
+
+def make_fanout(force_python: bool = False):
+    """Native fan-out when buildable, Python twin otherwise."""
+    if not force_python:
+        lib = _load_library()
+        if lib is not None:
+            return NativeFanout(lib)
+    return PyFanout()

@@ -153,6 +153,15 @@ class _ClientSession:
             self._require_agent_scope(req)
             service.complete_help(req["key"])
             return {"rid": rid, "ok": True}
+        if op == "get_metrics":
+            # service-monitor surface: one scrape = front-door counters +
+            # the assembly's shared registry (deli/scribe/merge-host/...).
+            snap = dict(self.server.metrics.snapshot())
+            service_metrics = getattr(service, "metrics", None)
+            if service_metrics is not None and service_metrics \
+                    is not self.server.metrics:
+                snap.update(service_metrics.snapshot())
+            return {"rid": rid, "metrics": snap}
         if op == "disconnect":
             if self.connection is not None:
                 self.connection.close()
@@ -240,7 +249,8 @@ class AlfredServer:
 
 def build_default_service(data_dir: str | None = None, merge_host=True,
                           native_bus: bool = False,
-                          batched_cadence: bool = False):
+                          batched_cadence: bool = False,
+                          native_fanout: bool = False):
     """Standalone assembly: routerlicious lambdas (+ device merge host,
     + durable file-backed storage when ``data_dir`` is given, + the C++
     shuttle bus with ``native_bus`` in in-memory mode). With
@@ -261,6 +271,9 @@ def build_default_service(data_dir: str | None = None, merge_host=True,
     if native_bus and data_dir is None:
         from .native_bus import make_message_bus
         kwargs["bus"] = make_message_bus()
+    if native_fanout:
+        from ..native.fanout import make_fanout
+        kwargs["fanout"] = make_fanout()
     if data_dir is not None:
         from .durable_store import (
             DurableMessageBus, FileStateStore, GitSnapshotStore)
@@ -283,6 +296,9 @@ def main(argv: list[str] | None = None) -> None:
                              "omitted = in-memory (tinylicious mode)")
     parser.add_argument("--native-bus", action="store_true",
                         help="run the in-memory bus on the C++ shuttle")
+    parser.add_argument("--native-fanout", action="store_true",
+                        help="broadcast through the C++ fan-out service "
+                             "(Redis pub/sub analog)")
     parser.add_argument("--cadence-ms", type=int, default=None,
                         help="batched-cadence mode: sequence through the "
                              "device host on this tick interval instead "
@@ -298,7 +314,8 @@ def main(argv: list[str] | None = None) -> None:
                                     merge_host=not args.no_merge_host,
                                     native_bus=args.native_bus,
                                     batched_cadence=args.cadence_ms
-                                    is not None)
+                                    is not None,
+                                    native_fanout=args.native_fanout)
 
     async def run() -> None:
         server = AlfredServer(service, args.host, args.port)

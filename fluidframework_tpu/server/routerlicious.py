@@ -331,6 +331,8 @@ class BroadcasterDocumentLambda:
     def handler(self, message: BusMessage) -> None:
         value = message.value
         if value["kind"] == "nack":
+            # Nacks are targeted (socket.io emits to ONE socket, never a
+            # room), so they bypass any pub/sub hop in every mode.
             conn = self._connections.get(value["target"])
             if conn is not None and conn.on_nack is not None:
                 raw: RawOperation = value["operation"]
@@ -348,7 +350,9 @@ class BroadcasterDocumentLambda:
                     message=f"nack:{value['code']}",
                 ))
             return
-        op: SequencedDocumentMessage = value["message"]
+        self._deliver_op(value["message"])
+
+    def _deliver_op(self, op: SequencedDocumentMessage) -> None:
         for client_id, conn in list(self._connections.items()):
             if not conn.open:
                 continue
@@ -361,11 +365,34 @@ class BroadcasterDocumentLambda:
         pass  # live fan-out has no durable state
 
 
+class FanoutBroadcasterDocumentLambda(BroadcasterDocumentLambda):
+    """Broadcaster over the native fan-out service: ops publish ONCE to
+    the document's room (services-shared redisSocketIoAdapter shape); the
+    service's frontend drain delivers each subscriber queue to its
+    connection. Per-connection crash-replay dedup moves to the drain."""
+
+    def __init__(self, doc_id: str, connections: dict[str, _LiveConnection],
+                 fanout) -> None:
+        super().__init__(doc_id, connections)
+        self._fanout = fanout
+
+    def _deliver_op(self, op: SequencedDocumentMessage) -> None:
+        import json as _json
+
+        from ..protocol.codec import to_wire
+        self._fanout.publish(self.doc_id,
+                             _json.dumps(to_wire(op)).encode())
+
+
 class _BroadcasterFactory:
     def __init__(self, service: "RouterliciousService") -> None:
         self._service = service
 
     def create(self, doc_id: str) -> BroadcasterDocumentLambda:
+        if self._service.fanout is not None:
+            return FanoutBroadcasterDocumentLambda(
+                doc_id, self._service._connections_for(doc_id),
+                self._service.fanout)
         return BroadcasterDocumentLambda(
             doc_id, self._service._connections_for(doc_id))
 
@@ -589,9 +616,15 @@ class RouterliciousService:
                  snapshots=None,
                  help_agents: list[str] | None = None,
                  batched_deli_host=None,
-                 auto_pump: bool = True) -> None:
+                 auto_pump: bool = True,
+                 fanout=None) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
+        # Optional native pub/sub broadcast hop (native/fanout.py — the
+        # Redis + socket.io-adapter analog). None = direct callbacks.
+        self.fanout = fanout
+        self._fanout_subs: dict[tuple[str, str], int] = {}
+        self._fanout_last_seq: dict[tuple[str, str], int] = {}
         self.logger = logger if logger is not None else NullLogger()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         if merge_host is not None:
@@ -679,10 +712,37 @@ class RouterliciousService:
                 moved += self._foreman.pump()
                 if self._merger is not None:
                     moved += self._merger.pump()
+                if self.fanout is not None:
+                    moved += self._drain_fanout()
                 if moved == 0:
                     break
         finally:
             self._pumping = False
+
+    def _drain_fanout(self) -> int:
+        """Frontend drain: deliver each subscriber's queued room payloads
+        to its connection (the socket-server side of the pub/sub hop)."""
+        import json as _json
+
+        from ..protocol.codec import from_wire
+        delivered = 0
+        for (doc_id, client_id), sub in list(self._fanout_subs.items()):
+            batch: list[SequencedDocumentMessage] = []
+            last_key = (doc_id, client_id)
+            while (payload := self.fanout.poll(sub)) is not None:
+                op = from_wire(_json.loads(payload.decode()))
+                if op.sequence_number <= self._fanout_last_seq.get(
+                        last_key, 0):
+                    continue  # crash-replay dedup, as in direct mode
+                self._fanout_last_seq[last_key] = op.sequence_number
+                batch.append(op)
+            if not batch:
+                continue
+            conn = self._connections_for(doc_id).get(client_id)
+            if conn is not None and conn.open:
+                delivered += len(batch)
+                conn.handler(batch)
+        return delivered
 
     # -- alfred front door -----------------------------------------------------
 
@@ -701,6 +761,10 @@ class RouterliciousService:
         connection = _LiveConnection(client_id, doc_id, self, handler,
                                      on_nack, on_signal, mode=mode)
         self._connections_for(doc_id)[client_id] = connection
+        if self.fanout is not None:
+            sub = self.fanout.connect()
+            self.fanout.join(sub, doc_id)
+            self._fanout_subs[(doc_id, client_id)] = sub
         self.logger.send_event("ClientConnect", docId=doc_id,
                                clientId=client_id, mode=mode)
         if mode != "read":
@@ -716,6 +780,11 @@ class RouterliciousService:
         return connection
 
     def disconnect(self, doc_id: str, client_id: str) -> None:
+        if self.fanout is not None:
+            sub = self._fanout_subs.pop((doc_id, client_id), None)
+            if sub is not None:
+                self.fanout.disconnect(sub)
+            self._fanout_last_seq.pop((doc_id, client_id), None)
         connection = self._connections_for(doc_id).pop(client_id, None)
         self.logger.send_event("ClientDisconnect", docId=doc_id,
                                clientId=client_id)

@@ -1,0 +1,83 @@
+"""Service monitor — scrape and watch a running alfred's metrics.
+
+Reference parity: server/service-monitor (the routerlicious monitoring
+satellite) collapsed to its useful core: a poller that scrapes the
+assembly's metrics registry through the front door (``get_metrics`` — the
+alfred analog of a /metrics endpoint) and renders deltas, so an operator
+can watch sequencing/broadcast/merge-host rates live.
+
+Usage::
+
+    python -m fluidframework_tpu.tools.monitor --port 7070            # watch
+    python -m fluidframework_tpu.tools.monitor --port 7070 --once     # scrape
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import time
+
+from ..protocol.codec import decode_body, encode_frame
+
+
+def scrape(host: str, port: int, timeout: float = 10.0) -> dict:
+    """One metrics scrape over a fresh front-door socket."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall(encode_frame({"rid": 1, "op": "get_metrics"}))
+        header = _recv_exactly(sock, 4)
+        body = _recv_exactly(sock, int.from_bytes(header, "big"))
+    resp = decode_body(body)
+    if "error" in resp:
+        raise RuntimeError(f"alfred error: {resp['error']}")
+    return resp["metrics"]
+
+
+def _recv_exactly(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def watch(host: str, port: int, interval: float,
+          out=sys.stdout) -> None:
+    """Poll forever, printing each scrape (absolute values) plus the
+    per-interval increase of every metric that grew — the monotonic
+    counters' rates — under ``"+<name>"`` keys. Gauges and histogram
+    percentiles stay absolute (a snapshot cannot tell the kinds apart)."""
+    prev: dict = {}
+    while True:
+        now = scrape(host, port)
+        line: dict = {name: value for name, value in sorted(now.items())}
+        for name, value in now.items():
+            if name in prev and value > prev[name]:
+                line[f"+{name}"] = round(value - prev[name], 3)
+        print(json.dumps({"ts": round(time.time(), 1), **line}),
+              file=out, flush=True)
+        prev = now
+        time.sleep(interval)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--once", action="store_true",
+                        help="print one scrape as JSON and exit")
+    args = parser.parse_args(argv)
+    if args.once:
+        print(json.dumps(scrape(args.host, args.port), indent=1,
+                         sort_keys=True))
+        return
+    watch(args.host, args.port, args.interval)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

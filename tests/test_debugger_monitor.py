@@ -1,0 +1,122 @@
+"""Debugger driver + service monitor (packages/drivers/debugger,
+server/service-monitor analogs)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from fluidframework_tpu.tools.debug_tool import load_session
+from fluidframework_tpu.tools.monitor import scrape
+from fluidframework_tpu.tools.replay import canonical, replay_summary
+
+GOLDENS = Path(__file__).parent / "goldens"
+
+
+class TestDebuggerDriver:
+    @pytest.mark.parametrize("name", ["string-conflict", "map-directory"])
+    def test_step_through_matches_truncated_replays(self, name):
+        directory = GOLDENS / name
+        service, container = load_session(directory)
+        assert service.cursor == 0
+
+        # Step in uneven increments; at every stop the container must equal
+        # a fresh truncated replay at that cursor (replayTo parity).
+        stops = []
+        while service.cursor < service.end_seq:
+            batch = service.step(5)
+            if not batch:
+                break
+            stops.append(service.cursor)
+        assert stops, "no ops recorded"
+        assert service.cursor == service.end_seq
+
+        final = canonical(container.summarize())
+        assert final == canonical(replay_summary(directory))
+
+        mid = stops[len(stops) // 2]
+        svc2, container2 = load_session(directory)
+        svc2.play_to(mid)
+        assert canonical(container2.summarize()) == canonical(
+            replay_summary(directory, up_to_seq=mid))
+
+    def test_cursor_clamps_delta_storage(self, tmp_path):
+        directory = GOLDENS / "string-conflict"
+        service, _container = load_session(directory)
+        service.step(3)
+        fetched = service.delta_storage.get_deltas(0)
+        assert all(m.sequence_number <= service.cursor for m in fetched)
+
+    def test_play_is_idempotent_at_end(self):
+        service, container = load_session(GOLDENS / "string-conflict")
+        service.play()
+        assert service.play() == []
+        assert service.step() == []
+
+
+class TestServiceMonitor:
+    def test_scrape_live_service_metrics(self):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "fluidframework_tpu.server.alfred",
+             "--port", "0", "--no-merge-host"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("READY "), (line, proc.stderr.read())
+            port = int(line.split()[1])
+
+            metrics = scrape("127.0.0.1", port)
+            assert isinstance(metrics, dict)
+
+            # Drive one real client round trip, then the scrape must show
+            # front-door and sequencing activity.
+            from fluidframework_tpu.dds.map import SharedMap
+            from fluidframework_tpu.drivers.tinylicious_driver import (
+                TinyliciousDocumentServiceFactory,
+            )
+            from fluidframework_tpu.runtime.container import Container
+            factory = TinyliciousDocumentServiceFactory(port=port)
+            svc = factory("doc")
+            container = Container.create_detached(svc)
+            ds = container.runtime.create_datastore("default")
+            ds.create_channel("root", SharedMap.channel_type)
+            with svc.dispatch_lock:
+                container.attach()
+                ds.get_channel("root").set("k", 1)
+            deadline = time.monotonic() + 30
+            while (container.runtime.pending.has_pending
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert not container.runtime.pending.has_pending
+            svc.close()
+
+            after = scrape("127.0.0.1", port)
+            assert after.get("alfred.connects", 0) >= 1
+            assert after.get("deli.sequenced_ops", 0) >= 1
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_monitor_cli_once(self):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "fluidframework_tpu.server.alfred",
+             "--port", "0", "--no-merge-host"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("READY ")
+            port = int(line.split()[1])
+            out = subprocess.run(
+                [sys.executable, "-m", "fluidframework_tpu.tools.monitor",
+                 "--port", str(port), "--once"],
+                capture_output=True, text=True, timeout=60)
+            assert out.returncode == 0, out.stderr
+            import json
+            assert isinstance(json.loads(out.stdout), dict)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

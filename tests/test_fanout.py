@@ -1,0 +1,108 @@
+"""Native fan-out service (§2.9 row 3 — Redis pub/sub +
+redisSocketIoAdapter analog) and its broadcast integration."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.native.fanout import PyFanout, make_fanout
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+
+def _impls():
+    impls = [PyFanout()]
+    native = make_fanout()
+    if native.is_native:
+        impls.append(native)
+    return impls
+
+
+@pytest.mark.parametrize("fanout", _impls(),
+                         ids=lambda f: "native" if f.is_native else "python")
+class TestFanoutCore:
+    def test_rooms_fifo_and_membership(self, fanout):
+        a = fanout.connect()
+        b = fanout.connect()
+        fanout.join(a, "doc1")
+        fanout.join(b, "doc1")
+        fanout.join(b, "doc2")
+
+        assert fanout.publish("doc1", b"m1") == 2
+        assert fanout.publish("doc2", b"m2") == 1
+        assert fanout.publish("nobody-home", b"m3") == 0
+
+        assert fanout.pending(a) == 1
+        assert fanout.poll(a) == b"m1"
+        assert fanout.poll(a) is None
+        assert [fanout.poll(b), fanout.poll(b)] == [b"m1", b"m2"]
+
+        fanout.leave(a, "doc1")
+        assert fanout.publish("doc1", b"m4") == 1  # only b now
+        assert fanout.poll(b) == b"m4"
+
+    def test_disconnect_cleans_rooms_and_queue(self, fanout):
+        a = fanout.connect()
+        fanout.join(a, "doc")
+        fanout.publish("doc", b"x")
+        fanout.disconnect(a)
+        assert fanout.poll(a) is None
+        assert fanout.publish("doc", b"y") == 0
+        with pytest.raises(KeyError):
+            fanout.join(a, "doc")
+
+    def test_large_payload_roundtrip(self, fanout):
+        a = fanout.connect()
+        fanout.join(a, "big")
+        payload = bytes(range(256)) * 4096  # 1 MiB binary
+        assert fanout.publish("big", payload) == 1
+        assert fanout.poll(a) == payload
+
+    def test_delivered_total(self, fanout):
+        before = fanout.delivered_total()
+        a = fanout.connect()
+        b = fanout.connect()
+        fanout.join(a, "r")
+        fanout.join(b, "r")
+        fanout.publish("r", b"z")
+        assert fanout.delivered_total() == before + 2
+
+
+def test_native_fanout_builds_here():
+    # This image has the toolchain; the native path must actually build
+    # (elsewhere make_fanout falls back to the Python twin).
+    assert make_fanout().is_native
+
+
+@pytest.mark.parametrize("force_python", [True, False])
+def test_service_broadcast_through_fanout(force_python):
+    service = RouterliciousService(fanout=make_fanout(force_python))
+
+    def make_doc(doc_id):
+        svc = LocalServiceAdapter(service, doc_id)
+        container = Container.create_detached(svc)
+        ds = container.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        container.attach()
+        return container
+
+    # The local driver duck-types over any service with the front-door
+    # surface; RouterliciousService has it.
+    class LocalServiceAdapter(LocalDocumentService):
+        pass
+
+    c1 = make_doc("doc")
+    c2 = Container.load(LocalServiceAdapter(service, "doc"))
+    m1 = c1.runtime.get_datastore("default").get_channel("root")
+    m2 = c2.runtime.get_datastore("default").get_channel("root")
+    m1.set("x", 1)
+    m2.set("y", 2)
+    assert m1.get("y") == 2 and m2.get("x") == 1
+    assert service.fanout.delivered_total() > 0
+
+    # Disconnect stops delivery to that subscriber but not others.
+    c2.close()
+    m1.set("z", 3)
+    assert m1.get("z") == 3

@@ -1,0 +1,104 @@
+"""Gateway web host (server/gateway analog): token minting + server-side
+document loading over the network front door."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.tinylicious_driver import (
+    TinyliciousDocumentServiceFactory,
+)
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.gateway import Gateway, serve
+from fluidframework_tpu.server.riddler import TenantManager
+
+
+@pytest.fixture(scope="module")
+def alfred():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.server.alfred",
+         "--port", "0", "--no-merge-host"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("READY "), (line, proc.stderr.read())
+    yield int(line.split()[1])
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, resp.read()
+
+
+def _make_doc(port: int, doc_id: str) -> None:
+    factory = TinyliciousDocumentServiceFactory(port=port)
+    svc = factory(doc_id)
+    container = Container.create_detached(svc)
+    ds = container.runtime.create_datastore("default")
+    ds.create_channel("root", SharedMap.channel_type)
+    with svc.dispatch_lock:
+        container.attach()
+        ds.get_channel("root").set("title", "hello-gateway")
+    deadline = time.monotonic() + 30
+    while (container.runtime.pending.has_pending
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert not container.runtime.pending.has_pending
+    svc.close()
+
+
+def test_gateway_serves_document_json_and_view(alfred):
+    _make_doc(alfred, "gdoc")
+    server, _thread = serve(Gateway("127.0.0.1", alfred))
+    port = server.server_address[1]
+    try:
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert status == 200
+
+        status, body = _get(f"http://127.0.0.1:{port}/doc/gdoc")
+        assert status == 200
+        summary = json.loads(body)
+        assert "hello-gateway" in json.dumps(summary)
+
+        status, body = _get(f"http://127.0.0.1:{port}/doc/gdoc/view")
+        assert status == 200
+        assert b"hello-gateway" in body and body.startswith(b"<!doctype")
+    finally:
+        server.shutdown()
+
+
+def test_gateway_token_minting_and_denial(alfred):
+    tenants = TenantManager()
+    tenant = tenants.create_tenant("acme")
+    server, _thread = serve(Gateway(
+        "127.0.0.1", alfred, tenant_id="acme",
+        tenant_secret=tenant.secret))
+    port = server.server_address[1]
+    try:
+        status, body = _get(f"http://127.0.0.1:{port}/token?doc=gdoc")
+        assert status == 200
+        token = json.loads(body)["token"]
+        claims = tenants.validate_token(token, document_id="gdoc")
+        assert claims["tenantId"] == "acme"
+    finally:
+        server.shutdown()
+
+    # No secret configured -> 403, not a crash.
+    server, _thread = serve(Gateway("127.0.0.1", alfred))
+    port = server.server_address[1]
+    try:
+        try:
+            status, _body = _get(f"http://127.0.0.1:{port}/token?doc=x")
+        except urllib.error.HTTPError as err:
+            status = err.code
+        assert status == 403
+    finally:
+        server.shutdown()
