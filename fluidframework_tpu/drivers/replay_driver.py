@@ -81,6 +81,20 @@ class ReplayDocumentService:
         return _ReplayConnection()
 
 
+def load_recorded(directory: str | Path
+                  ) -> tuple[list[SequencedDocumentMessage], dict | None]:
+    """Parse a recorded directory (ops.json [+ snapshot.json], wire-codec
+    JSON) — the ONE place that knows the on-disk format, shared by the
+    file driver, the golden harness, and the debug tool."""
+    directory = Path(directory)
+    messages = [from_wire(m) for m in json.loads(
+        (directory / OPS_FILE).read_text())]
+    snapshot_path = directory / SNAPSHOT_FILE
+    snapshot = from_wire(json.loads(snapshot_path.read_text())) \
+        if snapshot_path.exists() else None
+    return messages, snapshot
+
+
 class FileDocumentService(ReplayDocumentService):
     """Replay service reading ``ops.json`` (+ optional ``snapshot.json``)
     from a directory — the file-driver analog. Files are wire-codec JSON
@@ -88,13 +102,7 @@ class FileDocumentService(ReplayDocumentService):
 
     def __init__(self, directory: str | Path,
                  up_to_seq: int | None = None) -> None:
-        directory = Path(directory)
-        messages = [from_wire(m) for m in json.loads(
-            (directory / OPS_FILE).read_text())]
-        snapshot_path = directory / SNAPSHOT_FILE
-        snapshot = from_wire(json.loads(snapshot_path.read_text())) \
-            if snapshot_path.exists() else None
-        super().__init__(messages, snapshot, up_to_seq)
+        super().__init__(*load_recorded(directory), up_to_seq)
 
 
 def record_document(server, doc_id: str, directory: str | Path,

@@ -119,24 +119,26 @@ int64_t fanout_pending(void* handle, int64_t sub) {
     return static_cast<int64_t>(queue_it->second.size());
 }
 
-// Size in bytes of the head message (0 = empty queue, -1 = unknown sub).
+// Size in bytes of the head message (may be 0: empty payloads are
+// legal); -1 = unknown sub, -2 = empty queue.
 int64_t fanout_next_size(void* handle, int64_t sub) {
     Fanout* f = static_cast<Fanout*>(handle);
     std::lock_guard<std::mutex> lock(f->mu);
     auto queue_it = f->queues.find(sub);
     if (queue_it == f->queues.end()) return -1;
-    if (queue_it->second.empty()) return 0;
+    if (queue_it->second.empty()) return -2;
     return static_cast<int64_t>(queue_it->second.front().size());
 }
 
-// Pops the head message into buf. Returns bytes written, 0 on empty,
-// -1 on unknown sub, -2 if the buffer is too small (message stays).
+// Pops the head message into buf. Returns bytes written (may be 0),
+// -1 on unknown sub, -2 if the buffer is too small (message stays),
+// -3 on empty queue.
 int64_t fanout_poll(void* handle, int64_t sub, char* buf, int64_t cap) {
     Fanout* f = static_cast<Fanout*>(handle);
     std::lock_guard<std::mutex> lock(f->mu);
     auto queue_it = f->queues.find(sub);
     if (queue_it == f->queues.end()) return -1;
-    if (queue_it->second.empty()) return 0;
+    if (queue_it->second.empty()) return -3;
     const std::string& head = queue_it->second.front();
     if (static_cast<int64_t>(head.size()) > cap) return -2;
     std::memcpy(buf, head.data(), head.size());

@@ -113,11 +113,12 @@ class NativeFanout:
 
     def poll(self, sub: int) -> bytes | None:
         size = self._lib.fanout_next_size(self._handle, sub)
-        if size <= 0:
+        if size < 0:  # -1 unknown sub, -2 empty queue
             return None
-        buf = ctypes.create_string_buffer(int(size))
-        written = self._lib.fanout_poll(self._handle, sub, buf, size)
-        if written <= 0:
+        # size may be 0 (empty payloads are legal and must still drain).
+        buf = ctypes.create_string_buffer(max(int(size), 1))
+        written = self._lib.fanout_poll(self._handle, sub, buf, len(buf))
+        if written < 0:
             return None
         return buf.raw[:written]
 

@@ -81,10 +81,13 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._json(400, {"error": "missing ?doc="})
                 return self._json(200,
                                   {"token": self.gateway.mint_token(doc)})
-            if len(parts) >= 2 and parts[0] == "doc":
+            is_doc = (len(parts) == 2 and parts[0] == "doc")
+            is_view = (len(parts) == 3 and parts[0] == "doc"
+                       and parts[2] == "view")
+            if is_doc or is_view:
                 doc_id = parts[1]
                 state = self.gateway.render(doc_id)
-                if len(parts) == 3 and parts[2] == "view":
+                if is_view:
                     body = ("<!doctype html><title>%s</title><h1>%s</h1>"
                             "<pre id=\"fluid-state\">%s</pre>" % (
                                 html.escape(doc_id), html.escape(doc_id),

@@ -20,20 +20,14 @@ import sys
 from pathlib import Path
 
 from ..drivers.debug_driver import DebuggerDocumentService
-from ..drivers.replay_driver import OPS_FILE, SNAPSHOT_FILE
-from ..protocol.codec import from_wire
+from ..drivers.replay_driver import load_recorded
 from ..runtime.container import Container
 from .replay import canonical
 
 
 def load_session(directory: str | Path, start_seq: int = 0):
     """(service, container) over a recorded directory, paused at start."""
-    directory = Path(directory)
-    messages = [from_wire(m) for m in json.loads(
-        (directory / OPS_FILE).read_text())]
-    snapshot_path = directory / SNAPSHOT_FILE
-    snapshot = from_wire(json.loads(snapshot_path.read_text())) \
-        if snapshot_path.exists() else None
+    messages, snapshot = load_recorded(directory)
     service = DebuggerDocumentService(messages, snapshot, start_seq)
     container = Container.load(service, mode="read")
     return service, container

@@ -53,7 +53,16 @@ def watch(host: str, port: int, interval: float,
     percentiles stay absolute (a snapshot cannot tell the kinds apart)."""
     prev: dict = {}
     while True:
-        now = scrape(host, port)
+        try:
+            now = scrape(host, port)
+        except (OSError, ConnectionError) as err:
+            # A restarting service must not kill the watcher; report and
+            # retry on the next interval.
+            print(json.dumps({"ts": round(time.time(), 1),
+                              "unreachable": repr(err)}),
+                  file=out, flush=True)
+            time.sleep(interval)
+            continue
         line: dict = {name: value for name, value in sorted(now.items())}
         for name, value in now.items():
             if name in prev and value > prev[name]:

@@ -1,0 +1,112 @@
+"""Legacy client-api facade + odsp-analog caching driver."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu import client_api
+from fluidframework_tpu.drivers.cached_driver import (
+    CachingDocumentService,
+    EpochMismatchError,
+)
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.server.local_server import LocalCollabServer
+
+
+class TestClientApi:
+    def test_create_edit_load_roundtrip(self):
+        server = LocalCollabServer()
+        doc = client_api.create(LocalDocumentService(server, "legacy"))
+        root = doc.get_root()
+        root.set("title", "client-api")
+        text = doc.create_string()
+        text.insert_text(0, "hello")
+        root.set("text", text.handle)
+        cell = doc.create_cell()
+        cell.set(42)
+        root.set("cell", cell.handle)
+
+        other = client_api.load(
+            lambda d: LocalDocumentService(server, d), "legacy")
+        assert other.existing
+        other_root = other.get_root()
+        assert other_root.get("title") == "client-api"
+        assert other_root.get("text").get().get_text() == "hello"
+        assert other_root.get("cell").get().get() == 42
+
+    def test_all_creators(self):
+        server = LocalCollabServer()
+        doc = client_api.create(LocalDocumentService(server, "kinds"))
+        matrix = doc.create_matrix()
+        matrix.insert_rows(0, 1)
+        matrix.insert_cols(0, 1)
+        matrix.set_cell(0, 0, "x")
+        directory = doc.create_directory()
+        directory.set("k", 1)
+        ink = doc.create_ink()
+        root = doc.get_root()
+        for name, channel in (("m", matrix), ("d", directory), ("i", ink)):
+            root.set(name, channel.handle)
+        again = client_api.load(
+            lambda d: LocalDocumentService(server, d), "kinds")
+        assert again.get_root().get("m").get().get_cell(0, 0) == "x"
+        assert again.get_root().get("d").get().get("k") == 1
+
+
+class TestCachingDriver:
+    def _server_with_doc(self):
+        server = LocalCollabServer()
+        doc = client_api.create(LocalDocumentService(server, "doc"))
+        doc.get_root().set("k", "v")
+        # Persist a snapshot so loads have something to cache.
+        server.upload_snapshot("doc", doc.container.summarize())
+        return server, doc
+
+    def test_snapshot_and_delta_caching(self):
+        server, _doc = self._server_with_doc()
+        service = CachingDocumentService(LocalDocumentService(server, "doc"))
+
+        first = service.storage.get_latest_snapshot()
+        again = service.storage.get_latest_snapshot()
+        assert first is not None and again is first
+        assert service.stats["snapshot_fetches"] == 1
+        assert service.stats["snapshot_hits"] == 1
+
+        all_deltas = service.delta_storage.get_deltas(0)
+        assert all_deltas
+        hit = service.delta_storage.get_deltas(
+            0, all_deltas[-1].sequence_number)
+        assert [m.sequence_number for m in hit] == \
+            [m.sequence_number for m in all_deltas]
+        assert service.stats["delta_hits"] >= 1
+
+    def test_container_loads_through_cache(self):
+        server, doc = self._server_with_doc()
+        service = CachingDocumentService(LocalDocumentService(server, "doc"))
+        from fluidframework_tpu.runtime.container import Container
+        loaded = client_api.Document(Container.load(service))
+        assert loaded.get_root().get("k") == "v"
+        # Live edits keep flowing through the caching connection...
+        doc.get_root().set("k2", "v2")
+        assert loaded.get_root().get("k2") == "v2"
+        # ...and warmed the delta cache as they passed.
+        assert service._cached_thru > 0
+
+    def test_epoch_mismatch_flushes_and_retries(self):
+        server, _doc = self._server_with_doc()
+        epoch = {"value": 1}
+        service = CachingDocumentService(
+            LocalDocumentService(server, "doc"),
+            epoch_source=lambda: epoch["value"])
+        assert service.storage.get_latest_snapshot() is not None
+        assert service._snapshot_cache is not None
+
+        epoch["value"] = 2  # file restored/branched server-side
+        with pytest.raises(EpochMismatchError) as err:
+            service.storage.get_latest_snapshot()
+        assert err.value.can_retry
+        assert service._snapshot_cache is None  # flushed
+        assert service.stats["epoch_flushes"] == 1
+
+        # The retry (loader behavior on a retryable driver error) works.
+        assert service.storage.get_latest_snapshot() is not None

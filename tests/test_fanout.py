@@ -53,6 +53,16 @@ class TestFanoutCore:
         with pytest.raises(KeyError):
             fanout.join(a, "doc")
 
+    def test_empty_payload_drains(self, fanout):
+        a = fanout.connect()
+        fanout.join(a, "empty-room")
+        fanout.publish("empty-room", b"")
+        fanout.publish("empty-room", b"after")
+        assert fanout.poll(a) == b""   # empty payloads are legal...
+        assert fanout.poll(a) == b"after"  # ...and must not wedge the queue
+        assert fanout.poll(a) is None
+        fanout.disconnect(a)
+
     def test_large_payload_roundtrip(self, fanout):
         a = fanout.connect()
         fanout.join(a, "big")
