@@ -10,7 +10,7 @@ same verbs here, implemented over the modern Loader/Container stack.
 
 from __future__ import annotations
 
-import itertools
+import uuid
 from typing import Callable
 
 from .dds.cell import SharedCell
@@ -29,9 +29,9 @@ _ROOT_MAP = "root"
 class Document:
     """Loader + runtime + root map in one object (document.ts:58)."""
 
-    def __init__(self, container: Container) -> None:
+    def __init__(self, container: Container, existing: bool = True) -> None:
         self.container = container
-        self._names = itertools.count()
+        self._existing = existing
         datastore = container.runtime.get_datastore(_ROOT_STORE)
         self._datastore = datastore
 
@@ -42,12 +42,16 @@ class Document:
 
     @property
     def existing(self) -> bool:
-        return self.container.attached
+        """True when the document pre-existed this session (loaded, not
+        created here) — the reference client-api's existing flag."""
+        return self._existing
 
     # -- creators (document.ts createMap/createString/...) --------------------
 
     def _create(self, channel_type: str):
-        name = f"channel-{next(self._names)}"
+        # Channel ids must be globally unique — a per-session counter
+        # collides across sessions/clients (document.ts uses uuid()).
+        name = f"channel-{uuid.uuid4().hex}"
         return self._datastore.create_channel(name, channel_type)
 
     def create_map(self) -> SharedMap:
@@ -78,11 +82,11 @@ def create(service: DocumentService) -> Document:
     datastore = container.runtime.create_datastore(_ROOT_STORE)
     datastore.create_channel(_ROOT_MAP, SharedMap.channel_type)
     container.attach()
-    return Document(container)
+    return Document(container, existing=False)
 
 
 def load(service_factory: Callable[[str], DocumentService],
          doc_id: str) -> Document:
     """Open an existing document (client-api load(): resolve + request)."""
     container = Container.load(service_factory(doc_id))
-    return Document(container)
+    return Document(container, existing=True)

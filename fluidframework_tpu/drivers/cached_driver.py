@@ -40,8 +40,10 @@ class _CachingSnapshotStorage:
 
     def upload_snapshot(self, snapshot: dict) -> str:
         handle = self._service.inner.storage.upload_snapshot(snapshot)
-        # Our own upload is the freshest state — cache it directly.
-        self._service._snapshot_cache = snapshot
+        # An upload is not the acked head until the service sequences the
+        # summarize/ack (it may be nacked or lose a summary race), so only
+        # invalidate — the next read fetches whatever the service honors.
+        self._service._snapshot_cache = None
         return handle
 
 
@@ -69,8 +71,11 @@ class CachingDocumentService:
         self.storage = _CachingSnapshotStorage(self)
         self.delta_storage = _CachingDeltaStorage(self)
         self._snapshot_cache: dict | None = None
-        # Contiguous delta log cache: ops with seq in [1, _cached_thru].
+        # Contiguous delta log cache: ops with seq in
+        # (_cache_base, _cached_thru]. The base seeds from the FIRST read
+        # so a snapshot-anchored load never drags the full history in.
         self._delta_cache: list[SequencedDocumentMessage] = []
+        self._cache_base: int | None = None
         self._cached_thru = 0
         self.stats = {"snapshot_hits": 0, "snapshot_fetches": 0,
                       "delta_hits": 0, "delta_fetches": 0,
@@ -90,7 +95,15 @@ class CachingDocumentService:
     def flush_cache(self) -> None:
         self._snapshot_cache = None
         self._delta_cache = []
+        self._cache_base = None
         self._cached_thru = 0
+
+    def _absorb(self, messages) -> None:
+        """Extend the contiguous cache; the invariant lives ONLY here."""
+        for message in messages:
+            if message.sequence_number == self._cached_thru + 1:
+                self._delta_cache.append(message)
+                self._cached_thru = message.sequence_number
 
     # -- cached reads ----------------------------------------------------------
 
@@ -108,18 +121,22 @@ class CachingDocumentService:
     def _get_deltas(self, from_seq: int, to_seq: int | None
                     ) -> list[SequencedDocumentMessage]:
         self._validate_epoch()
+        if self._cache_base is None:
+            # Anchor the window at the first read's floor (a
+            # snapshot-anchored load starts deep in the log).
+            self._cache_base = from_seq
+            self._cached_thru = from_seq
+        if from_seq < self._cache_base:
+            # Below the cached window — serve straight from the backend
+            # rather than dragging the whole history into the cache.
+            self.stats["delta_fetches"] += 1
+            return self.inner.delta_storage.get_deltas(from_seq, to_seq)
         if to_seq is not None and to_seq <= self._cached_thru:
             self.stats["delta_hits"] += 1
         else:
-            # Extend the contiguous cache from the backend, then serve
-            # every read out of it.
             self.stats["delta_fetches"] += 1
-            fetched = self.inner.delta_storage.get_deltas(self._cached_thru,
-                                                          to_seq)
-            for message in fetched:
-                if message.sequence_number == self._cached_thru + 1:
-                    self._delta_cache.append(message)
-                    self._cached_thru = message.sequence_number
+            self._absorb(self.inner.delta_storage.get_deltas(
+                self._cached_thru, to_seq))
         return [m for m in self._delta_cache
                 if m.sequence_number > from_seq
                 and (to_seq is None or m.sequence_number <= to_seq)]
@@ -129,10 +146,10 @@ class CachingDocumentService:
     def connect(self, handler, on_nack=None, on_signal=None,
                 mode: str = "write"):
         def caching_handler(messages: list[SequencedDocumentMessage]) -> None:
-            for message in messages:
-                if message.sequence_number == self._cached_thru + 1:
-                    self._delta_cache.append(message)
-                    self._cached_thru = message.sequence_number
+            if self._cache_base is None and messages:
+                self._cache_base = messages[0].sequence_number - 1
+                self._cached_thru = self._cache_base
+            self._absorb(messages)
             handler(messages)
 
         return self.inner.connect(caching_handler, on_nack=on_nack,

@@ -34,6 +34,25 @@ class TestClientApi:
         assert other_root.get("text").get().get_text() == "hello"
         assert other_root.get("cell").get().get() == 42
 
+    def test_create_after_load_never_collides(self):
+        # Channel ids are uuid-based (document.ts parity): a second
+        # session creating channels on a loaded doc must not collide with
+        # the first session's names, and `existing` distinguishes them.
+        server = LocalCollabServer()
+        doc = client_api.create(LocalDocumentService(server, "collide"))
+        assert not doc.existing
+        first = doc.create_string()
+        doc.get_root().set("a", first.handle)
+
+        again = client_api.load(
+            lambda d: LocalDocumentService(server, d), "collide")
+        assert again.existing
+        second = again.create_string()
+        second.insert_text(0, "late")
+        again.get_root().set("b", second.handle)
+        assert first.id != second.id
+        assert doc.get_root().get("b").get().get_text() == "late"
+
     def test_all_creators(self):
         server = LocalCollabServer()
         doc = client_api.create(LocalDocumentService(server, "kinds"))
