@@ -436,6 +436,7 @@ def bench_sequencer(num_docs: int = 10_240, k: int = 64,
     import jax.numpy as jnp
 
     from fluidframework_tpu.ops import sequencer as seqk
+    from fluidframework_tpu.ops import sequencer_pallas as seqp
     from fluidframework_tpu.protocol.messages import MessageType
 
     n_clients = 4
@@ -460,11 +461,13 @@ def bench_sequencer(num_docs: int = 10_240, k: int = 64,
             *[jnp.asarray(_tile(np.asarray(f), num_docs)) for f in one]))
 
     def apply(state, batch):
-        new_state, _tickets = seqk.process_batch(state, batch)
+        new_state, _tickets = seqp.process_batch_best(state, batch)
         return new_state
 
     out = _run_device(apply, seqk.init_state(num_docs, n_clients + 4),
                       batches, num_docs * k)
+    out["kernel_path"] = ("xla_scan" if seqp.default_interpret()
+                          else "pallas_vmem")
 
     # Scalar baseline: the deli ticket loop.
     from fluidframework_tpu.protocol.messages import ClientDetail

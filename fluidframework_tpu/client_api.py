@@ -29,7 +29,7 @@ _ROOT_MAP = "root"
 class Document:
     """Loader + runtime + root map in one object (document.ts:58)."""
 
-    def __init__(self, container: Container, existing: bool = True) -> None:
+    def __init__(self, container: Container, existing: bool) -> None:
         self.container = container
         self._existing = existing
         datastore = container.runtime.get_datastore(_ROOT_STORE)

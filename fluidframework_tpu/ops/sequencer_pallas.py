@@ -1,0 +1,319 @@
+"""Pallas TPU tick kernel for the batched sequencer — VMEM-resident deli.
+
+Same restructuring as :mod:`mergetree_pallas` applied to the deli ticket
+loop (:mod:`sequencer`): each grid program holds a doc block's sequencer
+state (per-doc scalars as [D, 1] columns, client tables as [D, C] planes)
+in VMEM across the whole K-op tick, emitting the per-op ticket planes
+[D, K] in the same pass — the XLA path's lax.scan round-trips the full
+state through HBM every step.
+
+Semantics are pinned to :func:`sequencer.process_batch` (itself pinned to
+the scalar DocumentSequencer oracle) by differential test
+(tests/test_sequencer_pallas.py); reference parity transits
+deli/lambda.ts:236-470 via those oracles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..protocol.messages import MessageType
+from . import opcodes as oc
+from .sequencer import OpBatch, SequencerState, TicketBatch
+
+I32 = jnp.int32
+
+_SCALARS = ("seq", "msn", "last_sent_msn", "nack_future")
+_CLIENTS = ("active", "cseq", "cref", "clu", "csum", "cnack", "cevict")
+_OPS = ("valid", "kind", "slot", "target", "client_seq", "ref_seq",
+        "timestamp", "has_contents", "can_summarize", "can_evict",
+        "is_nack_future")
+_TICKETS = ("kind", "seq", "msn", "send", "nack_code")
+
+
+def _gather_client(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x[idx[d], d] per doc — gather along the client (sublane) axis."""
+    client = jax.lax.broadcasted_iota(I32, x.shape, 0)
+    return jnp.sum(jnp.where(client == idx, x, 0), axis=0, keepdims=True)
+
+
+def _ticket_step_vec(s: dict, op: dict):
+    """sequencer._ticket_step vectorized over a doc block. Layout puts
+    DOCS ON LANES: client tables are [C, D] planes (clients ride the
+    sublane axis, perfectly tiled for small C), per-doc scalars and op
+    fields are [1, D] rows. Bools are carried as int32 planes; per-doc
+    [1, D] masks broadcast EXPLICITLY before meeting [C, D] planes —
+    Mosaic cannot lower the implicit sub-32-bit broadcast-select."""
+    num_slots = s["active"].shape[0]
+    lanes = jax.lax.broadcasted_iota(I32, s["active"].shape, 0)
+
+    def bc(mask):
+        return jnp.broadcast_to(mask, s["active"].shape)
+    opvalid = op["valid"] != 0
+    is_client = op["slot"] >= 0
+    slot = jnp.clip(op["slot"], 0, num_slots - 1)
+    target = jnp.clip(op["target"], 0, num_slots - 1)
+
+    active_b = s["active"] != 0
+    cnack_b = s["cnack"] != 0
+    at_slot_active = _gather_client(s["active"], slot) != 0
+    at_slot_cseq = _gather_client(s["cseq"], slot)
+    at_slot_csum = _gather_client(s["csum"], slot) != 0
+    at_slot_cnack = _gather_client(s["cnack"], slot) != 0
+    at_target_active = _gather_client(s["active"], target) != 0
+
+    exists = is_client & at_slot_active
+    expected = at_slot_cseq + 1
+    gap = exists & (op["client_seq"] > expected)
+    dup = exists & (op["client_seq"] < expected)
+
+    is_join = op["kind"] == int(MessageType.CLIENT_JOIN)
+    is_leave = op["kind"] == int(MessageType.CLIENT_LEAVE)
+    join_dup = (~is_client) & is_join & at_target_active
+    leave_dup = (~is_client) & is_leave & ~at_target_active
+
+    service_only = (
+        (op["kind"] == int(MessageType.CLIENT_JOIN))
+        | (op["kind"] == int(MessageType.CLIENT_LEAVE))
+        | (op["kind"] == int(MessageType.NO_CLIENT))
+        | (op["kind"] == int(MessageType.CONTROL))
+        | (op["kind"] == int(MessageType.SUMMARY_ACK))
+        | (op["kind"] == int(MessageType.SUMMARY_NACK))
+    )
+    invalid_type = is_client & ~gap & ~dup & service_only
+    nonexistent = (is_client & ~gap & ~dup & ~invalid_type
+                   & (~at_slot_active | at_slot_cnack))
+    refseq_nack = (is_client & ~gap & ~dup & ~invalid_type & ~nonexistent
+                   & (op["ref_seq"] != -1) & (op["ref_seq"] < s["msn"]))
+    summarize_nack = (
+        is_client & ~gap & ~dup & ~invalid_type & ~nonexistent & ~refseq_nack
+        & (op["kind"] == int(MessageType.SUMMARIZE)) & ~at_slot_csum)
+
+    nack_future = s["nack_future"] != 0
+    nacked = opvalid & (nack_future | gap | invalid_type | nonexistent
+                        | refseq_nack | summarize_nack)
+    ignored = opvalid & ~nack_future & (dup | join_dup | leave_dup)
+    sequenced = opvalid & ~nacked & ~ignored
+
+    nack_code = jnp.where(
+        nack_future, I32(oc.NACK_FUTURE),
+        jnp.where(gap, I32(oc.NACK_GAP),
+                  jnp.where(invalid_type, I32(oc.NACK_INVALID_TYPE),
+                            jnp.where(nonexistent,
+                                      I32(oc.NACK_NONEXISTENT_CLIENT),
+                                      jnp.where(refseq_nack,
+                                                I32(oc.NACK_REFSEQ_BELOW_MSN),
+                                                jnp.where(
+                                                    summarize_nack,
+                                                    I32(oc.NACK_NO_SUMMARY_SCOPE),
+                                                    I32(oc.NACK_NONE)))))))
+
+    do_refseq_mark = opvalid & ~nack_future & refseq_nack
+    onehot_slot = (lanes == slot) & bc(is_client)
+    mark = onehot_slot & bc(do_refseq_mark)
+    cseq = jnp.where(mark, op["client_seq"], s["cseq"])
+    cref = jnp.where(mark, s["msn"], s["cref"])
+    clu = jnp.where(mark, op["timestamp"], s["clu"])
+    cnack = jnp.where(mark, 1, s["cnack"])
+
+    onehot_target = lanes == target
+    do_join = opvalid & ~nack_future & is_join & ~is_client
+    do_leave = sequenced & is_leave & ~is_client
+    join_mask = onehot_target & bc(do_join)
+    active = jnp.where(join_mask, 1,
+                       jnp.where(onehot_target & bc(do_leave), 0,
+                                 s["active"]))
+    cseq = jnp.where(join_mask, 0, cseq)
+    cref = jnp.where(join_mask, s["msn"], cref)
+    clu = jnp.where(join_mask, op["timestamp"], clu)
+    fresh_join_mask = join_mask & bc(~at_target_active)
+    csum = jnp.where(fresh_join_mask, op["can_summarize"], s["csum"])
+    cevict = jnp.where(fresh_join_mask, op["can_evict"], s["cevict"])
+    cnack = jnp.where(join_mask, 0, cnack)
+
+    is_noop = op["kind"] == int(MessageType.NOOP)
+    is_noclient = op["kind"] == int(MessageType.NO_CLIENT)
+    is_control = op["kind"] == int(MessageType.CONTROL)
+    # Boolean algebra instead of a where over bool operands — Mosaic has
+    # no select for sub-32-bit [D, 1] vectors.
+    rev1 = sequenced & ((is_client & ~is_noop)
+                        | (~is_client
+                           & ~(is_noop | is_noclient | is_control)))
+    seq1 = s["seq"] + rev1.astype(I32)
+
+    ref_eff = jnp.where(is_client & (op["ref_seq"] == -1), seq1,
+                        op["ref_seq"])
+    up = onehot_slot & bc(sequenced & is_client)
+    cseq = jnp.where(up, op["client_seq"], cseq)
+    cref = jnp.where(up, ref_eff, cref)
+    clu = jnp.where(up, op["timestamp"], clu)
+    cnack = jnp.where(up, 0, cnack)
+
+    active_next_b = active != 0
+    min_ref = jnp.min(jnp.where(active_next_b, cref, oc.INT32_MAX),
+                      axis=0, keepdims=True)
+    no_clients = ~jnp.any(active_next_b, axis=0, keepdims=True)
+    msn1 = jnp.where(no_clients, seq1, min_ref)
+
+    stale = msn1 <= s["last_sent_msn"]
+    has_contents = op["has_contents"] != 0
+    client_noop = sequenced & is_noop & is_client
+    server_noop = sequenced & is_noop & ~is_client
+    noclient = sequenced & is_noclient & ~is_client
+    control = sequenced & is_control & ~is_client
+
+    send = jnp.full_like(seq1, oc.SEND_IMMEDIATE)
+    send = jnp.where(client_noop & (~has_contents | stale),
+                     oc.SEND_LATER, send)
+    send = jnp.where(server_noop & stale, oc.SEND_NEVER, send)
+    send = jnp.where(noclient & ~no_clients, oc.SEND_NEVER, send)
+    send = jnp.where(control, oc.SEND_NEVER, send)
+
+    rev2 = ((client_noop & has_contents & ~stale)
+            | (server_noop & ~stale)
+            | (noclient & no_clients))
+    seq2 = seq1 + rev2.astype(I32)
+    msn2 = jnp.where(noclient & no_clients, seq2, msn1)
+    nack_future_next = nack_future | (control & (op["is_nack_future"] != 0))
+
+    applied = sequenced
+    touched = bc(applied | do_refseq_mark | do_join)
+    state = {
+        "seq": jnp.where(applied, seq2, s["seq"]),
+        "msn": jnp.where(applied, msn2, s["msn"]),
+        "last_sent_msn": jnp.where(
+            applied & (send == oc.SEND_IMMEDIATE), msn2,
+            s["last_sent_msn"]),
+        "nack_future": jnp.where(opvalid, nack_future_next.astype(I32),
+                                 s["nack_future"]),
+        "active": jnp.where(touched, active, s["active"]),
+        "cseq": jnp.where(touched, cseq, s["cseq"]),
+        "cref": jnp.where(touched, cref, s["cref"]),
+        "clu": jnp.where(touched, clu, s["clu"]),
+        "csum": jnp.where(touched, csum, s["csum"]),
+        "cnack": jnp.where(touched, cnack, s["cnack"]),
+        "cevict": jnp.where(touched, cevict, s["cevict"]),
+    }
+    ticket = {
+        "kind": jnp.where(nacked, I32(oc.OUT_NACK),
+                          jnp.where(sequenced, I32(oc.OUT_SEQUENCED),
+                                    I32(oc.OUT_IGNORED))),
+        "seq": jnp.where(nacked, s["seq"],
+                         jnp.where(sequenced, seq2, I32(-1))),
+        "msn": jnp.where(nacked, s["msn"],
+                         jnp.where(sequenced, msn2, I32(-1))),
+        "send": jnp.where(sequenced, send, I32(oc.SEND_IMMEDIATE)),
+        "nack_code": jnp.where(nacked, nack_code, I32(oc.NACK_NONE)),
+    }
+    return state, ticket
+
+
+def _tick_kernel(*refs, num_ops: int):
+    scalar_refs = refs[0:4]
+    client_refs = refs[4:11]
+    op_refs = refs[11:22]
+    out_scalar_refs = refs[22:26]
+    out_client_refs = refs[26:33]
+    ticket_refs = refs[33:38]
+
+    state = {name: ref[:] for name, ref in zip(_SCALARS, scalar_refs)}
+    state.update({name: ref[:] for name, ref in zip(_CLIENTS, client_refs)})
+
+    def body(k, state):
+        # Op rows read and ticket rows written via dynamic SUBLANE slices
+        # (rows = ops) — no masked reductions, no ticket planes in the
+        # fori carry.
+        op = {name: ref[pl.ds(k, 1), :]
+              for name, ref in zip(_OPS, op_refs)}
+        state, ticket = _ticket_step_vec(state, op)
+        for name, ref in zip(_TICKETS, ticket_refs):
+            ref[pl.ds(k, 1), :] = ticket[name]
+        return state
+
+    state = jax.lax.fori_loop(0, num_ops, body, state)
+    for name, ref in zip(_SCALARS, out_scalar_refs):
+        ref[:] = state[name]
+    for name, ref in zip(_CLIENTS, out_client_refs):
+        ref[:] = state[name]
+
+
+def _pad_lanes(x: jax.Array, bp: int, fill) -> jax.Array:
+    """Pad the trailing (doc) axis to the lane-block multiple."""
+    if x.shape[-1] == bp:
+        return x
+    pads = [(0, 0)] * (x.ndim - 1) + [(0, bp - x.shape[-1])]
+    return jnp.pad(x, pads, constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("block_docs", "interpret"))
+def process_batch_pallas(state: SequencerState, ops: OpBatch,
+                         block_docs: int = 512, interpret: bool = False):
+    """Drop-in replacement for :func:`sequencer.process_batch`."""
+    b, c = state.active.shape
+    k = ops.kind.shape[1]
+    d = min(block_docs, max(128, -(-b // 128) * 128))
+    bp = -(-b // d) * d
+
+    scalars = [_pad_lanes(getattr(state, n).astype(I32)[None, :], bp, 0)
+               for n in _SCALARS]
+    clients = [_pad_lanes(getattr(state, n).astype(I32).T, bp,
+                          1 if n == "cevict" else 0)
+               for n in _CLIENTS]
+    op_arrays = [_pad_lanes(getattr(ops, n).astype(I32).T, bp,
+                            -1 if n == "slot" else 0)
+                 for n in _OPS]
+
+    scalar_spec = pl.BlockSpec((1, d), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)
+    client_spec = pl.BlockSpec((c, d), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)
+    op_spec = pl.BlockSpec((k, d), lambda i: (0, i),
+                           memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        functools.partial(_tick_kernel, num_ops=k),
+        grid=(bp // d,),
+        in_specs=[scalar_spec] * 4 + [client_spec] * 7 + [op_spec] * 11,
+        out_specs=[scalar_spec] * 4 + [client_spec] * 7 + [op_spec] * 5,
+        out_shape=(
+            [jax.ShapeDtypeStruct((1, bp), jnp.int32)] * 4
+            + [jax.ShapeDtypeStruct((c, bp), jnp.int32)] * 7
+            + [jax.ShapeDtypeStruct((k, bp), jnp.int32)] * 5),
+        input_output_aliases={i: i for i in range(11)},
+        interpret=interpret,
+    )(*scalars, *clients, *op_arrays)
+
+    new_state = SequencerState(
+        seq=out[0][0, :b],
+        msn=out[1][0, :b],
+        last_sent_msn=out[2][0, :b],
+        nack_future=out[3][0, :b] != 0,
+        active=out[4][:, :b].T != 0,
+        cseq=out[5][:, :b].T,
+        cref=out[6][:, :b].T,
+        clu=out[7][:, :b].T,
+        csum=out[8][:, :b].T != 0,
+        cnack=out[9][:, :b].T != 0,
+        cevict=out[10][:, :b].T != 0,
+    )
+    tickets = TicketBatch(
+        kind=out[11][:, :b].T, seq=out[12][:, :b].T, msn=out[13][:, :b].T,
+        send=out[14][:, :b].T, nack_code=out[15][:, :b].T)
+    return new_state, tickets
+
+
+def default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def process_batch_best(state: SequencerState, ops: OpBatch):
+    """Pallas VMEM kernel on TPU, XLA scan path elsewhere."""
+    from .sequencer import process_batch
+    if default_interpret():
+        return process_batch(state, ops)
+    return process_batch_pallas(state, ops)

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..ops import opcodes as oc
 from ..ops import sequencer as seqk
+from ..ops import sequencer_pallas as seqp
 from ..protocol.messages import MessageType
 from .sequencer import (
     DocumentSequencer,
@@ -299,7 +300,7 @@ class KernelSequencerHost:
             max_k = max(max_k, len(per_doc_ops[row]))
         ops = seqk.make_op_batch(per_doc_ops, self._capacity,
                                  _next_pow2(max_k))
-        self._state, out = seqk.process_batch(self._state, ops)
+        self._state, out = seqp.process_batch_best(self._state, ops)
         for doc_id in doc_ids:
             row = self._rows[doc_id]
             self._ready.setdefault(doc_id, []).extend(self._decode_doc(

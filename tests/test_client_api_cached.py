@@ -103,7 +103,8 @@ class TestCachingDriver:
         server, doc = self._server_with_doc()
         service = CachingDocumentService(LocalDocumentService(server, "doc"))
         from fluidframework_tpu.runtime.container import Container
-        loaded = client_api.Document(Container.load(service))
+        loaded = client_api.Document(Container.load(service),
+                                     existing=True)
         assert loaded.get_root().get("k") == "v"
         # Live edits keep flowing through the caching connection...
         doc.get_root().set("k2", "v2")
