@@ -1,0 +1,115 @@
+"""ctypes binding for the C++ front-door socket bridge (bridge.cpp).
+
+The bridge owns every socket: accept, framed reads, framed writes — the
+native transport layer of SURVEY.md §2.9/§5.8 (the libuv/ws analog under
+alfred). Python pumps decoded events and pushes response bodies; framing
+never crosses the boundary. Falls back to ``None`` when the toolchain is
+unavailable (callers then use the asyncio alfred server).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from pathlib import Path
+
+_SRC = Path(__file__).parent / "bridge.cpp"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_LIB = _BUILD_DIR / "libbridge.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+EV_OPEN = 0
+EV_DATA = 1
+EV_CLOSE = 2
+
+
+def _load_library() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if (not _LIB.exists()
+                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+                _BUILD_DIR.mkdir(exist_ok=True)
+                tmp = _BUILD_DIR / f"libbridge.{os.getpid()}.tmp.so"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-pthread",
+                     str(_SRC), "-o", str(tmp)],
+                    check=True, capture_output=True, timeout=120)
+                tmp.replace(_LIB)
+            lib = ctypes.CDLL(str(_LIB))
+        except (OSError, subprocess.SubprocessError):
+            _lib_failed = True
+            return None
+        lib.bridge_start.restype = ctypes.c_void_p
+        lib.bridge_start.argtypes = [ctypes.c_int]
+        lib.bridge_port.restype = ctypes.c_int
+        lib.bridge_port.argtypes = [ctypes.c_void_p]
+        lib.bridge_next_size.restype = ctypes.c_int64
+        lib.bridge_next_size.argtypes = [ctypes.c_void_p]
+        lib.bridge_poll.restype = ctypes.c_int64
+        lib.bridge_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64]
+        lib.bridge_send.restype = ctypes.c_int
+        lib.bridge_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_char_p, ctypes.c_uint32]
+        lib.bridge_close.restype = ctypes.c_int
+        lib.bridge_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bridge_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeBridge:
+    """Framed-TCP server; poll() yields (conn_id, kind, body bytes)."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int) -> None:
+        self._lib = lib
+        self._handle = handle
+        self.port = int(lib.bridge_port(handle))
+
+    def poll(self) -> tuple[int, int, bytes] | None:
+        size = self._lib.bridge_next_size(self._handle)
+        if size < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(size))
+        got = self._lib.bridge_poll(self._handle, buf, size)
+        if got < 12:
+            return None
+        conn, kind = struct.unpack_from("<qi", buf.raw, 0)
+        return conn, kind, buf.raw[12:got]
+
+    def send(self, conn: int, body: bytes) -> bool:
+        return self._lib.bridge_send(self._handle, conn, body,
+                                     len(body)) == 0
+
+    def close_conn(self, conn: int) -> None:
+        self._lib.bridge_close(self._handle, conn)
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.bridge_stop(self._handle)
+            self._handle = 0
+
+    def __del__(self) -> None:
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def start_bridge(port: int = 0) -> NativeBridge | None:
+    """Start a native bridge server; None if the toolchain is missing."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    handle = lib.bridge_start(port)
+    if not handle:
+        return None
+    return NativeBridge(lib, handle)

@@ -24,6 +24,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..protocol.messages import MessageType
 from . import opcodes as oc
+from .mergetree_pallas import default_interpret
 from .sequencer import OpBatch, SequencerState, TicketBatch
 
 I32 = jnp.int32
@@ -305,10 +306,6 @@ def process_batch_pallas(state: SequencerState, ops: OpBatch,
         kind=out[11][:, :b].T, seq=out[12][:, :b].T, msn=out[13][:, :b].T,
         send=out[14][:, :b].T, nack_code=out[15][:, :b].T)
     return new_state, tickets
-
-
-def default_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def process_batch_best(state: SequencerState, ops: OpBatch):

@@ -133,9 +133,16 @@ def from_wire(obj: Any) -> Any:
     return obj
 
 
-def encode_frame(payload: Any) -> bytes:
+def encode_body(payload: Any) -> bytes:
+    """Frame body alone — transports that own framing (the native bridge)
+    prepend their own length word."""
     body = json.dumps(to_wire(payload), separators=(",", ":")).encode()
     assert len(body) <= MAX_FRAME, f"frame too large: {len(body)}"
+    return body
+
+
+def encode_frame(payload: Any) -> bytes:
+    body = encode_body(payload)
     return _LEN.pack(len(body)) + body
 
 

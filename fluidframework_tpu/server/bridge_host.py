@@ -1,0 +1,150 @@
+"""Native-bridge front door — alfred's request surface over the C++
+socket bridge.
+
+Reference parity: the alfred socket handler (alfred/index.ts:140-477)
+with the transport owned by native code (SURVEY.md §2.9's front-door ↔
+TPU-host bridge): bridge.cpp accepts connections and does all framed
+socket IO; this host pumps decoded request frames through the SAME
+request dispatch the asyncio alfred uses (one wire protocol, two
+transports — the network driver connects to either unchanged).
+
+Run standalone::
+
+    python -m fluidframework_tpu.server.bridge_host --port 7071
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import Any
+
+from ..native.bridge import EV_CLOSE, EV_DATA, EV_OPEN, start_bridge
+from ..protocol.codec import decode_body, encode_body
+from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
+from .alfred import _ClientSession
+
+
+class _BridgeSession(_ClientSession):
+    """Alfred session whose outbox is the native bridge connection."""
+
+    def __init__(self, server: "BridgeFrontDoor", conn_id: int) -> None:
+        # Deliberately skip _ClientSession.__init__ (no asyncio writer);
+        # mirror its state.
+        self.server = server
+        self.conn_id = conn_id
+        self.connection = None
+        self.doc_id = None
+
+    def push(self, payload: dict) -> None:
+        if payload is None:
+            return
+        self.server._bridge.send(self.conn_id, encode_body(payload))
+
+
+class BridgeFrontDoor:
+    """Pumps bridge events through the alfred request dispatch."""
+
+    def __init__(self, service, port: int = 0,
+                 logger: TelemetryLogger | None = None,
+                 metrics: MetricsRegistry | None = None,
+                 tenants=None, throttler=None) -> None:
+        bridge = start_bridge(port)
+        if bridge is None:
+            raise RuntimeError("native bridge unavailable (no toolchain)")
+        self.service = service
+        self.logger = logger if logger is not None else NullLogger()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.tenants = tenants
+        self.throttler = throttler
+        self._bridge = bridge
+        self.port = bridge.port
+        self._sessions: dict[int, _BridgeSession] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump_loop, daemon=True)
+        self._thread.start()
+
+    # -- event pump ------------------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        while not self._stop.is_set():
+            event = self._bridge.poll()
+            if event is None:
+                time.sleep(0.001)
+                continue
+            try:
+                self._dispatch(*event)
+            except Exception as err:  # the pump must never die
+                self.logger.send_error("BridgePumpFailed", err)
+
+    def _dispatch(self, conn_id: int, kind: int, body: bytes) -> None:
+        if kind == EV_OPEN:
+            self._sessions[conn_id] = _BridgeSession(self, conn_id)
+        elif kind == EV_CLOSE:
+            session = self._sessions.pop(conn_id, None)
+            if session is not None and session.connection is not None:
+                session.connection.close()
+            # Reap the native side (fd + writer thread) too.
+            self._bridge.close_conn(conn_id)
+        elif kind == EV_DATA:
+            self._handle_data(conn_id, body)
+
+    def _handle_data(self, conn_id: int, body: bytes) -> None:
+        session = self._sessions.get(conn_id)
+        if session is None:
+            return
+        try:
+            req: Any = decode_body(body)
+        except Exception:
+            self._bridge.close_conn(conn_id)
+            return
+        if not isinstance(req, dict):
+            session.push({"rid": None, "error": "request must be an object"})
+            return
+        try:
+            resp = session.handle_request(req)
+        except Exception as err:  # keep the socket alive, report
+            self.logger.send_error("BridgeRequestFailed", err,
+                                   op=req.get("op"))
+            resp = {"rid": req.get("rid"), "error": repr(err)}
+        session.push(resp)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+        for session in list(self._sessions.values()):
+            if session.connection is not None:
+                session.connection.close()
+        self._sessions.clear()
+        if self._thread.is_alive():
+            # A request is wedged inside the service; freeing the native
+            # bridge under the pump would be a use-after-free. Leak it —
+            # process teardown reclaims the fds.
+            self.logger.send_event("BridgeStopLeaked")
+            return
+        self._bridge.stop()
+
+
+def main(argv: list[str] | None = None) -> None:
+    from .alfred import build_default_service
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=7071)
+    parser.add_argument("--no-merge-host", action="store_true")
+    parser.add_argument("--data-dir", default=None)
+    args = parser.parse_args(argv)
+    service = build_default_service(args.data_dir,
+                                    merge_host=not args.no_merge_host)
+    front = BridgeFrontDoor(service, args.port)
+    print(f"READY {front.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        front.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,137 @@
+"""C++ front-door socket bridge (§2.9/§5.8 native transport): the same
+wire protocol as alfred, sockets owned by native code."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.tinylicious_driver import (
+    TinyliciousDocumentServiceFactory,
+)
+from fluidframework_tpu.native.bridge import _load_library, start_bridge
+
+pytestmark = pytest.mark.skipif(
+    _load_library() is None, reason="no C++ toolchain for the bridge")
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+
+def test_native_bridge_builds_here():
+    bridge = start_bridge()
+    assert bridge is not None and bridge.port > 0
+    bridge.stop()
+
+
+def test_bridge_echo_roundtrip():
+    import socket
+    bridge = start_bridge()
+    try:
+        with socket.create_connection(("127.0.0.1", bridge.port)) as sock:
+            sock.sendall(len(b"hello").to_bytes(4, "big") + b"hello")
+            deadline = time.monotonic() + 10
+            opened = data = None
+            while time.monotonic() < deadline and data is None:
+                event = bridge.poll()
+                if event is None:
+                    time.sleep(0.005)
+                    continue
+                if event[1] == 0:
+                    opened = event[0]
+                elif event[1] == 1:
+                    data = event
+            assert opened is not None and data is not None
+            assert data[0] == opened and data[2] == b"hello"
+            assert bridge.send(opened, b"world")
+            header = sock.recv(4)
+            assert int.from_bytes(header, "big") == 5
+            assert sock.recv(5) == b"world"
+        # client hangup surfaces as CLOSE
+        deadline = time.monotonic() + 10
+        closed = False
+        while time.monotonic() < deadline and not closed:
+            event = bridge.poll()
+            if event is not None and event[1] == 2:
+                closed = True
+            else:
+                time.sleep(0.005)
+        assert closed
+    finally:
+        bridge.stop()
+
+
+def test_full_client_stack_over_bridge():
+    """The network driver speaks to the C++ front door unchanged."""
+    service = RouterliciousService()
+    front = BridgeFrontDoor(service)
+    try:
+        factory = TinyliciousDocumentServiceFactory(port=front.port)
+        svc1 = factory("bdoc")
+        c1 = Container.create_detached(svc1)
+        ds = c1.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        with svc1.dispatch_lock:
+            c1.attach()
+            ds.get_channel("root").set("k", "via-bridge")
+        deadline = time.monotonic() + 30
+        while (c1.runtime.pending.has_pending
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert not c1.runtime.pending.has_pending
+
+        svc2 = factory("bdoc")
+        c2 = Container.load(svc2)
+        with svc2.dispatch_lock:
+            got = (c2.runtime.get_datastore("default")
+                   .get_channel("root").get("k"))
+        assert got == "via-bridge"
+
+        # Cross-client live broadcast through the native transport.
+        with svc1.dispatch_lock:
+            ds.get_channel("root").set("k2", 7)
+
+        def remote():
+            with svc2.dispatch_lock:
+                return (c2.runtime.get_datastore("default")
+                        .get_channel("root").get("k2"))
+        deadline = time.monotonic() + 30
+        while remote() != 7 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert remote() == 7
+        svc1.close()
+        svc2.close()
+    finally:
+        front.close()
+
+
+def test_bridge_standalone_service():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.server.bridge_host",
+         "--port", "0", "--no-merge-host"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("READY "), (line, proc.stderr.read())
+        port = int(line.split()[1])
+        factory = TinyliciousDocumentServiceFactory(port=port)
+        svc = factory("sdoc")
+        container = Container.create_detached(svc)
+        ds = container.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        with svc.dispatch_lock:
+            container.attach()
+            ds.get_channel("root").set("x", 1)
+        deadline = time.monotonic() + 60
+        while (container.runtime.pending.has_pending
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert not container.runtime.pending.has_pending
+        svc.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
