@@ -307,7 +307,7 @@ def _gen_matrix_stream(rng: random.Random, n_ops: int) -> list[dict]:
     return ops
 
 
-def bench_matrix(num_docs: int = 4096, k: int = 32, ticks: int = 6) -> dict:
+def bench_matrix(num_docs: int = 16384, k: int = 32, ticks: int = 6) -> dict:
     import jax.numpy as jnp
 
     from fluidframework_tpu.ops import matrix_kernel as mxk

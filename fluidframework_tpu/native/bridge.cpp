@@ -30,6 +30,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -70,7 +71,11 @@ struct Bridge {
     std::map<int64_t, std::unique_ptr<Conn>> conns;
     int64_t next_conn = 1;
     std::deque<Event> events;
-    std::vector<std::thread> reapers;
+    // Detached per-close reapers; stop() waits for the count to drain
+    // before freeing the Bridge (their Conn readers touch b->events).
+    std::mutex reap_mu;
+    std::condition_variable reap_cv;
+    int live_reapers = 0;
 };
 
 bool read_exact(int fd, char* buf, size_t n) {
@@ -140,6 +145,8 @@ void accept_loop(Bridge* b) {
         int fd = ::accept(b->listen_fd, nullptr, nullptr);
         if (fd < 0) {
             if (b->stopping.load()) return;
+            // EMFILE etc.: back off instead of spinning a core.
+            std::this_thread::sleep_for(std::chrono::milliseconds(10));
             continue;
         }
         int one = 1;
@@ -248,14 +255,22 @@ int bridge_close(void* handle, int64_t conn) {
         b->conns.erase(it);
     }
     // Joining reader/writer can block on in-flight IO; do it off the
-    // caller's thread so the Python pump never stalls.
-    Bridge* braw = b;
+    // caller's thread (detached) so the Python pump never stalls and no
+    // unjoined thread accumulates per disconnect.
     Conn* craw = owned.release();
-    std::lock_guard<std::mutex> lock(braw->mu);
-    braw->reapers.emplace_back([craw] {
+    {
+        std::lock_guard<std::mutex> lock(b->reap_mu);
+        ++b->live_reapers;
+    }
+    std::thread([b, craw] {
         shutdown_conn(craw);
         delete craw;
-    });
+        {
+            std::lock_guard<std::mutex> lock(b->reap_mu);
+            --b->live_reapers;
+        }
+        b->reap_cv.notify_all();
+    }).detach();
     return 0;
 }
 
@@ -271,12 +286,10 @@ void bridge_stop(void* handle) {
         conns.swap(b->conns);
     }
     for (auto& entry : conns) shutdown_conn(entry.second.get());
-    std::vector<std::thread> reapers;
     {
-        std::lock_guard<std::mutex> lock(b->mu);
-        reapers.swap(b->reapers);
+        std::unique_lock<std::mutex> lock(b->reap_mu);
+        b->reap_cv.wait(lock, [b] { return b->live_reapers == 0; });
     }
-    for (auto& t : reapers) t.join();
     delete b;
 }
 

@@ -38,11 +38,17 @@ def _load_library() -> ctypes.CDLL | None:
                     or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
                 _BUILD_DIR.mkdir(exist_ok=True)
                 tmp = _BUILD_DIR / f"libbridge.{os.getpid()}.tmp.so"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-pthread",
-                     str(_SRC), "-o", str(tmp)],
-                    check=True, capture_output=True, timeout=120)
-                tmp.replace(_LIB)
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-pthread",
+                         str(_SRC), "-o", str(tmp)],
+                        check=True, capture_output=True, timeout=120)
+                    tmp.replace(_LIB)
+                except (OSError, subprocess.SubprocessError):
+                    # No toolchain but a previously built .so may still
+                    # be loadable (checkout mtimes are not ordered).
+                    if not _LIB.exists():
+                        raise
             lib = ctypes.CDLL(str(_LIB))
         except (OSError, subprocess.SubprocessError):
             _lib_failed = True
@@ -75,6 +81,8 @@ class NativeBridge:
         self.port = int(lib.bridge_port(handle))
 
     def poll(self) -> tuple[int, int, bytes] | None:
+        if not self._handle:
+            return None
         size = self._lib.bridge_next_size(self._handle)
         if size < 0:
             return None
@@ -86,11 +94,14 @@ class NativeBridge:
         return conn, kind, buf.raw[12:got]
 
     def send(self, conn: int, body: bytes) -> bool:
+        if not self._handle:
+            return False
         return self._lib.bridge_send(self._handle, conn, body,
                                      len(body)) == 0
 
     def close_conn(self, conn: int) -> None:
-        self._lib.bridge_close(self._handle, conn)
+        if self._handle:
+            self._lib.bridge_close(self._handle, conn)
 
     def stop(self) -> None:
         if self._handle:

@@ -205,7 +205,7 @@ _CELL_FILL = {"cell_rh": -1, "cell_ch": -1, "cell_val": 0, "cell_seq": 0,
 
 @functools.partial(jax.jit, static_argnames=("block_docs", "interpret"))
 def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
-                      block_docs: int = 32,
+                      block_docs: int = 64,
                       interpret: bool = False) -> MatrixState:
     """Drop-in replacement for :func:`matrix_kernel.apply_tick`."""
     b, s = state.rows.length.shape

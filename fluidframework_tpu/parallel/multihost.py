@@ -1,0 +1,94 @@
+"""Multi-host scale-out — the DCN side of the device mesh (SURVEY §5.8).
+
+Reference analog: the reference scales its ordering service over many
+Node processes with Kafka partitions assigning documents to consumers;
+here the same assignment is the document axis of a process-spanning
+``jax.sharding.Mesh``. ICI carries nothing on the merge path (per-doc
+independence, see :mod:`.mesh`); DCN carries (a) the op streams each
+host feeds to its own chips and (b) jax.distributed's control plane.
+
+The serving recipe per host:
+
+1. ``initialize(...)`` once per process (coordinator address, process
+   count, process id — e.g. from the launcher env). Single-process
+   deployments skip it (returns False).
+2. ``global_mesh()`` — the docs-axis mesh over EVERY process's devices.
+3. ``local_docs(mesh, num_docs)`` — the contiguous row range this
+   process is responsible for; the front door / bus partitions route
+   exactly those documents here (the Kafka partition-assignment analog).
+4. Build op batches for those rows only and lift them to global arrays
+   with ``feed(mesh, tree)`` — each host supplies its shard, no
+   cross-host data movement.
+5. Run the jitted tick on the global arrays; outputs stay sharded.
+
+Everything here is exercised single-process by tests (the degenerate
+1-host mesh and the virtual 8-device CPU mesh); the multi-host paths go
+through the same addressable-shard APIs jax defines for both cases.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .mesh import DOCS_AXIS, doc_sharding, make_mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """jax.distributed.initialize for multi-process serving; no-op (False)
+    for single-process deployments."""
+    if not num_processes or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    return True
+
+
+def global_mesh() -> jax.sharding.Mesh:
+    """Docs-axis mesh over every device of every process."""
+    return make_mesh(jax.devices())
+
+
+def local_docs(mesh: jax.sharding.Mesh, num_docs: int) -> tuple[int, int]:
+    """[start, stop) of the document rows THIS process feeds and owns.
+
+    Derived from the sharding's addressable shard indices, so it is
+    correct for any process→device assignment jax reports — single
+    process (full range), or one slice per host in a multi-host mesh.
+    """
+    sharding = doc_sharding(mesh)
+    index_map = sharding.addressable_devices_indices_map((num_docs,))
+    starts = []
+    stops = []
+    for index in index_map.values():
+        doc_slice = index[0]
+        start = doc_slice.start if doc_slice.start is not None else 0
+        stop = doc_slice.stop if doc_slice.stop is not None else num_docs
+        starts.append(start)
+        stops.append(stop)
+    low, high = min(starts), max(stops)
+    # Document ownership must be contiguous for the front door's range
+    # routing; jax lays a 1-D mesh out in order, so it is.
+    span = sorted(zip(starts, stops))
+    cursor = low
+    for start, stop in span:
+        assert start <= cursor, "non-contiguous local doc shards"
+        cursor = max(cursor, stop)
+    return low, high
+
+
+def feed(mesh: jax.sharding.Mesh, tree):
+    """Lift per-host numpy arrays (this host's doc rows) into globally
+    sharded jax arrays — the DCN feed boundary. Each process passes ONLY
+    its ``local_docs`` rows; jax assembles the logical [B, ...] array
+    without moving rows between hosts."""
+    sharding = doc_sharding(mesh)
+
+    def lift(local):
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(local))
+
+    return jax.tree.map(lift, tree)

@@ -46,28 +46,19 @@ async def read_frame(reader: asyncio.StreamReader) -> Any:
     return decode_body(await reader.readexactly(length))
 
 
-class _ClientSession:
-    """One socket = one (doc, client) session, mirroring the reference's
-    per-socket connection state (alfred index.ts:278)."""
+class RequestSession:
+    """One connection = one (doc, client) session, mirroring the
+    reference's per-socket connection state (alfred index.ts:278).
+    Transport-agnostic: subclasses own ``push`` (asyncio writer here,
+    the native bridge in server.bridge_host)."""
 
-    def __init__(self, server: "AlfredServer",
-                 writer: asyncio.StreamWriter) -> None:
+    def __init__(self, server) -> None:
         self.server = server
-        self.writer = writer
-        self.outbox: asyncio.Queue = asyncio.Queue()
         self.connection = None  # service-side live connection
         self.doc_id: str | None = None
 
     def push(self, payload: dict) -> None:
-        self.outbox.put_nowait(payload)
-
-    async def writer_loop(self) -> None:
-        while True:
-            payload = await self.outbox.get()
-            if payload is None:
-                break
-            self.writer.write(encode_frame(payload))
-            await self.writer.drain()
+        raise NotImplementedError
 
     def handle_request(self, req: dict) -> dict:
         """Dispatch one request synchronously against the service."""
@@ -180,6 +171,27 @@ class _ClientSession:
         claims = self.server.tenants.validate_token(token)
         if ScopeType.AGENT not in claims.get("scopes", ()):
             raise AuthError("agent scope required")
+
+
+class _ClientSession(RequestSession):
+    """RequestSession over an asyncio stream writer."""
+
+    def __init__(self, server: "AlfredServer",
+                 writer: asyncio.StreamWriter) -> None:
+        super().__init__(server)
+        self.writer = writer
+        self.outbox: asyncio.Queue = asyncio.Queue()
+
+    def push(self, payload: dict) -> None:
+        self.outbox.put_nowait(payload)
+
+    async def writer_loop(self) -> None:
+        while True:
+            payload = await self.outbox.get()
+            if payload is None:
+                break
+            self.writer.write(encode_frame(payload))
+            await self.writer.drain()
 
 
 class AlfredServer:

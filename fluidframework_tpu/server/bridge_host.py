@@ -24,19 +24,15 @@ from typing import Any
 from ..native.bridge import EV_CLOSE, EV_DATA, EV_OPEN, start_bridge
 from ..protocol.codec import decode_body, encode_body
 from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
-from .alfred import _ClientSession
+from .alfred import RequestSession
 
 
-class _BridgeSession(_ClientSession):
-    """Alfred session whose outbox is the native bridge connection."""
+class _BridgeSession(RequestSession):
+    """Alfred request session whose outbox is the native bridge."""
 
     def __init__(self, server: "BridgeFrontDoor", conn_id: int) -> None:
-        # Deliberately skip _ClientSession.__init__ (no asyncio writer);
-        # mirror its state.
-        self.server = server
+        super().__init__(server)
         self.conn_id = conn_id
-        self.connection = None
-        self.doc_id = None
 
     def push(self, payload: dict) -> None:
         if payload is None:
