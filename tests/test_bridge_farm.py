@@ -1,0 +1,126 @@
+"""Randomized convergence farm over the C++ bridge front door: concurrent
+edits + disconnect/offline-edit/reconnect churn across real sockets — the
+reconnectFarm shape (client.reconnectFarm.spec.ts) at the transport level."""
+
+from __future__ import annotations
+
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.drivers.network_driver import NetworkDocumentService
+from fluidframework_tpu.native.bridge import _load_library
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.tools.replay import canonical
+
+pytestmark = pytest.mark.skipif(
+    _load_library() is None, reason="no C++ toolchain for the bridge")
+
+
+@pytest.fixture(scope="module")
+def bridge_port():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.server.bridge_host",
+         "--port", "0", "--no-merge-host"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("READY "), (line, proc.stderr.read())
+        yield int(line.split()[1])
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def _wait(services, predicate, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        locks = [svc.dispatch_lock for svc in services]
+        for lock in locks:
+            lock.acquire()
+        try:
+            if predicate():
+                return
+        finally:
+            for lock in reversed(locks):
+                lock.release()
+        time.sleep(0.03)
+    raise AssertionError("farm did not converge in time")
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_bridge_reconnect_farm(bridge_port, seed):
+    rng = random.Random(seed)
+    doc_id = f"farm-{seed}"
+    svc0 = NetworkDocumentService("127.0.0.1", bridge_port, doc_id)
+    c0 = Container.create_detached(svc0)
+    ds = c0.runtime.create_datastore("default")
+    ds.create_channel("root", SharedMap.channel_type)
+    ds.create_channel("text", SharedString.channel_type)
+    with svc0.dispatch_lock:
+        c0.attach()
+
+    services = [svc0]
+    containers = [c0]
+    for _ in range(2):
+        svc = NetworkDocumentService("127.0.0.1", bridge_port, doc_id)
+        with svc.dispatch_lock:
+            containers.append(Container.load(svc))
+        services.append(svc)
+
+    def parts(c):
+        datastore = c.runtime.get_datastore("default")
+        return (datastore.get_channel("root"),
+                datastore.get_channel("text"))
+
+    offline: set[int] = set()
+    for _round in range(8):
+        for i, c in enumerate(containers):
+            svc = services[i]
+            with svc.dispatch_lock:
+                root, text = parts(c)
+                r = rng.random()
+                if r < 0.15 and i not in offline and i != 0:
+                    c.disconnect()
+                    offline.add(i)
+                elif r < 0.3 and i in offline:
+                    c.reconnect()
+                    offline.discard(i)
+                elif r < 0.7:
+                    root.set(f"k{rng.randrange(8)}", rng.randrange(100))
+                else:
+                    n = len(text.get_text())
+                    if n > 6 and rng.random() < 0.4:
+                        start = rng.randrange(n - 2)
+                        text.remove_text(start,
+                                         start + rng.randint(1, 2))
+                    else:
+                        text.insert_text(rng.randint(0, n),
+                                         rng.choice(["ab", "Z", "xyz"]))
+    for i in sorted(offline):
+        with services[i].dispatch_lock:
+            containers[i].reconnect()
+
+    # Summary equality is folded into the locked predicate: checking it
+    # after _wait releases the dispatch locks would race a trailing
+    # in-flight broadcast applied to only some containers.
+    def converged():
+        texts = [parts(c)[1].get_text() for c in containers]
+        roots = [dict(parts(c)[0].items()) for c in containers]
+        seqs = [c.delta_manager.last_processed_seq for c in containers]
+        pending = [c.runtime.pending.has_pending for c in containers]
+        if not (all(t == texts[0] for t in texts)
+                and all(r == roots[0] for r in roots)
+                and len(set(seqs)) == 1 and not any(pending)):
+            return False
+        summaries = [canonical(c.summarize()) for c in containers]
+        return summaries[0] == summaries[1] == summaries[2]
+
+    _wait(services, converged)
+    for svc in services:
+        svc.close()
