@@ -80,6 +80,31 @@ def _gather_lane(x: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.sum(jnp.where(lane == idx, x, 0), axis=-1, keepdims=True)
 
 
+class LanePrims:
+    """Single-device primitives: the segment axis is whole on the chip.
+    mergetree_sharded swaps in collective twins (distributed prefix sums,
+    ppermute edge rolls) to shard the SEGMENT axis across a mesh — the
+    long-document sequence-parallel path. merge_apply_vec is written
+    against this interface so both paths share one semantic source."""
+
+    @staticmethod
+    def lane_iota(shape: tuple) -> jax.Array:
+        """Global segment index along the last axis."""
+        return jax.lax.broadcasted_iota(I32, shape, len(shape) - 1)
+
+    excl_cumsum = staticmethod(_excl_cumsum)
+    first_true = staticmethod(_first_true)
+    gather = staticmethod(_gather_lane)
+
+    @staticmethod
+    def any_(mask: jax.Array) -> jax.Array:
+        return jnp.any(mask, axis=-1, keepdims=True)
+
+    @staticmethod
+    def roll(field: jax.Array, shift: int) -> jax.Array:
+        return pltpu.roll(field, shift=shift, axis=field.ndim - 1)
+
+
 def _vis_len(p: dict, ref_seq, client):
     validb = p["valid"] != 0
     ins_vis = validb & ((p["ins_seq"] <= ref_seq)
@@ -91,31 +116,33 @@ def _vis_len(p: dict, ref_seq, client):
     return jnp.where(ins_vis & ~removed_vis, p["length"], 0)
 
 
-def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict):
+def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict,
+                    prims=LanePrims):
     """One sequenced op per doc, vectorized over the doc (sublane) axis.
 
     ``p`` maps plane name → [D, S] i32; ``prop`` is [P, D, S]; ``count`` is
     [D, 1]; op fields are [D, 1]. Mirrors mergetree_kernel._apply_op with
     per-doc scalars as [D, 1] columns. Returns (planes', prop', count').
+    ``prims`` supplies the segment-axis primitives (LanePrims docstring).
     """
-    lane = jax.lax.broadcasted_iota(I32, p["length"].shape, 1)
+    lane = prims.lane_iota(p["length"].shape)
     opvalid = op["valid"] != 0
     is_insert = op["kind"] == MT_INSERT
     is_remove = op["kind"] == MT_REMOVE
 
     vis = _vis_len(p, op["ref_seq"], op["client"])
-    cum = _excl_cumsum(vis)
+    cum = prims.excl_cumsum(vis)
 
     p1 = op["pos"]
     p2 = jnp.where(is_insert, I32(-1), op["end"])
     in1 = (cum < p1) & (p1 < cum + vis)
     in2 = (cum < p2) & (p2 < cum + vis) & (p2 != p1)
-    i1 = _first_true(in1)
-    i2 = _first_true(in2)
-    has1 = jnp.any(in1, axis=-1, keepdims=True)
-    has2 = jnp.any(in2, axis=-1, keepdims=True)
-    o1 = p1 - _gather_lane(cum, i1)
-    o2 = p2 - _gather_lane(cum, i2)
+    i1 = prims.first_true(in1)
+    i2 = prims.first_true(in2)
+    has1 = prims.any_(in1)
+    has2 = prims.any_(in2)
+    o1 = p1 - prims.gather(cum, i1)
+    o2 = p2 - prims.gather(cum, i2)
     same = has1 & has2 & (i1 == i2)
     t1 = i1 + 1
     t2 = i2 + 1 + jnp.where(has1 & (i1 <= i2), 1, 0)
@@ -126,16 +153,16 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict):
     shift1 = has1 & (lane >= t1)
 
     def sh1(field):
-        return jnp.where(shift1, pltpu.roll(field, shift=1, axis=field.ndim - 1), field)
+        return jnp.where(shift1, prims.roll(field, 1), field)
 
     # Mosaic only rotates 32-bit lanes, so the skip mask rolls as int32.
     skip = ((p["valid"] == 0) | ((p["rem_seq"] != NONE_SEQ)
                                  & (p["rem_seq"] <= op["ref_seq"])))
     cum_post = jnp.where(has1 & (lane == t1), p1, sh1(cum))
     candidate = (cum_post == p1) & (sh1(skip.astype(I32)) == 0)
-    has_cand = jnp.any(candidate, axis=-1, keepdims=True)
+    has_cand = prims.any_(candidate)
     count_post = count + has1.astype(I32)
-    tp = jnp.where(has_cand, _first_true(candidate), count_post)
+    tp = jnp.where(has_cand, prims.first_true(candidate), count_post)
 
     placedf = tp
     t1f = jnp.where(is_insert & (tp <= t1), t1 + 1, t1)
@@ -145,8 +172,8 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict):
              + (gate_b & (lane >= point_b)).astype(I32))
 
     def shifted(field):
-        r1 = pltpu.roll(field, shift=1, axis=field.ndim - 1)
-        r2 = pltpu.roll(field, shift=2, axis=field.ndim - 1)
+        r1 = prims.roll(field, 1)
+        r2 = prims.roll(field, 2)
         cond0 = shift == 0
         cond1 = shift == 1
         if field.ndim == 3:  # [P, D, S] prop planes
