@@ -162,11 +162,11 @@ class _MergePool:
     def release(self, row: int) -> None:
         """Blank a device row and recycle its index."""
         self.members[row] = None
-        self.state = mtk.MergeState(**{
+        self.state = self.place(mtk.MergeState(**{
             f: (getattr(self.state, f).at[row].set(
                 _MERGE_FILL[f]) if f != "prop_val"
                 else self.state.prop_val.at[row].set(0))
-            for f in mtk.MergeState._fields})
+            for f in mtk.MergeState._fields}))
         self.text.chunks[row] = []
         self.text.used[row] = 0
         self.free.append(row)
@@ -174,9 +174,9 @@ class _MergePool:
     def _grow_rows(self) -> None:
         old = self.capacity
         self.capacity = old * 2
-        self.state = jax.device_put(mtk.MergeState(**{
+        self.state = self.place(jax.device_put(mtk.MergeState(**{
             f: _pad_axis(getattr(self.state, f), 0, old, _MERGE_FILL[f])
-            for f in mtk.MergeState._fields}))
+            for f in mtk.MergeState._fields})))
         self.text.chunks += [[] for _ in range(old)]
         self.text.used += [0] * old
         # members stays shorter than capacity; alloc() grows it by append
@@ -188,8 +188,8 @@ class _MergePool:
         if new == self.num_props:
             return
         extra = new - self.num_props
-        self.state = self.state._replace(prop_val=jnp.asarray(
-            _pad_axis(self.state.prop_val, 2, extra, 0)))
+        self.state = self.place(self.state._replace(prop_val=jnp.asarray(
+            _pad_axis(self.state.prop_val, 2, extra, 0))))
         self.num_props = new
 
     def row_arrays(self, row: int) -> dict[str, np.ndarray]:
@@ -199,9 +199,46 @@ class _MergePool:
 
     def write_row(self, row: int, arrays: dict[str, np.ndarray]) -> None:
         """Install planes (padded by the caller) into a row."""
-        self.state = mtk.MergeState(**{
+        self.state = self.place(mtk.MergeState(**{
             f: getattr(self.state, f).at[row].set(arrays[f])
-            for f in mtk.MergeState._fields})
+            for f in mtk.MergeState._fields}))
+
+    # -- device-dispatch hooks (overridden by the sharded pool) ---------------
+
+    def apply(self, batch: mtk.MergeOpBatch) -> mtk.MergeState:
+        return mtp.apply_tick_best(self.state, batch)
+
+    def compact_state(self, min_seq) -> mtk.MergeState:
+        return mtk.compact(self.state, min_seq)
+
+    def place(self, state: mtk.MergeState) -> mtk.MergeState:
+        return state
+
+
+class _ShardedMergePool(_MergePool):
+    """A bucket whose SEGMENT axis is sharded over a device mesh — the
+    serving home for documents too large for one chip's table
+    (ops/mergetree_sharded.py, the sequence-parallel path). Everything
+    else about the pool (rows, text, migration) is inherited; device
+    dispatch goes through the collective kernel and every host-side
+    rebuild is re-placed with the segment sharding."""
+
+    def __init__(self, slots: int, num_props: int, mesh,
+                 row_capacity: int = 1) -> None:
+        from ..ops import mergetree_sharded as mts
+        self._mts = mts
+        self.mesh = mesh
+        super().__init__(slots, num_props, row_capacity)
+        self.state = self.place(self.state)
+
+    def apply(self, batch: mtk.MergeOpBatch) -> mtk.MergeState:
+        return self._mts.apply_tick_sharded(self.state, batch, self.mesh)
+
+    def compact_state(self, min_seq) -> mtk.MergeState:
+        return self.place(mtk.compact(self.state, min_seq))
+
+    def place(self, state: mtk.MergeState) -> mtk.MergeState:
+        return self._mts.shard_merge_state(state, self.mesh)
 
 
 class KernelMergeHost:
@@ -209,9 +246,26 @@ class KernelMergeHost:
 
     def __init__(self, merge_slots: int = 128, map_slots: int = 32,
                  num_props: int = 4, row_capacity: int = 8,
-                 flush_threshold: int = 256, metrics=None) -> None:
+                 flush_threshold: int = 256, metrics=None,
+                 seg_mesh=None, sharded_slot_threshold: int = 65536) -> None:
         from ..utils import MetricsRegistry
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # Sequence-parallel escape hatch: documents whose segment tables
+        # outgrow one chip migrate into pools whose SEGMENT axis is
+        # sharded over ``seg_mesh`` (ops/mergetree_sharded.py) instead of
+        # growing a single-chip table without bound. Misconfiguration
+        # must fail HERE, not at the first flush mid-serving: pool slot
+        # counts are powers of two, so the mesh size must be one too, and
+        # every shard needs >= 2 slots.
+        self.seg_mesh = seg_mesh
+        if seg_mesh is not None:
+            n_shards = seg_mesh.devices.size
+            assert n_shards & (n_shards - 1) == 0, (
+                f"seg_mesh size {n_shards} must be a power of two "
+                "(pool slot counts are)")
+            sharded_slot_threshold = max(sharded_slot_threshold,
+                                         2 * n_shards)
+        self.sharded_slot_threshold = max(8, sharded_slot_threshold)
         self._row_capacity = max(1, row_capacity)
         self._map_capacity = max(1, row_capacity)
         self._merge_slots = max(8, merge_slots)  # smallest bucket size
@@ -262,7 +316,13 @@ class KernelMergeHost:
         slots = max(_next_pow2(slots), self._merge_slots)
         pool = self._merge_pools.get(slots)
         if pool is None:
-            pool = _MergePool(slots, self._num_props, self._row_capacity)
+            if (self.seg_mesh is not None
+                    and slots >= self.sharded_slot_threshold):
+                pool = _ShardedMergePool(slots, self._num_props,
+                                         self.seg_mesh)
+            else:
+                pool = _MergePool(slots, self._num_props,
+                                  self._row_capacity)
             self._merge_pools[slots] = pool
         return pool
 
@@ -691,7 +751,7 @@ class KernelMergeHost:
                 for r in pool.members:
                     if r is not None and short[r.row]:
                         min_seq[r.row] = r.min_seq
-                pool.state = mtk.compact(pool.state, jnp.asarray(min_seq))
+                pool.state = pool.compact_state(jnp.asarray(min_seq))
                 self.stats["compactions"] += 1
                 still = need > mtk.capacity_margin(pool.state)
                 for r in pool_rows:
@@ -714,7 +774,7 @@ class KernelMergeHost:
             for r in pool_rows:
                 per_doc[r.row] = r.pending
             batch = mtk.make_merge_op_batch(per_doc, pool.capacity, k)
-            pool.state = mtp.apply_tick_best(pool.state, batch)
+            pool.state = pool.apply(batch)
             self.stats["device_ops"] += sum(
                 len(r.pending) for r in pool_rows)
             for r in pool_rows:

@@ -1,0 +1,55 @@
+"""Huge-document serving through the sequence-parallel pool: a document
+whose segment table outgrows the single-chip buckets migrates into a
+_ShardedMergePool (segment axis over the virtual mesh) and keeps serving
+— device text still byte-identical to every replica."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.ops.mergetree_sharded import make_seg_mesh
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.merge_host import KernelMergeHost, _ShardedMergePool
+from tests.test_mergetree import get_string, make_string_doc, random_edit
+
+
+def test_huge_doc_migrates_to_sharded_pool(cpu_mesh_devices):
+    mesh = make_seg_mesh(cpu_mesh_devices)
+    # Tiny buckets + low threshold so the migration happens at test scale:
+    # merge_slots=16, anything needing >= 64 slots goes sequence-parallel.
+    host = KernelMergeHost(merge_slots=16, seg_mesh=mesh,
+                           sharded_slot_threshold=64)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_string_doc(server, "huge")
+    c2 = Container.load(LocalDocumentService(server, "huge"))
+
+    rng = random.Random(3)
+    for _ in range(120):
+        random_edit(rng, get_string(c1 if rng.random() < 0.5 else c2))
+    host.flush()
+
+    t1 = get_string(c1).get_text()
+    assert t1 == get_string(c2).get_text()
+    assert host.text("huge", "default", "text") == t1
+
+    key = next(iter(host._merge_rows))
+    row = host._merge_rows[key]
+    assert isinstance(row.pool, _ShardedMergePool), (
+        f"doc stayed in a {row.pool.slots}-slot single-chip pool")
+    # The serving state is genuinely distributed over the mesh.
+    devices = {s.device for s in row.pool.state.length.addressable_shards}
+    assert len(devices) == len(cpu_mesh_devices)
+    assert host.stats["migrations"] >= 1
+
+    # And the sharded pool keeps serving subsequent edits.
+    for _ in range(20):
+        random_edit(rng, get_string(c1))
+    host.flush()
+    t1 = get_string(c1).get_text()
+    assert get_string(c2).get_text() == t1
+    assert host.text("huge", "default", "text") == t1
