@@ -18,7 +18,6 @@ differentially tested against the scalar oracle
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

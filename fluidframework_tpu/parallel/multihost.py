@@ -31,7 +31,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from .mesh import DOCS_AXIS, doc_sharding, make_mesh
+from .mesh import doc_sharding, make_mesh
 
 
 def initialize(coordinator_address: str | None = None,

@@ -31,7 +31,7 @@ from typing import Any
 from ..native import OpLog
 from ..protocol.codec import from_wire, register_codec, to_wire
 from ..protocol.messages import MessageType
-from .bus import BusMessage, MessageBus, Topic, partition_for
+from .bus import BusMessage, MessageBus, Topic
 from .sequencer import RawOperation
 
 # -- RawOperation over the wire/journal ---------------------------------------
