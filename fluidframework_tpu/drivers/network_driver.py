@@ -114,6 +114,20 @@ class NetworkDocumentService:
         self.events = TypedEventEmitter()  # "disconnect" on socket loss
 
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        # The timeout above covers connection ESTABLISHMENT only. Left in
+        # place it would also bound the reader thread's recv, tearing the
+        # connection down after `timeout` seconds of idle (no inbound
+        # broadcasts) — RPC timeouts are enforced at the response queue in
+        # _request, so recv must block indefinitely. Sends stay bounded
+        # via SO_SNDTIMEO (kernel-level, independent of the Python socket
+        # timeout): a peer that stops reading must not wedge _send_lock
+        # holders forever.
+        self._sock.settimeout(None)
+        import struct as _struct
+        self._sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            _struct.pack("ll", int(timeout),
+                         int((timeout % 1.0) * 1_000_000)))
         self._send_lock = threading.Lock()
         self._rid = itertools.count(1)
         self._pending: dict[int, queue.Queue] = {}

@@ -17,60 +17,40 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
-import threading
 import zlib
 from pathlib import Path
 
+from ._loader import build_and_load
+
 _SRC = Path(__file__).parent / "oplog.cpp"
-_BUILD_DIR = Path(__file__).parent / "_build"
-_LIB = _BUILD_DIR / "liboplog.so"
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_lib_failed = False
+_configured: ctypes.CDLL | None = None
 
 
 def _load_library() -> ctypes.CDLL | None:
-    global _lib, _lib_failed
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if (not _LIB.exists()
-                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
-                _BUILD_DIR.mkdir(exist_ok=True)
-                # Build to a process-unique temp path and publish
-                # atomically: a concurrent process must never CDLL a
-                # half-written .so (which would also poison the mtime
-                # check forever).
-                tmp = _BUILD_DIR / f"liboplog.{os.getpid()}.tmp.so"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", str(_SRC),
-                     "-o", str(tmp), "-lz"],
-                    check=True, capture_output=True, timeout=120)
-                tmp.replace(_LIB)
-            lib = ctypes.CDLL(str(_LIB))
-        except (OSError, subprocess.SubprocessError):
-            _lib_failed = True
-            return None
-        lib.oplog_open.restype = ctypes.c_void_p
-        lib.oplog_open.argtypes = [ctypes.c_char_p]
-        lib.oplog_count.restype = ctypes.c_long
-        lib.oplog_count.argtypes = [ctypes.c_void_p]
-        lib.oplog_append.restype = ctypes.c_long
-        lib.oplog_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                     ctypes.c_uint32]
-        lib.oplog_sync.restype = ctypes.c_int
-        lib.oplog_sync.argtypes = [ctypes.c_void_p]
-        lib.oplog_read_len.restype = ctypes.c_long
-        lib.oplog_read_len.argtypes = [ctypes.c_void_p, ctypes.c_long]
-        lib.oplog_read.restype = ctypes.c_long
-        lib.oplog_read.argtypes = [ctypes.c_void_p, ctypes.c_long,
-                                   ctypes.c_char_p, ctypes.c_uint32]
-        lib.oplog_close.restype = None
-        lib.oplog_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    global _configured
+    if _configured is not None:
+        return _configured
+    lib = build_and_load("oplog", _SRC, extra_flags=("-lz",))
+    if lib is None:
+        return None
+    lib.oplog_open.restype = ctypes.c_void_p
+    lib.oplog_open.argtypes = [ctypes.c_char_p]
+    lib.oplog_count.restype = ctypes.c_long
+    lib.oplog_count.argtypes = [ctypes.c_void_p]
+    lib.oplog_append.restype = ctypes.c_long
+    lib.oplog_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint32]
+    lib.oplog_sync.restype = ctypes.c_int
+    lib.oplog_sync.argtypes = [ctypes.c_void_p]
+    lib.oplog_read_len.restype = ctypes.c_long
+    lib.oplog_read_len.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.oplog_read.restype = ctypes.c_long
+    lib.oplog_read.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                               ctypes.c_char_p, ctypes.c_uint32]
+    lib.oplog_close.restype = None
+    lib.oplog_close.argtypes = [ctypes.c_void_p]
+    _configured = lib
+    return _configured
 
 
 class _NativeOpLog:

@@ -15,11 +15,20 @@
 //
 //   bridge_start(port) -> handle          bridge_port(handle)
 //   bridge_next_size(handle)              size of next event payload
+//   bridge_poll_wait(handle, timeout_ms)  block until an event is queued;
+//       returns its size, or -3 on timeout (cv wait, no busy polling)
 //   bridge_poll(handle, buf, cap)         -> [conn:8B][kind:4B][body...]
 //       kind: 0 = OPEN, 1 = DATA (body = one frame), 2 = CLOSE
 //   bridge_send(handle, conn, data, len)  enqueue one framed body
+//       (0 ok, -1 unknown/closing, -2 outbox full — caller should close)
 //   bridge_close(handle, conn)            server-side disconnect
 //   bridge_stop(handle)
+//
+// Backpressure: a connection whose decoded frames pile up faster than
+// the host pump drains them (kMaxInboundQueue) is dropped, and a peer
+// that stops reading until kMaxOutbox responses queue up gets -2 from
+// bridge_send — mirroring socket.io/Redis adapter slow-consumer drops;
+// kMaxFrame alone only bounds a single frame.
 //
 // Exposed as a C ABI for ctypes (bridge.py).
 
@@ -45,6 +54,8 @@
 namespace {
 
 constexpr uint32_t kMaxFrame = 64u * 1024u * 1024u;
+constexpr size_t kMaxInboundQueue = 8192;  // decoded frames per conn
+constexpr size_t kMaxOutbox = 8192;        // queued responses per conn
 
 struct Event {
     int64_t conn;
@@ -67,10 +78,12 @@ struct Bridge {
     int port = 0;
     std::atomic<bool> stopping{false};
     std::thread acceptor;
-    std::mutex mu;  // guards conns, events
+    std::mutex mu;  // guards conns, events, inbound_depth
+    std::condition_variable events_cv;
     std::map<int64_t, std::unique_ptr<Conn>> conns;
     int64_t next_conn = 1;
     std::deque<Event> events;
+    std::map<int64_t, size_t> inbound_depth;  // queued DATA events per conn
     // Detached per-close reapers; stop() waits for the count to drain
     // before freeing the Bridge (their Conn readers touch b->events).
     std::mutex reap_mu;
@@ -109,11 +122,21 @@ void reader_loop(Bridge* b, int64_t id, int fd) {
         if (len > kMaxFrame) break;
         std::string body(len, '\0');
         if (len && !read_exact(fd, &body[0], len)) break;
-        std::lock_guard<std::mutex> lock(b->mu);
-        b->events.push_back(Event{id, 1, std::move(body)});
+        {
+            std::lock_guard<std::mutex> lock(b->mu);
+            // Backpressure: drop the connection rather than buffer a
+            // sender that outruns the pump without bound.
+            if (b->inbound_depth[id] >= kMaxInboundQueue) break;
+            ++b->inbound_depth[id];
+            b->events.push_back(Event{id, 1, std::move(body)});
+        }
+        b->events_cv.notify_one();
     }
-    std::lock_guard<std::mutex> lock(b->mu);
-    b->events.push_back(Event{id, 2, std::string()});
+    {
+        std::lock_guard<std::mutex> lock(b->mu);
+        b->events.push_back(Event{id, 2, std::string()});
+    }
+    b->events_cv.notify_one();
 }
 
 void writer_loop(Conn* c) {
@@ -160,6 +183,7 @@ void accept_loop(Bridge* b) {
         raw->writer = std::thread(writer_loop, raw);
         b->conns[id] = std::move(conn);
         b->events.push_back(Event{id, 0, std::string()});
+        b->events_cv.notify_one();
     }
 }
 
@@ -213,6 +237,15 @@ int64_t bridge_next_size(void* handle) {
     return static_cast<int64_t>(12 + b->events.front().body.size());
 }
 
+int64_t bridge_poll_wait(void* handle, int timeout_ms) {
+    Bridge* b = static_cast<Bridge*>(handle);
+    std::unique_lock<std::mutex> lock(b->mu);
+    b->events_cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                          [b] { return !b->events.empty(); });
+    if (b->events.empty()) return -3;
+    return static_cast<int64_t>(12 + b->events.front().body.size());
+}
+
 int64_t bridge_poll(void* handle, char* buf, int64_t cap) {
     Bridge* b = static_cast<Bridge*>(handle);
     std::lock_guard<std::mutex> lock(b->mu);
@@ -224,6 +257,13 @@ int64_t bridge_poll(void* handle, char* buf, int64_t cap) {
     std::memcpy(buf + 8, &event.kind, 4);
     if (!event.body.empty())
         std::memcpy(buf + 12, event.body.data(), event.body.size());
+    if (event.kind == 1) {
+        auto depth = b->inbound_depth.find(event.conn);
+        if (depth != b->inbound_depth.end() && depth->second > 0)
+            --depth->second;
+    } else if (event.kind == 2) {
+        b->inbound_depth.erase(event.conn);
+    }
     b->events.pop_front();
     return need;
 }
@@ -238,6 +278,7 @@ int bridge_send(void* handle, int64_t conn, const char* data,
     {
         std::lock_guard<std::mutex> out_lock(c->out_mu);
         if (c->closing) return -1;
+        if (c->outbox.size() >= kMaxOutbox) return -2;
         c->outbox.emplace_back(data, len);
     }
     c->out_cv.notify_one();

@@ -10,18 +10,13 @@ unavailable (callers then use the asyncio alfred server).
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
-import subprocess
-import threading
 from pathlib import Path
 
+from ._loader import build_and_load
+
 _SRC = Path(__file__).parent / "bridge.cpp"
-_BUILD_DIR = Path(__file__).parent / "_build"
-_LIB = _BUILD_DIR / "libbridge.so"
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_lib_failed = False
+_configured: ctypes.CDLL | None = None
 
 EV_OPEN = 0
 EV_DATA = 1
@@ -29,47 +24,31 @@ EV_CLOSE = 2
 
 
 def _load_library() -> ctypes.CDLL | None:
-    global _lib, _lib_failed
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if (not _LIB.exists()
-                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
-                _BUILD_DIR.mkdir(exist_ok=True)
-                tmp = _BUILD_DIR / f"libbridge.{os.getpid()}.tmp.so"
-                try:
-                    subprocess.run(
-                        ["g++", "-O2", "-shared", "-fPIC", "-pthread",
-                         str(_SRC), "-o", str(tmp)],
-                        check=True, capture_output=True, timeout=120)
-                    tmp.replace(_LIB)
-                except (OSError, subprocess.SubprocessError):
-                    # No toolchain but a previously built .so may still
-                    # be loadable (checkout mtimes are not ordered).
-                    if not _LIB.exists():
-                        raise
-            lib = ctypes.CDLL(str(_LIB))
-        except (OSError, subprocess.SubprocessError):
-            _lib_failed = True
-            return None
-        lib.bridge_start.restype = ctypes.c_void_p
-        lib.bridge_start.argtypes = [ctypes.c_int]
-        lib.bridge_port.restype = ctypes.c_int
-        lib.bridge_port.argtypes = [ctypes.c_void_p]
-        lib.bridge_next_size.restype = ctypes.c_int64
-        lib.bridge_next_size.argtypes = [ctypes.c_void_p]
-        lib.bridge_poll.restype = ctypes.c_int64
-        lib.bridge_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                    ctypes.c_int64]
-        lib.bridge_send.restype = ctypes.c_int
-        lib.bridge_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                    ctypes.c_char_p, ctypes.c_uint32]
-        lib.bridge_close.restype = ctypes.c_int
-        lib.bridge_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.bridge_stop.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    global _configured
+    if _configured is not None:
+        return _configured
+    lib = build_and_load("bridge", _SRC)
+    if lib is None:
+        return None
+    lib.bridge_start.restype = ctypes.c_void_p
+    lib.bridge_start.argtypes = [ctypes.c_int]
+    lib.bridge_port.restype = ctypes.c_int
+    lib.bridge_port.argtypes = [ctypes.c_void_p]
+    lib.bridge_next_size.restype = ctypes.c_int64
+    lib.bridge_next_size.argtypes = [ctypes.c_void_p]
+    lib.bridge_poll.restype = ctypes.c_int64
+    lib.bridge_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+    lib.bridge_poll_wait.restype = ctypes.c_int64
+    lib.bridge_poll_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bridge_send.restype = ctypes.c_int
+    lib.bridge_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                ctypes.c_char_p, ctypes.c_uint32]
+    lib.bridge_close.restype = ctypes.c_int
+    lib.bridge_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.bridge_stop.argtypes = [ctypes.c_void_p]
+    _configured = lib
+    return _configured
 
 
 class NativeBridge:
@@ -80,10 +59,15 @@ class NativeBridge:
         self._handle = handle
         self.port = int(lib.bridge_port(handle))
 
-    def poll(self) -> tuple[int, int, bytes] | None:
+    def poll(self, wait_ms: int = 0) -> tuple[int, int, bytes] | None:
+        """Pop the next event; with wait_ms > 0 block until one arrives
+        (condition variable in the C++ side — no busy polling)."""
         if not self._handle:
             return None
-        size = self._lib.bridge_next_size(self._handle)
+        if wait_ms > 0:
+            size = self._lib.bridge_poll_wait(self._handle, wait_ms)
+        else:
+            size = self._lib.bridge_next_size(self._handle)
         if size < 0:
             return None
         buf = ctypes.create_string_buffer(int(size))
@@ -96,8 +80,12 @@ class NativeBridge:
     def send(self, conn: int, body: bytes) -> bool:
         if not self._handle:
             return False
-        return self._lib.bridge_send(self._handle, conn, body,
-                                     len(body)) == 0
+        rc = self._lib.bridge_send(self._handle, conn, body, len(body))
+        if rc == -2:
+            # Peer stopped reading and its outbox is full: drop it
+            # (slow-consumer backpressure) instead of buffering forever.
+            self.close_conn(conn)
+        return rc == 0
 
     def close_conn(self, conn: int) -> None:
         if self._handle:

@@ -20,8 +20,14 @@
 #include <set>
 #include <string>
 #include <mutex>
+#include <vector>
 
 namespace {
+
+// Slow-consumer bound: a subscriber that never polls is evicted once
+// this many payloads queue up (the socket.io Redis adapter analog drops
+// slow clients rather than buffering without bound).
+constexpr size_t kMaxQueue = 65536;
 
 struct Fanout {
     std::mutex mu;
@@ -30,7 +36,24 @@ struct Fanout {
     std::map<int64_t, std::deque<std::string>> queues;
     std::map<std::string, std::set<int64_t>> rooms;
     std::map<int64_t, std::set<std::string>> memberships;
+    std::set<int64_t> evicted;
 };
+
+// Caller holds f->mu.
+void drop_subscriber(Fanout* f, int64_t sub) {
+    auto member_it = f->memberships.find(sub);
+    if (member_it != f->memberships.end()) {
+        for (const std::string& room : member_it->second) {
+            auto room_it = f->rooms.find(room);
+            if (room_it != f->rooms.end()) {
+                room_it->second.erase(sub);
+                if (room_it->second.empty()) f->rooms.erase(room_it);
+            }
+        }
+        f->memberships.erase(member_it);
+    }
+    f->queues.erase(sub);
+}
 
 }  // namespace
 
@@ -51,20 +74,12 @@ int64_t fanout_connect(void* handle) {
 int fanout_disconnect(void* handle, int64_t sub) {
     Fanout* f = static_cast<Fanout*>(handle);
     std::lock_guard<std::mutex> lock(f->mu);
-    auto queue_it = f->queues.find(sub);
-    if (queue_it == f->queues.end()) return -1;
-    auto member_it = f->memberships.find(sub);
-    if (member_it != f->memberships.end()) {
-        for (const std::string& room : member_it->second) {
-            auto room_it = f->rooms.find(room);
-            if (room_it != f->rooms.end()) {
-                room_it->second.erase(sub);
-                if (room_it->second.empty()) f->rooms.erase(room_it);
-            }
-        }
-        f->memberships.erase(member_it);
-    }
-    f->queues.erase(queue_it);
+    // An evicted sub's queue is already gone; its disconnect must still
+    // succeed and clear the eviction flag (else the set grows forever).
+    bool was_evicted = f->evicted.erase(sub) > 0;
+    if (f->queues.find(sub) == f->queues.end())
+        return was_evicted ? 0 : -1;
+    drop_subscriber(f, sub);
     return 0;
 }
 
@@ -101,14 +116,30 @@ int64_t fanout_publish(void* handle, const char* room, uint32_t room_len,
     if (room_it == f->rooms.end()) return 0;
     std::string payload(data, data_len);
     int64_t count = 0;
+    std::vector<int64_t> over;
     for (int64_t sub : room_it->second) {
         auto queue_it = f->queues.find(sub);
         if (queue_it == f->queues.end()) continue;
+        if (queue_it->second.size() >= kMaxQueue) {
+            over.push_back(sub);
+            continue;
+        }
         queue_it->second.push_back(payload);
         ++count;
     }
+    for (int64_t sub : over) {
+        drop_subscriber(f, sub);
+        f->evicted.insert(sub);
+    }
     f->delivered += count;
     return count;
+}
+
+// 1 if the subscriber was dropped for slow consumption, else 0.
+int fanout_was_evicted(void* handle, int64_t sub) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    return f->evicted.count(sub) ? 1 : 0;
 }
 
 int64_t fanout_pending(void* handle, int64_t sub) {

@@ -12,65 +12,53 @@ with the identical surface otherwise.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 from collections import deque
 from pathlib import Path
 
+from ._loader import build_and_load
+
 _SRC = Path(__file__).parent / "fanout.cpp"
-_BUILD_DIR = Path(__file__).parent / "_build"
-_LIB = _BUILD_DIR / "libfanout.so"
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_lib_failed = False
+_configured: ctypes.CDLL | None = None
+
+#: Slow-consumer bound, mirrored in fanout.cpp's kMaxQueue.
+MAX_QUEUE = 65536
 
 
 def _load_library() -> ctypes.CDLL | None:
-    global _lib, _lib_failed
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if (not _LIB.exists()
-                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
-                _BUILD_DIR.mkdir(exist_ok=True)
-                tmp = _BUILD_DIR / f"libfanout.{os.getpid()}.tmp.so"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", str(_SRC),
-                     "-o", str(tmp)],
-                    check=True, capture_output=True, timeout=120)
-                tmp.replace(_LIB)
-            lib = ctypes.CDLL(str(_LIB))
-        except (OSError, subprocess.SubprocessError):
-            _lib_failed = True
-            return None
-        lib.fanout_create.restype = ctypes.c_void_p
-        lib.fanout_destroy.argtypes = [ctypes.c_void_p]
-        lib.fanout_connect.restype = ctypes.c_int64
-        lib.fanout_connect.argtypes = [ctypes.c_void_p]
-        lib.fanout_disconnect.restype = ctypes.c_int
-        lib.fanout_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        for name in ("fanout_join", "fanout_leave"):
-            fn = getattr(lib, name)
-            fn.restype = ctypes.c_int
-            fn.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                           ctypes.c_char_p, ctypes.c_uint32]
-        lib.fanout_publish.restype = ctypes.c_int64
-        lib.fanout_publish.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
-            ctypes.c_char_p, ctypes.c_uint32]
-        lib.fanout_pending.restype = ctypes.c_int64
-        lib.fanout_pending.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.fanout_next_size.restype = ctypes.c_int64
-        lib.fanout_next_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.fanout_poll.restype = ctypes.c_int64
-        lib.fanout_poll.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                    ctypes.c_char_p, ctypes.c_int64]
-        lib.fanout_delivered_total.restype = ctypes.c_int64
-        lib.fanout_delivered_total.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    global _configured
+    if _configured is not None:
+        return _configured
+    lib = build_and_load("fanout", _SRC)
+    if lib is None:
+        return None
+    lib.fanout_create.restype = ctypes.c_void_p
+    lib.fanout_destroy.argtypes = [ctypes.c_void_p]
+    lib.fanout_connect.restype = ctypes.c_int64
+    lib.fanout_connect.argtypes = [ctypes.c_void_p]
+    lib.fanout_disconnect.restype = ctypes.c_int
+    lib.fanout_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    for name in ("fanout_join", "fanout_leave"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                       ctypes.c_char_p, ctypes.c_uint32]
+    lib.fanout_publish.restype = ctypes.c_int64
+    lib.fanout_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32]
+    lib.fanout_pending.restype = ctypes.c_int64
+    lib.fanout_pending.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.fanout_next_size.restype = ctypes.c_int64
+    lib.fanout_next_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.fanout_poll.restype = ctypes.c_int64
+    lib.fanout_poll.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                ctypes.c_char_p, ctypes.c_int64]
+    lib.fanout_delivered_total.restype = ctypes.c_int64
+    lib.fanout_delivered_total.argtypes = [ctypes.c_void_p]
+    lib.fanout_was_evicted.restype = ctypes.c_int
+    lib.fanout_was_evicted.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    _configured = lib
+    return _configured
 
 
 class NativeFanout:
@@ -115,12 +103,24 @@ class NativeFanout:
         size = self._lib.fanout_next_size(self._handle, sub)
         if size < 0:  # -1 unknown sub, -2 empty queue
             return None
-        # size may be 0 (empty payloads are legal and must still drain).
-        buf = ctypes.create_string_buffer(max(int(size), 1))
-        written = self._lib.fanout_poll(self._handle, sub, buf, len(buf))
-        if written < 0:
-            return None
-        return buf.raw[:written]
+        while True:
+            # size may be 0 (empty payloads are legal and must still drain).
+            buf = ctypes.create_string_buffer(max(int(size), 1))
+            written = self._lib.fanout_poll(self._handle, sub, buf, len(buf))
+            if written == -2:
+                # Head grew between next_size and poll (another producer
+                # appended and a concurrent consumer popped): the message
+                # is retained — re-size and retry rather than wedging.
+                size = self._lib.fanout_next_size(self._handle, sub)
+                if size < 0:
+                    return None
+                continue
+            if written < 0:  # -1 unknown sub, -3 drained meanwhile
+                return None
+            return buf.raw[:written]
+
+    def was_evicted(self, sub: int) -> bool:
+        return bool(self._lib.fanout_was_evicted(self._handle, sub))
 
     def delivered_total(self) -> int:
         return int(self._lib.fanout_delivered_total(self._handle))
@@ -137,6 +137,7 @@ class PyFanout:
         self._rooms: dict[str, set[int]] = {}
         self._memberships: dict[int, set[str]] = {}
         self._delivered = 0
+        self._evicted: set[int] = set()
 
     def connect(self) -> int:
         sub = self._next
@@ -152,6 +153,7 @@ class PyFanout:
                 if not members:
                     del self._rooms[room]
         self._queues.pop(sub, None)
+        self._evicted.discard(sub)
 
     def join(self, sub: int, room: str) -> None:
         if sub not in self._queues:
@@ -165,9 +167,16 @@ class PyFanout:
 
     def publish(self, room: str, payload: bytes) -> int:
         count = 0
+        over = []
         for sub in self._rooms.get(room, ()):  # set order is fine: queues
+            if len(self._queues[sub]) >= MAX_QUEUE:
+                over.append(sub)
+                continue
             self._queues[sub].append(payload)  # are per-subscriber FIFO
             count += 1
+        for sub in over:  # slow-consumer eviction, mirroring fanout.cpp
+            self.disconnect(sub)
+            self._evicted.add(sub)
         self._delivered += count
         return count
 
@@ -179,6 +188,9 @@ class PyFanout:
         if not queue:
             return None
         return queue.popleft()
+
+    def was_evicted(self, sub: int) -> bool:
+        return sub in self._evicted
 
     def delivered_total(self) -> int:
         return self._delivered

@@ -11,67 +11,50 @@ pure-Python bus.
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
-import subprocess
-import threading
 from pathlib import Path
 
+from ._loader import build_and_load
+
 _SRC = Path(__file__).parent / "shuttle.cpp"
-_BUILD_DIR = Path(__file__).parent / "_build"
-_LIB = _BUILD_DIR / "libshuttle.so"
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_lib_failed = False
+_configured: ctypes.CDLL | None = None
 
 
 def _load_library() -> ctypes.CDLL | None:
-    global _lib, _lib_failed
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if (not _LIB.exists()
-                    or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
-                _BUILD_DIR.mkdir(exist_ok=True)
-                tmp = _BUILD_DIR / f"libshuttle.{os.getpid()}.tmp.so"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", str(_SRC),
-                     "-o", str(tmp), "-lz"],
-                    check=True, capture_output=True, timeout=120)
-                tmp.replace(_LIB)
-            lib = ctypes.CDLL(str(_LIB))
-        except (OSError, subprocess.SubprocessError):
-            _lib_failed = True
-            return None
-        lib.shuttle_create.restype = ctypes.c_void_p
-        lib.shuttle_create.argtypes = [ctypes.c_int]
-        lib.shuttle_num_partitions.restype = ctypes.c_int
-        lib.shuttle_num_partitions.argtypes = [ctypes.c_void_p]
-        lib.shuttle_produce.restype = ctypes.c_int64
-        lib.shuttle_produce.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
-            ctypes.c_char_p, ctypes.c_uint32,
-            ctypes.POINTER(ctypes.c_int)]
-        lib.shuttle_count.restype = ctypes.c_int64
-        lib.shuttle_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.shuttle_read_size.restype = ctypes.c_int64
-        lib.shuttle_read_size.argtypes = [ctypes.c_void_p, ctypes.c_int,
-                                          ctypes.c_int64, ctypes.c_int64]
-        lib.shuttle_read.restype = ctypes.c_int64
-        lib.shuttle_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
-                                     ctypes.c_int64, ctypes.c_int64,
-                                     ctypes.c_char_p, ctypes.c_int64]
-        lib.shuttle_committed.restype = ctypes.c_int64
-        lib.shuttle_committed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                          ctypes.c_int]
-        lib.shuttle_commit.restype = ctypes.c_int
-        lib.shuttle_commit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                       ctypes.c_int, ctypes.c_int64]
-        lib.shuttle_destroy.restype = None
-        lib.shuttle_destroy.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    global _configured
+    if _configured is not None:
+        return _configured
+    lib = build_and_load("shuttle", _SRC, extra_flags=("-lz",))
+    if lib is None:
+        return None
+    lib.shuttle_create.restype = ctypes.c_void_p
+    lib.shuttle_create.argtypes = [ctypes.c_int]
+    lib.shuttle_num_partitions.restype = ctypes.c_int
+    lib.shuttle_num_partitions.argtypes = [ctypes.c_void_p]
+    lib.shuttle_produce.restype = ctypes.c_int64
+    lib.shuttle_produce.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.shuttle_count.restype = ctypes.c_int64
+    lib.shuttle_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.shuttle_read_size.restype = ctypes.c_int64
+    lib.shuttle_read_size.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_int64, ctypes.c_int64]
+    lib.shuttle_read.restype = ctypes.c_int64
+    lib.shuttle_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                 ctypes.c_int64, ctypes.c_int64,
+                                 ctypes.c_char_p, ctypes.c_int64]
+    lib.shuttle_committed.restype = ctypes.c_int64
+    lib.shuttle_committed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+    lib.shuttle_commit.restype = ctypes.c_int
+    lib.shuttle_commit.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_int64]
+    lib.shuttle_destroy.restype = None
+    lib.shuttle_destroy.argtypes = [ctypes.c_void_p]
+    _configured = lib
+    return _configured
 
 
 def shuttle_available() -> bool:

@@ -60,6 +60,10 @@ class RequestSession:
     def push(self, payload: dict) -> None:
         raise NotImplementedError
 
+    def drop(self) -> None:
+        """Close this session's transport (service-initiated disconnect,
+        e.g. slow-consumer eviction). Subclasses owning a socket override."""
+
     def handle_request(self, req: dict) -> dict:
         """Dispatch one request synchronously against the service."""
         service = self.server.service
@@ -96,6 +100,7 @@ class RequestSession:
                                               "signal": s}),
                 **kwargs)
             self.server.metrics.counter("alfred.connects").inc()
+            self.connection.on_closed = self.drop
             return {"rid": rid, "client_id": self.connection.client_id}
         if op == "submit":
             if self.server.throttler is not None:
@@ -192,6 +197,16 @@ class _ClientSession(RequestSession):
                 break
             self.writer.write(encode_frame(payload))
             await self.writer.drain()
+
+    def drop(self) -> None:
+        # Runs on the event-loop thread (service pumps happen inside
+        # handle_request): closing the transport unblocks the session's
+        # read_frame, whose teardown path finishes the cleanup.
+        self.push(None)
+        try:
+            self.writer.close()
+        except RuntimeError:
+            pass  # loop already torn down
 
 
 class AlfredServer:

@@ -39,6 +39,11 @@ class _BridgeSession(RequestSession):
             return
         self.server._bridge.send(self.conn_id, encode_body(payload))
 
+    def drop(self) -> None:
+        # Service-initiated disconnect: close the native connection; the
+        # resulting EV_CLOSE finishes session cleanup in the pump.
+        self.server._bridge.close_conn(self.conn_id)
+
 
 class BridgeFrontDoor:
     """Pumps bridge events through the alfred request dispatch."""
@@ -66,9 +71,10 @@ class BridgeFrontDoor:
 
     def _pump_loop(self) -> None:
         while not self._stop.is_set():
-            event = self._bridge.poll()
+            # Blocking poll (cv in the C++ side): no busy-wait, and the
+            # bounded timeout keeps close() responsive.
+            event = self._bridge.poll(wait_ms=50)
             if event is None:
-                time.sleep(0.001)
                 continue
             try:
                 self._dispatch(*event)

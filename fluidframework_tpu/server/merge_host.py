@@ -260,9 +260,12 @@ class KernelMergeHost:
         self.seg_mesh = seg_mesh
         if seg_mesh is not None:
             n_shards = seg_mesh.devices.size
-            assert n_shards & (n_shards - 1) == 0, (
-                f"seg_mesh size {n_shards} must be a power of two "
-                "(pool slot counts are)")
+            if n_shards & (n_shards - 1) != 0:
+                # ValueError, not assert: python -O must not defer this
+                # to the first sharded flush mid-serving.
+                raise ValueError(
+                    f"seg_mesh size {n_shards} must be a power of two "
+                    "(pool slot counts are)")
             sharded_slot_threshold = max(sharded_slot_threshold,
                                          2 * n_shards)
         self.sharded_slot_threshold = max(8, sharded_slot_threshold)

@@ -302,6 +302,10 @@ class _LiveConnection:
     on_signal: Callable[[Any], None] | None = None
     open: bool = True
     mode: str = "write"
+    #: Transport hook set by the owning front-door session: invoked when
+    #: the SERVICE closes the connection (e.g. slow-consumer eviction) so
+    #: the client's socket actually drops and its reconnect path runs.
+    on_closed: Callable[[], None] | None = None
 
     def submit(self, messages: list[DocumentMessage]) -> None:
         assert self.open, "submit on closed connection"
@@ -727,6 +731,15 @@ class RouterliciousService:
         from ..protocol.codec import from_wire
         delivered = 0
         for (doc_id, client_id), sub in list(self._fanout_subs.items()):
+            if self.fanout.was_evicted(sub):
+                # Slow-consumer drop in the fan-out: the sub will never
+                # receive again, so close the connection (the client's
+                # reconnect path resyncs from the durable log) instead of
+                # leaving it silently deaf.
+                self.logger.send_event("FanoutSubscriberEvicted",
+                                       docId=doc_id, clientId=client_id)
+                self.disconnect(doc_id, client_id)
+                continue
             batch: list[SequencedDocumentMessage] = []
             last_key = (doc_id, client_id)
             while (payload := self.fanout.poll(sub)) is not None:
@@ -786,6 +799,17 @@ class RouterliciousService:
                 self.fanout.disconnect(sub)
             self._fanout_last_seq.pop((doc_id, client_id), None)
         connection = self._connections_for(doc_id).pop(client_id, None)
+        if connection is not None and connection.open:
+            # Service-initiated close (the client-initiated path flips
+            # `open` before calling us): mark it dead so further submits
+            # fail fast, and drop the owning transport so the client sees
+            # a real disconnect instead of going silently deaf.
+            connection.open = False
+            if connection.on_closed is not None:
+                try:
+                    connection.on_closed()
+                except Exception as err:
+                    self.logger.send_error("ConnectionDropFailed", err)
         self.logger.send_event("ClientDisconnect", docId=doc_id,
                                clientId=client_id)
         if connection is not None and connection.mode == "read":

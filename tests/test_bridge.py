@@ -109,6 +109,32 @@ def test_full_client_stack_over_bridge():
         front.close()
 
 
+def test_service_initiated_disconnect_drops_transport():
+    """A server-side disconnect (e.g. slow-consumer eviction) must close
+    the client's socket — not leave it connected but silently deaf."""
+    service = RouterliciousService()
+    front = BridgeFrontDoor(service)
+    try:
+        factory = TinyliciousDocumentServiceFactory(port=front.port)
+        svc = factory("dropdoc")
+        c = Container.create_detached(svc)
+        ds = c.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        with svc.dispatch_lock:
+            c.attach()
+        client_id = c.delta_manager.client_id
+        assert client_id is not None
+        dropped = []
+        svc.events.on("disconnect", lambda: dropped.append(True))
+        service.disconnect("dropdoc", client_id)
+        deadline = time.monotonic() + 15
+        while not dropped and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert dropped, "client never observed the server-side drop"
+    finally:
+        front.close()
+
+
 def test_bridge_standalone_service():
     proc = subprocess.Popen(
         [sys.executable, "-m", "fluidframework_tpu.server.bridge_host",

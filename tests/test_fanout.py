@@ -80,6 +80,34 @@ class TestFanoutCore:
         assert fanout.delivered_total() == before + 2
 
 
+@pytest.mark.parametrize("fanout", _impls(),
+                         ids=lambda f: "native" if f.is_native else "python")
+def test_slow_consumer_evicted(fanout):
+    # A subscriber that never polls is dropped once MAX_QUEUE payloads
+    # queue up (socket.io Redis-adapter slow-client semantics) instead of
+    # buffering without bound; healthy subscribers are untouched.
+    from fluidframework_tpu.native import fanout as fanout_mod
+    slow = fanout.connect()
+    ok = fanout.connect()
+    fanout.join(slow, "room")
+    fanout.join(ok, "room")
+    limit = fanout_mod.MAX_QUEUE
+    for i in range(limit + 2):
+        fanout.publish("room", b"p")
+        if fanout.poll(ok) is None:  # ok drains as it goes
+            raise AssertionError("healthy subscriber starved")
+    assert fanout.was_evicted(slow)
+    assert not fanout.was_evicted(ok)
+    assert fanout.poll(slow) is None
+    # The room still works for the healthy subscriber.
+    assert fanout.publish("room", b"tail") == 1
+    assert fanout.poll(ok) == b"tail"
+    # Disconnecting the evicted sub succeeds and clears the flag (the
+    # eviction set must not grow forever).
+    fanout.disconnect(slow)
+    assert not fanout.was_evicted(slow)
+
+
 def test_native_fanout_builds_here():
     # This image has the toolchain; the native path must actually build
     # (elsewhere make_fanout falls back to the Python twin).

@@ -122,6 +122,25 @@ class TestCrossProcess:
         svc1.close()
         svc2.close()
 
+    def test_idle_connection_survives_socket_timeout(self, alfred_port):
+        # The constructor timeout covers connection establishment and RPC
+        # waits only — it must NOT double as a recv timeout that kills an
+        # idle connection (no broadcasts for `timeout` seconds) from the
+        # reader thread.
+        svc = NetworkDocumentService("127.0.0.1", alfred_port, "idledoc",
+                                     timeout=1.0)
+        c = Container.create_detached(svc)
+        ds = c.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        with svc.dispatch_lock:
+            c.attach()
+        root = c.runtime.get_datastore("default").get_channel("root")
+        time.sleep(2.0)  # > timeout with no inbound traffic
+        with svc.dispatch_lock:
+            root.set("alive", True)  # would raise ConnectionError pre-fix
+        wait_until(lambda: root.get("alive") is True, timeout=5)
+        svc.close()
+
     def test_signals_cross_process(self, alfred_port):
         doc_id = "sigdoc"
         svc1 = NetworkDocumentService("127.0.0.1", alfred_port, doc_id)
