@@ -123,11 +123,10 @@ class NetworkDocumentService:
         # timeout): a peer that stops reading must not wedge _send_lock
         # holders forever.
         self._sock.settimeout(None)
-        import struct as _struct
         self._sock.setsockopt(
             socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-            _struct.pack("ll", int(timeout),
-                         int((timeout % 1.0) * 1_000_000)))
+            struct.pack("ll", int(timeout),
+                        int((timeout % 1.0) * 1_000_000)))
         self._send_lock = threading.Lock()
         self._rid = itertools.count(1)
         self._pending: dict[int, queue.Queue] = {}

@@ -16,7 +16,8 @@ import threading
 from pathlib import Path
 
 _BUILD_DIR = Path(__file__).parent / "_build"
-_lock = threading.Lock()
+_lock = threading.Lock()  # guards _cache and _name_locks only
+_name_locks: dict[str, threading.Lock] = {}
 _cache: dict[str, ctypes.CDLL | None] = {}
 
 
@@ -27,9 +28,17 @@ def build_and_load(name: str, src: Path,
     Returns None when the toolchain is unavailable and no matching
     artifact exists; callers fall back to their pure-Python twins.
     """
+    # Per-name locks: compiles of unrelated libraries (bridge vs shuttle,
+    # possibly from different threads at startup) must not serialize
+    # behind one global lock for the duration of a g++ run.
     with _lock:
         if name in _cache:
             return _cache[name]
+        name_lock = _name_locks.setdefault(name, threading.Lock())
+    with name_lock:
+        with _lock:
+            if name in _cache:
+                return _cache[name]
         try:
             source = src.read_bytes()
             digest = hashlib.sha256(source).hexdigest()[:16]
@@ -53,7 +62,7 @@ def build_and_load(name: str, src: Path,
                             pass
             lib = ctypes.CDLL(str(lib_path))
         except (OSError, subprocess.SubprocessError):
-            _cache[name] = None
-            return None
-        _cache[name] = lib
+            lib = None
+        with _lock:
+            _cache[name] = lib
         return lib

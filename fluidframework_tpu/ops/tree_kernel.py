@@ -53,6 +53,17 @@ TREE_INSERT_AFTER = 4    # op.parent = reference sibling slot
 TREE_INSERT_START = 5    # prepend at trait start (op.parent, op.trait)
 TREE_CONSTRAINT_EXISTS = 6  # valid iff op.node exists; no mutation
 TREE_CONSTRAINT_COUNT = 7   # valid iff |children(op.parent, op.trait)| == op.payload
+# Subtree move: the scalar detach(destination)+insert(source) pair fused
+# into ONE atomic op — the whole subtree keeps its internal structure and
+# only the root's (parent, trait, rank) changes. Placement flavours mirror
+# the insert kinds; validity additionally requires the destination NOT be
+# inside the moved subtree (the scalar's detached-anchor rejection:
+# _resolve_place refuses anchors whose parent chain no longer reaches
+# root once the source is detached — tree_core.py:115).
+TREE_MOVE = 8            # move to trait end (op.parent, op.trait)
+TREE_MOVE_BEFORE = 9     # op.parent = reference sibling slot
+TREE_MOVE_AFTER = 10     # op.parent = reference sibling slot
+TREE_MOVE_START = 11     # move to trait start (op.parent, op.trait)
 
 # Rank spacing for appends/prepends; midpoint inserts between two adjacent
 # ranks survive log2(GAP)=16 splits before the host must re-rank.
@@ -60,11 +71,12 @@ RANK_GAP = 1 << 16
 # Appends past this magnitude flag overflow instead of risking i32 wrap.
 RANK_LIMIT = 1 << 30
 
-# Detach propagates removal down the tree one level per pass, so trees up
-# to this depth converge; the serving host routes deeper docs to the scalar
-# path. Linear passes of a one-hot parent matvec beat pointer-doubling
-# gathers on TPU: XLA lowers 1-D dynamic gathers to slow serial loads,
-# while the [N, N] one-hot contraction rides the MXU.
+# Detach/move propagate the subtree mask down one level per pass, so trees
+# up to this depth converge; a mask still growing at the cap raises the
+# op's ``overflow`` flag (op not applied) so the serving host reroutes the
+# channel to the scalar path. Linear passes of a one-hot parent matvec
+# beat pointer-doubling gathers on TPU: XLA lowers 1-D dynamic gathers to
+# slow serial loads, while the [N, N] one-hot contraction rides the MXU.
 MAX_DEPTH_PASSES = 32
 
 
@@ -116,7 +128,16 @@ def _apply_op(s: TreeState, op):
     is_start = op.kind == TREE_INSERT_START
     is_cexists = op.kind == TREE_CONSTRAINT_EXISTS
     is_ccount = op.kind == TREE_CONSTRAINT_COUNT
-    is_sibling_rel = is_before | is_after
+    is_move_end = op.kind == TREE_MOVE
+    is_move_before = op.kind == TREE_MOVE_BEFORE
+    is_move_after = op.kind == TREE_MOVE_AFTER
+    is_move_start = op.kind == TREE_MOVE_START
+    is_move = is_move_end | is_move_before | is_move_after | is_move_start
+    place_end = is_end | is_move_end
+    place_start = is_start | is_move_start
+    place_before = is_before | is_move_before
+    place_after = is_after | is_move_after
+    is_sibling_rel = place_before | place_after
     is_insert = is_end | is_before | is_after | is_start
 
     # Resolve the destination (parent, trait): sibling-relative placements
@@ -143,13 +164,13 @@ def _apply_op(s: TreeState, op):
     start_rank = jnp.where(has_sibs, min_r - RANK_GAP, 0)
     before_rank = (prev_r + r_s) // 2
     after_rank = (r_s + next_r) // 2
-    new_rank = jnp.where(is_end, end_rank,
-                         jnp.where(is_start, start_rank,
-                                   jnp.where(is_before, before_rank,
+    new_rank = jnp.where(place_end, end_rank,
+                         jnp.where(place_start, start_rank,
+                                   jnp.where(place_before, before_rank,
                                              after_rank)))
     gap_ok = (jnp.abs(new_rank) < RANK_LIMIT) & jnp.where(
-        is_before, (before_rank > prev_r) & (before_rank < r_s),
-        jnp.where(is_after, (after_rank > r_s) & (after_rank < next_r),
+        place_before, (before_rank > prev_r) & (before_rank < r_s),
+        jnp.where(place_after, (after_rank > r_s) & (after_rank < next_r),
                   True))
 
     sib_exists = s.exists[anchor] & (op.parent > 0) & (op.parent < n)
@@ -157,53 +178,80 @@ def _apply_op(s: TreeState, op):
     insert_would = op.valid & is_insert & anchor_ok & ~node_exists \
         & (op.node != 0) & (op.node >= 0) & (op.node < n)
     insert_ok = insert_would & gap_ok
-    overflow = insert_would & ~gap_ok
 
-    ccount_ok = parent_exists & (sib_count == op.payload)
     # Unknown slots must be rejected, not clip-aliased onto slot n-1; and
     # the root is not a valid constraint anchor (scalar _resolve_place
     # rejects referenceSibling == ROOT_ID).
     node_ok = node_exists & (op.node >= 0) & (op.node < n)
-    ok = op.valid & jnp.where(
-        is_insert, insert_ok,
-        jnp.where(is_cexists, node_ok & (op.node != 0),
-                  jnp.where(is_ccount, ccount_ok,
-                            node_ok & jnp.where(is_detach,
-                                                op.node != 0, True))))
-
-    # set_value
     target = lanes == node
-    payload = jnp.where(target & ok & is_set, op.payload, s.payload)
 
-    # detach: drop node + all descendants. Each pass marks children of
-    # already-marked nodes via a one-hot parent matvec on the MXU:
-    # hit[i] = removed[parent[i]] = (parent[i] == j) . removed[j].
-    # The while_loop exits as soon as the removal set stops growing, so a
-    # non-detach op (empty seed) costs one pass and a detach costs
-    # subtree-depth passes — not the worst-case bound.
+    # Subtree mask of op.node (detach removal set / move cycle check).
+    # Each pass marks children of already-marked nodes via a one-hot
+    # parent matvec on the MXU: hit[i] = marked[parent[i]]
+    # = (parent[i] == j) . marked[j]. The while_loop exits as soon as the
+    # set stops growing, so a non-detach/non-move op (empty seed) costs
+    # one pass and a real one costs subtree-depth passes.
     parent_onehot = (s.parent[:, None] == lanes[None, :]).astype(jnp.bfloat16)
-    seed = target & ok & is_detach
+    seed = target & op.valid & node_ok & (op.node != 0) \
+        & (is_detach | is_move)
 
     def not_converged(carry):
-        _removed, changed, passes = carry
+        _marked, changed, passes = carry
         return changed & (passes < MAX_DEPTH_PASSES)
 
     def grow(carry):
-        removed, _, passes = carry
-        hit = (parent_onehot @ removed.astype(jnp.bfloat16)) > 0
-        new = removed | hit
-        return new, jnp.any(new != removed), passes + 1
+        marked, _, passes = carry
+        hit = (parent_onehot @ marked.astype(jnp.bfloat16)) > 0
+        new = marked | hit
+        return new, jnp.any(new != marked), passes + 1
 
-    removed, _, _ = jax.lax.while_loop(
+    marked, still_growing, _ = jax.lax.while_loop(
         not_converged, grow, (seed, jnp.any(seed), 0))
-    exists = s.exists & ~removed
+    # The mask was still growing when the pass cap hit: it may be missing
+    # deeper descendants, so the op must NOT apply (an incomplete detach
+    # leaves orphans; an incomplete cycle check lets a move create a
+    # parent loop). Flagged as overflow so the serving host's existing
+    # overflow→scalar routing covers depth the same way it covers rank
+    # exhaustion.
+    depth_blown = still_growing
 
-    # insert (any flavour)
+    # Move validity: destination anchored OUTSIDE the moved subtree (a
+    # sibling anchor inside it — including the node itself — or a trait
+    # parent inside it is the scalar's detached-destination INVALID).
+    dest_in_sub = jnp.where(is_sibling_rel, marked[anchor],
+                            marked[jnp.clip(ins_parent, 0, n - 1)])
+    move_would = op.valid & is_move & node_ok & (op.node != 0) \
+        & anchor_ok & ~dest_in_sub
+    move_ok = move_would & gap_ok & ~depth_blown
+    detach_would = op.valid & is_detach & node_ok & (op.node != 0)
+    overflow = ((insert_would | move_would) & ~gap_ok) \
+        | ((detach_would | move_would) & depth_blown)
+
+    ccount_ok = parent_exists & (sib_count == op.payload)
+    ok = op.valid & jnp.where(
+        is_insert, insert_ok,
+        jnp.where(is_move, move_ok,
+                  jnp.where(is_cexists, node_ok & (op.node != 0),
+                            jnp.where(is_ccount, ccount_ok,
+                                      node_ok & jnp.where(
+                                          is_detach,
+                                          (op.node != 0) & ~depth_blown,
+                                          True)))))
+
+    # set_value
+    payload = jnp.where(target & ok & is_set, op.payload, s.payload)
+
+    # detach: drop node + all descendants (the subtree mask).
+    exists = s.exists & ~jnp.where(ok & is_detach, marked,
+                                   jnp.zeros_like(marked))
+
+    # insert (any flavour) / move (re-parent the subtree root only)
     do_insert = target & ok & is_insert
+    do_place = do_insert | (target & ok & is_move)
     exists = jnp.where(do_insert, True, exists)
-    parent_arr = jnp.where(do_insert, ins_parent, s.parent)
-    trait_arr = jnp.where(do_insert, ins_trait, s.trait)
-    rank_arr = jnp.where(do_insert, new_rank, s.rank)
+    parent_arr = jnp.where(do_place, ins_parent, s.parent)
+    trait_arr = jnp.where(do_place, ins_trait, s.trait)
+    rank_arr = jnp.where(do_place, new_rank, s.rank)
     payload = jnp.where(do_insert, op.payload, payload)
 
     return (TreeState(exists=exists, parent=parent_arr, trait=trait_arr,

@@ -45,11 +45,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..dds.mergetree import MergeEngine
+from ..dds.tree_core import ROOT_ID, VALID, Transaction, TreeSnapshot
 from ..ops import map_kernel as mk
 from ..ops import matrix_kernel as mxk
 from ..ops import matrix_pallas as mxp
 from ..ops import mergetree_kernel as mtk
 from ..ops import mergetree_pallas as mtp
+from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from .kernel_host import _next_pow2
 
@@ -112,6 +114,32 @@ class _MatrixRow:
         self.min_seq = 0
         self.next_row_handle = 0
         self.next_col_handle = 0
+
+
+class _TreeRow:
+    """Host bookkeeping for one device-served SharedTree channel: string id
+    → slot interning (the device stores only slots), per-row trait-label
+    interning, and the sequenced-edit log that seeds the scalar fallback."""
+
+    __slots__ = ("row", "slot_of", "info_of", "trait_ids", "trait_rev",
+                 "free", "next_slot", "pending", "raw_log", "scalar",
+                 "last_seq")
+
+    def __init__(self, row: int) -> None:
+        self.row = row
+        self.slot_of: dict[str, int] = {ROOT_ID: 0}
+        self.info_of: dict[int, tuple[str, str]] = {0: (ROOT_ID, "root")}
+        self.trait_ids: dict[str, int] = {}
+        self.trait_rev: list[str] = []
+        self.free: list[int] = []
+        self.next_slot = 1
+        self.pending: list[dict] = []
+        # Sequenced edits in order — the exact replay source if this
+        # channel leaves the device (unsupported edit shape / rank
+        # overflow), mirroring the merge row's raw_log contract.
+        self.raw_log: list[dict] = []
+        self.scalar: TreeSnapshot | None = None
+        self.last_seq = 0
 
 
 def _pad_axis(a, axis: int, extra: int, fill):
@@ -247,7 +275,8 @@ class KernelMergeHost:
     def __init__(self, merge_slots: int = 128, map_slots: int = 32,
                  num_props: int = 4, row_capacity: int = 8,
                  flush_threshold: int = 256, metrics=None,
-                 seg_mesh=None, sharded_slot_threshold: int = 65536) -> None:
+                 seg_mesh=None, sharded_slot_threshold: int = 65536,
+                 tree_slots: int = 32) -> None:
         from ..utils import MetricsRegistry
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # Sequence-parallel escape hatch: documents whose segment tables
@@ -286,6 +315,14 @@ class KernelMergeHost:
         self._matrix_vec_slots = 64
         self._matrix_cell_slots = 256
         self._matrix_rows: dict[ChannelKey, _MatrixRow] = {}
+
+        # Tree channels share one pooled TreeState [B, N] (uniform slot
+        # axis; both axes grow pow2) — SharedTree.processCore behind the
+        # service (SharedTree.ts:446, Checkout.ts:172 rebase).
+        self._tree_state: tk.TreeState | None = None
+        self._tree_capacity = max(1, row_capacity)
+        self._tree_slots = max(8, tree_slots)
+        self._tree_rows: dict[ChannelKey, _TreeRow] = {}
 
         self._merge_rows: dict[ChannelKey, _MergeRow] = {}
         self._map_rows: dict[ChannelKey, _MapRow] = {}
@@ -415,6 +452,8 @@ class KernelMergeHost:
             # Matrix ops carry a target axis/cell and reuse type names the
             # merge/map sets also use — route by shape FIRST.
             self._ingest_matrix(key, channel_op, message)
+        elif kind == "edit" and "edit" in channel_op:
+            self._ingest_tree(key, channel_op, message)
         elif kind in _MERGE_OPS:
             self._ingest_merge(key, channel_op, message)
         elif kind in _MAP_OPS:
@@ -694,6 +733,398 @@ class KernelMergeHost:
         for r in rows:
             r.pending = []
 
+    # -- tree channels (SharedTree.ts:446 behind the service) ------------------
+    #
+    # Device-served edit shapes (everything else routes the channel to the
+    # scalar fallback, which replays the exact sequenced-edit log through
+    # Transaction — always correct, never fast):
+    #
+    #   [set_value]                      → TREE_SET_VALUE
+    #   [detach(single-node, no dest)]   → TREE_DETACH
+    #   [constraint]                     → TREE_CONSTRAINT_EXISTS (no mutation)
+    #   [build, insert(source=build)]    → TREE_INSERT* chain
+    #   [detach(single, dest), insert]   → TREE_MOVE* (fused subtree move)
+    #
+    # Atomicity argument (a scalar Transaction drops the WHOLE edit when
+    # any change fails): single-change edits are trivially atomic; a
+    # build+insert chain cascades — children/siblings anchor on the
+    # previous insert's node, so a failed first placement starves every
+    # later op of its anchor; a move pair is one device op. Multi-change
+    # edits outside these shapes (e.g. two independent set_values) cannot
+    # cascade, so they are not device-served.
+
+    def _tree_row(self, key: ChannelKey) -> _TreeRow:
+        state = self._tree_rows.get(key)
+        if state is None:
+            row = len(self._tree_rows)
+            if row >= self._tree_capacity:
+                self._grow_tree_rows()
+            state = _TreeRow(row)
+            self._tree_rows[key] = state
+        return state
+
+    def _ensure_tree_state(self) -> None:
+        if self._tree_state is None:
+            self._tree_state = tk.init_state(self._tree_capacity,
+                                             self._tree_slots)
+
+    def _grow_tree_rows(self) -> None:
+        old = self._tree_capacity
+        self._tree_capacity = old * 2
+        if self._tree_state is not None:
+            fills = dict(exists=False, parent=-1, trait=0, rank=0, payload=0)
+            padded = {f: _pad_axis(getattr(self._tree_state, f), 0, old,
+                                   fills[f])
+                      for f in tk.TreeState._fields}
+            # Fresh rows must carry a live root in slot 0.
+            padded["exists"][old:, 0] = True
+            self._tree_state = jax.device_put(tk.TreeState(**padded))
+
+    def _grow_tree_slots(self, need: int) -> None:
+        new = self._tree_slots
+        while new < need:
+            new *= 2
+        if new == self._tree_slots:
+            return
+        extra = new - self._tree_slots
+        if self._tree_state is not None:
+            fills = dict(exists=False, parent=-1, trait=0, rank=0, payload=0)
+            self._tree_state = jax.device_put(tk.TreeState(**{
+                f: _pad_axis(getattr(self._tree_state, f), 1, extra,
+                             fills[f])
+                for f in tk.TreeState._fields}))
+        self._tree_slots = new
+
+    def _blank_tree_row(self, row: int) -> tk.TreeState:
+        s = self._tree_state
+        return tk.TreeState(
+            exists=s.exists.at[row].set(False).at[row, 0].set(True),
+            parent=s.parent.at[row].set(-1),
+            trait=s.trait.at[row].set(0),
+            rank=s.rank.at[row].set(0),
+            payload=s.payload.at[row].set(0))
+
+    def _ingest_tree(self, key: ChannelKey, channel_op: dict,
+                     message: SequencedDocumentMessage) -> None:
+        row = self._tree_row(key)
+        seq = message.sequence_number
+        if seq <= row.last_seq:
+            return  # bus replay
+        row.last_seq = seq
+        edit = channel_op["edit"]
+        if row.scalar is not None:
+            self._tree_scalar_apply(row, edit)
+            self.stats["scalar_ops"] += 1
+            return
+        row.raw_log.append(edit)
+        ops = self._encode_tree_edit(row, edit)
+        if row.scalar is not None:
+            # A capacity flush inside encoding overflowed this row and the
+            # scalar replay (from raw_log) already covered this edit.
+            return
+        if ops is None:
+            self._route_tree_to_scalar(row)
+            self.stats["scalar_ops"] += 1
+            return
+        row.pending.extend(ops)
+        self._pending_ops += len(ops)
+
+    def _tree_scalar_apply(self, row: _TreeRow, edit: dict) -> None:
+        txn = Transaction(row.scalar)
+        if txn.apply_edit(edit) == VALID:
+            row.scalar = txn.snapshot
+
+    def _route_tree_to_scalar(self, row: _TreeRow) -> None:
+        """Replay the channel's sequenced edits through the scalar
+        Transaction path and serve it host-side from now on."""
+        snap = TreeSnapshot()
+        for edit in row.raw_log:
+            txn = Transaction(snap)
+            if txn.apply_edit(edit) == VALID:
+                snap = txn.snapshot
+        row.scalar = snap
+        row.raw_log = []  # the snapshot IS the state from here on
+        self._pending_ops -= len(row.pending)
+        row.pending = []
+        if self._tree_state is not None:
+            self._tree_state = self._blank_tree_row(row.row)
+        self.stats["overflow_routed"] += 1
+
+    # -- tree edit translation -------------------------------------------------
+
+    def _tree_trait_id(self, row: _TreeRow, label: Any) -> int:
+        tid = row.trait_ids.get(label)
+        if tid is None:
+            tid = len(row.trait_rev) + 1  # 0 = the root's own trait plane
+            row.trait_ids[label] = tid
+            row.trait_rev.append(label)
+        return tid
+
+    def _encode_tree_edit(self, row: _TreeRow,
+                          edit: dict) -> list[dict] | None:
+        """Device ops for one edit; [] = no state change either way
+        (scalar-invalid or no-op), None = unsupported shape → scalar."""
+        changes = edit.get("changes")
+        if not isinstance(changes, list):
+            return None
+        if len(changes) == 1:
+            ch = changes[0]
+            kind = ch.get("type")
+            if kind == "set_value":
+                slot = row.slot_of.get(ch.get("node"))
+                if slot is None:
+                    return []  # unknown node: scalar-invalid
+                return [dict(kind=tk.TREE_SET_VALUE, node=slot,
+                             payload=self._intern(ch.get("payload")))]
+            if kind == "detach" and ch.get("destination") is None:
+                return self._encode_tree_detach(row, ch.get("source"))
+            if kind == "constraint":
+                return self._encode_tree_constraint(row, ch)
+            return None
+        if len(changes) == 2:
+            first, second = changes
+            if (first.get("type") == "build"
+                    and second.get("type") == "insert"
+                    and second.get("source") == first.get("destination")):
+                return self._encode_tree_build_insert(row, first, second)
+            if (first.get("type") == "detach"
+                    and first.get("destination") is not None
+                    and second.get("type") == "insert"
+                    and second.get("source") == first.get("destination")):
+                return self._encode_tree_move(row, first, second)
+        return None
+
+    @staticmethod
+    def _single_node_range(source: Any) -> tuple[str, bool] | None:
+        """(sibling id, is_real_range) for a same-sibling range; None for
+        ranges the device cannot enumerate (multi-node / trait-based).
+        is_real_range is False for empty or inverted ranges — scalar
+        treats those as a valid no-op / an invalid edit respectively, and
+        either way no state changes."""
+        if not isinstance(source, dict):
+            return None
+        start, end = source.get("start"), source.get("end")
+        if not (isinstance(start, dict) and isinstance(end, dict)):
+            return None
+        sib = start.get("referenceSibling")
+        if sib is None or end.get("referenceSibling") != sib:
+            return None
+        real = (start.get("side") == "before"
+                and end.get("side") == "after")
+        return sib, real
+
+    def _encode_tree_detach(self, row: _TreeRow,
+                            source: Any) -> list[dict] | None:
+        rng = self._single_node_range(source)
+        if rng is None:
+            return None
+        sib, real = rng
+        if not real or sib == ROOT_ID:
+            return []
+        slot = row.slot_of.get(sib)
+        if slot is None:
+            return []  # unknown anchor: scalar-invalid
+        return [dict(kind=tk.TREE_DETACH, node=slot)]
+
+    def _encode_tree_constraint(self, row: _TreeRow,
+                                ch: dict) -> list[dict]:
+        # Constraints never mutate; their only effect is edit validity,
+        # which for a single-change edit changes no state. Emit EXISTS
+        # checks where translatable so the device path is exercised.
+        rng = ch.get("range")
+        if not isinstance(rng, dict):
+            return []
+        ops = []
+        for place in (rng.get("start"), rng.get("end")):
+            if not isinstance(place, dict):
+                continue
+            sib = place.get("referenceSibling")
+            if sib and sib != ROOT_ID:
+                slot = row.slot_of.get(sib)
+                if slot:
+                    ops.append(dict(kind=tk.TREE_CONSTRAINT_EXISTS,
+                                    node=slot))
+        return ops
+
+    _TREE_INVALID = "invalid"
+
+    def _encode_tree_place(self, row: _TreeRow, place: Any):
+        """(insert kind, anchor slot, trait id) | "invalid" (scalar drops
+        the edit — no state change) | None (unsupported)."""
+        if not isinstance(place, dict):
+            return None
+        if "referenceSibling" in place:
+            sib = place["referenceSibling"]
+            if sib == ROOT_ID:
+                return self._TREE_INVALID
+            slot = row.slot_of.get(sib)
+            if slot is None:
+                return self._TREE_INVALID
+            kind = (tk.TREE_INSERT_BEFORE if place.get("side") == "before"
+                    else tk.TREE_INSERT_AFTER)
+            return kind, slot, 0
+        trait = place.get("referenceTrait")
+        if not isinstance(trait, dict):
+            return None
+        pslot = row.slot_of.get(trait.get("parent"))
+        if pslot is None:
+            return self._TREE_INVALID
+        tid = self._tree_trait_id(row, trait.get("label"))
+        kind = (tk.TREE_INSERT_START if place.get("side") == "start"
+                else tk.TREE_INSERT)
+        return kind, pslot, tid
+
+    @staticmethod
+    def _count_spec_nodes(specs: list) -> int | None:
+        total = 0
+        stack = list(specs)
+        while stack:
+            spec = stack.pop()
+            if not isinstance(spec, dict) or "id" not in spec:
+                return None
+            total += 1
+            for child_specs in (spec.get("traits") or {}).values():
+                stack.extend(child_specs)
+        return total
+
+    def _ensure_tree_slots(self, row: _TreeRow, fresh: int) -> None:
+        shortfall = fresh - len(row.free)
+        if shortfall <= 0 or row.next_slot + shortfall <= self._tree_slots:
+            return
+        # Apply pending first so the exists read-back is current, then
+        # reclaim slots of deleted/never-materialized nodes (the tree
+        # zamboni); grow only if that is not enough. NOTE: the flush can
+        # overflow-route THIS row to scalar — callers re-check.
+        self.flush()
+        if row.scalar is None:
+            self._reclaim_tree_slots(row)
+        shortfall = fresh - len(row.free)
+        if shortfall > 0 and row.next_slot + shortfall > self._tree_slots:
+            self._grow_tree_slots(_next_pow2(row.next_slot + shortfall))
+
+    def _reclaim_tree_slots(self, row: _TreeRow) -> None:
+        if self._tree_state is None:
+            return
+        exists = np.asarray(self._tree_state.exists[row.row])
+        in_free = set(row.free)
+        for slot in list(row.info_of):
+            if slot != 0 and slot not in in_free and not exists[slot]:
+                node_id, _ = row.info_of.pop(slot)
+                row.slot_of.pop(node_id, None)
+                row.free.append(slot)
+        self.stats["compactions"] += 1
+
+    def _alloc_tree_slot(self, row: _TreeRow, spec: dict) -> int:
+        slot = row.free.pop() if row.free else row.next_slot
+        if slot == row.next_slot:
+            row.next_slot += 1
+        row.slot_of[spec["id"]] = slot
+        row.info_of[slot] = (spec["id"], spec.get("definition", ""))
+        return slot
+
+    def _encode_tree_build_insert(self, row: _TreeRow, build: dict,
+                                  insert: dict) -> list[dict] | None:
+        specs = build.get("source")
+        if not isinstance(specs, list) or not specs:
+            return None
+        count = self._count_spec_nodes(specs)
+        if count is None:
+            return None
+        # Conservative: an id collision with ANY known node (alive or not)
+        # breaks the cascade-atomicity argument (a colliding insert fails
+        # but leaves an EXISTING anchor) — scalar handles it exactly.
+        stack = list(specs)
+        while stack:
+            spec = stack.pop()
+            if spec["id"] in row.slot_of:
+                return None
+            for child_specs in (spec.get("traits") or {}).values():
+                stack.extend(child_specs)
+        place = self._encode_tree_place(row, insert.get("destination"))
+        if place is None:
+            return None
+        if place == self._TREE_INVALID:
+            return []
+        self._ensure_tree_slots(row, count)
+        if row.scalar is not None:
+            return []  # flush inside ensure overflow-routed this row
+        kind, anchor, tid = place
+        ops: list[dict] = []
+        prev_slot = -1
+        for spec in specs:
+            slot = self._alloc_tree_slot(row, spec)
+            if prev_slot < 0:
+                ops.append(dict(kind=kind, node=slot, parent=anchor,
+                                trait=tid,
+                                payload=self._intern(spec.get("payload"))))
+            else:
+                # Later top-level siblings chain after the previous one,
+                # matching the scalar's list splice order.
+                ops.append(dict(kind=tk.TREE_INSERT_AFTER, node=slot,
+                                parent=prev_slot,
+                                payload=self._intern(spec.get("payload"))))
+            prev_slot = slot
+            self._encode_tree_children(row, spec, slot, ops)
+        return ops
+
+    def _encode_tree_children(self, row: _TreeRow, spec: dict,
+                              parent_slot: int, ops: list[dict]) -> None:
+        for label, child_specs in (spec.get("traits") or {}).items():
+            tid = self._tree_trait_id(row, label)
+            for child in child_specs:
+                slot = self._alloc_tree_slot(row, child)
+                ops.append(dict(kind=tk.TREE_INSERT, node=slot,
+                                parent=parent_slot, trait=tid,
+                                payload=self._intern(child.get("payload"))))
+                self._encode_tree_children(row, child, slot, ops)
+
+    _MOVE_KIND = {tk.TREE_INSERT: tk.TREE_MOVE,
+                  tk.TREE_INSERT_START: tk.TREE_MOVE_START,
+                  tk.TREE_INSERT_BEFORE: tk.TREE_MOVE_BEFORE,
+                  tk.TREE_INSERT_AFTER: tk.TREE_MOVE_AFTER}
+
+    def _encode_tree_move(self, row: _TreeRow, detach: dict,
+                          insert: dict) -> list[dict] | None:
+        rng = self._single_node_range(detach.get("source"))
+        if rng is None:
+            return None
+        sib, real = rng
+        if not real or sib == ROOT_ID:
+            return []  # empty/inverted range: no-op or invalid either way
+        slot = row.slot_of.get(sib)
+        if slot is None:
+            return []  # unknown node: scalar-invalid
+        place = self._encode_tree_place(row, insert.get("destination"))
+        if place is None:
+            return None
+        if place == self._TREE_INVALID:
+            return []
+        kind, anchor, tid = place
+        return [dict(kind=self._MOVE_KIND[kind], node=slot, parent=anchor,
+                     trait=tid)]
+
+    def _flush_tree(self) -> None:
+        rows = [r for r in self._tree_rows.values() if r.pending]
+        if not rows:
+            return
+        self._ensure_tree_state()
+        k = _next_pow2(max(len(r.pending) for r in rows))
+        per_doc: list[list[dict]] = [[] for _ in range(self._tree_capacity)]
+        for r in rows:
+            per_doc[r.row] = r.pending
+        batch = tk.make_tree_op_batch(per_doc, self._tree_capacity, k)
+        self._tree_state, outs = tk.apply_tick(self._tree_state, batch)
+        overflowed = np.asarray(jnp.any(outs.overflow, axis=1))
+        self.stats["device_ops"] += sum(len(r.pending) for r in rows)
+        self.stats["flushes"] += 1
+        for r in rows:
+            r.pending = []
+        for r in rows:
+            if overflowed[r.row]:
+                # Rank space exhausted mid-tick: the device state is
+                # partially applied; rebuild exactly from the edit log.
+                self._route_tree_to_scalar(r)
+
     def _ingest_map(self, key: ChannelKey, channel_op: dict,
                     message: SequencedDocumentMessage) -> None:
         row = self._map_row(key)
@@ -726,6 +1157,7 @@ class KernelMergeHost:
         self._flush_merge()
         self._flush_map()
         self._flush_matrix()
+        self._flush_tree()
         if self._pending_ops:
             self.metrics.histogram("merge_host.tick_seconds").observe(
                 _time.perf_counter() - start)
@@ -817,7 +1249,55 @@ class KernelMergeHost:
         return sorted(
             [k for k in self._merge_rows if k.doc_id == doc_id]
             + [k for k in self._map_rows if k.doc_id == doc_id]
-            + [k for k in self._matrix_rows if k.doc_id == doc_id])
+            + [k for k in self._matrix_rows if k.doc_id == doc_id]
+            + [k for k in self._tree_rows if k.doc_id == doc_id])
+
+    def tree_snapshot(self, doc_id: str, datastore: str,
+                      channel: str) -> dict:
+        """Converged tree of a SharedTree channel in the canonical
+        ``TreeSnapshot.serialize()`` form (byte-comparable to replicas)."""
+        key = ChannelKey(doc_id, datastore, channel)
+        row = self._tree_rows[key]
+        if row.pending:
+            self.flush()
+        if row.scalar is not None:
+            return row.scalar.serialize()
+        if self._tree_state is None:
+            return TreeSnapshot().serialize()
+        exists = np.asarray(self._tree_state.exists[row.row])
+        parent = np.asarray(self._tree_state.parent[row.row])
+        trait = np.asarray(self._tree_state.trait[row.row])
+        rank = np.asarray(self._tree_state.rank[row.row])
+        payload = np.asarray(self._tree_state.payload[row.row])
+        # Children of each (parent, trait), rank-ascending (slot index
+        # breaks exact-rank ties — ranks are unique per trait in practice:
+        # colliding midpoints overflow to the scalar path instead).
+        by_parent: dict[int, dict[int, list[int]]] = {}
+        for slot in range(exists.shape[0]):
+            if exists[slot] and slot != 0:
+                by_parent.setdefault(int(parent[slot]), {}).setdefault(
+                    int(trait[slot]), []).append(slot)
+        out: dict[str, dict] = {}
+        for slot in range(exists.shape[0]):
+            if not exists[slot]:
+                continue
+            node_id, definition = row.info_of[slot]
+            traits = {}
+            for tid, slots in sorted(
+                    by_parent.get(slot, {}).items(),
+                    key=lambda kv: row.trait_rev[kv[0] - 1]):
+                slots.sort(key=lambda i: (int(rank[i]), i))
+                traits[row.trait_rev[tid - 1]] = [
+                    row.info_of[i][0] for i in slots]
+            out[node_id] = {
+                "definition": definition,
+                "payload": self._val_rev[payload[slot]],
+                "traits": traits,
+                "parent": (None if slot == 0 else
+                           [row.info_of[int(parent[slot])][0],
+                            row.trait_rev[int(trait[slot]) - 1]]),
+            }
+        return dict(sorted(out.items()))
 
     def matrix_grid(self, doc_id: str, datastore: str,
                     channel: str) -> list[list]:
@@ -916,6 +1396,11 @@ class KernelMergeHost:
                     "kind": "matrix",
                     "grid": self.matrix_grid(*key),
                 }
+            elif key in self._tree_rows:
+                channels[key.channel] = {
+                    "kind": "tree",
+                    "tree": self.tree_snapshot(*key),
+                }
             else:
                 channels[key.channel] = {
                     "kind": "map",
@@ -926,6 +1411,8 @@ class KernelMergeHost:
         seqs += [r.last_seq for k, r in self._map_rows.items()
                  if k.doc_id == doc_id]
         seqs += [r.last_seq for k, r in self._matrix_rows.items()
+                 if k.doc_id == doc_id]
+        seqs += [r.last_seq for k, r in self._tree_rows.items()
                  if k.doc_id == doc_id]
         return {"datastores": datastores,
                 "sequence_number": max(seqs, default=0)}

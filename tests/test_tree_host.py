@@ -1,0 +1,254 @@
+"""SharedTree channels served by the device kernel behind the service.
+
+BASELINE config 5 (batched tree rebase) through the SERVING path: tree
+edits flow client → LocalCollabServer → KernelMergeHost → tree_kernel
+rows, and the device-materialized snapshot must match every client
+replica byte-for-byte — including under slot pressure (reclaim + growth),
+rank-midpoint exhaustion (overflow → scalar routing), and edit shapes the
+device cannot serve atomically (→ scalar routing).
+
+Reference parity: experimental/dds/tree/src/SharedTree.ts:446 processCore,
+Checkout.ts:172 rebase, hosted server-side.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.tree import SharedTree
+from fluidframework_tpu.dds.tree_core import ROOT_ID
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+
+def make_tree_doc(server, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("tree", SharedTree.channel_type)
+    container.attach()
+    return container
+
+
+def get_tree(container) -> SharedTree:
+    return container.runtime.get_datastore("default").get_channel("tree")
+
+
+def node(nid, payload=None, **traits):
+    return {"id": nid, "definition": "n", "payload": payload,
+            "traits": {k: list(v) for k, v in traits.items()}}
+
+
+def end_of(parent, label="children"):
+    return {"referenceTrait": {"parent": parent, "label": label},
+            "side": "end"}
+
+
+def range_of(nid):
+    return {"start": {"referenceSibling": nid, "side": "before"},
+            "end": {"referenceSibling": nid, "side": "after"}}
+
+
+def random_tree_edit(rng, tree, counter):
+    """One random typed-builder edit against a replica's current view."""
+    view = tree.current_view
+    attached = [nid for nid in view.nodes
+                if nid == ROOT_ID or view.nodes[nid].parent is not None]
+    non_root = [n for n in attached if n != ROOT_ID]
+    roll = rng.random()
+    if roll < 0.45 or not non_root:
+        nid = f"n{next(counter)}"
+        spec = node(nid, payload=rng.randrange(100))
+        if rng.random() < 0.3:
+            spec["traits"]["kids"] = [node(f"{nid}k{i}")
+                                      for i in range(rng.randrange(1, 3))]
+        anchor = rng.choice(attached)
+        if anchor != ROOT_ID and rng.random() < 0.5:
+            place = {"referenceSibling": anchor,
+                     "side": rng.choice(["before", "after"])}
+        else:
+            place = {"referenceTrait": {"parent": anchor,
+                                        "label": rng.choice(["children",
+                                                             "kids"])},
+                     "side": rng.choice(["start", "end"])}
+        tree.insert_node(spec, place)
+    elif roll < 0.65:
+        tree.set_payload(rng.choice(non_root), rng.randrange(1000))
+    elif roll < 0.8:
+        tree.delete_range(range_of(rng.choice(non_root)))
+    else:
+        src = rng.choice(non_root)
+        dest_anchor = rng.choice(attached)
+        if dest_anchor != ROOT_ID and rng.random() < 0.5:
+            place = {"referenceSibling": dest_anchor,
+                     "side": rng.choice(["before", "after"])}
+        else:
+            place = {"referenceTrait": {"parent": dest_anchor,
+                                        "label": "children"},
+                     "side": rng.choice(["start", "end"])}
+        tree.move_range(range_of(src), place)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tree_farm_device_replica_matches_clients(seed):
+    import itertools
+
+    host = KernelMergeHost(flush_threshold=16)
+    server = LocalCollabServer(merge_host=host)
+    rng = random.Random(seed)
+    counter = itertools.count()
+    c1 = make_tree_doc(server, "doc")
+    others = [Container.load(LocalDocumentService(server, "doc"))
+              for _ in range(2)]
+    replicas = [c1] + others
+    for _round in range(6):
+        paused = [c for c in replicas if rng.random() < 0.3]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(4, 10)):
+            random_tree_edit(rng, get_tree(rng.choice(replicas)), counter)
+        for c in paused:
+            c.inbound.resume()
+    views = [get_tree(c).current_view.serialize() for c in replicas]
+    assert all(v == views[0] for v in views), "replicas diverged"
+    assert host.tree_snapshot("doc", "default", "tree") == views[0]
+    assert host.stats["device_ops"] > 0
+
+
+def test_tree_slot_pressure_reclaims_then_grows():
+    host = KernelMergeHost(flush_threshold=4, tree_slots=8)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_tree_doc(server, "doc")
+    t1 = get_tree(c1)
+    # Churn: insert then delete, forcing dead slots the reclaim pass frees.
+    for i in range(6):
+        t1.insert_node(node(f"tmp{i}"), end_of(ROOT_ID))
+        t1.delete_range(range_of(f"tmp{i}"))
+    # Then grow past the original capacity with live nodes.
+    for i in range(20):
+        t1.insert_node(node(f"live{i}", payload=i), end_of(ROOT_ID))
+    expected = t1.current_view.serialize()
+    assert host.tree_snapshot("doc", "default", "tree") == expected
+    assert host._tree_slots > 8
+    assert host.stats["compactions"] > 0  # the reclaim pass ran
+
+
+def test_tree_unsupported_edit_shape_routes_to_scalar():
+    host = KernelMergeHost(flush_threshold=4)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_tree_doc(server, "doc")
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    t1, t2 = get_tree(c1), get_tree(c2)
+    t1.insert_node(node("a", payload=1), end_of(ROOT_ID))
+    t1.insert_node(node("b", payload=2), end_of(ROOT_ID))
+    assert host.stats["overflow_routed"] == 0
+    # Two independent set_values in ONE edit: atomic in the scalar
+    # Transaction, not cascade-safe on device → channel leaves the device.
+    t2.apply_edit([{"type": "set_value", "node": "a", "payload": 10},
+                   {"type": "set_value", "node": "b", "payload": 20}])
+    assert host.stats["overflow_routed"] == 1
+    expected = t1.current_view.serialize()
+    assert expected == t2.current_view.serialize()
+    assert host.tree_snapshot("doc", "default", "tree") == expected
+    # The scalar-served channel keeps tracking later edits exactly.
+    t1.insert_node(node("c"), end_of("a", "sub"))
+    t2.move_range(range_of("b"), {"referenceSibling": "a", "side": "before"})
+    expected = t1.current_view.serialize()
+    assert expected == t2.current_view.serialize()
+    assert host.tree_snapshot("doc", "default", "tree") == expected
+
+
+def test_tree_rank_exhaustion_overflows_to_scalar():
+    host = KernelMergeHost(flush_threshold=2)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_tree_doc(server, "doc")
+    t1 = get_tree(c1)
+    t1.insert_node(node("anchor"), end_of(ROOT_ID))
+    # Repeated before-the-same-anchor inserts halve the rank gap each
+    # time; ~16 splits exhaust the midpoint space → device flags overflow
+    # → exact scalar rebuild from the edit log.
+    for i in range(24):
+        t1.insert_node(node(f"w{i}"),
+                       {"referenceSibling": "anchor", "side": "before"})
+    expected = t1.current_view.serialize()
+    assert host.tree_snapshot("doc", "default", "tree") == expected
+    assert host.stats["overflow_routed"] >= 1
+    key = ("doc", "default", "tree")
+    assert host._tree_rows[key].scalar is not None
+    # Still converging post-reroute.
+    t1.set_payload("anchor", "end")
+    assert host.tree_snapshot("doc", "default", "tree") \
+        == t1.current_view.serialize()
+
+
+def test_tree_depth_cap_overflows_to_scalar():
+    """A detach whose subtree is deeper than the kernel's propagation cap
+    (MAX_DEPTH_PASSES) must NOT partially apply — the op flags overflow
+    and the channel reroutes to the exact scalar replay."""
+    from fluidframework_tpu.ops.tree_kernel import MAX_DEPTH_PASSES
+
+    host = KernelMergeHost(flush_threshold=4)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_tree_doc(server, "doc")
+    t1 = get_tree(c1)
+    depth = MAX_DEPTH_PASSES + 8
+    spec = node(f"c{depth - 1}", payload=depth - 1)
+    for i in reversed(range(depth - 1)):
+        spec = node(f"c{i}", payload=i, kids=[spec])
+    t1.insert_node(spec, end_of(ROOT_ID))
+    assert host.tree_snapshot("doc", "default", "tree") \
+        == t1.current_view.serialize()
+    t1.delete_range(range_of("c0"))
+    expected = t1.current_view.serialize()
+    assert host.tree_snapshot("doc", "default", "tree") == expected
+    assert "c0" not in expected
+    assert host.stats["overflow_routed"] >= 1
+
+
+def test_tree_invalid_concurrent_edits_match():
+    """Concurrent delete + edit-under-deleted-node: the late edit must be
+    INVALID (dropped whole) on device exactly as on every client."""
+    host = KernelMergeHost(flush_threshold=100)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_tree_doc(server, "doc")
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    t1, t2 = get_tree(c1), get_tree(c2)
+    t1.insert_node(node("a"), end_of(ROOT_ID))
+    t1.insert_node(node("b"), end_of("a", "sub"))
+    # Concurrently: c1 deletes the subtree, c2 edits inside it.
+    c2.inbound.pause()
+    t1.delete_range(range_of("a"))
+    t2.set_payload("b", "doomed")
+    t2.insert_node(node("c"), end_of("b", "sub"))
+    c2.inbound.resume()
+    expected = t1.current_view.serialize()
+    assert expected == t2.current_view.serialize()
+    assert "b" not in expected and "c" not in expected
+    assert host.tree_snapshot("doc", "default", "tree") == expected
+
+
+def test_tree_through_routerlicious_and_restart():
+    """Tree channels behind the full service; a restarted service with a
+    fresh host rebuilds the device replica from the durable op log."""
+    import itertools
+
+    host1 = KernelMergeHost(flush_threshold=16)
+    server1 = RouterliciousService(merge_host=host1)
+    rng = random.Random(5)
+    counter = itertools.count()
+    c1 = make_tree_doc(server1, "doc")
+    c2 = Container.load(LocalDocumentService(server1, "doc"))
+    for _ in range(25):
+        random_tree_edit(rng, get_tree(rng.choice([c1, c2])), counter)
+    expected = get_tree(c1).current_view.serialize()
+    assert expected == get_tree(c2).current_view.serialize()
+    assert host1.tree_snapshot("doc", "default", "tree") == expected
+
+    host2 = KernelMergeHost(flush_threshold=16)
+    server2 = RouterliciousService(bus=server1.bus, store=server1.store,
+                                   merge_host=host2)
+    server2.connect("doc", lambda msgs: None)
+    assert host2.tree_snapshot("doc", "default", "tree") == expected
