@@ -428,6 +428,221 @@ def bench_tree(num_docs: int = 8192, k: int = 32, ticks: int = 6,
     return out
 
 
+# -- end-to-end: the serving path ---------------------------------------------
+
+_STORM_CLIENT_SRC = r"""
+import json, socket, struct, sys, time
+import numpy as np
+
+cfg = json.loads(sys.stdin.readline())
+sock = socket.create_connection(("127.0.0.1", cfg["port"]))
+rng = np.random.default_rng(cfg["seed"])
+docs = cfg["docs"]  # [[doc_id, client_id], ...]
+k = cfg["k"]
+cseqs = {d: c0 for (d, _cl), c0 in zip(docs, cfg["cseq0"])}
+
+def frame(rid):
+    hdr_docs, chunks = [], []
+    for doc_id, client_id in docs:
+        kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
+        slots = rng.integers(0, cfg["num_slots"], k).astype(np.uint32)
+        vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+        chunks.append(kinds | (slots << 2) | (vals << 12))
+        hdr_docs.append([doc_id, client_id, cseqs[doc_id], 1, k])
+        cseqs[doc_id] += k
+    head = json.dumps({"op": "storm", "rid": rid, "docs": hdr_docs},
+                      separators=(",", ":")).encode()
+    body = (bytes((0, 1)) + struct.pack("<I", len(head)) + head
+            + b"".join(c.tobytes() for c in chunks))
+    return struct.pack(">I", len(body)) + body
+
+def recv_exact(n):
+    raw = b""
+    while len(raw) < n:
+        chunk = sock.recv(n - len(raw))
+        if not chunk:
+            raise SystemExit("server closed the connection")
+        raw += chunk
+    return raw
+
+def read_ack():
+    length = struct.unpack(">I", recv_exact(4))[0]
+    return json.loads(recv_exact(length).decode())
+
+frames = [frame(t) for t in range(cfg["ticks"])]  # pre-built, untimed
+print("READY", flush=True)
+assert sys.stdin.readline().strip() == "GO"
+t0 = time.perf_counter()
+for data in frames:          # pipelined: the bridge buffers inbound
+    sock.sendall(data)
+ack_times, acked = [], 0
+while acked < cfg["ticks"]:
+    ack = read_ack()
+    if ack.get("storm"):
+        acked += 1
+        ack_times.append(time.perf_counter() - t0)
+print(json.dumps({"elapsed": time.perf_counter() - t0,
+                  "ack_times": ack_times}), flush=True)
+"""
+
+
+def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
+                    n_conns: int = 8, num_slots: int = 32) -> dict:
+    """End-to-end merged-ops/sec through the REAL serving path: client
+    processes → framed TCP → C++ bridge front door → alfred dispatch →
+    deli (device sequencer kernel, full NACK/MSN semantics) → merger (map
+    kernel fold, fused with the ticket seqs) → durable columnar op log +
+    fan-out publish + acks back over the wire. Contrast with the
+    kernel-only map number: this pays framing, sockets, host scatter,
+    host→device transfer and durability on every tick."""
+    import subprocess
+
+    from fluidframework_tpu.native.fanout import make_fanout
+    from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
+    from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+    from fluidframework_tpu.server.merge_host import KernelMergeHost
+    from fluidframework_tpu.server.routerlicious import RouterliciousService
+    from fluidframework_tpu.server.storm import StormController
+
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=num_docs)
+    merge_host = KernelMergeHost(map_slots=num_slots, row_capacity=num_docs,
+                                 flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False, fanout=make_fanout())
+    storm = StormController(service, seq_host, merge_host,
+                            flush_threshold_docs=num_docs)
+    front = BridgeFrontDoor(service, 0)
+
+    # Setup (untimed): one writer joins per document through the service
+    # front door; the joins sequence through the batched deli host.
+    docs = [f"storm-doc-{i}" for i in range(num_docs)]
+    clients = {}
+    for d in docs:
+        clients[d] = service.connect(d, lambda msgs: None).client_id
+    service.pump()
+
+    # Warm-up (untimed): one full-shape tick compiles the fused program.
+    rng = np.random.default_rng(123)
+    chunks = []
+    hdr_docs = []
+    for d in docs:
+        chunks.append(rng.integers(0, 1 << 20, k).astype(np.uint32) << 12)
+        hdr_docs.append([d, clients[d], 1, 1, k])
+    storm.submit_frame(None, {"op": "storm", "docs": hdr_docs},
+                       memoryview(b"".join(c.tobytes() for c in chunks)))
+    storm.flush()
+    assert storm.stats["sequenced_ops"] == num_docs * k
+    storm.tick_seconds.clear()
+
+    # Timed run: client processes (no GIL sharing with the server) send
+    # `ticks` frames each, pipelined; every doc's tick needs all conns.
+    per_conn = num_docs // n_conns
+    procs = []
+    for c in range(n_conns):
+        conn_docs = docs[c * per_conn:(c + 1) * per_conn]
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _STORM_CLIENT_SRC],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        proc.stdin.write(json.dumps({
+            "port": front.port, "k": k, "ticks": ticks, "seed": c,
+            "num_slots": num_slots,
+            "docs": [[d, clients[d]] for d in conn_docs],
+            "cseq0": [k + 1] * len(conn_docs),
+        }) + "\n")
+        proc.stdin.flush()
+        procs.append(proc)
+    for proc in procs:
+        assert proc.stdout.readline().strip() == "READY"
+    before = storm.stats["sequenced_ops"]
+    ticks_before = storm.stats["ticks"]
+    start = time.perf_counter()
+    for proc in procs:
+        proc.stdin.write("GO\n")
+        proc.stdin.flush()
+    results = [json.loads(proc.stdout.readline()) for proc in procs]
+    elapsed = time.perf_counter() - start
+    for proc in procs:
+        proc.wait(timeout=30)
+    sequenced = storm.stats["sequenced_ops"] - before
+    tick_ms = 1000.0 * np.asarray(storm.tick_seconds)
+    ack_gaps = []
+    for res in results:
+        times = [0.0] + res["ack_times"]
+        ack_gaps.extend(b - a for a, b in zip(times, times[1:]))
+    front.close()  # freerun below DONATES the live host states
+
+    # Measure the host->device link (the axon tunnel in this harness):
+    # every e2e tick must move 4 bytes/op across it, so link_MBps/4 is an
+    # absolute ops/s ceiling FOR THIS ATTACHMENT — a locally-attached
+    # chip (PCIe, GB/s) lifts it by two orders of magnitude.
+    import jax
+
+    probe = np.zeros((num_docs, k), np.uint32)
+    put_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        arr = jax.device_put(probe)
+        np.asarray(arr[0, 0])
+        put_times.append(time.perf_counter() - t0)
+    link_mbps = probe.nbytes / 1e6 / min(put_times)
+
+    # Device-only freerun of the SAME fused program (deli + merger) with
+    # inputs resident: what this serving tick does when the link is not
+    # the bottleneck.
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.server.storm import _storm_tick
+    b_seq = seq_host._capacity
+    b_map = merge_host._map_capacity
+    rng2 = np.random.default_rng(7)
+    fr_words = jnp.asarray(
+        rng2.integers(0, 1 << 20, (b_map, k)).astype(np.uint32) << 12)
+    fr_counts = jnp.asarray(
+        np.where(np.arange(b_seq) < num_docs, k, 0).astype(np.int32))
+    fr_slot = jnp.zeros(b_seq, jnp.int32)
+    fr_ref = jnp.ones(b_seq, jnp.int32)
+    fr_ts = jnp.full(b_seq, 1, jnp.int32)
+    fr_gather = jnp.arange(b_map, dtype=jnp.int32)
+    ss, ms = seq_host._state, merge_host._xstate
+    cseq = int(1e6)
+    reps = 5
+    res = _storm_tick(ss, ms, fr_slot, jnp.full(b_seq, cseq, jnp.int32),
+                      fr_ref, fr_ts, fr_counts, fr_gather, fr_words,
+                      fr_counts[:b_map])
+    ss, ms = res[0], res[1]
+    np.asarray(res[2][0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        cseq += k
+        res = _storm_tick(ss, ms, fr_slot,
+                          jnp.full(b_seq, cseq, jnp.int32), fr_ref, fr_ts,
+                          fr_counts, fr_gather, fr_words,
+                          fr_counts[:b_map])
+        ss, ms = res[0], res[1]
+    np.asarray(res[2][0])
+    fused_rate = num_docs * k * reps / (time.perf_counter() - t0)
+
+    out = {
+        "e2e_ops_per_sec": sequenced / elapsed,
+        "sequenced_ops": sequenced,
+        "elapsed_s": elapsed,
+        "link_MBps_measured": round(link_mbps, 1),
+        "link_implied_ops_ceiling": round(link_mbps * 1e6 / 4, 1),
+        "fused_tick_device_ops_per_sec": round(fused_rate, 1),
+        "tick_ms_p50": float(np.percentile(tick_ms, 50)),
+        "tick_ms_p99": float(np.percentile(tick_ms, 99)),
+        "ack_interval_ms_p50": float(np.percentile(ack_gaps, 50)) * 1000,
+        "num_docs": num_docs,
+        "ops_per_tick": num_docs * k,
+        "ticks": int(storm.stats["ticks"] - ticks_before),
+        "path": "client procs -> TCP -> C++ bridge -> alfred -> "
+                "sequencer kernel -> map kernel (fused) -> durable log "
+                "+ fanout + acks",
+    }
+    return out
+
+
 # -- sequencer ----------------------------------------------------------------
 
 
@@ -502,6 +717,7 @@ def rngless(i: int) -> int:
 def main() -> None:
     detail = {
         "map_storm_10k_docs": bench_map(),
+        "e2e_storm_10k_docs": bench_e2e_storm(),
         "mergetree_stress": bench_mergetree(),
         "matrix_composed": bench_matrix(),
         "tree_rebase_1k_docs": bench_tree(),
@@ -513,17 +729,30 @@ def main() -> None:
             "numpy_batched_cpu = this framework's own batched semantics "
             "on CPU (strongest same-machine contender for the map storm). "
             "tick_ms_* = blocked latency of one batched device apply; an "
-            "op waits at most one tick at the kernel."),
+            "op waits at most one tick at the kernel. e2e_storm = "
+            "sustained rate through the REAL path (client processes -> "
+            "TCP -> C++ bridge -> alfred -> device deli -> device merger "
+            "-> durable log + fanout + acks); it is bounded by the "
+            "harness's tunneled TPU attachment, whose measured bandwidth "
+            "(link_MBps_measured, varies by hour) implies the reported "
+            "ops ceiling at 4 bytes/op — fused_tick_device_ops_per_sec "
+            "is the same serving program with inputs resident, i.e. the "
+            "rate a locally-attached chip's serving loop sustains."),
     }
     head = detail["map_storm_10k_docs"]
     for name, res in detail.items():
-        if isinstance(res, dict):
+        if isinstance(res, dict) and "scalar_python_ops_per_sec" in res:
             res["speedup_vs_scalar_python"] = round(
                 res["device_ops_per_sec"] / res["scalar_python_ops_per_sec"],
                 2)
     head["speedup_vs_numpy_batched_cpu"] = round(
         head["device_ops_per_sec"] / head["numpy_batched_cpu_ops_per_sec"],
         2)
+    e2e = detail["e2e_storm_10k_docs"]
+    e2e["fraction_of_kernel_only_rate"] = round(
+        e2e["e2e_ops_per_sec"] / head["device_ops_per_sec"], 4)
+    e2e["fraction_of_link_ceiling"] = round(
+        e2e["e2e_ops_per_sec"] / e2e["link_implied_ops_ceiling"], 3)
     with open("BENCH_DETAIL.json", "w") as f:
         json.dump(detail, f, indent=2)
     print(json.dumps(detail, indent=2), file=sys.stderr)
@@ -532,9 +761,13 @@ def main() -> None:
     # ratio and the V8 caveat are in BENCH_DETAIL.json.
     print(json.dumps({
         "metric": "merged map ops/sec across 10240 concurrent docs "
-                  "(p99 tick %.2fms; %sx vs numpy-batched CPU)"
+                  "(p99 tick %.2fms; %sx vs numpy-batched CPU; "
+                  "e2e through sockets+deli+merger %.1fM ops/s = %.1f%% "
+                  "of kernel rate)"
                   % (head["tick_ms_p99"],
-                     head["speedup_vs_numpy_batched_cpu"]),
+                     head["speedup_vs_numpy_batched_cpu"],
+                     e2e["e2e_ops_per_sec"] / 1e6,
+                     100 * e2e["fraction_of_kernel_only_rate"]),
         "value": round(head["device_ops_per_sec"], 1),
         "unit": "ops/s",
         "vs_baseline": head["speedup_vs_scalar_python"],

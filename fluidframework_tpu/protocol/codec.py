@@ -133,6 +133,52 @@ def from_wire(obj: Any) -> Any:
     return obj
 
 
+# -- binary op-storm frames ---------------------------------------------------
+#
+# The columnar fast path (server/storm.py) ships op BATCHES as packed
+# arrays instead of per-op JSON: a frame body starting with NUL (JSON can
+# never start with one) is a storm frame:
+#
+#   [0]   magic 0x00
+#   [1]   version 0x01
+#   [2:6] u32 LE header length H
+#   [6:6+H]  JSON header {"op": "storm", "rid", "docs": [[doc_id,
+#            client_id, first_client_seq, ref_seq, count], ...]}
+#   [6+H:]   concatenated per-doc op words, u32 LE (4 bytes/op — the
+#            map kernel's kind|slot<<2|value<<12 wire format)
+#
+# This is the rdkafka-batching analog of SURVEY §2.9: the hot path never
+# touches per-op Python objects between the socket and the device.
+
+STORM_MAGIC = 0x00
+_STORM_HDR = struct.Struct("<I")
+
+
+def is_storm_body(body: bytes) -> bool:
+    return len(body) > 6 and body[0] == STORM_MAGIC
+
+
+def encode_storm_body(header: dict, payload: bytes) -> bytes:
+    head = json.dumps(header, separators=(",", ":")).encode()
+    body = (bytes((STORM_MAGIC, 1)) + _STORM_HDR.pack(len(head))
+            + head + payload)
+    assert len(body) <= MAX_FRAME, f"storm frame too large: {len(body)}"
+    return body
+
+
+def encode_storm_frame(header: dict, payload: bytes) -> bytes:
+    body = encode_storm_body(header, payload)
+    return _LEN.pack(len(body)) + body
+
+
+def decode_storm_body(body: bytes) -> tuple[dict, memoryview]:
+    if body[0] != STORM_MAGIC or body[1] != 1:
+        raise ValueError("not a v1 storm frame")
+    hlen = _STORM_HDR.unpack_from(body, 2)[0]
+    header = json.loads(bytes(body[6:6 + hlen]).decode())
+    return header, memoryview(body)[6 + hlen:]
+
+
 def encode_body(payload: Any) -> bytes:
     """Frame body alone — transports that own framing (the native bridge)
     prepend their own length word."""

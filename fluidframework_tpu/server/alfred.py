@@ -34,16 +34,25 @@ import asyncio
 import sys
 from typing import Any
 
-from ..protocol.codec import MAX_FRAME, decode_body, encode_frame
+from ..protocol.codec import (
+    MAX_FRAME,
+    decode_body,
+    encode_frame,
+    is_storm_body,
+)
 from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
 
 
-async def read_frame(reader: asyncio.StreamReader) -> Any:
+async def read_frame_raw(reader: asyncio.StreamReader) -> bytes:
     header = await reader.readexactly(4)
     length = int.from_bytes(header, "big")
     if length > MAX_FRAME:
         raise ConnectionError(f"oversized frame: {length}")
-    return decode_body(await reader.readexactly(length))
+    return await reader.readexactly(length)
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    return decode_body(await read_frame_raw(reader))
 
 
 class RequestSession:
@@ -63,6 +72,27 @@ class RequestSession:
     def drop(self) -> None:
         """Close this session's transport (service-initiated disconnect,
         e.g. slow-consumer eviction). Subclasses owning a socket override."""
+
+    def handle_binary(self, body: bytes) -> dict | None:
+        """A storm frame (codec.is_storm_body): columnar op batch into the
+        service's fast path. The ack is pushed after the tick that
+        sequences it; None = no immediate response."""
+        from ..protocol.codec import decode_storm_body
+
+        storm = getattr(self.server.service, "storm", None)
+        if storm is None:
+            return {"rid": None, "error": "storm path not enabled"}
+        try:
+            header, payload = decode_storm_body(body)
+        except Exception as err:
+            return {"rid": None, "error": f"bad storm frame: {err!r}"}
+        try:
+            storm.submit_frame(self.push, header, payload)
+        except Exception as err:
+            # The error must answer the offending frame and keep the
+            # socket alive — exactly like the JSON request path.
+            return {"rid": header.get("rid"), "error": repr(err)}
+        return None
 
     def handle_request(self, req: dict) -> dict:
         """Dispatch one request synchronously against the service."""
@@ -163,6 +193,12 @@ class RequestSession:
                 self.connection.close()
                 self.connection = None
             return {"rid": rid, "ok": True}
+        if op == "storm_flush":
+            storm = getattr(service, "storm", None)
+            if storm is None:
+                return {"rid": rid, "error": "storm path not enabled"}
+            storm.flush()
+            return {"rid": rid, "ok": True}
         return {"rid": rid, "error": f"unknown op {op!r}"}
 
     def _require_agent_scope(self, req: dict) -> None:
@@ -249,9 +285,15 @@ class AlfredServer:
         try:
             while True:
                 try:
-                    req = await read_frame(reader)
+                    body = await read_frame_raw(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
+                if is_storm_body(body):
+                    resp = session.handle_binary(body)
+                    if resp is not None:
+                        session.push(resp)
+                    continue
+                req = decode_body(body)
                 try:
                     resp = session.handle_request(req)
                 except Exception as err:  # report, keep the socket alive

@@ -22,7 +22,7 @@ import time
 from typing import Any
 
 from ..native.bridge import EV_CLOSE, EV_DATA, EV_OPEN, start_bridge
-from ..protocol.codec import decode_body, encode_body
+from ..protocol.codec import decode_body, encode_body, is_storm_body
 from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
 from .alfred import RequestSession
 
@@ -75,6 +75,16 @@ class BridgeFrontDoor:
             # bounded timeout keeps close() responsive.
             event = self._bridge.poll(wait_ms=50)
             if event is None:
+                # Idle: drain any storm frames below the tick threshold
+                # (the batched-cadence operator tick) so connection-skewed
+                # tails never starve waiting for a full cohort.
+                storm = getattr(self.service, "storm", None)
+                if storm is not None and (storm._frames
+                                          or storm._inflight is not None):
+                    try:
+                        storm.flush()
+                    except Exception as err:
+                        self.logger.send_error("BridgeStormFlushFailed", err)
                 continue
             try:
                 self._dispatch(*event)
@@ -96,6 +106,15 @@ class BridgeFrontDoor:
     def _handle_data(self, conn_id: int, body: bytes) -> None:
         session = self._sessions.get(conn_id)
         if session is None:
+            return
+        if is_storm_body(body):
+            try:
+                resp = session.handle_binary(body)
+            except Exception as err:
+                self.logger.send_error("BridgeStormFailed", err)
+                resp = {"rid": None, "error": repr(err)}
+            if resp is not None:
+                session.push(resp)
             return
         try:
             req: Any = decode_body(body)

@@ -99,6 +99,12 @@ class KernelSequencerHost:
         # here until the next flush() caller collects them — nothing is
         # ever sequenced-and-dropped.
         self._ready: dict[str, list[Ticket]] = {}
+        # Host mirror of the device state, fetched in ONE transfer and
+        # reused until the next device write: per-document checkpoint()
+        # calls must not each pay a device readback (a tunneled TPU
+        # attachment charges ~100ms per round trip — 10k docs would turn
+        # one pump into 10k RTTs).
+        self._host_state = None
 
     @property
     def _ghost(self) -> int:
@@ -126,6 +132,7 @@ class KernelSequencerHost:
         # Padded rows must match init defaults (cevict inits True).
         grown.cevict[old:] = True
         self._state = jax.device_put(grown)
+        self._host_state = None
         self._slots += [{} for _ in range(old)]
         self._pending += [[] for _ in range(old)]
         self._timeout_ms += [self.DEFAULT_TIMEOUT_MS] * old
@@ -145,6 +152,7 @@ class KernelSequencerHost:
                for f in self._state._fields})
         grown.cevict[:, self._alloc_slots + 1:] = True
         self._state = jax.device_put(grown)
+        self._host_state = None
         self._alloc_slots = new_alloc
 
     def _slot_for(self, row: int, client_id: str, fresh: set[str],
@@ -268,6 +276,8 @@ class KernelSequencerHost:
         enc = self._encode(row, raw, fresh)
         ops = seqk.make_op_batch([[enc]], 1, 1)
         self._state, out = _step_one(self._state, row, ops)
+        self._host_state = None
+        out = jax.tree.map(np.asarray, out)
         return self._decode_doc(row, [raw], [enc], out, 0, fresh)[0]
 
     # -- batched tick path ------------------------------------------------------
@@ -301,6 +311,11 @@ class KernelSequencerHost:
         ops = seqk.make_op_batch(per_doc_ops, self._capacity,
                                  _next_pow2(max_k))
         self._state, out = seqp.process_batch_best(self._state, ops)
+        self._host_state = None
+        # One transfer for the whole tick: the per-op decode below
+        # must index HOST arrays, not a device buffer (each device
+        # index would be a tunnel round trip).
+        out = jax.tree.map(np.asarray, out)
         for doc_id in doc_ids:
             row = self._rows[doc_id]
             self._ready.setdefault(doc_id, []).extend(self._decode_doc(
@@ -330,12 +345,20 @@ class KernelSequencerHost:
 
     # -- checkpoint / restore ---------------------------------------------------
 
+    def _host_view(self):
+        """Full host copy of the device state (one transfer, cached until
+        the next device write)."""
+        if self._host_state is None:
+            self._host_state = jax.tree.map(np.asarray, self._state)
+        return self._host_state
+
     def checkpoint(self, doc_id: str,
                    log_offset: int = -1) -> SequencerCheckpoint:
-        """Read one document's device row back into the durable checkpoint
-        format shared with the scalar sequencer (deli checkpointContext)."""
+        """Read one document's row from the cached host mirror into the
+        durable checkpoint format shared with the scalar sequencer (deli
+        checkpointContext)."""
         row = self._rows[doc_id]
-        s = jax.tree.map(lambda a: np.asarray(a[row]), self._state)
+        s = jax.tree.map(lambda a: a[row], self._host_view())
         clients = []
         for client_id, slot in sorted(self._slots[row].items()):
             if not bool(s.active[slot]):
@@ -399,6 +422,7 @@ class KernelSequencerHost:
         self._state = seqk.SequencerState(
             **{f: getattr(self._state, f).at[row].set(vals[f])
                for f in self._state._fields})
+        self._host_state = None
 
     # -- LocalCollabServer integration -----------------------------------------
 

@@ -89,13 +89,18 @@ class _MergeRow:
 
 
 class _MapRow:
-    __slots__ = ("row", "key_slots", "pending", "last_seq")
+    __slots__ = ("row", "key_slots", "pending", "last_seq",
+                 "literal_values")
 
     def __init__(self, row: int) -> None:
         self.row = row
         self.key_slots: dict[str, int] = {}
         self.pending: list[dict] = []
         self.last_seq = 0
+        # Storm channels (server/storm.py) carry literal small-int values
+        # in the op words instead of interned ids; they reject dict-path
+        # traffic, so one row is always one mode.
+        self.literal_values = False
 
 
 class _MatrixRow:
@@ -1128,6 +1133,10 @@ class KernelMergeHost:
     def _ingest_map(self, key: ChannelKey, channel_op: dict,
                     message: SequencedDocumentMessage) -> None:
         row = self._map_row(key)
+        if row.literal_values:
+            raise ValueError(
+                f"channel {key} is storm-served (literal values); dict-path "
+                "ops cannot mix on one channel")
         seq = message.sequence_number
         if seq <= row.last_seq:
             return
@@ -1376,6 +1385,10 @@ class KernelMergeHost:
             self.flush()
         present = np.asarray(self._xstate.present[row.row])
         value = np.asarray(self._xstate.value[row.row])
+        if row.literal_values:
+            return {name: int(value[slot])
+                    for name, slot in row.key_slots.items()
+                    if present[slot]}
         return {name: self._val_rev[value[slot]]
                 for name, slot in row.key_slots.items() if present[slot]}
 

@@ -624,6 +624,8 @@ class RouterliciousService:
                  fanout=None) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
+        # Optional columnar fast path (server/storm.py attaches itself).
+        self.storm = None
         # Optional native pub/sub broadcast hop (native/fanout.py — the
         # Redis + socket.io-adapter analog). None = direct callbacks.
         self.fanout = fanout
@@ -743,6 +745,11 @@ class RouterliciousService:
             batch: list[SequencedDocumentMessage] = []
             last_key = (doc_id, client_id)
             while (payload := self.fanout.poll(sub)) is not None:
+                if payload[:1] == b"\x00":
+                    # Compact storm tick frame (server/storm.py): consumed
+                    # by storm-aware frontends; the per-op connections here
+                    # catch up via get_deltas materialization instead.
+                    continue
                 op = from_wire(_json.loads(payload.decode()))
                 if op.sequence_number <= self._fanout_last_seq.get(
                         last_key, 0):
@@ -853,6 +860,23 @@ class RouterliciousService:
         self._maybe_pump()
         log: list[SequencedDocumentMessage] = self.store.get(
             f"ops/{doc_id}", [])
+        storm_records = self.store.get(f"storm_ops/{doc_id}", [])
+        if storm_records:
+            # Columnar scriptorium records (storm fast path) materialize
+            # per-op messages lazily — only the catch-up read path pays,
+            # and only for records overlapping the requested range (a
+            # tip reader must not rebuild the whole history).
+            from .storm import materialize_storm_records
+            storm = self.storm
+            wanted = [r for r in storm_records
+                      if r["last_seq"] > from_seq
+                      and (to_seq is None or r["first_seq"] <= to_seq)]
+            log = sorted(
+                log + materialize_storm_records(
+                    wanted,
+                    storm.datastore if storm else "default",
+                    storm.channel if storm else "root"),
+                key=lambda m: m.sequence_number)
         return [m for m in log
                 if m.sequence_number > from_seq
                 and (to_seq is None or m.sequence_number <= to_seq)]

@@ -1,0 +1,286 @@
+"""Columnar op-storm fast path (server/storm.py): the batched-cadence
+deli → merger pipeline fused into one device tick, fed by binary frames.
+
+Oracles: (1) the device map state must equal a scalar MapData replay of
+the messages the catch-up read path materializes from the columnar
+durable records; (2) resending an un-acked frame must be fully ignored
+(kernel clientSequenceNumber dedup — at-least-once delivery contract);
+(3) unknown writers are rejected by the sequencer kernel, not trusted.
+"""
+
+import json
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.dds.map_data import MapData
+from fluidframework_tpu.protocol.codec import (
+    decode_storm_body,
+    encode_storm_body,
+    encode_storm_frame,
+    is_storm_body,
+)
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+from fluidframework_tpu.server.storm import StormController
+
+
+def make_service(num_docs=8, flush_threshold_docs=10**9):
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=num_docs)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False)
+    storm = StormController(service, seq_host, merge_host,
+                            flush_threshold_docs=flush_threshold_docs)
+    return service, storm, merge_host
+
+
+def join_docs(service, docs):
+    clients = {d: service.connect(d, lambda m: None).client_id
+               for d in docs}
+    service.pump()
+    return clients
+
+
+def make_words(rng, k, num_slots=16):
+    kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
+    slots = rng.integers(0, num_slots, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def replay_oracle(service, doc_id):
+    """Scalar MapData fold of the materialized catch-up messages."""
+    data = MapData()
+    for m in service.get_deltas(doc_id, 0):
+        if m.type != MessageType.OPERATION or not isinstance(m.contents,
+                                                             dict):
+            continue
+        inner = m.contents.get("contents", {}).get("contents")
+        if inner:
+            data.process(inner, False, None)
+    return dict(data.items())
+
+
+def test_codec_roundtrip():
+    words = np.arange(7, dtype=np.uint32)
+    body = encode_storm_body({"op": "storm", "docs": []}, words.tobytes())
+    assert is_storm_body(body)
+    header, payload = decode_storm_body(body)
+    assert header["op"] == "storm"
+    assert np.array_equal(np.frombuffer(payload, np.uint32), words)
+    assert not is_storm_body(b'{"op": "connect"}')
+
+
+def test_storm_matches_scalar_replay_and_acks():
+    docs = [f"doc{i}" for i in range(8)]
+    service, storm, merge_host = make_service()
+    clients = join_docs(service, docs)
+    rng = np.random.default_rng(0)
+    k = 64
+    acks = []
+    cseqs = {d: 1 for d in docs}
+    for _tick in range(3):
+        payload, hdr_docs = b"", []
+        for d in docs:
+            w = make_words(rng, k)
+            payload += w.tobytes()
+            hdr_docs.append([d, clients[d], cseqs[d], 1, k])
+            cseqs[d] += k
+        storm.submit_frame(acks.append, {"op": "storm", "rid": _tick,
+                                         "docs": hdr_docs},
+                           memoryview(payload))
+    storm.flush()
+    assert storm.stats["sequenced_ops"] == len(docs) * k * 3
+    assert len(acks) == 3
+    for ack in acks:
+        assert all(a[0] == k for a in ack["acks"])
+    for d in docs:
+        assert merge_host.map_entries(d, "default", "root") \
+            == replay_oracle(service, d), d
+
+
+def test_storm_resend_is_ignored_not_reapplied():
+    docs = ["doc0", "doc1"]
+    service, storm, merge_host = make_service()
+    clients = join_docs(service, docs)
+    k = 16
+    words = make_words(np.random.default_rng(1), k)
+    hdr = {"op": "storm", "rid": 1,
+           "docs": [[d, clients[d], 1, 1, k] for d in docs]}
+    for _ in range(2):  # first send + verbatim resend (no ack seen)
+        storm.submit_frame(None, dict(hdr), memoryview(words.tobytes() * 2))
+        storm.flush()
+    assert storm.stats["sequenced_ops"] == len(docs) * k
+    assert storm.stats["nacked_or_ignored_ops"] == len(docs) * k
+    for d in docs:
+        assert merge_host.map_entries(d, "default", "root") \
+            == replay_oracle(service, d)
+
+
+def test_storm_unknown_writer_rejected_by_kernel():
+    service, storm, merge_host = make_service()
+    join_docs(service, ["doc0"])
+    k = 8
+    words = make_words(np.random.default_rng(2), k)
+    acks = []
+    storm.submit_frame(acks.append, {
+        "op": "storm", "rid": 9,
+        "docs": [["doc0", "client-never-joined", 1, 1, k]],
+    }, memoryview(words.tobytes()))
+    storm.flush()
+    assert acks[0]["acks"][0][0] == 0  # zero ops sequenced
+    assert storm.stats["sequenced_ops"] == 0
+    assert merge_host.map_entries("doc0", "default", "root") == {}
+
+
+def test_storm_and_dict_paths_share_the_sequencer_state():
+    """Per-doc total order is ONE stream: ops submitted through the
+    regular front door and storm ops interleave with strictly increasing
+    seqs."""
+    service, storm, merge_host = make_service()
+    docs = ["doc0"]
+    clients = join_docs(service, docs)
+    k = 8
+    words = make_words(np.random.default_rng(3), k)
+    storm.submit_frame(None, {"op": "storm", "docs": [
+        ["doc0", clients["doc0"], 1, 1, k]]}, memoryview(words.tobytes()))
+    storm.flush()
+    msgs = service.get_deltas("doc0", 0)
+    seqs = [m.sequence_number for m in msgs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # join (seq 1) + k storm ops
+    assert len([m for m in msgs if m.type == MessageType.OPERATION]) == k
+
+
+def test_storm_channel_rejects_dict_traffic():
+    from fluidframework_tpu.protocol.messages import (
+        SequencedDocumentMessage,
+    )
+
+    service, storm, merge_host = make_service()
+    clients = join_docs(service, ["doc0"])
+    words = make_words(np.random.default_rng(4), 4)
+    storm.submit_frame(None, {"op": "storm", "docs": [
+        ["doc0", clients["doc0"], 1, 1, 4]]}, memoryview(words.tobytes()))
+    storm.flush()
+    dict_op = SequencedDocumentMessage(
+        client_id=clients["doc0"], sequence_number=10**6,
+        minimum_sequence_number=0, client_sequence_number=99,
+        reference_sequence_number=1, type=MessageType.OPERATION,
+        contents={"address": "default",
+                  "contents": {"address": "root",
+                               "contents": {"type": "set", "key": "x",
+                                            "value": 1}}},
+        timestamp=0, data=None)
+    with pytest.raises(ValueError, match="storm-served"):
+        merge_host.ingest("doc0", dict_op)
+
+
+def test_storm_over_bridge_wire():
+    from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
+
+    docs = [f"d{i}" for i in range(4)]
+    service, storm, merge_host = make_service(
+        flush_threshold_docs=len(docs))
+    front = BridgeFrontDoor(service, 0)
+    try:
+        clients = join_docs(service, docs)
+        sock = socket.create_connection(("127.0.0.1", front.port))
+        sock.settimeout(30)
+        k = 32
+        words = (np.arange(k, dtype=np.uint32) << 12)
+        hdr = {"op": "storm", "rid": 7,
+               "docs": [[d, clients[d], 1, 1, k] for d in docs]}
+        sock.sendall(encode_storm_frame(hdr, words.tobytes() * len(docs)))
+        length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
+        ack = json.loads(sock.recv(length, socket.MSG_WAITALL).decode())
+        assert ack["rid"] == 7 and all(a[0] == k for a in ack["acks"])
+        for d in docs:
+            assert merge_host.map_entries(d, "default", "root") \
+                == {"k0": k - 1}  # LWW: the last set wins
+        sock.close()
+    finally:
+        front.close()
+
+
+def test_malformed_storm_frames_fail_alone():
+    """Bad frames are rejected BEFORE buffering (never poisoning other
+    sessions' frames) and the socket answers with an error and lives."""
+    from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
+
+    service, storm, merge_host = make_service(flush_threshold_docs=1)
+    front = BridgeFrontDoor(service, 0)
+    try:
+        clients = join_docs(service, ["doc0"])
+        sock = socket.create_connection(("127.0.0.1", front.port))
+        sock.settimeout(30)
+
+        def roundtrip(hdr, payload):
+            sock.sendall(encode_storm_frame(hdr, payload))
+            n = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
+            return json.loads(sock.recv(n, socket.MSG_WAITALL).decode())
+
+        w4 = np.zeros(4, np.uint32).tobytes()
+        # count exceeding the payload
+        resp = roundtrip({"op": "storm", "rid": 1,
+                          "docs": [["doc0", clients["doc0"], 1, 1, 99]]},
+                         w4)
+        assert "error" in resp
+        # repeated doc within one frame
+        resp = roundtrip({"op": "storm", "rid": 2,
+                          "docs": [["doc0", clients["doc0"], 1, 1, 4],
+                                   ["doc0", clients["doc0"], 5, 1, 4]]},
+                         w4 * 2)
+        assert "error" in resp
+        # key slot out of the configured range
+        big_slot = np.full(4, np.uint32(1000 << 2), np.uint32)
+        resp = roundtrip({"op": "storm", "rid": 3,
+                          "docs": [["doc0", clients["doc0"], 1, 1, 4]]},
+                         big_slot.tobytes())
+        assert "error" in resp
+        # negative count must not slip through np.frombuffer
+        resp = roundtrip({"op": "storm", "rid": 4,
+                          "docs": [["doc0", clients["doc0"], 1, 1, -1]]},
+                         w4)
+        assert "error" in resp
+        # ...and the connection still works for a GOOD frame.
+        resp = roundtrip({"op": "storm", "rid": 5,
+                          "docs": [["doc0", clients["doc0"], 1, 1, 4]]},
+                         np.full(4, 9 << 12, np.uint32).tobytes())
+        assert resp.get("storm") and resp["acks"][0][0] == 4
+        assert storm.stats["sequenced_ops"] == 4
+        sock.close()
+    finally:
+        front.close()
+
+
+def test_storm_tail_frame_drains_on_idle():
+    """A frame below the tick threshold must still sequence (bridge idle
+    drain) rather than starve waiting for a full cohort."""
+    import time
+
+    from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
+
+    service, storm, merge_host = make_service(flush_threshold_docs=1000)
+    front = BridgeFrontDoor(service, 0)
+    try:
+        clients = join_docs(service, ["doc0"])
+        sock = socket.create_connection(("127.0.0.1", front.port))
+        sock.settimeout(30)
+        words = np.full(4, 7 << 12, np.uint32)
+        sock.sendall(encode_storm_frame(
+            {"op": "storm", "rid": 1,
+             "docs": [["doc0", clients["doc0"], 1, 1, 4]]},
+            words.tobytes()))
+        length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
+        ack = json.loads(sock.recv(length, socket.MSG_WAITALL).decode())
+        assert ack["acks"][0][0] == 4
+        sock.close()
+    finally:
+        front.close()
