@@ -91,16 +91,37 @@ def _run_device(apply_fn, state, batches, ops_per_tick: int,
         lat.append((time.perf_counter() - start) * 1000.0)
     lat_arr = np.asarray(lat)
     best_rate = float(sorted(rates)[1])  # median of 3
+    # Pipelined (depth-2) completion cadence: submit tick N+1 BEFORE
+    # syncing tick N, the way the serving host runs (storm controller's
+    # depth-1 harvest). The sync still pays one transport RTT, but the
+    # device time of the next tick hides under it — this is the latency
+    # an op actually sees at a kept-fed kernel.
+    pipe = []
+    st = state0
+    prev = None
+    for i in range(latency_ticks):
+        batch = batches[i % len(batches)]
+        start = time.perf_counter()
+        nxt = apply_fn(st, batch)
+        if prev is not None:
+            _force(prev)  # prev tick's OUTPUT: exactly one tick in flight
+        pipe.append((time.perf_counter() - start) * 1000.0)
+        prev = st = nxt
+    pipe_arr = np.asarray(pipe[1:])
     return {
         "device_ops_per_sec": best_rate,
-        # Free-running per-tick time — the device cost of one batched
-        # apply when the pipeline is kept fed (the serving cadence).
+        # Free-running per-tick time — the pure device cost of one batched
+        # apply when the pipeline is kept fed (the serving cadence floor).
         "tick_ms_freerun": 1000.0 * ops_per_tick / best_rate,
         # Blocked round-trip latency per tick: submit one tick, sync to
         # host. On a tunneled/remote attachment this includes transport
         # RTT, so it upper-bounds the device tick latency.
         "tick_ms_p50": float(np.percentile(lat_arr, 50)),
         "tick_ms_p99": float(np.percentile(lat_arr, 99)),
+        # Depth-2 pipelined cadence (serving shape): per-tick wall time
+        # with the next tick already enqueued when syncing the previous.
+        "tick_ms_pipelined_p50": float(np.percentile(pipe_arr, 50)),
+        "tick_ms_pipelined_p99": float(np.percentile(pipe_arr, 99)),
         "ops_per_tick": ops_per_tick,
     }
 

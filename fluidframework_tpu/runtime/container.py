@@ -25,6 +25,40 @@ from .container_runtime import ContainerRuntime
 from .delta_manager import DeltaManager
 
 
+class Audience:
+    """Every connected client of the document — INCLUDING read-only
+    connections, which never enter the quorum (container.ts:1700 region's
+    audience wiring; the quorum tracks write clients only). Fed by
+    service-emitted ``__audience__`` signals."""
+
+    def __init__(self) -> None:
+        self.members: dict[str, dict] = {}
+        self.on_add_member: list[Callable[[str, dict], None]] = []
+        self.on_remove_member: list[Callable[[str, dict], None]] = []
+
+    def get_members(self) -> dict[str, dict]:
+        return dict(self.members)
+
+    def get_member(self, client_id: str) -> dict | None:
+        return self.members.get(client_id)
+
+    def _apply(self, payload: dict) -> None:
+        event = payload.get("event")
+        if event == "snapshot":
+            self.members = {m["client_id"]: dict(m)
+                            for m in payload.get("members", [])}
+        elif event == "join":
+            member = dict(payload["member"])
+            self.members[member["client_id"]] = member
+            for cb in self.on_add_member:
+                cb(member["client_id"], member)
+        elif event == "leave":
+            member = self.members.pop(payload.get("client_id"), None)
+            if member is not None:
+                for cb in self.on_remove_member:
+                    cb(payload["client_id"], member)
+
+
 class Container:
     def __init__(self, document_service: DocumentService,
                  registry=None) -> None:
@@ -40,6 +74,7 @@ class Container:
             on_nack=self._on_nack,
         )
         self._mode = "write"
+        self.audience = Audience()
         self.on_connected: list[Callable[[str], None]] = []
         self.on_disconnected: list[Callable[[], None]] = []
         self.on_signal: list[Callable[[Any], None]] = []
@@ -211,6 +246,15 @@ class Container:
             cb(nack)
 
     def _process_signal(self, signal: Any) -> None:
+        content = signal.get("content") if isinstance(signal, dict) else None
+        # Only SERVICE-crafted audience signals (client_id None) may touch
+        # the roster — a client echoing the payload shape must not spoof
+        # membership, and falls through to the app like any signal.
+        if (isinstance(content, dict)
+                and content.get("type") == "__audience__"  # audience.py
+                and signal.get("client_id") is None):
+            self.audience._apply(content)
+            return  # system signal, not app-visible
         for cb in self.on_signal:
             cb(signal)
 

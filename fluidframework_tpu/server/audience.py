@@ -1,0 +1,39 @@
+"""Audience announcements — the service side of the container's audience
+roster (container.ts:1700 region): every connection, including read-only
+ones that never reach the quorum, is announced via system signals
+(``client_id`` None on the wire; clients reject the shape from peers).
+
+Shared by both service assemblies (RouterliciousService and
+LocalCollabServer); connection objects are duck-typed
+(client_id / mode / on_signal).
+"""
+
+from __future__ import annotations
+
+AUDIENCE_SIGNAL = "__audience__"
+
+
+def _signal(content: dict) -> dict:
+    return {"client_id": None, "content": {"type": AUDIENCE_SIGNAL,
+                                           **content}}
+
+
+def announce_connect(connections, connection) -> None:
+    """Send the newcomer the full roster; announce it to everyone else."""
+    if connection.on_signal is not None:
+        connection.on_signal(_signal({
+            "event": "snapshot",
+            "members": [{"client_id": c.client_id, "mode": c.mode}
+                        for c in connections.values()]}))
+    member = {"client_id": connection.client_id, "mode": connection.mode}
+    for other in connections.values():
+        if (other.client_id != connection.client_id
+                and other.on_signal is not None):
+            other.on_signal(_signal({"event": "join", "member": member}))
+
+
+def announce_leave(connections, client_id: str) -> None:
+    for other in connections.values():
+        if other.on_signal is not None:
+            other.on_signal(_signal({"event": "leave",
+                                     "client_id": client_id}))

@@ -114,6 +114,10 @@ class LocalCollabServer:
                                  on_signal)
         connection.mode = mode
         document.connections[client_id] = connection
+        # Audience wiring (container.ts:1700): announce EVERY connection
+        # (read-only ones included — they never reach the quorum).
+        from .audience import announce_connect
+        announce_connect(document.connections, connection)
         # Read clients receive the broadcast stream but never enter the
         # quorum or the MSN calculation (the reference sequences joins only
         # for write connections — a reader must not pin minSeq).
@@ -132,6 +136,9 @@ class LocalCollabServer:
     def disconnect(self, doc_id: str, client_id: str) -> None:
         document = self._document(doc_id)
         connection = document.connections.pop(client_id, None)
+        if connection is not None:
+            from .audience import announce_leave
+            announce_leave(document.connections, client_id)
         if connection is not None and connection.mode == "read":
             return
         self._sequence_raw(document, RawOperation(

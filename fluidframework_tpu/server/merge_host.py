@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dds.mergetree import MergeEngine
+from ..dds.mergetree import Marker, MergeEngine, Segment
 from ..dds.tree_core import ROOT_ID, VALID, Transaction, TreeSnapshot
 from ..ops import map_kernel as mk
 from ..ops import matrix_kernel as mxk
@@ -57,6 +57,14 @@ from .kernel_host import _next_pow2
 
 _MERGE_OPS = frozenset({"insert", "remove", "annotate", "group"})
 _MAP_OPS = frozenset({"set", "delete", "clear"})
+
+# Text pools are append-only; once a row's pool churn passes this mark the
+# host repacks it down to the referenced slices (zamboni for text bytes).
+_TEXT_REPACK_MIN = 1 << 20
+# Tree channels trim their applied edit-log prefix into a materialized
+# base snapshot once it outgrows this (the overflow fallback replays
+# base + remaining log).
+_TREE_LOG_TRIM = 512
 
 # A marker occupies one pool char; stripped at materialization. Real text
 # never contains NUL (the wire format is JSON-ish strings).
@@ -71,7 +79,8 @@ class ChannelKey(NamedTuple):
 
 class _MergeRow:
     __slots__ = ("pool", "row", "client_slots", "key_slots", "pending",
-                 "raw_log", "scalar", "min_seq", "last_seq", "markers")
+                 "raw_log", "scalar", "min_seq", "last_seq", "markers",
+                 "repack_at", "applied_seq", "applied_min_seq")
 
     def __init__(self) -> None:
         self.pool: "_MergePool | None" = None
@@ -79,13 +88,21 @@ class _MergeRow:
         self.client_slots: dict[str, int] = {}
         self.key_slots: dict[str, int] = {}
         self.pending: list[dict] = []
-        # Full sequenced history (subop, seq, ref_seq, client) — the replay
-        # source if this channel overflows to the scalar path.
+        # Sequenced ops NOT YET applied on device (subop, seq, ref_seq,
+        # client) — trimmed at every flush; the scalar-fallback replay
+        # source is the device row itself (seeded exactly) plus this tail,
+        # so a long-lived document's host memory stays bounded.
         self.raw_log: list[tuple[dict, int, int, str]] = []
         self.scalar: MergeEngine | None = None
         self.min_seq = 0
         self.last_seq = 0
+        # Frontier the DEVICE row reflects (advances when raw_log trims):
+        # the scalar seed starts here, then replays the unapplied tail.
+        self.applied_seq = 0
+        self.applied_min_seq = 0
         self.markers = 0
+        # Text-pool churn level that triggers the next repack attempt.
+        self.repack_at = _TEXT_REPACK_MIN
 
 
 class _MapRow:
@@ -106,17 +123,21 @@ class _MapRow:
 class _MatrixRow:
     __slots__ = ("row", "client_slots", "pending", "raw_log", "scalar",
                  "last_seq", "min_seq", "next_row_handle",
-                 "next_col_handle")
+                 "next_col_handle", "applied_seq", "applied_min_seq")
 
     def __init__(self, row: int) -> None:
         self.row = row
         self.client_slots: dict[str, int] = {}
         self.pending: list[dict] = []
-        # (channel_op, seq, ref_seq, client) — scalar-fallback replay source.
+        # Ops NOT YET applied on device (channel_op, seq, ref_seq, client)
+        # — trimmed at every flush; the fallback seeds from the device row
+        # and replays only this tail (bounded host memory).
         self.raw_log: list[tuple[dict, int, int, str]] = []
         self.scalar: tuple | None = None  # (rows vec, cols vec, cells dict)
         self.last_seq = 0
         self.min_seq = 0
+        self.applied_seq = 0
+        self.applied_min_seq = 0
         self.next_row_handle = 0
         self.next_col_handle = 0
 
@@ -128,7 +149,7 @@ class _TreeRow:
 
     __slots__ = ("row", "slot_of", "info_of", "trait_ids", "trait_rev",
                  "free", "next_slot", "pending", "raw_log", "scalar",
-                 "last_seq")
+                 "last_seq", "base")
 
     def __init__(self, row: int) -> None:
         self.row = row
@@ -139,10 +160,13 @@ class _TreeRow:
         self.free: list[int] = []
         self.next_slot = 1
         self.pending: list[dict] = []
-        # Sequenced edits in order — the exact replay source if this
+        # Sequenced edits since ``base`` — the exact replay source if this
         # channel leaves the device (unsupported edit shape / rank
-        # overflow), mirroring the merge row's raw_log contract.
+        # overflow). At clean flush boundaries an over-long applied prefix
+        # folds into ``base`` (a device-materialized snapshot), bounding
+        # host memory; the fallback replays base + remaining log.
         self.raw_log: list[dict] = []
+        self.base: dict | None = None  # serialized TreeSnapshot
         self.scalar: TreeSnapshot | None = None
         self.last_seq = 0
 
@@ -536,10 +560,59 @@ class KernelMergeHost:
                                     prop_val=self._intern(value)))
             self._pending_ops += 1
 
-    def _route_to_scalar(self, key: ChannelKey, row: _MergeRow) -> None:
-        """Client-slot bitmask exhausted: replay the channel's full history
-        through the scalar engine and serve it host-side from now on."""
+    def _seed_merge_engine(self, row: _MergeRow) -> MergeEngine:
+        """Exact scalar twin of a device merge row: every table slot —
+        live AND tombstoned-in-window — becomes a Segment with its insert
+        seq/client, removal seq/client/overlap set and props, so future
+        position transforms resolve identically. O(row), paid only when a
+        channel leaves the device; replaces replaying full history."""
+        arrays = row.pool.row_arrays(row.row)
+        buffer = row.pool.text.buffer(row.row)
+        slot_rev = {s: c for c, s in row.client_slots.items()}
+        key_rev = {s: k for k, s in row.key_slots.items()}
         engine = MergeEngine(local_client=None)
+        engine.current_seq = row.applied_seq
+        engine.min_seq = row.applied_min_seq
+        none_seq = int(mtk.NONE_SEQ)
+        for i in range(arrays["valid"].shape[0]):
+            if not arrays["valid"][i]:
+                continue
+            length = int(arrays["length"][i])
+            if length == 0:
+                continue  # transient zero-length slot: nothing to carry
+            start = int(arrays["pool_start"][i])
+            text = buffer[start:start + length]
+            if text == _MARKER_CHAR * length:
+                # Marker / item-run segment (encoded as NUL chars; item
+                # payloads are opaque to the server). A non-str content
+                # keeps text() from serving NULs; placeholders preserve
+                # the position-space length.
+                content: Any = Marker() if length == 1 \
+                    else tuple([None] * length)
+            else:
+                content = text
+            rem_seq = int(arrays["rem_seq"][i])
+            overlap = {slot_rev[s] for s in range(mtk.MAX_CLIENT_SLOTS)
+                       if (int(arrays["rem_overlap"][i]) >> s) & 1
+                       and s in slot_rev}
+            props = {key_rev[p]: self._val_rev[int(arrays["prop_val"][i, p])]
+                     for p in range(arrays["prop_val"].shape[1])
+                     if int(arrays["prop_val"][i, p]) and p in key_rev}
+            engine.segments.append(Segment(
+                content=content,
+                seq=int(arrays["ins_seq"][i]),
+                client=slot_rev.get(int(arrays["ins_client"][i])),
+                removed_seq=None if rem_seq == none_seq else rem_seq,
+                removed_client=slot_rev.get(int(arrays["rem_client"][i])),
+                removed_overlap=overlap,
+                props=props or None,
+            ))
+        return engine
+
+    def _route_to_scalar(self, key: ChannelKey, row: _MergeRow) -> None:
+        """Client-slot bitmask exhausted: seed the scalar engine from the
+        device row (exact, O(row)) and replay only the unapplied tail."""
+        engine = self._seed_merge_engine(row)
         for op, seq, ref_seq, client in row.raw_log:
             engine.apply_remote(op, seq, ref_seq, client)
         row.scalar = engine
@@ -601,14 +674,70 @@ class KernelMergeHost:
         row.pending.extend(encoded)
         self._pending_ops += len(encoded)
 
-    def _route_matrix_to_scalar(self, row: _MatrixRow) -> None:
-        """Client-slot bitmask exhausted: replay through scalar permutation
-        vectors + an LWW cell fold and serve host-side from now on."""
+    def _seed_matrix_scalar(self, row: _MatrixRow) -> tuple:
+        """Exact scalar twin of a device matrix row: the two embedded
+        merge states become PermutationVectors (handle runs from
+        pool_start), the cell table becomes the LWW dict."""
         from ..dds.matrix import PermutationVector
-        rows_vec = PermutationVector(None)
-        cols_vec = PermutationVector(None)
+        s = self._matrix_state
+        slot_rev = {sl: c for c, sl in row.client_slots.items()}
+        none_seq = int(mtk.NONE_SEQ)
+
+        def seed_vec(ms: mtk.MergeState,
+                     next_handle: int) -> PermutationVector:
+            vec = PermutationVector(None)
+            # Handle allocation continues where the host's device-path
+            # counter left off (a fresh vector restarting at 0 would
+            # collide new runs with live handles).
+            vec.next_handle = next_handle
+            engine = vec.engine
+            engine.current_seq = row.applied_seq
+            engine.min_seq = row.applied_min_seq
+            arrays = {f: np.asarray(getattr(ms, f)[row.row])
+                      for f in mtk.MergeState._fields if f != "count"}
+            for i in range(arrays["valid"].shape[0]):
+                if not arrays["valid"][i] or arrays["length"][i] == 0:
+                    continue
+                base = int(arrays["pool_start"][i])
+                length = int(arrays["length"][i])
+                rem = int(arrays["rem_seq"][i])
+                overlap = {slot_rev[c]
+                           for c in range(mtk.MAX_CLIENT_SLOTS)
+                           if (int(arrays["rem_overlap"][i]) >> c) & 1
+                           and c in slot_rev}
+                engine.segments.append(Segment(
+                    content=tuple(range(base, base + length)),
+                    seq=int(arrays["ins_seq"][i]),
+                    client=slot_rev.get(int(arrays["ins_client"][i])),
+                    removed_seq=None if rem == none_seq else rem,
+                    removed_client=slot_rev.get(
+                        int(arrays["rem_client"][i])),
+                    removed_overlap=overlap,
+                ))
+            return vec
+
         cells: dict[tuple[int, int], Any] = {}
-        row.scalar = (rows_vec, cols_vec, cells)
+        used = np.asarray(s.cell_used[row.row])
+        cell_rh = np.asarray(s.cell_rh[row.row])
+        cell_ch = np.asarray(s.cell_ch[row.row])
+        cell_val = np.asarray(s.cell_val[row.row])
+        for c in range(used.shape[0]):
+            if used[c]:
+                cells[(int(cell_rh[c]), int(cell_ch[c]))] = \
+                    self._val_rev[int(cell_val[c])]
+        return (seed_vec(s.rows, row.next_row_handle),
+                seed_vec(s.cols, row.next_col_handle), cells)
+
+    def _route_matrix_to_scalar(self, row: _MatrixRow) -> None:
+        """Client-slot bitmask exhausted: seed scalar permutation vectors
+        + the LWW cell dict from the device row, replay the unapplied
+        tail, and serve host-side from now on."""
+        if self._matrix_state is None:
+            from ..dds.matrix import PermutationVector
+            row.scalar = (PermutationVector(None), PermutationVector(None),
+                          {})
+        else:
+            row.scalar = self._seed_matrix_scalar(row)
         self._pending_ops -= len(row.pending)
         row.pending = []
         for op, seq, ref_seq, client in row.raw_log:
@@ -737,6 +866,9 @@ class KernelMergeHost:
         self.stats["flushes"] += 1
         for r in rows:
             r.pending = []
+            r.raw_log = []  # device row now reflects the whole history
+            r.applied_seq = r.last_seq
+            r.applied_min_seq = r.min_seq
 
     # -- tree channels (SharedTree.ts:446 behind the service) ------------------
     #
@@ -840,9 +972,11 @@ class KernelMergeHost:
             row.scalar = txn.snapshot
 
     def _route_tree_to_scalar(self, row: _TreeRow) -> None:
-        """Replay the channel's sequenced edits through the scalar
-        Transaction path and serve it host-side from now on."""
-        snap = TreeSnapshot()
+        """Replay the channel's sequenced edits (on top of the trimmed
+        base snapshot, if any) through the scalar Transaction path and
+        serve it host-side from now on."""
+        snap = (TreeSnapshot.load(row.base) if row.base is not None
+                else TreeSnapshot())
         for edit in row.raw_log:
             txn = Transaction(snap)
             if txn.apply_edit(edit) == VALID:
@@ -1109,26 +1243,33 @@ class KernelMergeHost:
                      trait=tid)]
 
     def _flush_tree(self) -> None:
-        rows = [r for r in self._tree_rows.values() if r.pending]
-        if not rows:
+        items = [(key, r) for key, r in self._tree_rows.items()
+                 if r.pending]
+        if not items:
             return
         self._ensure_tree_state()
-        k = _next_pow2(max(len(r.pending) for r in rows))
+        k = _next_pow2(max(len(r.pending) for _, r in items))
         per_doc: list[list[dict]] = [[] for _ in range(self._tree_capacity)]
-        for r in rows:
+        for _, r in items:
             per_doc[r.row] = r.pending
         batch = tk.make_tree_op_batch(per_doc, self._tree_capacity, k)
         self._tree_state, outs = tk.apply_tick(self._tree_state, batch)
         overflowed = np.asarray(jnp.any(outs.overflow, axis=1))
-        self.stats["device_ops"] += sum(len(r.pending) for r in rows)
+        self.stats["device_ops"] += sum(len(r.pending) for _, r in items)
         self.stats["flushes"] += 1
-        for r in rows:
+        for _, r in items:
             r.pending = []
-        for r in rows:
+        for key, r in items:
             if overflowed[r.row]:
                 # Rank space exhausted mid-tick: the device state is
-                # partially applied; rebuild exactly from the edit log.
+                # partially applied; rebuild exactly from base + edit log.
                 self._route_tree_to_scalar(r)
+            elif len(r.raw_log) > _TREE_LOG_TRIM:
+                # Clean boundary: the device row reflects the whole log —
+                # fold it into a materialized base snapshot.
+                r.base = self.tree_snapshot(*key)
+                r.raw_log = []
+                self.stats["compactions"] += 1
 
     def _ingest_map(self, key: ChannelKey, channel_op: dict,
                     message: SequencedDocumentMessage) -> None:
@@ -1223,7 +1364,42 @@ class KernelMergeHost:
                 len(r.pending) for r in pool_rows)
             for r in pool_rows:
                 r.pending = []
+                # The device row now reflects everything in raw_log; the
+                # tail resets so host memory per channel stays bounded.
+                r.raw_log = []
+                r.applied_seq = r.last_seq
+                r.applied_min_seq = r.min_seq
+                if r.pool.text.used[r.row] > r.repack_at:
+                    self._repack_text_pool(r)
         self.stats["flushes"] += 1
+
+    def _repack_text_pool(self, row: _MergeRow) -> None:
+        """Zamboni for text bytes: the pool is append-only, so a long-lived
+        document's pool grows with total INSERTED text. Rebuild it from the
+        slices the live table still references (tombstones included) and
+        rewrite the row's pool_start plane."""
+        pool = row.pool
+        arrays = pool.row_arrays(row.row)
+        buffer = pool.text.buffer(row.row)
+        starts = arrays["pool_start"].copy()
+        pieces: list[str] = []
+        used = 0
+        for i in range(arrays["valid"].shape[0]):
+            if not arrays["valid"][i] or arrays["length"][i] == 0:
+                continue
+            start = int(starts[i])
+            length = int(arrays["length"][i])
+            pieces.append(buffer[start:start + length])
+            starts[i] = used
+            used += length
+        pool.state = pool.place(pool.state._replace(
+            pool_start=pool.state.pool_start.at[row.row].set(starts)))
+        pool.text.chunks[row.row] = pieces
+        pool.text.used[row.row] = used
+        # Back off if the row is legitimately large: retry only after
+        # another threshold's worth of churn.
+        row.repack_at = max(_TEXT_REPACK_MIN, 3 * used)
+        self.stats["compactions"] += 1
 
     @staticmethod
     def _rows_by_pool(rows: list[_MergeRow]

@@ -621,7 +621,8 @@ class RouterliciousService:
                  help_agents: list[str] | None = None,
                  batched_deli_host=None,
                  auto_pump: bool = True,
-                 fanout=None) -> None:
+                 fanout=None,
+                 idle_check_interval: int = 64) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
         # Optional columnar fast path (server/storm.py attaches itself).
@@ -656,6 +657,12 @@ class RouterliciousService:
         clock_start = int(self.store.get("clock", 0))
         self._clock_iter = itertools.count(clock_start + 1)
         self._pumping = False
+        # deli checkIdleClients cadence: every Nth pump crafts leaves for
+        # clients idle past their timeout (a stuck client must not pin the
+        # MSN forever — zamboni would starve).
+        self.idle_check_interval = max(1, idle_check_interval)
+        self._pumps_since_idle_check = 0
+        self._batched_deli_host = batched_deli_host
 
         # auto_pump=False is the batched-cadence mode: submits only produce
         # to the bus; the operator (or load harness) pumps on its own tick,
@@ -724,6 +731,44 @@ class RouterliciousService:
                     break
         finally:
             self._pumping = False
+        self._pumps_since_idle_check += 1
+        if self._pumps_since_idle_check >= self.idle_check_interval:
+            self._pumps_since_idle_check = 0
+            self.eject_idle_clients()
+
+    def eject_idle_clients(self,
+                           timeout_ms: int | None = None
+                           ) -> list[tuple[str, str]]:
+        """Craft CLIENT_LEAVE for every client idle past its timeout
+        (deli/lambda.ts:171 checkIdleClients): the leave sequences through
+        the normal path, freeing the MSN so zamboni proceeds. Returns the
+        (doc_id, client_id) pairs ejected."""
+        now = self._clock()
+        ejected: list[tuple[str, str]] = []
+        if self._batched_deli_host is not None:
+            ejected = self._batched_deli_host.idle_clients(now, timeout_ms)
+        else:
+            for doc_id, doc_lambda in self._deli._docs.items():
+                sequencer = getattr(doc_lambda, "sequencer", None)
+                if sequencer is None:
+                    continue
+                # One ejection per doc per check (the reference's
+                # getIdleClient shape); the next check catches the rest.
+                client_id = sequencer.get_idle_client(now, timeout_ms)
+                if client_id is not None:
+                    ejected.append((doc_id, client_id))
+        for doc_id, client_id in ejected:
+            self.logger.send_event("IdleClientEjected", docId=doc_id,
+                                   clientId=client_id)
+            self.orderer.order_system(doc_id, RawOperation(
+                client_id=None,
+                type=MessageType.CLIENT_LEAVE,
+                data=client_id,
+                timestamp=now,
+            ))
+        if ejected:
+            self._maybe_pump()
+        return ejected
 
     def _drain_fanout(self) -> int:
         """Frontend drain: deliver each subscriber's queued room payloads
@@ -787,6 +832,7 @@ class RouterliciousService:
             self._fanout_subs[(doc_id, client_id)] = sub
         self.logger.send_event("ClientConnect", docId=doc_id,
                                clientId=client_id, mode=mode)
+        self._announce_audience(doc_id, connection)
         if mode != "read":
             self.orderer.order_system(doc_id, RawOperation(
                 client_id=None,
@@ -799,6 +845,10 @@ class RouterliciousService:
             self._maybe_pump()
         return connection
 
+    def _announce_audience(self, doc_id: str, connection) -> None:
+        from .audience import announce_connect
+        announce_connect(self._connections_for(doc_id), connection)
+
     def disconnect(self, doc_id: str, client_id: str) -> None:
         if self.fanout is not None:
             sub = self._fanout_subs.pop((doc_id, client_id), None)
@@ -806,6 +856,9 @@ class RouterliciousService:
                 self.fanout.disconnect(sub)
             self._fanout_last_seq.pop((doc_id, client_id), None)
         connection = self._connections_for(doc_id).pop(client_id, None)
+        if connection is not None:
+            from .audience import announce_leave
+            announce_leave(self._connections_for(doc_id), client_id)
         if connection is not None and connection.open:
             # Service-initiated close (the client-initiated path flips
             # `open` before calling us): mark it dead so further submits

@@ -153,11 +153,14 @@ class DocumentSequencer:
             return -1
         return min(entry.ref_seq for entry in self.clients.values())
 
-    def get_idle_client(self, now: int) -> str | None:
+    def get_idle_client(self, now: int,
+                        timeout_ms: int | None = None) -> str | None:
         """Oldest client idle past the timeout, if any (deli getIdleClient)."""
+        timeout = (self.client_timeout_ms if timeout_ms is None
+                   else timeout_ms)
         idle = [
             e for e in self.clients.values()
-            if e.can_evict and now - e.last_update > self.client_timeout_ms
+            if e.can_evict and now - e.last_update > timeout
         ]
         if not idle:
             return None

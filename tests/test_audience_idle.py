@@ -1,0 +1,157 @@
+"""Audience roster (container.ts:1700 region) + idle-client ejection
+(deli/lambda.ts:171 checkIdleClients) behind both service assemblies."""
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+from fluidframework_tpu.server.sequencer import DocumentSequencer
+
+
+def make_doc(server, doc_id="doc"):
+    service = LocalDocumentService(server, doc_id)
+    container = Container.create_detached(service)
+    datastore = container.runtime.create_datastore("default")
+    datastore.create_channel("root", SharedMap.channel_type)
+    container.attach()
+    return container
+
+
+class TestAudience:
+    def test_roster_includes_read_only_clients(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        reader = Container.load(LocalDocumentService(server, "doc"),
+                                mode="read")
+        all_ids = {c1.client_id, c2.client_id, reader.client_id}
+        assert None not in all_ids and len(all_ids) == 3
+        for c in (c1, c2, reader):
+            assert set(c.audience.get_members()) == all_ids, c
+        # Read-only clients are in the audience but NOT the quorum.
+        assert reader.client_id not in c1.protocol.quorum.get_members()
+        assert c1.audience.get_member(reader.client_id)["mode"] == "read"
+
+    def test_join_leave_events_fire(self):
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        added, removed = [], []
+        c1.audience.on_add_member.append(lambda cid, m: added.append(cid))
+        c1.audience.on_remove_member.append(
+            lambda cid, m: removed.append(cid))
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        assert added == [c2.client_id]
+        c2_id = c2.client_id
+        c2.close()
+        assert removed == [c2_id]
+        assert c2_id not in c1.audience.get_members()
+
+    def test_client_cannot_spoof_audience(self):
+        """A client echoing the __audience__ payload shape must not touch
+        peers' rosters — only service-crafted signals (client_id None)
+        qualify; the spoof falls through as an ordinary app signal."""
+        server = LocalCollabServer()
+        c1 = make_doc(server)
+        c2 = Container.load(LocalDocumentService(server, "doc"))
+        seen = []
+        c1.on_signal.append(seen.append)
+        c2.submit_signal({"type": "__audience__", "event": "leave",
+                          "client_id": c1.client_id})
+        assert c1.client_id in c1.audience.get_members()
+        assert c2.client_id in c1.audience.get_members()
+        assert any(s.get("client_id") == c2.client_id for s in seen)
+
+    def test_audience_over_routerlicious(self):
+        class Adapter(LocalDocumentService):
+            pass
+
+        service = RouterliciousService()
+        svc1 = Adapter(service, "doc")
+        c1 = Container.create_detached(svc1)
+        ds = c1.runtime.create_datastore("default")
+        ds.create_channel("root", SharedMap.channel_type)
+        c1.attach()
+        c2 = Container.load(Adapter(service, "doc"))
+        assert set(c1.audience.get_members()) \
+            == set(c2.audience.get_members()) \
+            == {c1.client_id, c2.client_id}
+        c2_id = c2.client_id
+        c2.close()
+        assert c2_id not in c1.audience.get_members()
+
+
+class TestIdleEjection:
+    def _service(self, **kwargs):
+        return RouterliciousService(
+            sequencer_factory=lambda: DocumentSequencer(client_timeout_ms=5),
+            **kwargs)
+
+    def test_stuck_client_no_longer_pins_msn(self):
+        service = self._service()
+        seen_msns = []
+        live = service.connect("doc", lambda msgs: seen_msns.extend(
+            m.minimum_sequence_number for m in msgs))
+        stuck = service.connect("doc", lambda msgs: None)
+        # The stuck client joins then never speaks again; the live client
+        # keeps working, which advances the service clock past the
+        # stuck client's timeout.
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage,
+            MessageType,
+        )
+        for i in range(1, 12):
+            live.submit([DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=2,
+                type=MessageType.OPERATION, contents={"i": i})])
+        msn_before = max(seen_msns)
+        ejected = service.eject_idle_clients()
+        assert (("doc", stuck.client_id) in ejected), ejected
+        # With the stuck client's leave sequenced, the MSN tracks the live
+        # client again instead of the stuck join.
+        live.submit([DocumentMessage(
+            client_sequence_number=12, reference_sequence_number=14,
+            type=MessageType.OPERATION, contents={"i": 12})])
+        assert max(seen_msns) > msn_before
+
+    def test_pump_cadence_triggers_ejection(self):
+        service = self._service(idle_check_interval=1)
+        live = service.connect("doc", lambda msgs: None)
+        stuck = service.connect("doc", lambda msgs: None)
+        stuck_id = stuck.client_id
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage,
+            MessageType,
+        )
+        for i in range(1, 12):
+            live.submit([DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=2,
+                type=MessageType.OPERATION, contents={"i": i})])
+        # No explicit call: the pump cadence crafted the leave.
+        assert stuck_id not in {
+            c["client_id"]
+            for c in service.store.get("deli/doc")["clients"]}
+
+    def test_batched_host_ejection(self):
+        host = KernelSequencerHost(num_slots=4)
+        service = RouterliciousService(batched_deli_host=host,
+                                       auto_pump=False)
+        live = service.connect("doc", lambda msgs: None)
+        stuck = service.connect("doc", lambda msgs: None)
+        service.pump()
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage,
+            MessageType,
+        )
+        for i in range(1, 8):
+            live.submit([DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=2,
+                type=MessageType.OPERATION, contents={"i": i})])
+            service.pump()
+        ejected = service.eject_idle_clients(timeout_ms=4)
+        assert ("doc", stuck.client_id) in ejected
+        service.pump()
+        cp = service.store.get("deli/doc")
+        assert stuck.client_id not in {c["client_id"]
+                                       for c in cp["clients"]}
