@@ -47,6 +47,11 @@ class SharedObject:
         self.attributes = attributes or {"type": self.channel_type}
         self._connection: Any = None  # ChannelDeltaConnection once bound
         self.on_op: list[Callable[[SequencedDocumentMessage, bool], None]] = []
+        # Seq of the last sequenced message that touched this channel —
+        # the summarizerNode dirty bit: a channel unchanged since the last
+        # ACKED summary serializes as a handle, not content (summary.ts:53).
+        self.last_changed_seq = 0
+        self._gc_cache: tuple[int, list[str]] | None = None
 
     # -- attach/bind lifecycle ----------------------------------------------
 
@@ -73,6 +78,16 @@ class SharedObject:
         from ..runtime.handles import collect_handle_routes
         return collect_handle_routes(self.summarize_core())
 
+    def gc_routes(self) -> list[str]:
+        """get_gc_data with a dirty-bit cache: unchanged channels (whose
+        summary is a handle stub) must not re-serialize just for GC."""
+        if (self._gc_cache is not None
+                and self._gc_cache[0] == self.last_changed_seq):
+            return self._gc_cache[1]
+        routes = self.get_gc_data()
+        self._gc_cache = (self.last_changed_seq, routes)
+        return routes
+
     def bind_connection(self, connection: Any) -> None:
         """Called by the data store when the channel becomes live."""
         self._connection = connection
@@ -91,6 +106,7 @@ class SharedObject:
     def process(self, message: SequencedDocumentMessage, local: bool,
                 local_op_metadata: Any) -> None:
         assert message.type == MessageType.OPERATION
+        self.last_changed_seq = message.sequence_number
         self.process_core(message, local, local_op_metadata)
         for cb in self.on_op:
             cb(message, local)

@@ -32,7 +32,8 @@ class SnapshotStorage(Protocol):
 
     def get_latest_snapshot(self) -> dict | None: ...
 
-    def upload_snapshot(self, snapshot: dict) -> str: ...
+    def upload_snapshot(self, snapshot: dict,
+                        parent: str | None = None) -> str: ...
 
 
 class DeltaStorage(Protocol):

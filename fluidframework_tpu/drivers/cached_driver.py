@@ -38,8 +38,10 @@ class _CachingSnapshotStorage:
     def get_latest_snapshot(self) -> dict | None:
         return self._service._get_snapshot()
 
-    def upload_snapshot(self, snapshot: dict) -> str:
-        handle = self._service.inner.storage.upload_snapshot(snapshot)
+    def upload_snapshot(self, snapshot: dict,
+                        parent: str | None = None) -> str:
+        handle = self._service.inner.storage.upload_snapshot(snapshot,
+                                                             parent)
         # An upload is not the acked head until the service sequences the
         # summarize/ack (it may be nacked or lose a summary race), so only
         # invalidate — the next read fetches whatever the service honors.

@@ -21,8 +21,10 @@ class _LocalSnapshotStorage:
     def get_latest_snapshot(self) -> dict | None:
         return self._server.get_latest_snapshot(self._doc_id)
 
-    def upload_snapshot(self, snapshot: dict) -> str:
-        return self._server.upload_snapshot(self._doc_id, snapshot)
+    def upload_snapshot(self, snapshot: dict,
+                        parent: str | None = None) -> str:
+        return self._server.upload_snapshot(self._doc_id, snapshot,
+                                            parent)
 
     def create_blob(self, blob_id: str, data: bytes) -> str:
         return self._server.create_blob(self._doc_id, blob_id, data)

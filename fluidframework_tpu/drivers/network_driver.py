@@ -70,9 +70,11 @@ class _NetworkSnapshotStorage:
         return self._service._request({"op": "get_latest_snapshot"})[
             "snapshot"]
 
-    def upload_snapshot(self, snapshot: dict) -> str:
+    def upload_snapshot(self, snapshot: dict,
+                        parent: str | None = None) -> str:
         return self._service._request({"op": "upload_snapshot",
-                                       "snapshot": snapshot})["handle"]
+                                       "snapshot": snapshot,
+                                       "parent": parent})["handle"]
 
     def create_blob(self, blob_id: str, data: bytes) -> str:
         import base64

@@ -43,7 +43,8 @@ class _ReplaySnapshotStorage:
     def get_latest_snapshot(self) -> dict | None:
         return self._snapshot
 
-    def upload_snapshot(self, snapshot: dict) -> str:
+    def upload_snapshot(self, snapshot: dict,
+                        parent: str | None = None) -> str:
         raise RuntimeError("replay documents are read-only")
 
 

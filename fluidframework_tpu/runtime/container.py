@@ -324,12 +324,16 @@ class Container:
 
     # -- summary --------------------------------------------------------------
 
-    def summarize(self) -> dict:
-        """Full summary of protocol + runtime state at the current seq."""
+    def summarize(self, unchanged_before: int | None = None) -> dict:
+        """Summary of protocol + runtime state at the current seq. With
+        ``unchanged_before`` (the last ACKED summary's seq), unchanged
+        channels serialize as handle stubs into that summary — the
+        incremental form (summary.ts:53); callers must then upload with
+        the parent handle so the service can resolve the stubs."""
         return {
             "sequence_number": self.last_processed_seq,
             "protocol": self.protocol.snapshot(),
-            "runtime": self.runtime.summarize(),
+            "runtime": self.runtime.summarize(unchanged_before),
         }
 
     def close(self) -> None:

@@ -280,9 +280,9 @@ class ContainerRuntime:
 
     # -- summary --------------------------------------------------------------
 
-    def summarize(self) -> dict:
+    def summarize(self, unchanged_before: int | None = None) -> dict:
         datastores = {
-            datastore_id: datastore.summarize()
+            datastore_id: datastore.summarize(unchanged_before)
             for datastore_id, datastore in sorted(self.datastores.items())
         }
         gc = self.run_gc(datastores)

@@ -64,15 +64,24 @@ class DataStoreRuntime:
         already-built ``summarize()`` result to scan it instead of
         re-serializing channel state."""
         from .handles import collect_handle_routes
+        from ..protocol.summary import is_handle
         live = [cid for cid in self.channels
                 if cid not in self._adoption_pending]
         graph = {f"/{self.id}": [f"/{self.id}/{cid}" for cid in live]}
         for channel_id in live:
-            if summary is not None:
-                routes = collect_handle_routes(
-                    summary["channels"][channel_id]["content"])
+            channel = self.channels[channel_id]
+            node = None if summary is None else \
+                summary["channels"][channel_id]
+            if node is not None and not is_handle(node):
+                routes = collect_handle_routes(node["content"])
+                # Seed the dirty-bit cache from the inline content so the
+                # NEXT (incremental) summary's GC pass costs nothing for
+                # this channel if it stays unchanged.
+                channel._gc_cache = (channel.last_changed_seq, routes)
             else:
-                routes = self.channels[channel_id].get_gc_data()
+                # Handle stub (unchanged channel): routes come from the
+                # channel's dirty-bit cache, not a re-serialization.
+                routes = channel.gc_routes()
             graph[f"/{self.id}/{channel_id}"] = routes
         return graph
 
@@ -115,26 +124,14 @@ class DataStoreRuntime:
                 local_op_metadata: Any) -> None:
         envelope = message.contents
         if envelope.get("type") == "attach_channel":
-            if local:
-                return
-            address = envelope["address"]
-            if address not in self.channels:
-                self._adopt_channel(address, envelope["snapshot"])
-                return
-            if address in self._adoption_pending:
-                # Datastore-race leftover: the FIRST sequenced
-                # attach_channel for this id (winner's, or our own voided
-                # echo) defines its state on every replica.
-                self._adopt_channel(address, envelope["snapshot"])
-                return
-            # Same-id channel create race on a shared datastore: if OUR
-            # create of this channel is still pending, the remote
-            # attach_channel sequenced first — adopt its snapshot and void
-            # our pending create + ops (their echoes re-apply as remote
-            # ops, like every replica). Otherwise our create already won:
-            # ignore the later one (all replicas do).
-            if self.parent.void_channel(self.id, address):
-                self._adopt_channel(address, envelope["snapshot"])
+            self._process_attach(envelope, local)
+            # Stamp the channel's dirty bit on EVERY creation path (local
+            # echo and adoptions included): a channel born after the last
+            # acked summary must summarize inline — a handle stub would
+            # dangle (protocol/summary.py).
+            created = self.channels.get(envelope["address"])
+            if created is not None:
+                created.last_changed_seq = message.sequence_number
             return
         channel = self.channels[envelope["address"]]
         channel.process(
@@ -142,6 +139,28 @@ class DataStoreRuntime:
             local,
             local_op_metadata,
         )
+
+    def _process_attach(self, envelope: dict, local: bool) -> None:
+        if local:
+            return
+        address = envelope["address"]
+        if address not in self.channels:
+            self._adopt_channel(address, envelope["snapshot"])
+            return
+        if address in self._adoption_pending:
+            # Datastore-race leftover: the FIRST sequenced
+            # attach_channel for this id (winner's, or our own voided
+            # echo) defines its state on every replica.
+            self._adopt_channel(address, envelope["snapshot"])
+            return
+        # Same-id channel create race on a shared datastore: if OUR
+        # create of this channel is still pending, the remote
+        # attach_channel sequenced first — adopt its snapshot and void
+        # our pending create + ops (their echoes re-apply as remote
+        # ops, like every replica). Otherwise our create already won:
+        # ignore the later one (all replicas do).
+        if self.parent.void_channel(self.id, address):
+            self._adopt_channel(address, envelope["snapshot"])
 
     def resubmit(self, envelope: dict, local_op_metadata: Any) -> None:
         if envelope.get("type") == "attach_channel":
@@ -200,18 +219,31 @@ class DataStoreRuntime:
 
     # -- summary --------------------------------------------------------------
 
-    def summarize(self) -> dict:
+    def summarize(self, unchanged_before: int | None = None) -> dict:
         # Adoption-pending channels are provisional local state: on every
         # other replica they either do not exist yet or will be defined by
         # the first-sequenced attach_channel — excluding them keeps
         # summaries byte-identical across replicas during the race window.
+        #
+        # Incremental mode (summary.ts:53 handle reuse): channels whose
+        # last change is at or below ``unchanged_before`` (the last ACKED
+        # summary's seq) serialize as handle stubs into that summary
+        # instead of full content — O(changed) summaries.
+        from ..protocol.summary import make_handle
+
+        channels: dict[str, dict] = {}
+        for channel_id, channel in sorted(self.channels.items()):
+            if channel_id in self._adoption_pending:
+                continue
+            if (unchanged_before is not None
+                    and channel.last_changed_seq <= unchanged_before):
+                channels[channel_id] = make_handle(
+                    f"runtime/datastores/{self.id}/channels/{channel_id}")
+            else:
+                channels[channel_id] = channel.summarize()
         return {
             "attributes": dict(sorted(self.attributes.items())),
-            "channels": {
-                channel_id: channel.summarize()
-                for channel_id, channel in sorted(self.channels.items())
-                if channel_id not in self._adoption_pending
-            },
+            "channels": channels,
         }
 
     def load(self, snapshot: dict) -> None:

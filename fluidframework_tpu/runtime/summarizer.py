@@ -70,6 +70,14 @@ class SummaryManager:
         self.last_summary_time = clock() if clock else 0.0
         self.pending_handle: str | None = None
         self.pending_since_seq = 0
+        # Incremental-summary parent: the last ACKED summary's handle and
+        # its GENERATION seq (the state it captured). Channels unchanged
+        # since then serialize as handle stubs into it (summary.ts:53);
+        # None gen seq = next summary is full (e.g. a peer's summary was
+        # acked — we don't know what state it captured).
+        self.last_acked_handle: str | None = None
+        self.last_acked_gen_seq: int | None = None
+        self.pending_gen_seq: int | None = None
         self.events: list[SummarizerEvent] = []
         self.enabled = True
         container.on_op_processed.append(self._on_op)
@@ -137,6 +145,12 @@ class SummaryManager:
         handle = message.contents.get("handle")
         if self.pending_handle is not None and handle == self.pending_handle:
             self.pending_handle = None
+            self.last_acked_gen_seq = self.pending_gen_seq
+        else:
+            # A peer's summary: we can't know which seq it captured, so
+            # the next summary we generate is full.
+            self.last_acked_gen_seq = None
+        self.last_acked_handle = handle
         self.events.append(SummarizerEvent(
             "acked", message.sequence_number, handle=handle))
 
@@ -171,9 +185,26 @@ class SummaryManager:
             return None
         if container.runtime.pending.has_pending:
             return None  # unacked optimistic state; see _on_op
-        summary = container.summarize()
-        handle = container._service.storage.upload_snapshot(summary)
+        incremental = (self.last_acked_handle is not None
+                       and self.last_acked_gen_seq is not None)
+        summary = container.summarize(
+            unchanged_before=self.last_acked_gen_seq if incremental
+            else None)
+        try:
+            handle = container._service.storage.upload_snapshot(
+                summary,
+                parent=self.last_acked_handle if incremental else None)
+        except Exception as err:
+            # Upload/resolution failure (e.g. the parent summary was
+            # pruned): record it, fall back to a FULL summary next time,
+            # and never let the error escape into op processing.
+            self.last_acked_gen_seq = None
+            self.events.append(SummarizerEvent(
+                "nacked", container.last_processed_seq,
+                reason=f"upload failed: {err!r}"))
+            return None
         self.pending_handle = handle
+        self.pending_gen_seq = summary["sequence_number"]
         self.pending_since_seq = container.last_processed_seq
         # Record BEFORE submitting: the in-proc server delivers the ack
         # re-entrantly inside the submit call.

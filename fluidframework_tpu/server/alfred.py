@@ -152,7 +152,8 @@ class RequestSession:
         if op == "upload_snapshot":
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid,
-                    "handle": service.upload_snapshot(doc, req["snapshot"])}
+                    "handle": service.upload_snapshot(doc, req["snapshot"],
+                                                      req.get("parent"))}
         if op == "get_latest_snapshot":
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid, "snapshot": service.get_latest_snapshot(doc)}

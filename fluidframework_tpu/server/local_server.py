@@ -277,11 +277,20 @@ class LocalCollabServer:
                 if m.sequence_number > from_seq
                 and (to_seq is None or m.sequence_number <= to_seq)]
 
-    def upload_snapshot(self, doc_id: str, snapshot: dict) -> str:
+    def upload_snapshot(self, doc_id: str, snapshot: dict,
+                        parent: str | None = None) -> str:
         """Store a summary blob; returns its handle. The first upload of a
         document is its attach-time base and becomes load-visible at once;
-        later uploads become visible only via a sequenced summarize→ack."""
+        later uploads become visible only via a sequenced summarize→ack.
+        With ``parent``, handle stubs (incremental summaries) resolve
+        against that stored summary before the blob is stored."""
         document = self._document(doc_id)
+        if parent is not None:
+            from ..protocol.summary import resolve_handles
+            parent_tree = document.snapshots.get(parent)
+            if parent_tree is None:
+                raise KeyError(f"unknown parent summary {parent!r}")
+            snapshot = resolve_handles(snapshot, parent_tree)
         handle = f"{doc_id}/snapshots/{len(document.snapshots)}"
         document.snapshots[handle] = snapshot
         if document.acked_snapshot is None:

@@ -934,7 +934,18 @@ class RouterliciousService:
                 if m.sequence_number > from_seq
                 and (to_seq is None or m.sequence_number <= to_seq)]
 
-    def upload_snapshot(self, doc_id: str, snapshot: dict) -> str:
+    def upload_snapshot(self, doc_id: str, snapshot: dict,
+                        parent: str | None = None) -> str:
+        if parent is not None:
+            # Incremental summary (summary.ts:53): the client uploaded
+            # handle stubs for unchanged subtrees; resolve them against
+            # the stored parent so every reader sees a full tree (the
+            # content-addressed store dedups the unchanged subtrees).
+            from ..protocol.summary import resolve_handles
+            parent_tree = self.snapshots.get(doc_id, parent)
+            if parent_tree is None:
+                raise KeyError(f"unknown parent summary {parent!r}")
+            snapshot = resolve_handles(snapshot, parent_tree)
         handle = self.snapshots.upload(doc_id, snapshot)
         if self.snapshots.head(doc_id) is None:
             self.snapshots.set_head(doc_id, handle)

@@ -50,6 +50,87 @@ def test_manual_summary_acked_and_load_visible():
     assert c1.summarize() == c2.summarize()
 
 
+def test_incremental_summary_uploads_only_changed_channels():
+    """After an acked summary, changing 1 of 100 channels must serialize
+    ~1 channel: the other 99 ride as handle stubs into the acked parent
+    (summary.ts:53 handle reuse), and the service resolves them so new
+    clients still load a full tree."""
+    import json
+
+    server = LocalCollabServer()
+    service = LocalDocumentService(server, "doc")
+    c1 = Container.create_detached(service)
+    ds = c1.runtime.create_datastore("default")
+    for i in range(100):
+        ds.create_channel(f"ch{i}", SharedMap.channel_type)
+    c1.attach()
+    for i in range(100):  # fill every channel with real content
+        ds.get_channel(f"ch{i}").set("payload", "x" * 1000)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    uploads = []
+    original = service.storage.upload_snapshot
+
+    def spy(snapshot, parent=None):
+        uploads.append((json.dumps(snapshot, default=list), parent))
+        return original(snapshot, parent)
+
+    service.storage.upload_snapshot = spy
+    h1 = sm.summarize_now(reason="base")
+    assert h1 is not None and uploads[-1][1] is None  # full, no parent
+
+    ds.get_channel("ch42").set("changed", True)
+    h2 = sm.summarize_now(reason="delta")
+    assert h2 is not None
+    body, parent = uploads[-1]
+    assert parent == h1  # resolved against the acked base
+    full_body = uploads[0][0]
+    # ~1/100th the bytes: one channel inline, 99 handle stubs.
+    assert len(body) < len(full_body) / 10, (len(body), len(full_body))
+    from fluidframework_tpu.protocol.summary import count_handles
+    assert count_handles(json.loads(body)) == 99
+    # New clients load the RESOLVED tree — identical to the live replica.
+    c2 = open_doc(server)
+    assert c2.summarize() == c1.summarize()
+    assert c2.runtime.get_datastore("default").get_channel(
+        "ch42").get("changed") is True
+    assert c2.runtime.get_datastore("default").get_channel(
+        "ch7").get("payload") == "x" * 1000
+
+
+def test_incremental_summary_includes_channels_created_after_base():
+    """A channel born after the acked summary must serialize inline —
+    a handle stub would dangle in the parent."""
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    root_of(c1).set("x", 1)
+    assert sm.summarize_now(reason="base") is not None
+    ds = c1.runtime.get_datastore("default")
+    fresh = ds.create_channel("newcomer", SharedMap.channel_type)
+    fresh.set("born", "late")
+    assert sm.summarize_now(reason="delta") is not None
+    c2 = open_doc(server)
+    assert c2.runtime.get_datastore("default").get_channel(
+        "newcomer").get("born") == "late"
+    assert c1.summarize() == c2.summarize()
+
+
+def test_user_content_shaped_like_a_handle_is_not_resolved():
+    """Handle resolution is structural (channel positions only): a USER
+    value {'_handle': ...} inside changed channel content must survive
+    the incremental round trip untouched — no in-band collision."""
+    server = LocalCollabServer()
+    c1 = make_doc(server)
+    sm = SummaryManager(c1, SummaryConfig(max_ops=10_000))
+    root_of(c1).set("seed", 1)
+    assert sm.summarize_now(reason="base") is not None
+    root_of(c1).set("cfg", {"_handle": "protocol"})  # looks like a stub
+    assert sm.summarize_now(reason="delta") is not None
+    c2 = open_doc(server)
+    assert root_of(c2).get("cfg") == {"_handle": "protocol"}
+    assert c1.summarize() == c2.summarize()
+
+
 def test_unacked_upload_not_load_visible():
     server = LocalCollabServer()
     c1 = make_doc(server)
