@@ -1,0 +1,194 @@
+"""Sharded serving assembly — the multi-host deployment of the storm
+pipeline (SURVEY §5.8, the partitionManager.ts scale-out analog).
+
+The reference scales its ordering service by Kafka partitions assigning
+documents to consumer PROCESSES
+(server/routerlicious/packages/lambdas-driver/src/kafka-service/
+partitionManager.ts:24; config.json numberOfPartitions). Here the same
+assignment is the document axis of a ``jax.sharding.Mesh``:
+
+* each serving host (process) owns a CONTIGUOUS document-row range — in
+  a real multi-host deployment that range is
+  :func:`..parallel.multihost.local_docs`; the front door / bus routes
+  exactly those documents to it (the partition-assignment analog);
+* every host contributes its rows' columnar op planes; the global
+  [B, K] arrays are mesh-sharded so no host materializes another's rows
+  on its devices;
+* ONE fused device program — the same deli+merger tick the
+  single-process storm path runs (server/storm.py ``_storm_tick``) —
+  executes SPMD over the mesh; outputs stay sharded;
+* each host harvests ONLY its own rows (addressable shards) for acks,
+  durability and broadcast.
+
+Single-process deployments (and the virtual-CPU-mesh dryrun) run the
+identical code with simulated hosts: the per-host routing, sharded tick
+and shard-local harvest are exactly what a multi-process launch runs,
+with :func:`..parallel.multihost.feed` as the only difference in how the
+global arrays assemble.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+from ..ops import map_kernel as mk
+from ..ops import sequencer as seqk
+from ..protocol.messages import MessageType
+from .mesh import aggregate_metrics, doc_sharding, shard_state
+
+
+def _addressable_rows(arr) -> dict[int, int]:
+    """row -> value from the shards THIS process can address (never the
+    global array: in a multi-process mesh it spans foreign devices)."""
+    out: dict[int, int] = {}
+    for shard in arr.addressable_shards:
+        row_slice = shard.index[0]
+        start = row_slice.start if row_slice.start is not None else 0
+        for offset, value in enumerate(np.asarray(shard.data)):
+            out[start + offset] = int(value)
+    return out
+
+
+class HostPort(NamedTuple):
+    """One serving host's front door: the doc-row range it owns and the
+    columnar buffers its connections fill (the bus-partition analog)."""
+
+    host_id: int
+    start: int
+    stop: int
+
+    def owns(self, row: int) -> bool:
+        return self.start <= row < self.stop
+
+
+class ShardedServing:
+    """N serving hosts over one docs-sharded mesh, running the fused
+    sequencer+map storm tick as a single SPMD program."""
+
+    def __init__(self, mesh: jax.sharding.Mesh, num_docs: int, k: int,
+                 num_hosts: int, num_clients: int = 2,
+                 map_slots: int = 32) -> None:
+        if num_docs % mesh.devices.size:
+            raise ValueError("num_docs must divide over the mesh")
+        self.mesh = mesh
+        self.num_docs = num_docs
+        self.k = k
+        self.map_slots = map_slots
+        self.seq_state = shard_state(
+            seqk.init_state(num_docs, num_clients + 1), mesh)
+        self.map_state = shard_state(
+            mk.init_state(num_docs, map_slots), mesh)
+        # Contiguous per-host ranges — what multihost.local_docs reports
+        # per process in a real multi-host launch.
+        bounds = np.linspace(0, num_docs, num_hosts + 1).astype(int)
+        self.hosts = [HostPort(i, int(bounds[i]), int(bounds[i + 1]))
+                      for i in range(num_hosts)]
+        self._pending: list[dict] = [dict() for _ in range(num_hosts)]
+
+    def route(self, row: int) -> HostPort:
+        """The owning host of a document row (front-door routing)."""
+        for port in self.hosts:
+            if port.owns(row):
+                return port
+        raise KeyError(row)
+
+    # -- front door ------------------------------------------------------------
+
+    def join_all(self, slot: int = 0) -> None:
+        """Sequence a CLIENT_JOIN on every document (through the real
+        sequencer kernel, not state surgery)."""
+        b = self.num_docs
+        ops = seqk.make_op_batch(
+            [[dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=slot,
+                   timestamp=1)] for _ in range(b)], b, 1)
+        ops = shard_state(ops, self.mesh)
+        # process_batch is already jitted; wrapping it again would discard
+        # the trace cache per call.
+        self.seq_state, out = seqk.process_batch(self.seq_state, ops)
+        jax.block_until_ready(out.kind)
+
+    def submit(self, row: int, words: np.ndarray, first_cseq: int,
+               ref_seq: int = 1) -> None:
+        """One doc's op batch into its OWNING host's buffer — a frame for
+        a foreign row is a routing bug and raises (the bus partition
+        would never deliver it here)."""
+        port = self.route(row)
+        if len(words) > self.k:
+            raise ValueError(
+                f"batch of {len(words)} ops exceeds tick width {self.k}")
+        pending = self._pending[port.host_id]
+        if row in pending:
+            raise ValueError(f"row {row} already pending this tick")
+        pending[row] = (words, first_cseq, ref_seq)
+
+    # -- the sharded tick ------------------------------------------------------
+
+    def tick(self, now: int = 2):
+        """Assemble every host's contribution, run the fused SPMD tick,
+        and return each host's harvest of ITS OWN rows:
+        {host_id: {row: (n_seq, first_seq, last_seq)}}."""
+        from ..server.storm import _storm_tick
+
+        b, k = self.num_docs, self.k
+        slot = np.zeros(b, np.int32)
+        cseq0 = np.zeros(b, np.int32)
+        ref = np.zeros(b, np.int32)
+        counts = np.zeros(b, np.int32)
+        words_full = np.zeros((b, k), np.uint32)
+        gather = np.arange(b, dtype=np.int32)
+        submitted: list[tuple[int, int]] = []  # (host, row)
+        for port in self.hosts:
+            for row, (words, first_cseq, ref_seq) in \
+                    self._pending[port.host_id].items():
+                counts[row] = len(words)
+                words_full[row, :len(words)] = words
+                cseq0[row] = first_cseq
+                ref[row] = ref_seq
+                submitted.append((port.host_id, row))
+
+        sharding = doc_sharding(self.mesh)
+        put = lambda a: jax.device_put(a, sharding)
+        (self.seq_state, self.map_state, n_seq, first, last,
+         _msn) = _storm_tick(
+            self.seq_state, self.map_state, put(slot), put(cseq0),
+            put(ref), put(np.full(b, now, np.int32)), put(counts),
+            put(gather), put(words_full), put(counts))
+        # The device program has the batch; only now may buffers drop
+        # (at-least-once: an assembly failure above must keep them).
+        for port in self.hosts:
+            self._pending[port.host_id] = {}
+
+        # Shard-local harvest: each host reads ONLY the rows resident on
+        # ITS addressable devices — a multi-process launch cannot (and
+        # must not) materialize the global array.
+        n_seq_l = _addressable_rows(n_seq)
+        first_l = _addressable_rows(first)
+        last_l = _addressable_rows(last)
+        harvest: dict[int, dict[int, tuple[int, int, int]]] = {
+            port.host_id: {} for port in self.hosts}
+        for host_id, row in submitted:
+            n_ok = n_seq_l[row]
+            harvest[host_id][row] = ((n_ok, first_l[row], last_l[row])
+                                     if n_ok > 0 else (0, 0, 0))
+        return harvest
+
+    # -- observability ---------------------------------------------------------
+
+    def global_metrics(self) -> dict[str, int]:
+        """psum over the mesh: total sequenced ops + live keys across every
+        host's documents (the cross-partition metrics roll-up)."""
+        totals = aggregate_metrics(self.mesh, {
+            "seq": self.seq_state.seq,
+            "present": self.map_state.present.astype(np.int32).sum(axis=1),
+        })
+        return {name: int(value) for name, value in totals.items()}
+
+    def map_rows(self) -> np.ndarray:
+        """Converged map value plane (host copy) for verification."""
+        return np.asarray(self.map_state.value)
+
+
+__all__ = ["ShardedServing", "HostPort"]
