@@ -63,8 +63,7 @@ class PermutationVector:
         group = self.engine.pending_groups[0]
         remap: dict[int, int] = {}
         if group.op_kind == "insert":
-            position = {id(seg): i for i, seg in enumerate(self.engine.segments)}
-            for seg in sorted(group.segments, key=lambda s: position[id(s)]):
+            for seg in self.engine.document_order(group.segments):
                 finals = []
                 for temp in seg.content:
                     final = self.next_handle
@@ -83,6 +82,17 @@ class PermutationVector:
             self.engine.apply_remote(
                 {"type": "insert", "pos": op["pos"], "items": list(handles)},
                 seq, ref_seq, client)
+        elif op["type"] == "insertGroup":
+            # Regenerated multi-fragment insert (a pending run split by an
+            # interleaving insert): fragments apply sequentially at one
+            # seq in DOCUMENT order, handles allocated in that order —
+            # matching the submitter's document-order ack assignment.
+            for pos, count in op["ranges"]:
+                handles = range(self.next_handle, self.next_handle + count)
+                self.next_handle += count
+                self.engine.apply_remote(
+                    {"type": "insert", "pos": pos,
+                     "items": list(handles)}, seq, ref_seq, client)
         elif op["type"] == "removeGroup":
             # Regenerated multi-segment remove: ranges apply sequentially at
             # one seq (earlier ranges' removals are invisible to later walks,
@@ -188,7 +198,13 @@ class SharedMatrix(SharedObject):
         # write shadows the view (same model as map/merge-tree pending).
         self._pending_cells: dict[tuple[int, int], list] = {}
         self._local_seq = 0
-        self._remap_log: dict[int, int] = {}
+        # Per-AXIS temp→final handle remaps: rows and cols allocate temp
+        # handles from separate -1,-2,... sequences, so one shared table
+        # would let a rows remap clobber a cols remap for the same temp id
+        # (found by the matrix reconnect farm: a pending cell's column
+        # resolved through the ROWS remap and landed in the wrong column).
+        self._remap_log: dict[str, dict[int, int]] = {"rows": {},
+                                                      "cols": {}}
 
     # -- identity -------------------------------------------------------------
 
@@ -279,7 +295,16 @@ class SharedMatrix(SharedObject):
         if target in ("rows", "cols"):
             vector = self.rows if target == "rows" else self.cols
             if local:
-                remap = vector.ack(seq)
+                # A stashed multi-range op spans several engine groups;
+                # all ack at this message's seq (sequence.py's
+                # stashed_group shape).
+                acks = (len(local_op_metadata[2])
+                        if isinstance(local_op_metadata, tuple)
+                        and local_op_metadata
+                        and local_op_metadata[0] == "vector_multi" else 1)
+                remap: dict[int, int] = {}
+                for _ in range(acks):
+                    remap.update(vector.ack(seq))
                 if remap:
                     self._remap_handles(remap, axis=target)
             else:
@@ -296,8 +321,8 @@ class SharedMatrix(SharedObject):
         if local:
             _tag, row_handle, col_handle, local_seq = local_op_metadata[:4]
             # Temp handles may have been remapped by a row/col ack.
-            row_handle = self._current_handle(row_handle)
-            col_handle = self._current_handle(col_handle)
+            row_handle = self._current_handle(row_handle, "rows")
+            col_handle = self._current_handle(col_handle, "cols")
             key = (row_handle, col_handle)
             pending = self._pending_cells.get(key)
             if pending is not None and pending[0] == local_seq:
@@ -323,9 +348,32 @@ class SharedMatrix(SharedObject):
             v.engine.update_min_seq(message.minimum_sequence_number)
         self._prune_dead_cells()
 
+    @staticmethod
+    def _regen_vector_ranges(vector: PermutationVector,
+                             local_seq) -> tuple[str | None, list[list[int]]]:
+        """(op kind, regenerated ranges) of one pending vector group, its
+        fragments in document order; (None, []) when already acked."""
+        group = next((g for g in vector.engine.pending_groups
+                      if g.local_seq == local_seq), None)
+        if group is None:
+            return None, []
+        ranges: list[list[int]] = []
+        for seg in vector.engine.document_order(group.segments):
+            if group.op_kind == "insert":
+                if seg.seq != UNASSIGNED:
+                    continue
+                pos = vector.engine.get_position_at_local_seq(seg, local_seq)
+                ranges.append([pos, len(seg.content)])
+            else:
+                if seg.removed_seq != UNASSIGNED:
+                    continue  # a remote remove won; nothing to resubmit
+                pos = vector.engine.get_position_at_local_seq(seg, local_seq)
+                ranges.append([pos, pos + seg.length])
+        return group.op_kind, ranges
+
     def _remap_handles(self, remap: dict[int, int], axis: str) -> None:
         """A local row/col insert acked: temp handles became final."""
-        self._remap_log.update(remap)
+        self._remap_log[axis].update(remap)
         for table in (self.cells, self._pending_cells):
             for (rh, ch) in list(table):
                 new_rh = remap.get(rh, rh) if axis == "rows" else rh
@@ -333,10 +381,10 @@ class SharedMatrix(SharedObject):
                 if (new_rh, new_ch) != (rh, ch):
                     table[(new_rh, new_ch)] = table.pop((rh, ch))
 
-    def _current_handle(self, handle: int) -> int:
+    def _current_handle(self, handle: int, axis: str) -> int:
         if handle >= 0:
             return handle
-        return self._remap_log.get(handle, handle)
+        return self._remap_log[axis].get(handle, handle)
 
     def _prune_dead_cells(self) -> None:
         """Drop cells whose row/col handle no longer exists in ANY segment
@@ -355,32 +403,42 @@ class SharedMatrix(SharedObject):
         self._bind_client()
         if metadata is None:
             return
-        if metadata[0] == "vector":
-            _tag, axis, local_seq = metadata
-            vector = self.rows if axis == "rows" else self.cols
-            group = next((g for g in vector.engine.pending_groups
-                          if g.local_seq == local_seq), None)
-            if group is None:
-                return
-            if group.op_kind == "insert":
-                seg = group.segments[0]
-                pos = vector.engine.get_position_at_local_seq(seg, local_seq)
-                count = sum(len(s.content) for s in group.segments
-                            if s.seq == UNASSIGNED)
-                self.submit_local_message(
-                    {"target": axis, "type": "insert", "pos": pos,
-                     "count": count}, metadata)
+        if metadata[0] in ("vector", "vector_multi"):
+            if metadata[0] == "vector":
+                _tag, axis, local_seqs = metadata[0], metadata[1], \
+                    [metadata[2]]
             else:
-                # Every still-pending segment of the remove group, each range
-                # in the frame where earlier same-group removals are already
-                # invisible (get_position_at_local_seq's <= limit rule).
-                ranges = []
-                for seg in group.segments:
-                    if seg.removed_seq != UNASSIGNED:
-                        continue
-                    pos = vector.engine.get_position_at_local_seq(
-                        seg, local_seq)
-                    ranges.append([pos, pos + seg.length])
+                _tag, axis, local_seqs = metadata
+            vector = self.rows if axis == "rows" else self.cols
+            # Rejoin normalization + document-order fragment emission:
+            # the same two reconnect rules the sequence path applies (see
+            # MergeEngine.normalize_pending_for_reconnect and
+            # sequence._regenerate_group_subops).
+            vector.engine.normalize_pending_for_reconnect()
+            kind = None
+            ranges: list[list[int]] = []
+            for local_seq in local_seqs:
+                group_kind, group_ranges = self._regen_vector_ranges(
+                    vector, local_seq)
+                if group_kind is not None:
+                    kind = group_kind
+                ranges.extend(group_ranges)
+            if kind is None:
+                return  # every group already acked
+            if kind == "insert":
+                if len(ranges) == 1:
+                    self.submit_local_message(
+                        {"target": axis, "type": "insert",
+                         "pos": ranges[0][0], "count": ranges[0][1]},
+                        metadata)
+                else:
+                    # Split pending run: per-fragment inserts in document
+                    # order at one seq (a contiguous re-insert would
+                    # re-assemble differently on remotes).
+                    self.submit_local_message(
+                        {"target": axis, "type": "insertGroup",
+                         "ranges": ranges}, metadata)
+            else:
                 self.submit_local_message(
                     {"target": axis, "type": "removeGroup",
                      "ranges": ranges}, metadata)
@@ -390,8 +448,8 @@ class SharedMatrix(SharedObject):
         # must not shift it (they replay after us and re-shift remotely).
         _tag, row_handle, col_handle, local_seq, rows_limit, cols_limit = \
             metadata
-        row_handle = self._current_handle(row_handle)
-        col_handle = self._current_handle(col_handle)
+        row_handle = self._current_handle(row_handle, "rows")
+        col_handle = self._current_handle(col_handle, "cols")
         pending = self._pending_cells.get((row_handle, col_handle))
         if pending is None or pending[0] != local_seq:
             return  # superseded by a newer local write
@@ -466,10 +524,20 @@ class SharedMatrix(SharedObject):
             if contents["type"] == "insert":
                 _op, local_seq, _temps = vector.insert_local(
                     contents["pos"], contents["count"])
+            elif contents["type"] == "insertGroup":
+                # One stashed message, several engine groups: the ack path
+                # pops one group per local_seq listed (vector_multi).
+                seqs = []
+                for pos, count in contents["ranges"]:
+                    _op, ls, _temps = vector.insert_local(pos, count)
+                    seqs.append(ls)
+                return ("vector_multi", target, seqs)
             elif contents["type"] == "removeGroup":
-                local_seq = None
+                seqs = []
                 for start, end in contents["ranges"]:
-                    _op, local_seq = vector.remove_local(start, end - start)
+                    _op, ls = vector.remove_local(start, end - start)
+                    seqs.append(ls)
+                return ("vector_multi", target, seqs)
             else:
                 _op, local_seq = vector.remove_local(
                     contents["start"], contents["end"] - contents["start"])

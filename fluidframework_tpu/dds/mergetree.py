@@ -491,6 +491,38 @@ class MergeEngine:
             pos += self._vis_len_at_local_seq(seg, limit)
         raise ValueError("segment not in engine")
 
+    def document_order(self, segments: list["Segment"]) -> list["Segment"]:
+        """Sort a group's segments by their position in the document —
+        the one canonical order for regeneration/ack fragment emission
+        (split order is NOT document order). Segments no longer in the
+        table sort last."""
+        position = {id(s): i for i, s in enumerate(self.segments)}
+        return sorted(segments,
+                      key=lambda s: position.get(id(s), len(position)))
+
+    def normalize_pending_for_reconnect(self) -> None:
+        """Reorder pending (unacked) segments to the canonical side of
+        adjacent ACKED-removed tombstones before regenerating their ops
+        (the reference's rejoin segment normalization): a remote applier
+        of the regenerated insert walks at the reconnect refSeq, where
+        those tombstones are invisible holes it skips — landing the text
+        AFTER them — while the local segment was physically placed when
+        the tombstone was still live (BEFORE it). Bubble pending segments
+        rightward past acked tombstones so both layouts agree; visible
+        text is unaffected (tombstones have zero visible length), but
+        summaries and future tie-breaks see one canonical order."""
+        segs = self.segments
+        changed = True
+        while changed:
+            changed = False
+            for i in range(len(segs) - 1):
+                left, right = segs[i], segs[i + 1]
+                if (left.seq == UNASSIGNED
+                        and right.removed_seq is not None
+                        and right.removed_seq != UNASSIGNED):
+                    segs[i], segs[i + 1] = right, left
+                    changed = True
+
     def normalize_detached(self) -> None:
         """Detached → attached: local-only segments become baseline (seq 0),
         so they serialize into the attach snapshot."""

@@ -165,6 +165,8 @@ class SharedString(SharedObject):
         (client.ts regeneratePendingOp). Called once per pending message in
         FIFO order; each call regenerates the oldest *unregenerated* group."""
         self._bind_client()
+        # Rejoin normalization (idempotent; see MergeEngine docstring).
+        self.engine.normalize_pending_for_reconnect()
         if isinstance(metadata, tuple) and metadata and metadata[0] == "interval":
             _tag, label, interval_id, pending_id, horizon = metadata
             collection = self.get_interval_collection(label)
@@ -211,10 +213,20 @@ class SharedString(SharedObject):
         # Positions are computed in the view as of this op's localSeq —
         # later local pending ops must not shift them (the remote applier
         # won't have seen those yet when this op sequences).
+        #
+        # Fragments MUST emit in DOCUMENT order (group.segments is split
+        # order, not document order): each fragment's position counts the
+        # group's earlier-in-document fragments as present, and the remote
+        # applier processes subops sequentially — an out-of-order emission
+        # re-assembles a split insert differently on remotes than the
+        # fragments sit locally (found by the reference-intensity
+        # reconnect farm). Same ordering rule as PermutationVector.ack's
+        # document-order handle assignment.
+        ordered = self.engine.document_order(group.segments)
         limit = group.local_seq
         subops = []
         if group.op_kind == "insert":
-            for seg in group.segments:
+            for seg in ordered:
                 if seg.seq != UNASSIGNED:
                     continue
                 pos = self.engine.get_position_at_local_seq(seg, limit)
@@ -228,14 +240,14 @@ class SharedString(SharedObject):
                     op["props"] = dict(seg.props)
                 subops.append(op)
         elif group.op_kind == "remove":
-            for seg in group.segments:
+            for seg in ordered:
                 if seg.removed_seq != UNASSIGNED:
                     continue  # a remote remove won; nothing to resubmit
                 pos = self.engine.get_position_at_local_seq(seg, limit)
                 subops.append({"type": "remove", "start": pos,
                                "end": pos + seg.length})
         else:  # annotate
-            for seg in group.segments:
+            for seg in ordered:
                 if not any(k in seg.pending_props for k in group.props_keys):
                     continue
                 if seg.removed_seq is not None:

@@ -248,6 +248,13 @@ def encode_matrix_op(channel_op: dict, base: dict, alloc_rows, alloc_cols,
             return [dict(base, target=tcode, kind=mtk.MT_INSERT,
                          pos=channel_op["pos"], count=count,
                          handle_base=alloc(count))]
+        if channel_op["type"] == "insertGroup":
+            # Regenerated split insert: one kernel op per fragment, handles
+            # allocated in the fragments' document order (matching the
+            # scalar applier).
+            return [dict(base, target=tcode, kind=mtk.MT_INSERT,
+                         pos=pos, count=count, handle_base=alloc(count))
+                    for pos, count in channel_op["ranges"]]
         if channel_op["type"] == "removeGroup":
             return [dict(base, target=tcode, kind=mtk.MT_REMOVE,
                          pos=start, end=end)

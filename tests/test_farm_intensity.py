@@ -1,0 +1,162 @@
+"""Reference-intensity convergence farms.
+
+The reference stresses merge-tree with up to 32 clients x 512 ops/round
+(client.conflictFarm.spec.ts:50-57) and reconnect churn
+(client.reconnectFarm.spec.ts). These farms run that client count and
+per-round op volume — including a DEVICE-host replica ingesting the same
+sequenced stream — asserting every replica and the device state match
+after each drain."""
+
+import os
+import random
+
+import pytest
+
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.runtime.container import Container
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from tests.test_mergetree import get_string, make_string_doc, random_edit
+
+# Rounds scale up via env for the full reference profile (32 rounds);
+# the default keeps the always-on suite within budget at the reference's
+# CLIENT COUNT and OPS/ROUND.
+ROUNDS = int(os.environ.get("FARM_ROUNDS", "6"))
+
+
+def test_conflict_farm_reference_client_scale():
+    """24 clients x 256-512 ops/round (the reference tops at 32; the
+    device bitmask serves up to 31 distinct writers before the exact
+    scalar fallback), with a
+    device-host replica: every replica AND the device text must match
+    after every round's drain."""
+    rng = random.Random(7)
+    host = KernelMergeHost(flush_threshold=512)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_string_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(23)]
+    strings = [get_string(c) for c in containers]
+
+    for round_no in range(ROUNDS):
+        paused = [c for c in containers if rng.random() < 0.3]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(256, 513)):
+            random_edit(rng, strings[rng.randrange(len(strings))])
+        for c in paused:
+            c.inbound.resume()
+        texts = [s.get_text() for s in strings]
+        assert all(t == texts[0] for t in texts), round_no
+        assert host.text("doc", "default", "text") == texts[0], round_no
+    assert host.stats["device_ops"] > 0
+    for c in containers:
+        assert not c.nacks
+
+
+def test_reconnect_farm_reference_scale():
+    """16 clients x 128-256 ops/round with random disconnect/reconnect
+    (pending-op regeneration) + the device replica staying exact."""
+    rng = random.Random(21)
+    host = KernelMergeHost(flush_threshold=256)
+    server = LocalCollabServer(merge_host=host)
+    c1 = make_string_doc(server)
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(15)]
+
+    for round_no in range(ROUNDS):
+        dropped = [c for c in containers[1:] if rng.random() < 0.3]
+        for c in dropped:
+            c.disconnect()
+        for _ in range(rng.randrange(128, 257)):
+            c = containers[rng.randrange(len(containers))]
+            random_edit(rng, get_string(c))
+        for c in dropped:
+            c.reconnect()
+        texts = [get_string(c).get_text() for c in containers]
+        assert all(t == texts[0] for t in texts), round_no
+        assert host.text("doc", "default", "text") == texts[0], round_no
+    summaries = [c.summarize() for c in containers]
+    assert all(s == summaries[0] for s in summaries)
+
+
+def test_merge_host_farm_many_docs():
+    """Cross-document farm at service scale: 8 docs x 8 clients, all
+    device-served, every doc's replicas + device state matching."""
+    from tests.test_merge_host import get_parts, make_doc
+
+    rng = random.Random(3)
+    host = KernelMergeHost(flush_threshold=128)
+    server = LocalCollabServer(merge_host=host)
+    docs = []
+    for d in range(8):
+        c1 = make_doc(server, f"doc{d}")
+        docs.append([c1] + [
+            Container.load(LocalDocumentService(server, f"doc{d}"))
+            for _ in range(7)])
+
+    for _round in range(max(3, ROUNDS // 2)):
+        for containers in docs:
+            for _ in range(rng.randrange(32, 65)):
+                c = containers[rng.randrange(len(containers))]
+                text, root = get_parts(c)
+                if rng.random() < 0.6:
+                    random_edit(rng, text)
+                else:
+                    root.set(f"k{rng.randrange(8)}", rng.randrange(100))
+    for d, containers in enumerate(docs):
+        texts = [get_parts(c)[0].get_text() for c in containers]
+        maps = [dict(get_parts(c)[1].data.items()) for c in containers]
+        assert all(t == texts[0] for t in texts), d
+        assert all(m == maps[0] for m in maps), d
+        assert host.text(f"doc{d}", "default", "text") == texts[0], d
+        assert host.map_entries(f"doc{d}", "default", "root") == maps[0], d
+    assert host.stats["device_ops"] > 0
+
+
+def test_matrix_reconnect_farm():
+    """Matrix farm with reconnect churn at intensity: permutation-vector
+    pending ops (incl. split-run insertGroup regeneration) + LWW cells
+    must converge across 8 replicas and the device host."""
+    from fluidframework_tpu.dds.matrix import SharedMatrix
+    from tests.test_matrix import get_matrix, grid_of
+    from tests.test_matrix_kernel import random_matrix_edit
+
+    rng = random.Random(9)
+    host = KernelMergeHost(flush_threshold=64)
+    server = LocalCollabServer(merge_host=host)
+    c1 = Container.create_detached(LocalDocumentService(server, "doc"))
+    c1.runtime.create_datastore("default").create_channel(
+        "grid", SharedMatrix.channel_type)
+    c1.attach()
+    containers = [c1] + [Container.load(LocalDocumentService(server, "doc"))
+                         for _ in range(7)]
+    get_matrix(c1).insert_rows(0, 2)
+    get_matrix(c1).insert_cols(0, 2)
+
+    for round_no in range(ROUNDS):
+        dropped = [c for c in containers[1:] if rng.random() < 0.3]
+        for c in dropped:
+            c.disconnect()
+        for _ in range(rng.randrange(48, 97)):
+            c = containers[rng.randrange(len(containers))]
+            random_matrix_edit(rng, get_matrix(c))
+        for c in dropped:
+            c.reconnect()
+        grids = [grid_of(get_matrix(c)) for c in containers]
+        assert all(g == grids[0] for g in grids), round_no
+        assert host.matrix_grid("doc", "default", "grid") == grids[0], \
+            round_no
+
+
+@pytest.mark.skipif(os.environ.get("FARM_FULL") != "1",
+                    reason="full 32-round reference profile: set FARM_FULL=1")
+def test_conflict_farm_full_reference_profile():
+    """The reference's FULL profile (32 clients x up to 512 ops/round x 32
+    rounds) — minutes of wall time; run explicitly."""
+    global ROUNDS
+    saved, ROUNDS = ROUNDS, 32
+    try:
+        test_conflict_farm_reference_client_scale()
+    finally:
+        ROUNDS = saved

@@ -104,3 +104,14 @@ class TestBatchedDeli:
         assert sync_ticket.seq == 3
         buffered = host.flush()
         assert [t.seq for t in buffered["doc"]] == [1, 2]
+
+
+def test_storm_load_harness_small_scale():
+    """The full_storm load profile (>=1M ops on real hardware; the
+    reference full profile analog) at smoke scale: the harness drives the
+    real socket path and verifies against the scalar replay oracle."""
+    from fluidframework_tpu.tools.load_test import run_storm_load
+
+    report = run_storm_load(total_ops=8_192, num_docs=32, k=32)
+    assert report["converged"]
+    assert report["ops_sequenced"] >= 8_192

@@ -180,3 +180,30 @@ def test_matrix_reconnect_farm(seed):
         grids = [grid_of(m) for m in matrices]
         assert grids[0] == grids[1], (seed, _round)
     assert c1.summarize() == c2.summarize()
+
+
+def test_stashed_insert_group_acks_every_fragment():
+    """A stashed insertGroup spans several engine groups; its single
+    sequenced echo must ack ALL of them (one remap covering every
+    fragment's temp handles) — vector_multi metadata, mirroring the
+    sequence DDS's stashed_group shape."""
+    from fluidframework_tpu.dds.matrix import SharedMatrix
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedDocumentMessage,
+    )
+
+    m = SharedMatrix("grid", None)
+    contents = {"target": "rows", "type": "insertGroup",
+                "ranges": [[0, 2], [2, 3]]}
+    meta = m.apply_stashed_op(contents)
+    assert meta[0] == "vector_multi" and len(meta[2]) == 2
+    assert len(m.rows.engine.pending_groups) == 2
+    echo = SequencedDocumentMessage(
+        client_id="me", sequence_number=7, minimum_sequence_number=0,
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents=contents, timestamp=0)
+    m.process_core(echo, True, meta)
+    assert not m.rows.engine.pending_groups  # every fragment acked
+    assert m.rows.next_handle == 5           # all temp handles remapped
+    assert m.row_count == 5
