@@ -129,7 +129,34 @@ def _run_device(apply_fn, state, batches, ops_per_tick: int,
 # -- config 3: SharedMap op-storm ---------------------------------------------
 
 
-def bench_map(num_docs: int = 10_240, k: int = 256, num_slots: int = 32,
+def _cpu_batched_rate(apply_fn, state, batches, ops_per_tick: int) -> float:
+    """The SAME batched program on XLA-CPU (this machine's strongest
+    general baseline: identical semantics, compiled, vectorized) at a
+    scaled-down doc batch — rates normalize per op."""
+    import jax
+
+    cpu = jax.devices("cpu")[0]
+    state = jax.device_put(state, cpu)
+    batches = [jax.device_put(b, cpu) for b in batches[:2]]
+    st = apply_fn(state, batches[0])  # compile
+    jax.block_until_ready(st)
+    start = time.perf_counter()
+    reps = 2
+    for _ in range(reps):
+        for batch in batches:
+            st = apply_fn(st, batch)
+    jax.block_until_ready(st)
+    return ops_per_tick * len(batches) * reps / (
+        time.perf_counter() - start)
+
+
+# Peak int32 element-op rate of one v5e chip's VPU (8 sublanes x 128
+# lanes x ~4 ALUs x ~940 MHz) — the denominator for the utilization
+# ESTIMATE reported per workload (elems_per_op models in notes).
+_VPU_PEAK_ELEMS = 3.9e12
+
+
+def bench_map(num_docs: int = 10_240, k: int = 1024, num_slots: int = 32,
               ticks: int = 12) -> dict:
     import jax
 
@@ -207,6 +234,9 @@ def bench_map(num_docs: int = 10_240, k: int = 256, num_slots: int = 32,
             present[docs[dels], slot_col[dels]] = False
     elapsed = time.perf_counter() - start
     out["numpy_batched_cpu_ops_per_sec"] = num_docs * k * ticks / elapsed
+    # Winner compute touches S slots per op (dense masked-max).
+    out["vpu_util_est"] = round(
+        out["device_ops_per_sec"] * num_slots / _VPU_PEAK_ELEMS, 4)
     out["num_docs"] = num_docs
     return out
 
@@ -262,6 +292,17 @@ def bench_mergetree(num_docs: int = 8192, k: int = 32, ticks: int = 6,
                       batches, num_docs * k)
     out["kernel_path"] = ("xla_scan" if mtp.default_interpret()
                           else "pallas_vmem")
+    # XLA-CPU twin of the same batched program (strongest CPU contender).
+    cpu_docs = 256
+    cpu_batches = [mtk.MergeOpBatch(
+        *[jnp.asarray(_tile(np.asarray(f)[:1], cpu_docs)) for f in b])
+        for b in batches[:2]]  # _cpu_batched_rate uses two ticks
+    out["xla_cpu_batched_ops_per_sec"] = _cpu_batched_rate(
+        mtk.apply_tick, mtk.init_state(cpu_docs, num_slots), cpu_batches,
+        cpu_docs * k)
+    # Each op's split/place/mark machinery touches ~6 planes of S slots.
+    out["vpu_util_est"] = round(
+        out["device_ops_per_sec"] * 6 * num_slots / _VPU_PEAK_ELEMS, 4)
 
     # Scalar baseline: the same stream through the scalar MergeEngine.
     from fluidframework_tpu.dds.mergetree import MergeEngine
@@ -328,7 +369,7 @@ def _gen_matrix_stream(rng: random.Random, n_ops: int) -> list[dict]:
     return ops
 
 
-def bench_matrix(num_docs: int = 16384, k: int = 32, ticks: int = 6) -> dict:
+def bench_matrix(num_docs: int = 16384, k: int = 64, ticks: int = 6) -> dict:
     import jax.numpy as jnp
 
     from fluidframework_tpu.ops import matrix_kernel as mxk
@@ -347,6 +388,18 @@ def bench_matrix(num_docs: int = 16384, k: int = 32, ticks: int = 6) -> dict:
                       batches, num_docs * k)
     out["kernel_path"] = ("xla_scan" if mxp.default_interpret()
                           else "pallas_vmem")
+    cpu_docs = 128
+    cpu_batches = [mxk.MatrixOpBatch(
+        *[jnp.asarray(_tile(np.asarray(f)[:1], cpu_docs)) for f in b])
+        for b in batches[:2]]  # _cpu_batched_rate uses two ticks
+    out["xla_cpu_batched_ops_per_sec"] = _cpu_batched_rate(
+        mxk.apply_tick,
+        mxk.init_state(cpu_docs, vec_slots=256, cell_slots=256),
+        cpu_batches, cpu_docs * k)
+    # Two embedded merge states (6 planes x 256 vec slots) + cell table.
+    out["vpu_util_est"] = round(
+        out["device_ops_per_sec"] * (2 * 6 * 256 + 4 * 256)
+        / _VPU_PEAK_ELEMS, 4)
 
     # Scalar baseline: PermutationVectors + LWW cell dict (scalar engine).
     from fluidframework_tpu.dds.matrix import PermutationVector
@@ -434,6 +487,19 @@ def bench_tree(num_docs: int = 8192, k: int = 32, ticks: int = 6,
 
     out = _run_device(apply, tk.init_state(num_docs, num_slots), batches,
                       num_docs * k)
+    cpu_docs = 128
+    cpu_batches = [tk.TreeOpBatch(
+        *[jnp.asarray(_tile(np.asarray(f)[:1], cpu_docs)) for f in b])
+        for b in batches[:2]]  # _cpu_batched_rate uses two ticks
+    out["xla_cpu_batched_ops_per_sec"] = _cpu_batched_rate(
+        apply, tk.init_state(cpu_docs, num_slots), cpu_batches,
+        cpu_docs * k)
+    # 5 planes of N node slots + the [N, N] one-hot subtree matvec on
+    # detach/move ops (amortized ~N/4 per op in this mix).
+    out["vpu_util_est"] = round(
+        out["device_ops_per_sec"]
+        * (5 * num_slots + num_slots * num_slots // 4)
+        / _VPU_PEAK_ELEMS, 4)
 
     # Scalar baseline: the same ops through the scalar Transaction.
     from tests.test_tree_kernel import scalar_apply
@@ -704,6 +770,22 @@ def bench_sequencer(num_docs: int = 10_240, k: int = 64,
                       batches, num_docs * k)
     out["kernel_path"] = ("xla_scan" if seqp.default_interpret()
                           else "pallas_vmem")
+    cpu_docs = 256
+    cpu_batches = [seqk.OpBatch(
+        *[jnp.asarray(_tile(np.asarray(f)[:1], cpu_docs)) for f in b])
+        for b in batches[:2]]  # _cpu_batched_rate uses two ticks
+
+    def cpu_apply(state, batch):
+        new_state, _t = seqk.process_batch(state, batch)
+        return new_state
+
+    out["xla_cpu_batched_ops_per_sec"] = _cpu_batched_rate(
+        cpu_apply, seqk.init_state(cpu_docs, n_clients + 4), cpu_batches,
+        cpu_docs * k)
+    # Per op: the ticket state machine over C client lanes (~12 planes).
+    out["vpu_util_est"] = round(
+        out["device_ops_per_sec"] * 12 * (n_clients + 4)
+        / _VPU_PEAK_ELEMS, 4)
 
     # Scalar baseline: the deli ticket loop.
     from fluidframework_tpu.protocol.messages import ClientDetail
@@ -738,6 +820,7 @@ def rngless(i: int) -> int:
 def main() -> None:
     detail = {
         "map_storm_10k_docs": bench_map(),
+        "map_storm_saturated_k4096": bench_map(k=4096, ticks=6),
         "e2e_storm_10k_docs": bench_e2e_storm(),
         "mergetree_stress": bench_mergetree(),
         "matrix_composed": bench_matrix(),
@@ -749,8 +832,15 @@ def main() -> None:
             "faster than CPython but far below the device rate. "
             "numpy_batched_cpu = this framework's own batched semantics "
             "on CPU (strongest same-machine contender for the map storm). "
-            "tick_ms_* = blocked latency of one batched device apply; an "
-            "op waits at most one tick at the kernel. e2e_storm = "
+            "xla_cpu_batched = the SAME batched program compiled by XLA "
+            "on this machine's CPU at a scaled doc batch (rates "
+            "normalize per op). vpu_util_est = device_ops_per_sec x a "
+            "per-op elems-touched model / 3.9e12 peak int32 elem-ops "
+            "(v5e VPU estimate) — a coarse utilization indicator, not a "
+            "measurement. tick_ms_* = blocked latency of one batched "
+            "device apply; an op waits at most one tick at the kernel. "
+            "tick_ms_pipelined_* = depth-2 pipelined completion cadence "
+            "(the serving shape). e2e_storm = "
             "sustained rate through the REAL path (client processes -> "
             "TCP -> C++ bridge -> alfred -> device deli -> device merger "
             "-> durable log + fanout + acks); it is bounded by the "

@@ -154,6 +154,9 @@ class MergeEngine:
         # concurrent-create race) — no other replica has our pending
         # segments, so positions must resolve without them.
         self._foreign_self = False
+        # Set by a reconnect identity change; the first regeneration pass
+        # consumes it (normalize once per rejoin, not per pending message).
+        self._rejoin_normalize_pending = False
 
     # -- views ----------------------------------------------------------------
 
@@ -452,6 +455,7 @@ class MergeEngine:
         self.local_client = new_client
         if old == new_client:
             return
+        self._rejoin_normalize_pending = True
         # old may be None: edits made while never-yet-connected stamp
         # client=None and must adopt the first real identity, or their
         # acked segments diverge from what remotes recorded.
@@ -511,6 +515,9 @@ class MergeEngine:
         rightward past acked tombstones so both layouts agree; visible
         text is unaffected (tombstones have zero visible length), but
         summaries and future tie-breaks see one canonical order."""
+        if not self._rejoin_normalize_pending:
+            return  # already normalized since the last identity change
+        self._rejoin_normalize_pending = False
         segs = self.segments
         changed = True
         while changed:

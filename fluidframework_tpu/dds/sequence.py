@@ -253,6 +253,25 @@ class SharedString(SharedObject):
                 if seg.removed_seq is not None:
                     # A removed segment can never become visible again; a
                     # regenerated range op would land on live neighbors.
+                    # The optimistic local annotation must REVERT to the
+                    # acked base — the op carrying it will never sequence,
+                    # so replicas that never saw it keep the tombstone
+                    # unannotated (summaries must match byte-for-byte).
+                    for key in group.props_keys:
+                        pending = seg.pending_props.get(key)
+                        if pending is None:
+                            continue
+                        pending[0] -= 1
+                        if pending[0] <= 0:
+                            base = pending[1]
+                            del seg.pending_props[key]
+                            if seg.props is not None:
+                                if base is None:
+                                    seg.props.pop(key, None)
+                                    if not seg.props:
+                                        seg.props = None
+                                else:
+                                    seg.props[key] = base
                     continue
                 pos = self.engine.get_position_at_local_seq(seg, limit)
                 props = {k: (seg.props or {}).get(k)

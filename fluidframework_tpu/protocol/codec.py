@@ -171,6 +171,17 @@ def encode_storm_frame(header: dict, payload: bytes) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+def pack_map_words(kinds, slots, values):
+    """Pack parallel arrays into the storm op-word layout
+    (kind(2) | slot(10) | value(20)) — THE one definition of the wire
+    bit layout; decoders in map_kernel/storm materialization mirror it."""
+    import numpy as np
+
+    return (np.asarray(kinds, np.uint32)
+            | (np.asarray(slots, np.uint32) << 2)
+            | (np.asarray(values, np.uint32) << 12))
+
+
 def decode_storm_body(body: bytes) -> tuple[dict, memoryview]:
     if body[0] != STORM_MAGIC or body[1] != 1:
         raise ValueError("not a v1 storm frame")

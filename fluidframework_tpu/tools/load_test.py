@@ -34,6 +34,16 @@ PROFILES = {
 }
 
 
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("server closed the connection")
+        buf += chunk
+    return buf
+
+
 def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
                    k: int = 256, sample_docs: int = 4) -> dict:
     """The reference's FULL-profile op volume (testConfig.json: 10M ops;
@@ -65,50 +75,61 @@ def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
     storm = StormController(service, seq_host, merge_host,
                             flush_threshold_docs=num_docs)
     front = BridgeFrontDoor(service, 0)
-    docs = [f"storm-{i}" for i in range(num_docs)]
-    clients = {d: service.connect(d, lambda msgs: None).client_id
-               for d in docs}
-    service.pump()
+    sock = None
+    try:
+        docs = [f"storm-{i}" for i in range(num_docs)]
+        clients = {d: service.connect(d, lambda msgs: None).client_id
+                   for d in docs}
+        service.pump()
 
-    sock = socket.create_connection(("127.0.0.1", front.port))
-    sock.settimeout(600)
-    rng = np.random.default_rng(0)
-    cseq = {d: 1 for d in docs}
-    ticks = -(-total_ops // (num_docs * k))
-    sent = 0
-    start = time.perf_counter()
-    for tick in range(ticks):
-        header, chunks = [], []
-        for d in docs:
-            kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
-            slots = rng.integers(0, 32, k).astype(np.uint32)
-            vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
-            chunks.append(kinds | (slots << 2) | (vals << 12))
-            header.append([d, clients[d], cseq[d], 1, k])
-            cseq[d] += k
-        sock.sendall(encode_storm_frame(
-            {"op": "storm", "rid": tick, "docs": header},
-            b"".join(c.tobytes() for c in chunks)))
-        sent += num_docs * k
-        length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
-        json.loads(sock.recv(length, socket.MSG_WAITALL).decode())
-    elapsed = time.perf_counter() - start
-    sock.close()
+        from ..protocol.codec import pack_map_words
 
-    # Oracle on a sample: scalar replay of the materialized durable log.
-    verified = True
-    for d in docs[:sample_docs]:
-        data = MapData()
-        for m in service.get_deltas(d, 0):
-            if m.type != MessageType.OPERATION:
-                continue
-            inner = (m.contents or {}).get("contents", {}).get("contents")
-            if inner:
-                data.process(inner, False, None)
-        verified &= (merge_host.map_entries(d, "default", "root")
-                     == dict(data.items()))
-    sequenced = storm.stats["sequenced_ops"]
-    front.close()
+        sock = socket.create_connection(("127.0.0.1", front.port))
+        sock.settimeout(600)
+        rng = np.random.default_rng(0)
+        cseq = {d: 1 for d in docs}
+        ticks = -(-total_ops // (num_docs * k))
+        sent = 0
+        start = time.perf_counter()
+        for tick in range(ticks):
+            header, chunks = [], []
+            for d in docs:
+                chunks.append(pack_map_words(
+                    rng.choice([0, 0, 0, 1, 2], size=k),
+                    rng.integers(0, 32, k),
+                    rng.integers(0, 1 << 20, k)))
+                header.append([d, clients[d], cseq[d], 1, k])
+                cseq[d] += k
+            sock.sendall(encode_storm_frame(
+                {"op": "storm", "rid": tick, "docs": header},
+                b"".join(c.tobytes() for c in chunks)))
+            sent += num_docs * k
+            # MSG_WAITALL is ignored on a socket with a timeout (the fd
+            # goes non-blocking) — exact reads must loop.
+            length = struct.unpack(">I", _recv_exact(sock, 4))[0]
+            json.loads(_recv_exact(sock, length).decode())
+        elapsed = time.perf_counter() - start
+
+        # Oracle on a sample: scalar replay of the materialized log.
+        verified = True
+        for d in docs[:sample_docs]:
+            data = MapData()
+            for m in service.get_deltas(d, 0):
+                if m.type != MessageType.OPERATION:
+                    continue
+                inner = (m.contents or {}).get("contents",
+                                               {}).get("contents")
+                if inner:
+                    data.process(inner, False, None)
+            verified &= (merge_host.map_entries(d, "default", "root")
+                         == dict(data.items()))
+        sequenced = storm.stats["sequenced_ops"]
+    finally:
+        # Mid-run failures (timeout, short recv, nack) must not leak the
+        # listening bridge + pump thread into the calling process.
+        if sock is not None:
+            sock.close()
+        front.close()
     return {
         "profile": "full_storm",
         "ops_sent": sent,
