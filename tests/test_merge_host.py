@@ -349,6 +349,59 @@ def test_overflow_after_trimmed_log_seeds_from_device():
     assert any(props == {"bold": True} for _, props in runs)
 
 
+def test_scalar_channel_readmitted_to_device():
+    """The overflow escape is not one-way (VERDICT r2 weak #7): once the
+    departed writers' segments compact away (window advance), the channel
+    re-encodes onto a device row and serves on device again — exactly."""
+    host = KernelMergeHost(merge_slots=256, flush_threshold=8)
+    oracle = __import__(
+        "fluidframework_tpu.dds.mergetree",
+        fromlist=["MergeEngine"]).MergeEngine()
+    seq = 0
+
+    def both(op, client, msn=None):
+        nonlocal seq
+        seq += 1
+        host.ingest("doc", _op_message(seq, seq - 1, client, op,
+                                       msn=msn if msn is not None
+                                       else seq - 1))
+        oracle.apply_remote(op, seq, seq - 1, client)
+        oracle.update_min_seq(msn if msn is not None else seq - 1)
+
+    # Blow the bitmask: 36 distinct writers, one insert each at pos 0.
+    n_writers = mtk.MAX_CLIENT_SLOTS + 5
+    for i in range(n_writers):
+        both({"type": "insert", "pos": 0, "text": f"<{i}>"}, f"w{i}")
+    key = ("doc", "default", "text")
+    assert host.stats["overflow_routed"] == 1
+    assert host._merge_rows[key].scalar is not None
+
+    # Two surviving clients remove everything the departed writers wrote
+    # and keep editing; the window advances past the removals, zamboni
+    # compacts the old writers' segments away.
+    text_len = len(host.text(*key))
+    both({"type": "remove", "start": 0, "end": text_len}, "keeper-a")
+    both({"type": "insert", "pos": 0, "text": "fresh "}, "keeper-b")
+    both({"type": "annotate", "start": 0, "end": 5,
+          "props": {"kept": True}}, "keeper-a", msn=seq)
+    both({"type": "insert", "pos": 6, "text": "start"}, "keeper-a",
+         msn=seq)
+    host.flush()
+    row = host._merge_rows[key]
+    assert host.stats["readmissions"] == 1
+    assert row.scalar is None and row.pool is not None
+    assert host.text(*key) == oracle.get_text() == "fresh start"
+
+    # Device-served again: later ops run through the kernel and match.
+    device_before = host.stats["device_ops"]
+    both({"type": "insert", "pos": 5, "text": "er"}, "keeper-b", msn=seq)
+    both({"type": "remove", "start": 0, "end": 2}, "keeper-a", msn=seq)
+    assert host.text(*key) == oracle.get_text()
+    assert host.stats["device_ops"] > device_before
+    runs = host.rich_text(*key)
+    assert any(props == {"kept": True} for _, props in runs)
+
+
 def test_annotate_and_markers_materialize():
     host = KernelMergeHost(flush_threshold=100)
     server = LocalCollabServer(merge_host=host)
