@@ -185,7 +185,10 @@ def bench_map(num_docs: int = 10_240, k: int = 1024, num_slots: int = 32,
                for batch in host_batches]
 
     def apply(state, batch):
-        return mk.apply_tick_words(state, *batch)
+        # Pallas VMEM LWW fold on TPU (ops/map_pallas.py); the XLA
+        # dense-winner path elsewhere.
+        from fluidframework_tpu.ops import map_pallas as mpx
+        return mpx.apply_tick_words_best(state, *batch)
 
     out = _run_device(apply, mk.init_state(num_docs, num_slots), batches,
                       num_docs * k)

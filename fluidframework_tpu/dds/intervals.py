@@ -14,9 +14,10 @@ sender's (refSeq, client) view, re-anchored at apply.
 
 from __future__ import annotations
 
+import bisect
 import itertools
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable, Iterator
 
 from .mergetree import MergeEngine, Segment, UNASSIGNED
 
@@ -37,6 +38,9 @@ class SequenceInterval:
     props: dict = field(default_factory=dict)
 
 
+_INDEX_BLOCK = 64  # entries per max-end pruning block of the query index
+
+
 class IntervalCollection:
     """One labeled collection of intervals over a merge engine."""
 
@@ -51,8 +55,20 @@ class IntervalCollection:
         self._next_pending = itertools.count(1)
         engine.on_split.append(self._on_split)
         engine.on_compact.append(self._on_compact)
+        # Overlap-query index (intervalCollection.ts:265 IntervalTree +
+        # endIntervalTree). Anchor DOCUMENT order is edit-stable, so the
+        # index holds intervals sorted by resolved start and is rebuilt
+        # lazily: one O(S + n log n) pass the first query after any edit
+        # (engine fingerprint + explicit dirty marks), O(log n + k)
+        # afterwards — edits don't pay unless somebody queries.
+        self._index_dirty = True
+        self._index_fp: tuple | None = None
+        self._index_entries: list[tuple[int, int, SequenceInterval]] = []
+        self._index_starts: list[int] = []
+        self._index_block_max_end: list[int] = []
 
     def _on_split(self, head: Segment, tail: Segment, offset: int) -> None:
+        self._index_dirty = True
         for interval in self.intervals.values():
             for ref in (interval.start, interval.end):
                 if ref.segment is head and ref.offset >= offset:
@@ -63,6 +79,7 @@ class IntervalCollection:
         """Zamboni dropped/coalesced segments: chase anchors to survivors.
         rebind: {id(old_seg): (replacement | None, delta | None)} — delta
         None slides to the replacement's start; otherwise offset += delta."""
+        self._index_dirty = True
         for interval in self.intervals.values():
             for ref in (interval.start, interval.end):
                 while ref.segment is not None and id(ref.segment) in rebind:
@@ -125,6 +142,7 @@ class IntervalCollection:
             props=dict(props or {}),
         )
         self.intervals[interval_id] = interval
+        self._index_dirty = True
         pending_id = next(self._next_pending)
         self._pending[interval_id] = pending_id
         self._submit({"type": "intervalAdd", "label": self.label,
@@ -143,6 +161,7 @@ class IntervalCollection:
                                           client)
         if end is not None:
             interval.end = self._anchor(end, self._engine.current_seq, client)
+        self._index_dirty = True
         if props:
             interval.props.update(props)
             interval.props = {k: v for k, v in interval.props.items()
@@ -157,6 +176,7 @@ class IntervalCollection:
 
     def delete(self, interval_id: str) -> None:
         self.intervals.pop(interval_id, None)
+        self._index_dirty = True
         pending_id = next(self._next_pending)
         self._pending[interval_id] = pending_id
         self._submit({"type": "intervalDelete", "label": self.label,
@@ -175,10 +195,112 @@ class IntervalCollection:
             for interval_id, i in sorted(self.intervals.items())
         }
 
+    # -- overlap queries (intervalCollection.ts:265-334) -----------------------
+
+    def _rebuild_index(self) -> None:
+        engine = self._engine
+        fp = (engine.current_seq, engine._local_seq_counter,
+              len(self.intervals))
+        if not self._index_dirty and fp == self._index_fp:
+            return
+        # One visibility sweep resolves EVERY anchor in O(S) — per-anchor
+        # _resolve would make the rebuild O(n*S).
+        prefix: dict[int, tuple[int, int]] = {}
+        pos = 0
+        for seg in engine.segments:
+            vis = engine._vis_len(seg, engine.current_seq,
+                                  engine.local_client)
+            prefix[id(seg)] = (pos, vis)
+            pos += vis
+        total = pos
+
+        def resolve(ref: LocalRef) -> int:
+            if ref.segment is None:
+                return total
+            entry = prefix.get(id(ref.segment))
+            if entry is None:
+                return total  # compacted away mid-flight; slid to end
+            base, vis = entry
+            return base + min(ref.offset, max(vis - 1, 0)) if vis else base
+
+        entries = sorted(
+            ((resolve(i.start), resolve(i.end), i)
+             for i in self.intervals.values()),
+            key=lambda e: (e[0], e[1], e[2].id))
+        self._index_entries = entries
+        self._index_starts = [e[0] for e in entries]
+        # Block-max over ends: skip a whole block when nothing in it can
+        # reach back to the query start (the augmented-tree pruning).
+        self._index_block_max_end = [
+            max(e[1] for e in entries[b:b + _INDEX_BLOCK])
+            for b in range(0, len(entries), _INDEX_BLOCK)]
+        self._index_dirty = False
+        self._index_fp = fp
+
+    def find_overlapping_intervals(self, start: int, end: int
+                                   ) -> list[SequenceInterval]:
+        """Intervals [s, e] with s <= end and e >= start, in start order —
+        findOverlappingIntervals (intervalCollection.ts:295; inclusive
+        endpoints match the reference's IntervalTree.match semantics)."""
+        if end < start:
+            return []
+        self._rebuild_index()
+        hi = bisect.bisect_right(self._index_starts, end)
+        out: list[SequenceInterval] = []
+        b = 0
+        while b * _INDEX_BLOCK < hi:
+            lo = b * _INDEX_BLOCK
+            if self._index_block_max_end[b] < start:
+                b += 1  # nothing in this block reaches the query
+                continue
+            for s, e, interval in self._index_entries[
+                    lo:min(lo + _INDEX_BLOCK, hi)]:
+                if e >= start:
+                    out.append(interval)
+            b += 1
+        return out
+
+    def previous_interval(self, pos: int) -> SequenceInterval | None:
+        """Interval with the greatest start <= pos (ties: greatest end) —
+        previousInterval, intervalCollection.ts:313."""
+        self._rebuild_index()
+        idx = bisect.bisect_right(self._index_starts, pos) - 1
+        if idx < 0:
+            return None
+        # Entries sort by (start, end, id), so the last entry with
+        # start <= pos already has the greatest (end, id) among ties.
+        return self._index_entries[idx][2]
+
+    def next_interval(self, pos: int) -> SequenceInterval | None:
+        """Interval with the smallest start >= pos (ties: smallest end) —
+        nextInterval, intervalCollection.ts:321."""
+        self._rebuild_index()
+        idx = bisect.bisect_left(self._index_starts, pos)
+        if idx >= len(self._index_entries):
+            return None
+        return self._index_entries[idx][2]
+
+    def iterate(self, reverse: bool = False,
+                start_position: int | None = None
+                ) -> Iterator[SequenceInterval]:
+        """Start-ordered iteration, optionally from a given start
+        position (CreateForwardIteratorWithStartPosition family,
+        intervalCollection.ts:689-727)."""
+        self._rebuild_index()
+        if start_position is None:
+            entries = self._index_entries
+        else:
+            lo = bisect.bisect_left(self._index_starts, start_position)
+            hi = bisect.bisect_right(self._index_starts, start_position)
+            entries = self._index_entries[lo:hi]
+        for _, _, interval in (reversed(entries) if reverse else entries):
+            yield interval
+
     # -- sequenced apply -------------------------------------------------------
 
     def process(self, op: dict, local: bool, metadata, message) -> None:
         interval_id = op["id"]
+        self._index_dirty = True
         if local:
             pending_id = metadata[3]
             if self._pending.get(interval_id) == pending_id:
@@ -244,6 +366,7 @@ class IntervalCollection:
         return {"label": self.label, "intervals": out}
 
     def load(self, snap: dict) -> None:
+        self._index_dirty = True
         client = self._engine.local_client
         for entry in snap["intervals"]:
             self.intervals[entry["id"]] = SequenceInterval(

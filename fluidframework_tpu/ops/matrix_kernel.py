@@ -90,12 +90,14 @@ class _VecOp(NamedTuple):
     prop_val: jax.Array
 
 
-def init_state(num_docs: int, vec_slots: int = 64, cell_slots: int = 256
-               ) -> MatrixState:
+def init_state(num_docs: int, vec_slots: int = 64, cell_slots: int = 256,
+               overlap_words: int = 1) -> MatrixState:
     b, c = num_docs, cell_slots
     return MatrixState(
-        rows=mtk.init_state(b, vec_slots, num_props=1),
-        cols=mtk.init_state(b, vec_slots, num_props=1),
+        rows=mtk.init_state(b, vec_slots, num_props=1,
+                            overlap_words=overlap_words),
+        cols=mtk.init_state(b, vec_slots, num_props=1,
+                            overlap_words=overlap_words),
         cell_rh=jnp.full((b, c), -1, I32),
         cell_ch=jnp.full((b, c), -1, I32),
         cell_val=jnp.zeros((b, c), I32),

@@ -50,9 +50,9 @@ _MX_OPS = ("valid", "target", "kind", "pos", "end", "count", "handle_base",
            "row", "col", "value", "seq", "ref_seq", "client")
 
 
-def _handle_at_vec(p: dict, pos, ref_seq, client):
+def _handle_at_vec(p: dict, overlap, pos, ref_seq, client):
     """Storage handle at visible position pos, per doc ([D, 1]); -1 none."""
-    vis = _vis_len(p, ref_seq, client)
+    vis = _vis_len(p, overlap, ref_seq, client)
     cum = _excl_cumsum(vis)
     inside = (cum <= pos) & (pos < cum + vis)
     found = jnp.any(inside, axis=-1, keepdims=True)
@@ -62,8 +62,9 @@ def _handle_at_vec(p: dict, pos, ref_seq, client):
     return jnp.where(found, base + off, -1)
 
 
-def _matrix_apply_vec(rows, rows_prop, rows_count, cols, cols_prop,
-                      cols_count, cells, cell_count, op, num_cells: int):
+def _matrix_apply_vec(rows, rows_prop, rows_overlap, rows_count,
+                      cols, cols_prop, cols_overlap, cols_count,
+                      cells, cell_count, op, num_cells: int):
     opvalid = op["valid"] != 0
     is_rows = op["target"] == MX_ROWS
     is_cols = op["target"] == MX_COLS
@@ -77,12 +78,14 @@ def _matrix_apply_vec(rows, rows_prop, rows_count, cols, cols_prop,
     any_cell = jnp.any(opvalid & is_cell)
 
     def vec_phase(carry):
-        rows, rows_prop, rows_count, cols, cols_prop, cols_count = carry
+        (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+         cols_overlap, cols_count) = carry
         # ONE merge walk on the select-merged axis
         # (matrix_kernel._apply_matrix_op).
         sel = {name: jnp.where(is_rows, rows[name], cols[name])
                for name in _PLANES}
         sel_prop = jnp.where(is_rows[None], rows_prop, cols_prop)
+        sel_overlap = jnp.where(is_rows[None], rows_overlap, cols_overlap)
         sel_count = jnp.where(is_rows, rows_count, cols_count)
         zeros = jnp.zeros_like(op["kind"])
         vec_op = {"valid": op["valid"], "kind": op["kind"],
@@ -90,29 +93,34 @@ def _matrix_apply_vec(rows, rows_prop, rows_count, cols, cols_prop,
                   "ref_seq": op["ref_seq"], "client": op["client"],
                   "pool_start": op["handle_base"], "text_len": op["count"],
                   "prop_key": zeros, "prop_val": zeros}
-        walked, walked_prop, walked_count = merge_apply_vec(
-            sel, sel_prop, sel_count, vec_op)
+        walked, walked_prop, walked_overlap, walked_count = merge_apply_vec(
+            sel, sel_prop, sel_overlap, sel_count, vec_op)
         gate_r = opvalid & is_rows
         gate_c = opvalid & is_cols
         return (
             {n: jnp.where(gate_r, walked[n], rows[n]) for n in _PLANES},
             jnp.where(gate_r[None], walked_prop, rows_prop),
+            jnp.where(gate_r[None], walked_overlap, rows_overlap),
             jnp.where(gate_r, walked_count, rows_count),
             {n: jnp.where(gate_c, walked[n], cols[n]) for n in _PLANES},
             jnp.where(gate_c[None], walked_prop, cols_prop),
+            jnp.where(gate_c[None], walked_overlap, cols_overlap),
             jnp.where(gate_c, walked_count, cols_count),
         )
 
-    (new_rows, new_rows_prop, new_rows_count, new_cols, new_cols_prop,
-     new_cols_count) = jax.lax.cond(
+    (new_rows, new_rows_prop, new_rows_overlap, new_rows_count, new_cols,
+     new_cols_prop, new_cols_overlap, new_cols_count) = jax.lax.cond(
         any_vec, vec_phase, lambda carry: carry,
-        (rows, rows_prop, rows_count, cols, cols_prop, cols_count))
+        (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+         cols_overlap, cols_count))
 
     def cell_phase(carry):
         cells, cell_count = carry
         # Cell LWW write against the PRE-op axis tables.
-        rh = _handle_at_vec(rows, op["row"], op["ref_seq"], op["client"])
-        ch = _handle_at_vec(cols, op["col"], op["ref_seq"], op["client"])
+        rh = _handle_at_vec(rows, rows_overlap, op["row"], op["ref_seq"],
+                            op["client"])
+        ch = _handle_at_vec(cols, cols_overlap, op["col"], op["ref_seq"],
+                            op["client"])
         write = opvalid & is_cell & (rh >= 0) & (ch >= 0)
         match = ((cells["cell_used"] != 0) & (cells["cell_rh"] == rh)
                  & (cells["cell_ch"] == ch))
@@ -135,8 +143,9 @@ def _matrix_apply_vec(rows, rows_prop, rows_count, cols, cols_prop,
 
     new_cells, new_cell_count = jax.lax.cond(
         any_cell, cell_phase, lambda carry: carry, (cells, cell_count))
-    return (new_rows, new_rows_prop, new_rows_count, new_cols,
-            new_cols_prop, new_cols_count, new_cells, new_cell_count)
+    return (new_rows, new_rows_prop, new_rows_overlap, new_rows_count,
+            new_cols, new_cols_prop, new_cols_overlap, new_cols_count,
+            new_cells, new_cell_count)
 
 
 def _tick_kernel(*refs, num_ops: int, num_cells: int):
@@ -148,25 +157,26 @@ def _tick_kernel(*refs, num_ops: int, num_cells: int):
         i += n
         return out
 
-    rows_refs = take(8)
-    rows_prop_ref, rows_count_ref = take(2)
-    cols_refs = take(8)
-    cols_prop_ref, cols_count_ref = take(2)
+    rows_refs = take(7)
+    rows_prop_ref, rows_overlap_ref, rows_count_ref = take(3)
+    cols_refs = take(7)
+    cols_prop_ref, cols_overlap_ref, cols_count_ref = take(3)
     cell_refs = take(5)
     cell_count_ref, = take(1)
     op_refs = take(13)
-    out_rows = take(8)
-    out_rows_prop, out_rows_count = take(2)
-    out_cols = take(8)
-    out_cols_prop, out_cols_count = take(2)
+    out_rows = take(7)
+    out_rows_prop, out_rows_overlap, out_rows_count = take(3)
+    out_cols = take(7)
+    out_cols_prop, out_cols_overlap, out_cols_count = take(3)
     out_cells = take(5)
     out_cell_count, = take(1)
 
     rows = {n: r[:] for n, r in zip(_PLANES, rows_refs)}
     cols = {n: r[:] for n, r in zip(_PLANES, cols_refs)}
     cells = {n: r[:] for n, r in zip(_CELLS, cell_refs)}
-    carry = (rows, rows_prop_ref[:], rows_count_ref[:], cols,
-             cols_prop_ref[:], cols_count_ref[:], cells, cell_count_ref[:])
+    carry = (rows, rows_prop_ref[:], rows_overlap_ref[:], rows_count_ref[:],
+             cols, cols_prop_ref[:], cols_overlap_ref[:], cols_count_ref[:],
+             cells, cell_count_ref[:])
     op_vals = {n: r[:] for n, r in zip(_MX_OPS, op_refs)}
     op_lane = jax.lax.broadcasted_iota(
         I32, next(iter(op_vals.values())).shape, 1)
@@ -180,16 +190,18 @@ def _tick_kernel(*refs, num_ops: int, num_cells: int):
     # Dynamic trip count: skip trailing all-invalid steps (front-packed
     # sparse ticks), mirroring mergetree_pallas.
     last_valid = jnp.max(jnp.where(op_vals["valid"] != 0, op_lane + 1, 0))
-    (rows, rows_prop, rows_count, cols, cols_prop, cols_count, cells,
-     cell_count) = jax.lax.fori_loop(
+    (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+     cols_overlap, cols_count, cells, cell_count) = jax.lax.fori_loop(
         0, jnp.minimum(last_valid, num_ops), body, carry)
     for n, r in zip(_PLANES, out_rows):
         r[:] = rows[n]
     out_rows_prop[:] = rows_prop
+    out_rows_overlap[:] = rows_overlap
     out_rows_count[:] = rows_count
     for n, r in zip(_PLANES, out_cols):
         r[:] = cols[n]
     out_cols_prop[:] = cols_prop
+    out_cols_overlap[:] = cols_overlap
     out_cols_count[:] = cols_count
     for n, r in zip(_CELLS, out_cells):
         r[:] = cells[n]
@@ -197,8 +209,7 @@ def _tick_kernel(*refs, num_ops: int, num_cells: int):
 
 
 _VEC_FILL = {"valid": 0, "length": 0, "ins_seq": 0, "ins_client": -1,
-             "rem_seq": int(NONE_SEQ), "rem_client": -1,
-             "rem_overlap": 0, "pool_start": 0}
+             "rem_seq": int(NONE_SEQ), "rem_client": -1, "pool_start": 0}
 _CELL_FILL = {"cell_rh": -1, "cell_ch": -1, "cell_val": 0, "cell_seq": 0,
               "cell_used": 0}
 
@@ -212,6 +223,7 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
     c = state.cell_used.shape[1]
     k = ops.kind.shape[1]
     p = state.rows.prop_val.shape[2]
+    w = state.rows.rem_overlap.shape[2]
     d = min(block_docs, max(8, b))
     bp = -(-b // d) * d
     sp = -(-s // 128) * 128
@@ -225,11 +237,13 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
             planes.append(_pad_to(arr, 1, sp, _VEC_FILL[name]))
         prop = jnp.transpose(ms.prop_val, (2, 0, 1))
         prop = _pad_to(_pad_to(prop, 1, bp, 0), 2, sp, 0)
+        overlap = jnp.transpose(ms.rem_overlap, (2, 0, 1))
+        overlap = _pad_to(_pad_to(overlap, 1, bp, 0), 2, sp, 0)
         count = _pad_to(ms.count[:, None], 0, bp, 0)
-        return planes, prop, count
+        return planes, prop, overlap, count
 
-    rows_planes, rows_prop, rows_count = vec_inputs(state.rows)
-    cols_planes, cols_prop, cols_count = vec_inputs(state.cols)
+    rows_planes, rows_prop, rows_overlap, rows_count = vec_inputs(state.rows)
+    cols_planes, cols_prop, cols_overlap, cols_count = vec_inputs(state.cols)
     cell_planes = []
     for name in _CELLS:
         arr = getattr(state, name).astype(I32)
@@ -244,6 +258,8 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
                             memory_space=pltpu.VMEM)
     prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
                              memory_space=pltpu.VMEM)
+    overlap_spec = pl.BlockSpec((w, d, sp), lambda i: (0, i, 0),
+                                memory_space=pltpu.VMEM)
     count_spec = pl.BlockSpec((d, 1), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
     cell_spec = pl.BlockSpec((d, cp), lambda i: (i, 0),
@@ -251,11 +267,13 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
     op_spec = pl.BlockSpec((d, k), lambda i: (i, 0),
                            memory_space=pltpu.VMEM)
 
-    state_specs = ([vec_spec] * 8 + [prop_spec, count_spec]) * 2 \
+    state_specs = ([vec_spec] * 7
+                   + [prop_spec, overlap_spec, count_spec]) * 2 \
         + [cell_spec] * 5 + [count_spec]
     state_shapes = (
-        [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 8
+        [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 7
         + [jax.ShapeDtypeStruct((p, bp, sp), jnp.int32),
+           jax.ShapeDtypeStruct((w, bp, sp), jnp.int32),
            jax.ShapeDtypeStruct((bp, 1), jnp.int32)]) * 2 \
         + [jax.ShapeDtypeStruct((bp, cp), jnp.int32)] * 5 \
         + [jax.ShapeDtypeStruct((bp, 1), jnp.int32)]
@@ -268,10 +286,11 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
         out_shape=state_shapes,
         input_output_aliases={i: i for i in range(26)},
         interpret=interpret,
-    )(*rows_planes, rows_prop, rows_count, *cols_planes, cols_prop,
-      cols_count, *cell_planes, cell_count, *op_arrays)
+    )(*rows_planes, rows_prop, rows_overlap, rows_count, *cols_planes,
+      cols_prop, cols_overlap, cols_count, *cell_planes, cell_count,
+      *op_arrays)
 
-    def vec_state(planes, prop, count) -> MergeState:
+    def vec_state(planes, prop, overlap, count) -> MergeState:
         named = {n: a[:b, :s] for n, a in zip(_PLANES, planes)}
         return MergeState(
             valid=named["valid"] != 0,
@@ -280,7 +299,7 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
             ins_client=named["ins_client"],
             rem_seq=named["rem_seq"],
             rem_client=named["rem_client"],
-            rem_overlap=named["rem_overlap"],
+            rem_overlap=jnp.transpose(overlap, (1, 2, 0))[:b, :s],
             pool_start=named["pool_start"],
             prop_val=jnp.transpose(prop, (1, 2, 0))[:b, :s],
             count=count[:b, 0],
@@ -288,8 +307,8 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
 
     cells = {n: a[:b, :c] for n, a in zip(_CELLS, out[20:25])}
     return MatrixState(
-        rows=vec_state(out[0:8], out[8], out[9]),
-        cols=vec_state(out[10:18], out[18], out[19]),
+        rows=vec_state(out[0:7], out[7], out[8], out[9]),
+        cols=vec_state(out[10:17], out[17], out[18], out[19]),
         cell_rh=cells["cell_rh"],
         cell_ch=cells["cell_ch"],
         cell_val=cells["cell_val"],

@@ -40,11 +40,24 @@ MT_INSERT = 0
 MT_REMOVE = 1
 MT_ANNOTATE = 2
 
-# rem_overlap is an i32 bitmask: at most 31 distinct client slots per
-# document lifetime on the device path. The host must route documents that
-# accumulate more (e.g. via reconnect slot churn) to the scalar path —
-# make_merge_op_batch enforces the bound.
-MAX_CLIENT_SLOTS = 31
+# rem_overlap is a multi-word bitmask: W i32 planes give 32*W distinct
+# client slots per document lifetime on the device path (the reference
+# allows up to 1,000,000 clients/doc — routerlicious config.json:39 — and
+# stresses 32 concurrent writers, conflictFarm.spec.ts:50-57). The word
+# count is a state dimension chosen by the host (init_state overlap_words),
+# grown on demand like the prop planes; documents whose writer set exceeds
+# the host's configured ceiling route to the scalar path.
+OVERLAP_WORD_BITS = 32
+
+
+def client_capacity(state: "MergeState") -> int:
+    """Distinct client slots the state's overlap planes can track."""
+    return OVERLAP_WORD_BITS * state.rem_overlap.shape[-1]
+
+
+def overlap_words_for(num_clients: int) -> int:
+    """Overlap words needed to track ``num_clients`` distinct writers."""
+    return max(1, -(-num_clients // OVERLAP_WORD_BITS))
 
 
 class MergeState(NamedTuple):
@@ -56,7 +69,7 @@ class MergeState(NamedTuple):
     ins_client: jax.Array  # i32 inserting client slot
     rem_seq: jax.Array    # i32 removal seq; NONE_SEQ = live
     rem_client: jax.Array  # i32 removing client slot (-1 none)
-    rem_overlap: jax.Array  # i32 bitmask of additional concurrent removers
+    rem_overlap: jax.Array  # i32[B, S, W] bitmask planes of extra removers
     pool_start: jax.Array  # i32 offset into the host text pool
     prop_val: jax.Array   # i32[B, S, P] interned value ids (0 = unset)
     count: jax.Array      # i32[B] live slot high-water mark
@@ -78,8 +91,8 @@ class MergeOpBatch(NamedTuple):
     prop_val: jax.Array    # i32 interned value id; 0 deletes (annotate)
 
 
-def init_state(num_docs: int, num_slots: int, num_props: int = 4
-               ) -> MergeState:
+def init_state(num_docs: int, num_slots: int, num_props: int = 4,
+               overlap_words: int = 1) -> MergeState:
     b, s, p = num_docs, num_slots, num_props
     return MergeState(
         valid=jnp.zeros((b, s), jnp.bool_),
@@ -88,17 +101,35 @@ def init_state(num_docs: int, num_slots: int, num_props: int = 4
         ins_client=jnp.full((b, s), -1, I32),
         rem_seq=jnp.full((b, s), NONE_SEQ, I32),
         rem_client=jnp.full((b, s), -1, I32),
-        rem_overlap=jnp.zeros((b, s), I32),
+        rem_overlap=jnp.zeros((b, s, max(1, overlap_words)), I32),
         pool_start=jnp.zeros((b, s), I32),
         prop_val=jnp.zeros((b, s, p), I32),
         count=jnp.zeros((b,), I32),
     )
 
 
+def _overlap_bit(rem_overlap: jax.Array, client) -> jax.Array:
+    """Whether ``client``'s bit is set, per slot. [..., W] → [...]. The
+    sign bit is a plain payload bit: >> is arithmetic but ``& 1`` keeps
+    only the selected bit either way."""
+    w = rem_overlap.shape[-1]
+    c = jnp.clip(client, 0, OVERLAP_WORD_BITS * w - 1)
+    sel = jnp.sum(jnp.where(jnp.arange(w) == (c >> 5), rem_overlap, 0),
+                  axis=-1)
+    return (sel >> (c & 31)) & 1
+
+
+def _overlap_mask(client, num_words: int) -> jax.Array:
+    """One-hot [W] word vector with ``client``'s bit set in its word."""
+    c = jnp.clip(client, 0, OVERLAP_WORD_BITS * num_words - 1)
+    return jnp.where(jnp.arange(num_words) == (c >> 5),
+                     jnp.left_shift(I32(1), (c & 31).astype(I32)), 0)
+
+
 def _vis_len(s: MergeState, ref_seq, client):
     """Visible length per slot for (refSeq, client) — nodeLength equivalent."""
     ins_vis = s.valid & ((s.ins_seq <= ref_seq) | (s.ins_client == client))
-    overlap_bit = (s.rem_overlap >> jnp.clip(client, 0, 30)) & 1
+    overlap_bit = _overlap_bit(s.rem_overlap, client)
     removed_vis = (
         (s.rem_seq != NONE_SEQ)
         & ((s.rem_seq <= ref_seq) | (s.rem_client == client)
@@ -137,8 +168,9 @@ def _split_at(s: MergeState, pos, ref_seq, client) -> MergeState:
             rem_seq=_shift_insert(state.rem_seq, tail_at, state.rem_seq[idx]),
             rem_client=_shift_insert(state.rem_client, tail_at,
                                      state.rem_client[idx]),
-            rem_overlap=_shift_insert(state.rem_overlap, tail_at,
-                                      state.rem_overlap[idx]),
+            rem_overlap=jax.vmap(
+                lambda plane: _shift_insert(plane, tail_at, plane[idx]),
+                in_axes=1, out_axes=1)(state.rem_overlap),
             pool_start=_shift_insert(state.pool_start, tail_at,
                                      state.pool_start[idx] + offset),
             prop_val=jax.vmap(
@@ -175,7 +207,8 @@ def _place_segment(s: MergeState, op) -> MergeState:
         ins_client=_shift_insert(s.ins_client, idx, op.client),
         rem_seq=_shift_insert(s.rem_seq, idx, NONE_SEQ),
         rem_client=_shift_insert(s.rem_client, idx, -1),
-        rem_overlap=_shift_insert(s.rem_overlap, idx, 0),
+        rem_overlap=jax.vmap(lambda plane: _shift_insert(plane, idx, 0),
+                             in_axes=1, out_axes=1)(s.rem_overlap),
         pool_start=_shift_insert(s.pool_start, idx, op.pool_start),
         prop_val=jax.vmap(lambda plane: _shift_insert(plane, idx, 0),
                           in_axes=1, out_axes=1)(s.prop_val),
@@ -191,11 +224,13 @@ def _mark_range(s: MergeState, op) -> MergeState:
     in_range = (vis > 0) & (cum >= op.pos) & (cum < op.end)
     fresh = in_range & (s.rem_seq == NONE_SEQ)
     again = in_range & (s.rem_seq != NONE_SEQ)
-    bit = I32(1) << jnp.clip(op.client, 0, 30)
+    bit_vec = _overlap_mask(op.client, s.rem_overlap.shape[-1])
     return s._replace(
         rem_seq=jnp.where(fresh, op.seq, s.rem_seq),
         rem_client=jnp.where(fresh, op.client, s.rem_client),
-        rem_overlap=jnp.where(again, s.rem_overlap | bit, s.rem_overlap),
+        rem_overlap=jnp.where(again[:, None],
+                              s.rem_overlap | bit_vec[None, :],
+                              s.rem_overlap),
     )
 
 
@@ -321,7 +356,8 @@ def _apply_op(s: MergeState, op) -> MergeState:
         ins_client=jnp.where(is_placed, op.client, shifted(s.ins_client)),
         rem_seq=jnp.where(is_placed, NONE_SEQ, shifted(s.rem_seq)),
         rem_client=jnp.where(is_placed, -1, shifted(s.rem_client)),
-        rem_overlap=jnp.where(is_placed, 0, shifted(s.rem_overlap)),
+        rem_overlap=jnp.where(is_placed[:, None], 0,
+                              shifted(s.rem_overlap)),
         pool_start=jnp.where(is_placed, op.pool_start,
                              shifted(s.pool_start) + start_off),
         prop_val=jnp.where(is_placed[:, None], 0, shifted(s.prop_val)),
@@ -412,7 +448,11 @@ class TextPool:
 
 
 def make_merge_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
-                        k: int) -> MergeOpBatch:
+                        k: int, client_slots: int | None = None
+                        ) -> MergeOpBatch:
+    """``client_slots`` = the target state's overlap-plane capacity
+    (``client_capacity(state)``); when given, ops referencing slots beyond
+    it are rejected here rather than silently aliasing on the device."""
     fields = {name: np.zeros((num_docs, k), np.int32)
               for name in ("kind", "pos", "end", "seq", "ref_seq", "client",
                            "pool_start", "text_len", "prop_key", "prop_val")}
@@ -420,9 +460,11 @@ def make_merge_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
     for d, doc_ops in enumerate(ops_per_doc):
         assert len(doc_ops) <= k, f"tick overflow: {len(doc_ops)} > {k}"
         for i, op in enumerate(doc_ops):
-            assert 0 <= op.get("client", 0) < MAX_CLIENT_SLOTS, (
-                f"client slot {op.get('client')} exceeds device bitmask "
-                f"capacity ({MAX_CLIENT_SLOTS}); route doc to scalar path")
+            if client_slots is not None:
+                assert 0 <= op.get("client", 0) < client_slots, (
+                    f"client slot {op.get('client')} exceeds device overlap "
+                    f"capacity ({client_slots}); grow overlap words or "
+                    "route doc to scalar path")
             valid[d, i] = True
             for name in fields:
                 fields[name][d, i] = op.get(name, 0)

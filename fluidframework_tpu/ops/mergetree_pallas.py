@@ -48,8 +48,10 @@ from .mergetree_kernel import (
 
 I32 = jnp.int32
 
+# rem_overlap is NOT here: its multi-word planes ride a [W, D, S] operand
+# beside the prop planes (mergetree_kernel widened it per-state).
 _PLANES = ("valid", "length", "ins_seq", "ins_client", "rem_seq",
-           "rem_client", "rem_overlap", "pool_start")
+           "rem_client", "pool_start")
 _OPS = ("valid", "kind", "pos", "end", "seq", "ref_seq", "client",
         "pool_start", "text_len", "prop_key", "prop_val")
 
@@ -105,24 +107,45 @@ class LanePrims:
         return pltpu.roll(field, shift=shift, axis=field.ndim - 1)
 
 
-def _vis_len(p: dict, ref_seq, client):
+def _overlap_bit_vec(overlap: jax.Array, client: jax.Array) -> jax.Array:
+    """Per-slot bit for each doc's client. overlap [W, D, S]; client
+    [D, 1] → [D, S]. Arithmetic >> is fine: ``& 1`` keeps one bit."""
+    w = overlap.shape[0]
+    c = jnp.clip(client, 0, 32 * w - 1)
+    word_ids = jax.lax.broadcasted_iota(I32, overlap.shape, 0)
+    sel = jnp.sum(jnp.where(word_ids == (c >> 5)[None], overlap, 0),
+                  axis=0)
+    return (sel >> (c & 31)) & 1
+
+
+def _overlap_mask_vec(overlap_shape: tuple, client: jax.Array) -> jax.Array:
+    """[W, D, S] planes with each doc's client bit set in its word."""
+    w = overlap_shape[0]
+    c = jnp.clip(client, 0, 32 * w - 1)
+    word_ids = jax.lax.broadcasted_iota(I32, overlap_shape, 0)
+    bit = jnp.left_shift(I32(1), (c & 31))  # [D, 1]
+    return jnp.where(word_ids == (c >> 5)[None], bit[None], 0)
+
+
+def _vis_len(p: dict, overlap: jax.Array, ref_seq, client):
     validb = p["valid"] != 0
     ins_vis = validb & ((p["ins_seq"] <= ref_seq)
                         | (p["ins_client"] == client))
-    overlap_bit = (p["rem_overlap"] >> jnp.clip(client, 0, 30)) & 1
+    overlap_bit = _overlap_bit_vec(overlap, client)
     removed_vis = ((p["rem_seq"] != NONE_SEQ)
-                   & ((p["rem_seq"] <= ref_seq)
-                      | (p["rem_client"] == client) | (overlap_bit == 1)))
+                   & ((p["rem_client"] == client) | (p["rem_seq"] <= ref_seq)
+                      | (overlap_bit == 1)))
     return jnp.where(ins_vis & ~removed_vis, p["length"], 0)
 
 
-def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict,
-                    prims=LanePrims):
+def merge_apply_vec(p: dict, prop: jax.Array, overlap: jax.Array,
+                    count: jax.Array, op: dict, prims=LanePrims):
     """One sequenced op per doc, vectorized over the doc (sublane) axis.
 
-    ``p`` maps plane name → [D, S] i32; ``prop`` is [P, D, S]; ``count`` is
-    [D, 1]; op fields are [D, 1]. Mirrors mergetree_kernel._apply_op with
-    per-doc scalars as [D, 1] columns. Returns (planes', prop', count').
+    ``p`` maps plane name → [D, S] i32; ``prop`` is [P, D, S]; ``overlap``
+    is [W, D, S] remover-bitmask words; ``count`` is [D, 1]; op fields are
+    [D, 1]. Mirrors mergetree_kernel._apply_op with per-doc scalars as
+    [D, 1] columns. Returns (planes', prop', overlap', count').
     ``prims`` supplies the segment-axis primitives (LanePrims docstring).
     """
     lane = prims.lane_iota(p["length"].shape)
@@ -130,7 +153,7 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict,
     is_insert = op["kind"] == MT_INSERT
     is_remove = op["kind"] == MT_REMOVE
 
-    vis = _vis_len(p, op["ref_seq"], op["client"])
+    vis = _vis_len(p, overlap, op["ref_seq"], op["client"])
     cum = prims.excl_cumsum(vis)
 
     p1 = op["pos"]
@@ -202,11 +225,11 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict,
                                 shifted(p["ins_client"])),
         "rem_seq": jnp.where(is_placed, NONE_SEQ, shifted(p["rem_seq"])),
         "rem_client": jnp.where(is_placed, -1, shifted(p["rem_client"])),
-        "rem_overlap": jnp.where(is_placed, 0, shifted(p["rem_overlap"])),
         "pool_start": jnp.where(is_placed, op["pool_start"],
                                 shifted(p["pool_start"]) + start_off),
     }
     moved_prop = jnp.where(is_placed[None], 0, shifted(prop))
+    moved_overlap = jnp.where(is_placed[None], 0, shifted(overlap))
     moved_count = (count + has1.astype(I32)
                    + jnp.where(is_insert, 1, has2.astype(I32)))
 
@@ -223,16 +246,16 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict,
     in_range = (vis2 > 0) & (cum2 >= op["pos"]) & (cum2 < op["end"])
     fresh = in_range & (moved["rem_seq"] == NONE_SEQ)
     again = in_range & (moved["rem_seq"] != NONE_SEQ)
-    bit = I32(1) << jnp.clip(op["client"], 0, 30)
+    bit_planes = _overlap_mask_vec(moved_overlap.shape, op["client"])
 
     do_rem = ~is_insert & is_remove
     moved["rem_seq"] = jnp.where(do_rem & fresh, op["seq"],
                                  moved["rem_seq"])
     moved["rem_client"] = jnp.where(do_rem & fresh, op["client"],
                                     moved["rem_client"])
-    moved["rem_overlap"] = jnp.where(do_rem & again,
-                                     moved["rem_overlap"] | bit,
-                                     moved["rem_overlap"])
+    moved_overlap = jnp.where((do_rem & again)[None],
+                              moved_overlap | bit_planes,
+                              moved_overlap)
     is_annot = ~is_insert & ~is_remove
     plane_ids = jax.lax.broadcasted_iota(I32, moved_prop.shape, 0)
     annot_write = (is_annot & in_range)[None] & (plane_ids == op["prop_key"])
@@ -243,19 +266,21 @@ def merge_apply_vec(p: dict, prop: jax.Array, count: jax.Array, op: dict,
     out = {name: jnp.where(opvalid, moved[name], p[name])
            for name in _PLANES}
     out_prop = jnp.where(opvalid[None], moved_prop, prop)
+    out_overlap = jnp.where(opvalid[None], moved_overlap, overlap)
     out_count = jnp.where(opvalid, moved_count, count)
-    return out, out_prop, out_count
+    return out, out_prop, out_overlap, out_count
 
 
 def _tick_kernel(*refs, num_ops: int):
-    plane_refs = refs[:8]
-    prop_ref, count_ref = refs[8], refs[9]
+    plane_refs = refs[:7]
+    prop_ref, overlap_ref, count_ref = refs[7], refs[8], refs[9]
     op_refs = refs[10:21]
-    out_plane_refs = refs[21:29]
-    out_prop_ref, out_count_ref = refs[29], refs[30]
+    out_plane_refs = refs[21:28]
+    out_prop_ref, out_overlap_ref, out_count_ref = refs[28], refs[29], refs[30]
 
     planes = {name: ref[:] for name, ref in zip(_PLANES, plane_refs)}
     prop = prop_ref[:]
+    overlap = overlap_ref[:]
     count = count_ref[:]
     # Mosaic requires 128-aligned dynamic lane slices, so column k of the
     # op block is selected with a masked reduction instead of a load.
@@ -264,21 +289,23 @@ def _tick_kernel(*refs, num_ops: int):
                                        1)
 
     def body(k, carry):
-        planes, prop, count = carry
+        planes, prop, overlap, count = carry
         op = {name: jnp.sum(jnp.where(op_lane == k, v, 0),
                             axis=1, keepdims=True)
               for name, v in op_vals.items()}
-        return merge_apply_vec(planes, prop, count, op)
+        return merge_apply_vec(planes, prop, overlap, count, op)
 
     # Serving flushes pad every doc to the bucket's max pending count and
     # front-pack ops, so trailing steps are often invalid across the whole
     # block — a dynamic trip count skips them at zero per-step cost.
     last_valid = jnp.max(jnp.where(op_vals["valid"] != 0, op_lane + 1, 0))
-    planes, prop, count = jax.lax.fori_loop(
-        0, jnp.minimum(last_valid, num_ops), body, (planes, prop, count))
+    planes, prop, overlap, count = jax.lax.fori_loop(
+        0, jnp.minimum(last_valid, num_ops), body,
+        (planes, prop, overlap, count))
     for name, ref in zip(_PLANES, out_plane_refs):
         ref[:] = planes[name]
     out_prop_ref[:] = prop
+    out_overlap_ref[:] = overlap
     out_count_ref[:] = count
 
 
@@ -299,13 +326,14 @@ def apply_tick_pallas(state: MergeState, ops: MergeOpBatch,
     b, s = state.length.shape
     k = ops.kind.shape[1]
     p = state.prop_val.shape[2]
+    w = state.rem_overlap.shape[2]
     d = min(block_docs, max(8, b))
     bp = -(-b // d) * d  # pad docs to a block multiple
     sp = -(-s // 128) * 128  # pad slots to the lane tile
 
     plane_fill = {"valid": 0, "length": 0, "ins_seq": 0, "ins_client": -1,
                   "rem_seq": int(NONE_SEQ), "rem_client": -1,
-                  "rem_overlap": 0, "pool_start": 0}
+                  "pool_start": 0}
     planes = []
     for name in _PLANES:
         arr = getattr(state, name).astype(I32)
@@ -313,6 +341,8 @@ def apply_tick_pallas(state: MergeState, ops: MergeOpBatch,
         planes.append(_pad_to(arr, 1, sp, plane_fill[name]))
     prop = jnp.transpose(state.prop_val, (2, 0, 1))  # [P, B, S]
     prop = _pad_to(_pad_to(prop, 1, bp, 0), 2, sp, 0)
+    overlap = jnp.transpose(state.rem_overlap, (2, 0, 1))  # [W, B, S]
+    overlap = _pad_to(_pad_to(overlap, 1, bp, 0), 2, sp, 0)
     count = _pad_to(state.count[:, None], 0, bp, 0)
     op_arrays = [_pad_to(getattr(ops, name).astype(I32), 0, bp, 0)
                  for name in _OPS]
@@ -322,6 +352,8 @@ def apply_tick_pallas(state: MergeState, ops: MergeOpBatch,
                               memory_space=pltpu.VMEM)
     prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
                              memory_space=pltpu.VMEM)
+    overlap_spec = pl.BlockSpec((w, d, sp), lambda i: (0, i, 0),
+                                memory_space=pltpu.VMEM)
     count_spec = pl.BlockSpec((d, 1), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
     op_spec = pl.BlockSpec((d, k), lambda i: (i, 0),
@@ -330,17 +362,19 @@ def apply_tick_pallas(state: MergeState, ops: MergeOpBatch,
     out = pl.pallas_call(
         functools.partial(_tick_kernel, num_ops=k),
         grid=grid,
-        in_specs=[plane_spec] * 8 + [prop_spec, count_spec] + [op_spec] * 11,
-        out_specs=[plane_spec] * 8 + [prop_spec, count_spec],
+        in_specs=[plane_spec] * 7 + [prop_spec, overlap_spec, count_spec]
+        + [op_spec] * 11,
+        out_specs=[plane_spec] * 7 + [prop_spec, overlap_spec, count_spec],
         out_shape=(
-            [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 8
+            [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 7
             + [jax.ShapeDtypeStruct((p, bp, sp), jnp.int32),
+               jax.ShapeDtypeStruct((w, bp, sp), jnp.int32),
                jax.ShapeDtypeStruct((bp, 1), jnp.int32)]),
         input_output_aliases={i: i for i in range(10)},
         interpret=interpret,
-    )(*planes, prop, count, *op_arrays)
+    )(*planes, prop, overlap, count, *op_arrays)
 
-    new_planes = {name: arr[:b, :s] for name, arr in zip(_PLANES, out[:8])}
+    new_planes = {name: arr[:b, :s] for name, arr in zip(_PLANES, out[:7])}
     return MergeState(
         valid=new_planes["valid"] != 0,
         length=new_planes["length"],
@@ -348,9 +382,9 @@ def apply_tick_pallas(state: MergeState, ops: MergeOpBatch,
         ins_client=new_planes["ins_client"],
         rem_seq=new_planes["rem_seq"],
         rem_client=new_planes["rem_client"],
-        rem_overlap=new_planes["rem_overlap"],
+        rem_overlap=jnp.transpose(out[8], (1, 2, 0))[:b, :s],
         pool_start=new_planes["pool_start"],
-        prop_val=jnp.transpose(out[8], (1, 2, 0))[:b, :s],
+        prop_val=jnp.transpose(out[7], (1, 2, 0))[:b, :s],
         count=out[9][:b, 0],
     )
 

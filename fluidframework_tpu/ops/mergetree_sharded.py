@@ -114,10 +114,10 @@ class ShardPrims:
 
 def _step_factory(prims: ShardPrims):
     def step(carry, op):
-        planes, prop, count = carry
-        new_planes, new_prop, new_count = merge_apply_vec(
-            planes, prop, count, op, prims=prims)
-        return (new_planes, new_prop, new_count), ()
+        planes, prop, overlap, count = carry
+        new_planes, new_prop, new_overlap, new_count = merge_apply_vec(
+            planes, prop, overlap, count, op, prims=prims)
+        return (new_planes, new_prop, new_overlap, new_count), ()
 
     return step
 
@@ -141,34 +141,37 @@ def apply_tick_sharded(state: MergeState, ops: MergeOpBatch,
         f"need >= 2 segment slots per shard, have {local}")
 
     def tick(*flat):
-        planes = dict(zip(_PLANES, flat[:8]))
-        prop = flat[8]
+        planes = dict(zip(_PLANES, flat[:7]))
+        prop = flat[7]
+        overlap = flat[8]
         count = flat[9]
         op_arrays = dict(zip(_OPS, flat[10:]))
         prims = ShardPrims(SEGS_AXIS, num_shards, local)
         ops_t = {name: arr.T[:, :, None] for name, arr in
                  op_arrays.items()}  # [K, B, 1] scan leaves
-        (planes, prop, count), _ = jax.lax.scan(
-            _step_factory(prims), (planes, prop, count),
+        (planes, prop, overlap, count), _ = jax.lax.scan(
+            _step_factory(prims), (planes, prop, overlap, count),
             ops_t)
-        return tuple(planes[name] for name in _PLANES) + (prop, count)
+        return tuple(planes[name] for name in _PLANES) + (
+            prop, overlap, count)
 
     seg = PartitionSpec(None, SEGS_AXIS)
     seg3 = PartitionSpec(None, None, SEGS_AXIS)
     rep = PartitionSpec()
-    in_specs = (seg,) * 8 + (seg3, rep) + (rep,) * 11
-    out_specs = (seg,) * 8 + (seg3, rep)
+    in_specs = (seg,) * 7 + (seg3, seg3, rep) + (rep,) * 11
+    out_specs = (seg,) * 7 + (seg3, seg3, rep)
 
     flat_in = tuple(
         getattr(state, name).astype(I32) for name in _PLANES) + (
         jnp.transpose(state.prop_val, (2, 0, 1)),  # [P, B, S]
+        jnp.transpose(state.rem_overlap, (2, 0, 1)),  # [W, B, S]
         state.count[:, None].astype(I32),
     ) + tuple(getattr(ops, name).astype(I32) for name in _OPS)
 
     out = jax.shard_map(tick, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs)(*flat_in)
 
-    named = dict(zip(_PLANES, out[:8]))
+    named = dict(zip(_PLANES, out[:7]))
     return MergeState(
         valid=named["valid"] != 0,
         length=named["length"],
@@ -176,9 +179,9 @@ def apply_tick_sharded(state: MergeState, ops: MergeOpBatch,
         ins_client=named["ins_client"],
         rem_seq=named["rem_seq"],
         rem_client=named["rem_client"],
-        rem_overlap=named["rem_overlap"],
+        rem_overlap=jnp.transpose(out[8], (1, 2, 0)),
         pool_start=named["pool_start"],
-        prop_val=jnp.transpose(out[8], (1, 2, 0)),
+        prop_val=jnp.transpose(out[7], (1, 2, 0)),
         count=out[9][:, 0],
     )
 
@@ -195,7 +198,7 @@ def shard_merge_state(state: MergeState, mesh: Mesh) -> MergeState:
         ins_client=jax.device_put(state.ins_client, seg),
         rem_seq=jax.device_put(state.rem_seq, seg),
         rem_client=jax.device_put(state.rem_client, seg),
-        rem_overlap=jax.device_put(state.rem_overlap, seg),
+        rem_overlap=jax.device_put(state.rem_overlap, seg_prop),
         pool_start=jax.device_put(state.pool_start, seg),
         prop_val=jax.device_put(state.prop_val, seg_prop),
         count=jax.device_put(state.count, rep),

@@ -272,6 +272,77 @@ def process_batch(state: SequencerState, ops: OpBatch):
     return jax.vmap(_process_doc)(state, ops)
 
 
+@jax.jit
+def storm_tickets(state: SequencerState, slot, cseq0, ref, ts, counts):
+    """Closed-form deli ticket for the storm frame shape — NO per-op scan.
+
+    A storm batch is: one client per document, ``counts`` consecutive
+    OPERATION ops (client_seq = cseq0..cseq0+n-1), one shared ref_seq and
+    timestamp. On that shape the K-step ticket loop collapses to O(1)
+    per-doc algebra (deli/lambda.ts:236-341 specialized):
+
+      * dup resends are a PREFIX (clientSeqNumber dedup, lambda.ts:257):
+        dups = clip(cseq[slot]+1 - cseq0, 0, n);
+      * a gap (cseq0 > expected) rejects the whole batch — the first op
+        gap-NACKs without advancing cseq, so every later op still gaps;
+      * nack_future / inactive slot / nacked client reject the whole
+        batch with no state change (first op NACKs NONEXISTENT, the rest
+        gap — either way: nothing sequences, nothing moves);
+      * refSeq < MSN NACKs the first accepted op AND marks the client
+        (cseq=that op's clientSeq, cref=msn, nacked — lambda.ts:305-312),
+        which turns every later op into a no-state-change NACK;
+      * otherwise the m = n - dups survivors take seq+1..seq+m, the
+        client upserts once (cseq=cseq0+n-1, cref=ref or seq+m for
+        ref=-1), and MSN/last_sent_msn settle once at the end — the
+        intermediate per-op MSNs are monotone and unobserved.
+
+    All [B]/[B, C] vector math: the sequencer drops out of the fused
+    storm tick's critical path. Pinned to :func:`process_batch` on this
+    shape by differential test (tests/test_sequencer.py).
+
+    Returns (state', dups, n_seq, msn) — per-op planes derive as:
+    sequenced[i] = dups <= i < dups + n_seq; seq[i] = seq0 + 1 + i - dups.
+    """
+    b, c = state.active.shape
+    lanes = jnp.arange(c)[None, :]
+    onehot = lanes == jnp.clip(slot, 0, c - 1)[:, None]
+
+    def at(plane):
+        return jnp.sum(jnp.where(onehot, plane.astype(I32), 0), axis=1)
+
+    n = jnp.maximum(counts, 0)
+    ok = ((n > 0) & (slot >= 0) & (at(state.active) != 0)
+          & (at(state.cnack) == 0) & ~state.nack_future)
+    expected = at(state.cseq) + 1
+    no_gap = ok & (cseq0 <= expected)
+    dups = jnp.clip(expected - cseq0, 0, n)
+    m = jnp.where(no_gap, n - dups, 0)
+    refnack = no_gap & (m > 0) & (ref != -1) & (ref < state.msn)
+    n_seq = jnp.where(refnack, 0, m)
+    do_seq = n_seq > 0
+
+    seq2 = state.seq + n_seq
+    ref_eff = jnp.where(ref == -1, seq2, ref)
+    up = onehot & do_seq[:, None]
+    mark = onehot & refnack[:, None]
+    cseq_new = jnp.where(
+        up, (cseq0 + n - 1)[:, None],
+        jnp.where(mark, (cseq0 + dups)[:, None], state.cseq))
+    cref_new = jnp.where(
+        up, ref_eff[:, None],
+        jnp.where(mark, state.msn[:, None], state.cref))
+    clu_new = jnp.where(up | mark, ts[:, None], state.clu)
+    cnack_new = jnp.where(up, False, jnp.where(mark, True, state.cnack))
+    min_ref = jnp.min(jnp.where(state.active, cref_new, oc.INT32_MAX),
+                      axis=1)
+    msn2 = jnp.where(do_seq, min_ref, state.msn)
+    new_state = state._replace(
+        seq=seq2, msn=msn2,
+        last_sent_msn=jnp.where(do_seq, msn2, state.last_sent_msn),
+        cseq=cseq_new, cref=cref_new, clu=clu_new, cnack=cnack_new)
+    return new_state, dups, n_seq, msn2
+
+
 def find_idle(state: SequencerState, now: int, timeout_ms: int) -> jax.Array:
     """bool[B, C] mask of evictable idle clients. The host crafts leave ops
     for these (deli checkIdleClients piggybacks leaves via alfred).

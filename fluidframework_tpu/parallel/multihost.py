@@ -80,15 +80,20 @@ def local_docs(mesh: jax.sharding.Mesh, num_docs: int) -> tuple[int, int]:
     return low, high
 
 
-def feed(mesh: jax.sharding.Mesh, tree):
+def feed(mesh: jax.sharding.Mesh, tree, global_batch: int | None = None):
     """Lift per-host numpy arrays (this host's doc rows) into globally
     sharded jax arrays — the DCN feed boundary. Each process passes ONLY
     its ``local_docs`` rows; jax assembles the logical [B, ...] array
-    without moving rows between hosts."""
+    without moving rows between hosts. ``global_batch`` pins the global
+    doc count explicitly (required when the local slice alone is
+    ambiguous, e.g. a 1-host mesh fed a partial range)."""
     sharding = doc_sharding(mesh)
 
     def lift(local):
+        local = np.asarray(local)
+        shape = ((global_batch,) + local.shape[1:]
+                 if global_batch is not None else None)
         return jax.make_array_from_process_local_data(
-            sharding, np.asarray(local))
+            sharding, local, global_shape=shape)
 
     return jax.tree.map(lift, tree)

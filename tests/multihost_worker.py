@@ -1,0 +1,88 @@
+"""Worker process for the REAL 2-process jax.distributed serving test.
+
+Run as: python multihost_worker.py <process_id> <num_processes> <port>
+
+Each process owns 4 virtual CPU devices; jax.distributed assembles the
+8-device global mesh over DCN (the per-process partition-consumer model,
+lambdas-driver/src/kafka-service/partitionManager.ts:24). The process
+feeds ONLY its local_docs rows, runs the fused SPMD storm tick, harvests
+only its shard, and cross-checks the global psum metrics — which can
+only be right if the collective really ran across both processes.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    port = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes, process_id=process_id)
+    assert jax.process_count() == num_processes
+    assert len(jax.devices()) == 4 * num_processes, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    from fluidframework_tpu.parallel import multihost
+    from fluidframework_tpu.parallel.serving import ShardedServing
+
+    mesh = multihost.global_mesh()
+    num_docs, k = 16, 8
+    serving = ShardedServing(mesh, num_docs=num_docs, k=k,
+                             num_hosts=num_processes)
+    lo, hi = serving.local_lo, serving.local_hi
+    span = num_docs // num_processes
+    assert (lo, hi) == (process_id * span, (process_id + 1) * span), (lo, hi)
+    port_mine = serving.hosts[process_id]
+    assert (port_mine.start, port_mine.stop) == (lo, hi)
+
+    serving.join_all()
+
+    # Distinct per-row op batches: k set-ops on slots 0..k-1, value
+    # derived from the row so convergence is checkable per shard.
+    def words_for(row: int) -> np.ndarray:
+        slots = np.arange(k, dtype=np.uint32)
+        values = (1000 + row * 10 + slots).astype(np.uint32)
+        return (0 | (slots << 2) | (values << 12)).astype(np.uint32)
+
+    for row in range(lo, hi):
+        serving.submit(row, words_for(row), first_cseq=1)
+    harvest = serving.tick()
+
+    mine = harvest[process_id]
+    assert set(mine.keys()) == set(range(lo, hi)), mine
+    for row, (n_seq, first, last) in mine.items():
+        assert n_seq == k, (row, n_seq)
+        assert first == 2 and last == k + 1, (row, first, last)
+    for other in range(num_processes):
+        if other != process_id:
+            assert harvest[other] == {}, harvest[other]
+
+    local_rows = serving.local_map_rows()
+    assert set(local_rows.keys()) == set(range(lo, hi))
+    for row, plane in local_rows.items():
+        want = 1000 + row * 10 + np.arange(k)
+        assert np.array_equal(plane[:k], want), (row, plane[:k], want)
+
+    # Global totals ride a psum across BOTH processes: per doc the join
+    # (1) + k ops sequenced, over every doc of every host.
+    metrics = serving.global_metrics()
+    assert metrics["seq"] == num_docs * (k + 1), metrics
+    assert metrics["present"] == num_docs * k, metrics
+
+    print(f"OK process {process_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,8 +18,11 @@ from fluidframework_tpu.native.bridge import _load_library
 from fluidframework_tpu.runtime.container import Container
 from fluidframework_tpu.tools.replay import canonical
 
-pytestmark = pytest.mark.skipif(
-    _load_library() is None, reason="no C++ toolchain for the bridge")
+pytestmark = [
+    pytest.mark.soak,
+    pytest.mark.skipif(
+        _load_library() is None, reason="no C++ toolchain for the bridge"),
+]
 
 
 @pytest.fixture(scope="module")

@@ -12,6 +12,8 @@ import random
 
 import pytest
 
+pytestmark = pytest.mark.soak
+
 from fluidframework_tpu.drivers.local_driver import LocalDocumentService
 from fluidframework_tpu.runtime.container import Container
 from fluidframework_tpu.server.local_server import LocalCollabServer
@@ -25,9 +27,12 @@ ROUNDS = int(os.environ.get("FARM_ROUNDS", "6"))
 
 
 def _conflict_farm(n_clients: int, rounds: int,
-                   require_device_ops: bool) -> None:
+                   require_device_ops: bool,
+                   min_ops: int = 256, max_ops: int = 512) -> None:
     """Conflict farm body: every replica AND the device-host text must
-    match after every round's drain."""
+    match after every round's drain. With require_device_ops the farm
+    must stay ENTIRELY on the device path (overlap planes grow past 32
+    writers instead of overflow-routing — VERDICT r3 item 1)."""
     rng = random.Random(7)
     host = KernelMergeHost(flush_threshold=512)
     server = LocalCollabServer(merge_host=host)
@@ -40,7 +45,7 @@ def _conflict_farm(n_clients: int, rounds: int,
         paused = [c for c in containers if rng.random() < 0.3]
         for c in paused:
             c.inbound.pause()
-        for _ in range(rng.randrange(256, 513)):
+        for _ in range(rng.randrange(min_ops, max_ops + 1)):
             random_edit(rng, strings[rng.randrange(len(strings))])
         for c in paused:
             c.inbound.resume()
@@ -49,15 +54,25 @@ def _conflict_farm(n_clients: int, rounds: int,
         assert host.text("doc", "default", "text") == texts[0], round_no
     if require_device_ops:
         assert host.stats["device_ops"] > 0
+        assert host.stats["overflow_routed"] == 0
+        assert host.stats["scalar_ops"] == 0
     for c in containers:
         assert not c.nacks
 
 
 def test_conflict_farm_reference_client_scale():
-    """24 clients x 256-512 ops/round with a DEVICE-served replica (the
-    device bitmask holds up to 31 distinct writers; the full 32-client
-    profile below exercises the exact scalar fallback instead)."""
-    _conflict_farm(24, ROUNDS, require_device_ops=True)
+    """The reference's conflictFarm client scale — 32 clients x 256-512
+    ops/round (client.conflictFarm.spec.ts:50-57) — fully device-served:
+    zero ops on the scalar fallback."""
+    _conflict_farm(32, ROUNDS, require_device_ops=True)
+
+
+def test_conflict_farm_128_clients_device_served():
+    """BASELINE config 2's client count (128 writers, one doc) stays on
+    the device path: the overlap planes grow to 4 words and no channel
+    overflow-routes."""
+    _conflict_farm(128, max(2, ROUNDS // 3), require_device_ops=True,
+                   min_ops=128, max_ops=256)
 
 
 def test_reconnect_farm_reference_scale():
@@ -158,8 +173,7 @@ def test_matrix_reconnect_farm():
 @pytest.mark.skipif(os.environ.get("FARM_FULL") != "1",
                     reason="full 32-round reference profile: set FARM_FULL=1")
 def test_conflict_farm_full_reference_profile():
-    """The reference's FULL profile (32 clients x up to 512 ops/round x 32
-    rounds; the host serves the 32-writer channel through the exact
-    scalar fallback past the 31-slot device bitmask) — minutes of wall
-    time; run explicitly."""
-    _conflict_farm(32, 32, require_device_ops=False)
+    """The reference's FULL profile (32 clients x up to 512 ops/round x
+    32 rounds), entirely device-served — minutes of wall time; run
+    explicitly."""
+    _conflict_farm(32, 32, require_device_ops=True)

@@ -180,3 +180,96 @@ def test_interval_farm(seed):
         assert collections[0].resolved() == collections[1].resolved(), (
             seed, _round)
     assert c1.summarize() == c2.summarize()
+
+
+class TestIntervalIndex:
+    """Overlap-query index — findOverlappingIntervals / previous / next
+    (intervalCollection.ts:265-334) against a brute-force oracle."""
+
+    def test_find_overlapping_basic(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "x" * 40)
+        ic = t1.get_interval_collection("q")
+        a = ic.add(0, 5)
+        b = ic.add(3, 12)
+        c = ic.add(10, 20)
+        d = ic.add(25, 30)
+        got = [i.id for i in ic.find_overlapping_intervals(4, 11)]
+        assert got == [a.id, b.id, c.id]
+        assert [i.id for i in ic.find_overlapping_intervals(21, 24)] == []
+        assert [i.id for i in ic.find_overlapping_intervals(30, 99)] == [d.id]
+        # Inclusive endpoints, matching IntervalTree.match.
+        assert [i.id for i in ic.find_overlapping_intervals(5, 5)] \
+            == [a.id, b.id]
+
+    def test_previous_next(self):
+        _server, c1, c2, t1, t2 = setup_pair()
+        t1.insert_text(0, "y" * 40)
+        ic = t1.get_interval_collection("q")
+        a = ic.add(2, 4)
+        b = ic.add(10, 15)
+        assert ic.previous_interval(1) is None
+        assert ic.previous_interval(2).id == a.id
+        assert ic.previous_interval(9).id == a.id
+        assert ic.previous_interval(30).id == b.id
+        assert ic.next_interval(0).id == a.id
+        assert ic.next_interval(3).id == b.id
+        assert ic.next_interval(16) is None
+        assert [i.id for i in ic.iterate()] == [a.id, b.id]
+
+    def test_index_tracks_edits_and_remote_ops(self):
+        """The lazy index must match brute-force resolution after every
+        kind of mutation: local/remote inserts, removes, interval
+        add/change/delete from either replica."""
+        _server, c1, c2, t1, t2 = setup_pair()
+        rng = random.Random(11)
+        t1.insert_text(0, "abcdefghijklmnopqrstuvwxyz" * 4)
+        ic1 = t1.get_interval_collection("q")
+        ic2 = t2.get_interval_collection("q")
+        ids = []
+        for step in range(120):
+            roll = rng.random()
+            text_len = len(t1.get_text())
+            src_text, src_ic = (t1, ic1) if rng.random() < 0.5 else (t2, ic2)
+            if roll < 0.3 or not ids:
+                s = rng.randrange(max(1, text_len))
+                e = min(text_len, s + rng.randrange(1, 9))
+                ids.append(src_ic.add(s, e, interval_id=f"i{step}").id)
+            elif roll < 0.45:
+                src_ic.delete(ids.pop(rng.randrange(len(ids))))
+            elif roll < 0.6:
+                iid = rng.choice(ids)
+                s = rng.randrange(max(1, text_len))
+                src_ic.change(iid, start=s,
+                              end=min(text_len, s + rng.randrange(1, 6)))
+            elif roll < 0.8:
+                pos = rng.randrange(max(1, text_len))
+                src_text.insert_text(pos, "INS")
+            elif text_len > 4:
+                s = rng.randrange(text_len - 2)
+                src_text.remove_text(s, min(text_len, s + rng.randrange(1, 4)))
+            if step % 10 == 0:
+                for ic in (ic1, ic2):
+                    resolved = ic.resolved()
+                    qs = rng.randrange(120)
+                    qe = qs + rng.randrange(0, 30)
+                    oracle = sorted(
+                        iid for iid, (s, e, _p) in resolved.items()
+                        if s <= qe and e >= qs)
+                    got = sorted(
+                        i.id for i in ic.find_overlapping_intervals(qs, qe))
+                    assert got == oracle, (step, qs, qe)
+                    pos = rng.randrange(120)
+                    prev_oracle = max(
+                        ((s, e, iid) for iid, (s, e, _p) in resolved.items()
+                         if s <= pos), default=None)
+                    prev = ic.previous_interval(pos)
+                    assert (prev.id if prev else None) == (
+                        prev_oracle[2] if prev_oracle else None)
+                    nxt_oracle = min(
+                        ((s, e, iid) for iid, (s, e, _p) in resolved.items()
+                         if s >= pos), default=None)
+                    nxt = ic.next_interval(pos)
+                    assert (nxt.id if nxt else None) == (
+                        nxt_oracle[2] if nxt_oracle else None)
+        assert ic1.resolved() == ic2.resolved()

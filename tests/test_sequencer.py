@@ -8,6 +8,7 @@ identical tickets and identical end state.
 
 import random
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -329,3 +330,91 @@ def test_find_idle():
     idle = np.asarray(seqk.find_idle(state, now=1000, timeout_ms=500))
     assert idle[0].tolist() == [True, False, False]
     assert idle[1].tolist() == [False, False, False]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_storm_tickets_matches_process_batch(seed):
+    """The closed-form storm ticket (sequencer.storm_tickets) must be
+    bit-identical to the general K-step kernel on the storm frame shape:
+    one client/doc, consecutive client_seqs, shared ref/ts — across dup
+    prefixes, gaps, inactive/nacked slots, nack_future docs, refSeq<MSN
+    and refSeq=-1."""
+    import numpy as np
+
+    import fluidframework_tpu.ops.sequencer as seqk
+
+    rng = random.Random(seed)
+    b, c, kmax = 32, 8, 12
+    state = seqk.init_state(b, c)
+    # Randomized prior state: some active clients with varied cseq/cref,
+    # some nacked, some docs in nack_future.
+    active = np.zeros((b, c), np.bool_)
+    cseq = np.zeros((b, c), np.int32)
+    cref = np.zeros((b, c), np.int32)
+    cnack = np.zeros((b, c), np.bool_)
+    seq = np.zeros(b, np.int32)
+    msn = np.zeros(b, np.int32)
+    nack_future = np.zeros(b, np.bool_)
+    for d in range(b):
+        seq[d] = rng.randrange(5, 60)
+        for s in range(c):
+            if rng.random() < 0.7:
+                active[d, s] = True
+                cseq[d, s] = rng.randrange(0, 20)
+                cref[d, s] = rng.randrange(0, seq[d] + 1)
+                cnack[d, s] = rng.random() < 0.15
+        live = [cref[d, s] for s in range(c) if active[d, s]]
+        msn[d] = min(live) if live else seq[d]
+        nack_future[d] = rng.random() < 0.1
+    state = state._replace(
+        seq=jnp.asarray(seq), msn=jnp.asarray(msn),
+        last_sent_msn=jnp.asarray(msn),
+        nack_future=jnp.asarray(nack_future),
+        active=jnp.asarray(active), cseq=jnp.asarray(cseq),
+        cref=jnp.asarray(cref), cnack=jnp.asarray(cnack))
+
+    slot = np.zeros(b, np.int32)
+    cseq0 = np.zeros(b, np.int32)
+    ref = np.zeros(b, np.int32)
+    ts = np.full(b, 1234, np.int32)
+    counts = np.zeros(b, np.int32)
+    for d in range(b):
+        s = rng.randrange(c)
+        slot[d] = s
+        counts[d] = rng.randrange(0, kmax + 1)
+        # Exercise dup prefix / exact / gap starts.
+        cseq0[d] = cseq[d, s] + 1 + rng.choice([-3, -1, 0, 0, 0, 1, 2])
+        ref[d] = rng.choice([-1, max(0, msn[d] - 2), msn[d],
+                             int(seq[d])])
+
+    # Reference: the general kernel on the expanded per-op batch.
+    ops_per_doc = [
+        [dict(kind=int(MessageType.OPERATION), slot=int(slot[d]),
+              client_seq=int(cseq0[d] + i), ref_seq=int(ref[d]),
+              timestamp=int(ts[d]), has_contents=True)
+         for i in range(int(counts[d]))]
+        for d in range(b)]
+    batch = seqk.make_op_batch(ops_per_doc, b, kmax)
+    want_state, want_out = seqk.process_batch(state, batch)
+
+    got_state, dups, n_seq, msn2 = seqk.storm_tickets(
+        state, jnp.asarray(slot), jnp.asarray(cseq0), jnp.asarray(ref),
+        jnp.asarray(ts), jnp.asarray(counts))
+
+    for field in seqk.SequencerState._fields:
+        assert np.array_equal(np.asarray(getattr(got_state, field)),
+                              np.asarray(getattr(want_state, field))), field
+    # Derived per-op outcomes match the general tickets.
+    kind = np.asarray(want_out.kind)
+    seq_out = np.asarray(want_out.seq)
+    dups = np.asarray(dups)
+    n_seq = np.asarray(n_seq)
+    for d in range(b):
+        want_mask = (kind[d, :counts[d]] == oc.OUT_SEQUENCED)
+        got_mask = np.zeros(counts[d], np.bool_)
+        got_mask[dups[d]:dups[d] + n_seq[d]] = True
+        assert np.array_equal(got_mask, want_mask), (d, kind[d])
+        want_seqs = seq_out[d, :counts[d]][want_mask]
+        got_seqs = seq[d] + 1 + np.arange(n_seq[d])
+        assert np.array_equal(got_seqs, want_seqs), d
+    assert np.array_equal(np.asarray(msn2), np.asarray(got_state.msn))
