@@ -398,13 +398,51 @@ def capacity_margin(state: MergeState) -> np.ndarray:
     return np.asarray(state.valid.shape[1] - state.count)
 
 
-def compact(state: MergeState, min_seq: jax.Array) -> MergeState:
+def compact(state: MergeState, min_seq: jax.Array,
+            coalesce: bool = False) -> MergeState:
     """Zamboni: drop tombstones removed at/below min_seq[B] and pack live
-    slots to the front (stable order). Pure gather — no host round-trip."""
+    slots to the front (stable order). Pure gather — no host round-trip.
+
+    With ``coalesce`` the pack also MERGES adjacent fully-acked live runs
+    (the reference's leaf pack, mergeTree.ts:1412): a kept segment folds
+    into its kept-predecessor when both are live, inserted at/below the
+    window, text-pool contiguous, and property-identical. Below the
+    window a segment's (ins_seq, ins_client) can never affect another
+    op's visibility again — every future ref_seq is >= min_seq (refs
+    below MSN NACK at the sequencer) — so the merged run keeps the
+    head's identity and byte-identical semantics. This is what keeps a
+    long-lived document's slot count at COLLAB-WINDOW size instead of
+    history size (run the host text repack first so live document order
+    is pool-contiguous)."""
     def one(s: MergeState, ms):
         keep = s.valid & ~((s.rem_seq != NONE_SEQ) & (s.rem_seq <= ms))
-        order = jnp.cumsum(keep) - 1
         num_slots = s.valid.shape[0]
+        iota = jnp.arange(num_slots)
+        length = s.length
+        if coalesce:
+            acked_live = (keep & (s.rem_seq == NONE_SEQ)
+                          & (s.ins_seq <= ms) & (s.length > 0))
+            # Immediate KEPT predecessor of each slot (tombstones being
+            # dropped in this same pass don't break adjacency).
+            prev = jax.lax.cummax(jnp.where(keep, iota, -1))
+            prev = jnp.where(keep, jnp.roll(prev, 1).at[0].set(-1), -1)
+            prev_c = jnp.clip(prev, 0, num_slots - 1)
+            props_eq = jnp.all(s.prop_val == s.prop_val[prev_c],
+                               axis=-1)
+            fold = (acked_live & (prev >= 0) & acked_live[prev_c]
+                    & (s.pool_start == s.pool_start[prev_c]
+                       + s.length[prev_c])
+                    & props_eq)
+            # Chain head = nearest prior kept non-folding slot; the head
+            # absorbs its whole chain's length.
+            head = jax.lax.cummax(jnp.where(keep & ~fold, iota, -1))
+            chain_len = jnp.zeros_like(length).at[
+                jnp.where(keep, jnp.clip(head, 0, num_slots - 1),
+                          num_slots)].add(
+                jnp.where(keep, length, 0), mode="drop")
+            length = jnp.where(keep & ~fold, chain_len, length)
+            keep = keep & ~fold
+        order = jnp.cumsum(keep) - 1
         # Dropped slots scatter out of bounds (mode="drop") so they can
         # never clobber a kept slot's destination.
         dst = jnp.where(keep, order, num_slots)
@@ -413,7 +451,7 @@ def compact(state: MergeState, min_seq: jax.Array) -> MergeState:
             return out.at[dst].set(field, mode="drop")
         packed = MergeState(
             valid=jnp.zeros_like(s.valid).at[dst].set(keep, mode="drop"),
-            length=pack(s.length, 0),
+            length=pack(length, 0),
             ins_seq=pack(s.ins_seq, 0),
             ins_client=pack(s.ins_client, -1),
             rem_seq=pack(s.rem_seq, NONE_SEQ),

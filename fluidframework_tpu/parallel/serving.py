@@ -41,6 +41,26 @@ from . import multihost
 from .mesh import aggregate_metrics
 
 
+def _plane_rows(arr, port: "HostPort") -> np.ndarray:
+    """Host copy of one state plane's rows in [start, stop) — assembled
+    from addressable shards only (multi-process safe)."""
+    lead = port.stop - port.start
+    out = None
+    for shard in arr.addressable_shards:
+        row_slice = shard.index[0]
+        lo = row_slice.start if row_slice.start is not None else 0
+        data = np.asarray(shard.data)
+        hi = lo + data.shape[0]
+        s, e = max(lo, port.start), min(hi, port.stop)
+        if s >= e:
+            continue
+        if out is None:
+            out = np.zeros((lead,) + data.shape[1:], data.dtype)
+        out[s - port.start:e - port.start] = data[s - lo:e - lo]
+    assert out is not None, "no addressable rows in the host's range"
+    return out
+
+
 def _addressable_rows(arr) -> dict[int, int]:
     """row -> value from the shards THIS process can address (never the
     global array: in a multi-process mesh it spans foreign devices)."""
@@ -67,7 +87,17 @@ class HostPort(NamedTuple):
 
 class ShardedServing:
     """N serving hosts over one docs-sharded mesh, running the fused
-    sequencer+map storm tick as a single SPMD program."""
+    sequencer+map storm tick as a single SPMD program.
+
+    Failure story (kafka-service/checkpointManager.ts:24 analog): every
+    tick appends one durable columnar record per submitted row to
+    ``durable`` (the scriptorium leg); :meth:`checkpoint_host` captures a
+    host's row states + per-row log offsets. When a host dies, its device
+    state dies with it — a replacement assembly (possibly with its doc
+    range REASSIGNED to surviving hosts, :meth:`rebalance_from`) restores
+    the checkpoints and replays the durable tail through the REAL tick
+    path; the sequencer's clientSeq dedup makes the replay idempotent and
+    the restored seq counters make it regression-free."""
 
     def __init__(self, mesh: jax.sharding.Mesh, num_docs: int, k: int,
                  num_hosts: int, num_clients: int = 2,
@@ -103,6 +133,14 @@ class ShardedServing:
         self.hosts = [HostPort(i, int(bounds[i]), int(bounds[i + 1]))
                       for i in range(num_hosts)]
         self._pending: list[dict] = [dict() for _ in range(num_hosts)]
+        # Durable columnar tick records per row (the scriptorium leg of
+        # the storm pipeline): the replay source for host failover.
+        # Offsets in checkpoints are ABSOLUTE record counts; trim_durable
+        # retires the prefix below the fleet's checkpoint horizon so a
+        # long-running assembly's log memory is bounded by the
+        # checkpoint cadence, not total history.
+        self.durable: dict[int, list[dict]] = {}
+        self._durable_base: dict[int, int] = {}
 
 
     def route(self, row: int) -> HostPort:
@@ -158,6 +196,7 @@ class ShardedServing:
         words_full = np.zeros((b, k), np.uint32)
         gather = np.arange(b, dtype=np.int32)
         submitted: list[tuple[int, int]] = []  # (host, row)
+        records: dict[int, dict] = {}
         for port in self.hosts:
             for row, (words, first_cseq, ref_seq) in \
                     self._pending[port.host_id].items():
@@ -166,6 +205,8 @@ class ShardedServing:
                 cseq0[row] = first_cseq
                 ref[row] = ref_seq
                 submitted.append((port.host_id, row))
+                records[row] = dict(words=np.array(words, np.uint32),
+                                    cseq0=first_cseq, ref=ref_seq)
 
         lo, hi = self.local_lo, self.local_hi
         put = lambda a: multihost.feed(self.mesh, a[lo:hi],
@@ -192,7 +233,117 @@ class ShardedServing:
             n_ok = n_seq_l[row]
             harvest[host_id][row] = ((n_ok, first_l[row], last_l[row])
                                      if n_ok > 0 else (0, 0, 0))
+            # scriptorium: the durable columnar record for this (row,
+            # tick) — the failover replay source.
+            rec = records[row]
+            rec.update(n_seq=n_ok, first=first_l[row], last=last_l[row])
+            self.durable.setdefault(row, []).append(rec)
         return harvest
+
+    def durable_offset(self, row: int) -> int:
+        """Absolute record count of a row's durable log (checkpoint
+        cursor)."""
+        return (self._durable_base.get(row, 0)
+                + len(self.durable.get(row, [])))
+
+    def trim_durable(self, horizons: dict[int, int]) -> None:
+        """Retire durable records below the given ABSOLUTE per-row
+        offsets — call with the minimum checkpointed offset across hosts
+        (the Kafka log-retention analog). Restores against older
+        checkpoints become impossible after the trim, exactly as with a
+        retention-pruned bus."""
+        for row, horizon in horizons.items():
+            base = self._durable_base.get(row, 0)
+            cut = max(0, min(horizon - base,
+                             len(self.durable.get(row, []))))
+            if cut:
+                del self.durable[row][:cut]
+                self._durable_base[row] = base + cut
+
+    # -- failover (checkpointManager.ts:24 analog) -----------------------------
+
+    def checkpoint_host(self, host_id: int) -> dict:
+        """Durable snapshot of one host's rows: sequencer scalars +
+        client lanes + map planes + the per-row durable-log offset. The
+        checkpoint/offset pair is consistent BY CONSTRUCTION when taken
+        between ticks (tick() is the only writer)."""
+        port = self.hosts[host_id]
+        seq_rows = {f: _plane_rows(getattr(self.seq_state, f), port)
+                    for f in self.seq_state._fields}
+        map_rows = {f: _plane_rows(getattr(self.map_state, f), port)
+                    for f in self.map_state._fields}
+        return {
+            "host_id": host_id,
+            "start": port.start,
+            "stop": port.stop,
+            "seq": seq_rows,
+            "map": map_rows,
+            "log_offsets": {row: self.durable_offset(row)
+                            for row in range(port.start, port.stop)},
+        }
+
+    def rebalance_from(self, dead_host_id: int, target_host_id: int
+                       ) -> None:
+        """Reassign a dead host's doc range to a surviving neighbour (the
+        Kafka partition-reassignment analog). Ranges must stay contiguous
+        for front-door range routing."""
+        dead = self.hosts[dead_host_id]
+        target = self.hosts[target_host_id]
+        if dead.stop != target.start and target.stop != dead.start:
+            raise ValueError("rebalance target must be an adjacent range")
+        merged = HostPort(target.host_id, min(dead.start, target.start),
+                          max(dead.stop, target.stop))
+        self.hosts[target_host_id] = merged
+        self.hosts[dead_host_id] = HostPort(dead.host_id, dead.start,
+                                            dead.start)  # empty range
+        # The dead host's buffered frames are LOST (at-least-once:
+        # clients resend un-acked frames to the new owner).
+        self._pending[dead_host_id] = {}
+
+    def restore_host(self, checkpoint: dict,
+                     durable: dict[int, list[dict]],
+                     durable_base: dict[int, int] | None = None) -> None:
+        """Install a dead host's checkpointed rows into THIS assembly and
+        replay its durable-log tail through the REAL tick path. The
+        restored sequencer counters resume seq assignment exactly where
+        the log ends — no sequence regression — and clientSeq dedup makes
+        an overlapping replay idempotent. Submissions route via the
+        CURRENT host ranges, so run :meth:`rebalance_from` (or build the
+        replacement assembly with the new ranges) first. Single-controller
+        restore: a true multi-process relaunch restores each process's
+        own rows with the same codec."""
+        lo, hi = checkpoint["start"], checkpoint["stop"]
+        idx = np.arange(lo, hi)
+
+        def write(state, rows):
+            return type(state)(**{
+                f: getattr(state, f).at[idx].set(rows[f])
+                for f in state._fields})
+
+        self.seq_state = write(self.seq_state, checkpoint["seq"])
+        self.map_state = write(self.map_state, checkpoint["map"])
+        # Replay the tail one logged tick at a time (records of one row
+        # are strictly ordered; distinct rows may interleave freely).
+        def tail_of(row: int) -> list[dict]:
+            records = durable.get(row, [])
+            start = checkpoint["log_offsets"].get(row, 0)
+            if durable_base is not None:
+                start -= durable_base.get(row, 0)
+            if start < 0:
+                raise ValueError(
+                    f"row {row}: durable log trimmed past the checkpoint")
+            return records[start:]
+
+        depth = max((len(tail_of(row)) for row in range(lo, hi)),
+                    default=0)
+        for i in range(depth):
+            for row in range(lo, hi):
+                tail = tail_of(row)
+                if i < len(tail):
+                    rec = tail[i]
+                    self.submit(row, rec["words"], rec["cseq0"],
+                                rec["ref"])
+            self.tick()
 
     # -- observability ---------------------------------------------------------
 

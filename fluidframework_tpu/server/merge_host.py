@@ -321,8 +321,9 @@ class _MergePool:
     def apply(self, batch: mtk.MergeOpBatch) -> mtk.MergeState:
         return mtp.apply_tick_best(self.state, batch)
 
-    def compact_state(self, min_seq) -> mtk.MergeState:
-        return mtk.compact(self.state, min_seq)
+    def compact_state(self, min_seq, coalesce: bool = False
+                      ) -> mtk.MergeState:
+        return mtk.compact(self.state, min_seq, coalesce)
 
     def place(self, state: mtk.MergeState) -> mtk.MergeState:
         return state
@@ -347,8 +348,9 @@ class _ShardedMergePool(_MergePool):
     def apply(self, batch: mtk.MergeOpBatch) -> mtk.MergeState:
         return self._mts.apply_tick_sharded(self.state, batch, self.mesh)
 
-    def compact_state(self, min_seq) -> mtk.MergeState:
-        return self.place(mtk.compact(self.state, min_seq))
+    def compact_state(self, min_seq, coalesce: bool = False
+                      ) -> mtk.MergeState:
+        return self.place(mtk.compact(self.state, min_seq, coalesce))
 
     def place(self, state: mtk.MergeState) -> mtk.MergeState:
         return self._mts.shard_merge_state(state, self.mesh)
@@ -1535,6 +1537,20 @@ class KernelMergeHost:
                 pool.state = pool.compact_state(jnp.asarray(min_seq))
                 self.stats["compactions"] += 1
                 still = need > mtk.capacity_margin(pool.state)
+                if still.any():
+                    # Second chance before paying for a bigger bucket:
+                    # repack the short rows' text pools so live document
+                    # order is pool-contiguous, then COALESCE adjacent
+                    # acked runs (device zamboni pack) — a long-lived
+                    # document's slot need is its collab window, not its
+                    # history.
+                    for r in pool_rows:
+                        if still[r.row]:
+                            self._repack_text_pool(r)
+                    pool.state = pool.compact_state(jnp.asarray(min_seq),
+                                                    coalesce=True)
+                    self.stats["compactions"] += 1
+                    still = need > mtk.capacity_margin(pool.state)
                 for r in pool_rows:
                     if still[r.row]:
                         short_rows.append((r, int(need[r.row])))
@@ -1574,7 +1590,14 @@ class KernelMergeHost:
         """Zamboni for text bytes: the pool is append-only, so a long-lived
         document's pool grows with total INSERTED text. Rebuild it from the
         slices the live table still references (tombstones included) and
-        rewrite the row's pool_start plane."""
+        rewrite the row's pool_start plane. The table's slices land in
+        TABLE order, so after this pass adjacent document-order segments
+        are pool-contiguous — the precondition for the coalescing zamboni
+        (mergetree_kernel.compact coalesce).
+
+        Pending (not-yet-applied) insert ops also hold pool offsets; the
+        pressure path repacks BEFORE the tick, so their slices migrate
+        too and their op dicts are rewritten in place."""
         pool = row.pool
         arrays = pool.row_arrays(row.row)
         buffer = pool.text.buffer(row.row)
@@ -1589,6 +1612,12 @@ class KernelMergeHost:
             pieces.append(buffer[start:start + length])
             starts[i] = used
             used += length
+        for op in row.pending:
+            if op["kind"] == mtk.MT_INSERT and op["text_len"] > 0:
+                start = op["pool_start"]
+                pieces.append(buffer[start:start + op["text_len"]])
+                op["pool_start"] = used
+                used += op["text_len"]
         pool.state = pool.place(pool.state._replace(
             pool_start=pool.state.pool_start.at[row.row].set(starts)))
         pool.text.chunks[row.row] = pieces

@@ -122,7 +122,8 @@ class StormController:
                  merge_host: KernelMergeHost, datastore: str = "default",
                  channel: str = "root",
                  flush_threshold_docs: int = 4096,
-                 max_key_slots: int = 64) -> None:
+                 max_key_slots: int = 64,
+                 pipeline_depth: int = 1) -> None:
         self.service = service
         self.seq_host = seq_host
         self.merge_host = merge_host
@@ -139,12 +140,20 @@ class StormController:
         self._pending_docs = 0
         self.stats = {"ticks": 0, "sequenced_ops": 0, "submitted_ops": 0,
                       "nacked_or_ignored_ops": 0}
-        self.tick_seconds: list[float] = []  # wall time per flush round
-        # Depth-1 pipeline (SURVEY §7 hard part (c)): tick N's readbacks,
-        # durable records and acks are harvested AFTER tick N+1's device
-        # work is enqueued, so the host↔device round trip of one tick
-        # overlaps the next tick's compute instead of serializing.
-        self._inflight: dict | None = None
+        self.tick_seconds: list[float] = []  # submit→harvest per round
+        self.harvest_intervals: list[float] = []  # completion cadence
+        # Depth-N pipeline (SURVEY §7 hard part (c)): a tick's readbacks,
+        # durable records and acks are harvested only after N later
+        # ticks' device work is enqueued, so the host↔device round trip
+        # (a full transport RTT on a tunneled/remote attachment) hides
+        # under in-flight compute. Acks lag by ≤ depth ticks. Depth 1 is
+        # the safe default: clients that gate their NEXT frame on the
+        # previous ack (the request-response shape) would stall the
+        # cohort against a deeper ack debt; raise it only for senders
+        # that stream ahead of their acks.
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._inflight: list[dict] = []
+        self._last_harvest: float | None = None
         service.storm = self
 
     # -- front-door entry ------------------------------------------------------
@@ -294,20 +303,25 @@ class StormController:
             jnp.asarray(ref_full), jnp.asarray(ts_full),
             jnp.asarray(seq_counts), jnp.asarray(gather),
             jnp.asarray(words_full), jnp.asarray(map_counts))
-        # Pipeline: enqueue this tick's device work, then harvest the
-        # PREVIOUS tick (whose readbacks overlap this tick's compute).
-        prev, self._inflight = self._inflight, dict(
+        # Pipeline: enqueue this tick's device work (and start its
+        # device→host copies), then harvest only what has ≥ depth later
+        # ticks already in flight behind it.
+        rec = dict(
             descs=descs, doc_words=doc_words, map_rows=map_rows,
             acks=acks, now=now, submitted=int(desc_arr[:, 2].sum()),
             out=(n_seq, first, last, msn), start=round_start)
-        if prev is not None:
-            self._harvest_one(prev)
+        for out_arr in rec["out"]:
+            copy_async = getattr(out_arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        self._inflight.append(rec)
+        while len(self._inflight) > self.pipeline_depth:
+            self._harvest_one(self._inflight.pop(0))
         return True
 
     def _harvest(self) -> None:
-        if self._inflight is not None:
-            prev, self._inflight = self._inflight, None
-            self._harvest_one(prev)
+        while self._inflight:
+            self._harvest_one(self._inflight.pop(0))
 
     def _harvest_one(self, rec: dict) -> None:
         import time as _time
@@ -349,8 +363,15 @@ class StormController:
         self.stats["ticks"] += 1
         self.stats["sequenced_ops"] += total_seq
         self.stats["nacked_or_ignored_ops"] += rec["submitted"] - total_seq
+        # Storm ops are serving-path device ops: count them in the merge
+        # host's routing stats so scalar_fraction spans BOTH ingest paths.
+        self.merge_host.stats["device_ops"] += total_seq
         self.merge_host.metrics.counter("storm.sequenced_ops").inc(total_seq)
-        self.tick_seconds.append(_time.perf_counter() - rec["start"])
+        done = _time.perf_counter()
+        self.tick_seconds.append(done - rec["start"])
+        if self._last_harvest is not None:
+            self.harvest_intervals.append(done - self._last_harvest)
+        self._last_harvest = done
         for frame, idxs in rec["acks"]:
             if frame.push is not None:
                 frame.push({"rid": frame.rid, "storm": True, "acks": [

@@ -476,3 +476,41 @@ def test_summarize_materializes_from_device():
     assert "".join(t for t, _ in channels["text"]["content"]) == "abc"
     assert channels["root"]["entries"] == {"x": 1}
     assert summary["sequence_number"] > 0
+
+
+def test_long_lived_doc_stays_in_bucket_via_coalesce():
+    """A long-lived document whose window keeps advancing must NOT climb
+    buckets forever: under capacity pressure the host repacks the text
+    pool and runs the coalescing zamboni, so slot demand tracks the
+    collab window, not total history (mergeTree.ts:1412 pack analog)."""
+    host = KernelMergeHost(merge_slots=64, flush_threshold=48)
+    oracle = __import__(
+        "fluidframework_tpu.dds.mergetree",
+        fromlist=["MergeEngine"]).MergeEngine()
+    rng = random.Random(3)
+    seq = 0
+    length = 0
+    for i in range(3000):
+        seq += 1
+        if length > 30 and rng.random() < 0.45:
+            start = rng.randrange(length - 8)
+            op = {"type": "remove", "start": start,
+                  "end": start + rng.randrange(1, 9)}
+            length -= op["end"] - op["start"]
+        else:
+            text = "abcdefgh"[:rng.randrange(1, 8)]
+            op = {"type": "insert", "pos": rng.randrange(length + 1),
+                  "text": text}
+            length += len(text)
+        host.ingest("doc", _op_message(seq, seq - 1, f"c{i % 4}", op,
+                                       msn=seq - 1))
+        oracle.apply_remote(op, seq, seq - 1, f"c{i % 4}")
+        oracle.update_min_seq(seq - 1)
+    host.flush()
+    key = ("doc", "default", "text")
+    row = host._merge_rows[key]
+    # ~1650 inserts x 2 slots would demand a 8192-slot bucket without
+    # coalescing; the window is 1 op deep, so the table stays small.
+    assert row.pool.slots <= 256, row.pool.slots
+    assert host.stats["compactions"] > 0
+    assert host.text(*key) == oracle.get_text()

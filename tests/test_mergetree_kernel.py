@@ -203,3 +203,91 @@ def test_kernel_compact_drops_old_tombstones():
     assert mtk.materialize(state, pool, 0) == "hlo"
     # Doc 1 untouched.
     assert int(state.count[1]) == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_compact_coalesce_preserves_semantics(seed):
+    """The coalescing zamboni (compact(coalesce=True), mergeTree.ts:1412
+    pack analog): after merging adjacent acked live runs, (a) the
+    materialized text is byte-identical, (b) the slot count drops, and
+    (c) FUTURE concurrent ops (refs at/after the window) resolve exactly
+    as on the uncoalesced table."""
+    rng = random.Random(40 + seed)
+    pool = mtk.TextPool(1)
+    ops, length, seq = [], 0, 0
+    # Fully-acked history: plenty of adjacent same-client inserts.
+    for _ in range(120):
+        seq += 1
+        if length > 12 and rng.random() < 0.3:
+            start = rng.randrange(length - 6)
+            end = start + rng.randint(1, 6)
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=start, end=end,
+                            seq=seq, ref_seq=seq - 1,
+                            client=rng.randrange(4)))
+            length -= end - start
+        else:
+            text = "".join(rng.choice("abcdefgh")
+                           for _ in range(rng.randint(1, 5)))
+            ops.append(dict(kind=mtk.MT_INSERT,
+                            pos=rng.randint(0, length), seq=seq,
+                            ref_seq=seq - 1, client=rng.randrange(4),
+                            pool_start=pool.append(0, text),
+                            text_len=len(text)))
+            length += len(text)
+    state = mtk.init_state(1, 512)
+    state = mtk.apply_tick(state, mtk.make_merge_op_batch([ops], 1, 128))
+    ms = seq  # whole history acked below the window
+
+    # Host text repack (document order becomes pool-contiguous), exactly
+    # as the serving host runs before a coalescing compact.
+    valid = np.asarray(state.valid[0])
+    lens = np.asarray(state.length[0])
+    rems = np.asarray(state.rem_seq[0])
+    starts = np.asarray(state.pool_start[0]).copy()
+    buf = pool.buffer(0)
+    pieces, used = [], 0
+    for i in range(valid.shape[0]):
+        if valid[i] and lens[i] > 0:
+            pieces.append(buf[starts[i]:starts[i] + lens[i]])
+            starts[i] = used
+            used += lens[i]
+    pool.chunks[0] = pieces
+    pool.used[0] = used
+    state = state._replace(
+        pool_start=state.pool_start.at[0].set(jnp.asarray(starts)))
+
+    plain = mtk.compact(state, jnp.asarray([ms], np.int32))
+    packed = mtk.compact(state, jnp.asarray([ms], np.int32),
+                         coalesce=True)
+    assert mtk.materialize(packed, pool, 0) == \
+        mtk.materialize(plain, pool, 0)
+    assert int(packed.count[0]) < int(plain.count[0]), \
+        (int(packed.count[0]), int(plain.count[0]))
+
+    # Future concurrent ops on both tables must resolve identically:
+    # overlapping removes + inserts from distinct clients sharing refs.
+    future, flen = [], len(mtk.materialize(plain, pool, 0))
+    fseq = seq
+    for _ in range(24):
+        fseq += 1
+        if flen > 8 and rng.random() < 0.4:
+            start = rng.randrange(flen - 4)
+            end = start + rng.randint(1, 4)
+            future.append(dict(kind=mtk.MT_REMOVE, pos=start, end=end,
+                               seq=fseq, ref_seq=rng.randint(ms, fseq - 1),
+                               client=rng.randrange(4)))
+            flen -= end - start
+        else:
+            text = rng.choice("xyzw") * rng.randint(1, 3)
+            future.append(dict(kind=mtk.MT_INSERT,
+                               pos=rng.randint(0, flen), seq=fseq,
+                               ref_seq=rng.randint(ms, fseq - 1),
+                               client=rng.randrange(4),
+                               pool_start=pool.append(0, text),
+                               text_len=len(text)))
+            flen += len(text)
+    batch = mtk.make_merge_op_batch([future], 1, 32)
+    out_plain = mtk.apply_tick(plain, batch)
+    out_packed = mtk.apply_tick(packed, batch)
+    assert mtk.materialize(out_packed, pool, 0) == \
+        mtk.materialize(out_plain, pool, 0)
