@@ -58,7 +58,7 @@ def _force(state) -> None:
 
 
 def _run_device(apply_fn, state, batches, ops_per_tick: int,
-                latency_ticks: int = 20, passes: int = 4) -> dict:
+                latency_ticks: int = 36, passes: int = 4) -> dict:
     """Throughput (free-running, sync at end) + per-tick blocked latency.
 
     Each rep cycles the batch list ``passes`` times between host syncs so
@@ -648,6 +648,8 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
     storm.flush()
     assert storm.stats["sequenced_ops"] == num_docs * k
     storm.tick_seconds.clear()
+    storm.harvest_intervals.clear()
+    storm._last_harvest = None  # the client-setup gap is not a cadence
 
     # Timed run: client processes (no GIL sharing with the server) send
     # `ticks` frames each, pipelined; every doc's tick needs all conns.
@@ -720,7 +722,9 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
     fr_gather = jnp.arange(b_map, dtype=jnp.int32)
     ss, ms = seq_host._state, merge_host._xstate
     cseq = int(1e6)
-    reps = 5
+    # Enough chained reps that the single end-of-chain sync RTT (~120ms
+    # through the tunnel) amortizes below the per-tick device time.
+    reps = 24
     # Prestage EVERY per-rep input: a jnp.full inside the timed loop is
     # its own device dispatch, and on a tunneled attachment each costs
     # ~a full RTT — it would measure the tunnel, not the tick.

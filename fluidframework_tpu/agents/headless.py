@@ -78,9 +78,8 @@ class HeadlessAgentRunner:
         """The document's insights map, created on first agent visit."""
         runtime = container.runtime
         for datastore in runtime.datastores.values():
-            existing = datastore.channels.get(INSIGHTS_CHANNEL)
-            if existing is not None:
-                return existing
+            if INSIGHTS_CHANNEL in datastore.channel_ids():
+                return datastore.get_channel(INSIGHTS_CHANNEL)
         if not runtime.datastores:
             raise RuntimeError("document has no data stores to annotate")
         datastore = runtime.datastores[sorted(runtime.datastores)[0]]

@@ -48,6 +48,11 @@ class _CachingSnapshotStorage:
         self._service._snapshot_cache = None
         return handle
 
+    def resolve_blob(self, stub: dict) -> dict:
+        """Pass virtualized-stub resolution through to a virtualizing
+        inner storage (stubs only exist when one produced them)."""
+        return self._service.inner.storage.resolve_blob(stub)
+
 
 class _CachingDeltaStorage:
     def __init__(self, service: "CachingDocumentService") -> None:

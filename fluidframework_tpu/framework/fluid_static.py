@@ -24,7 +24,8 @@ class FluidContainer:
     @property
     def initial_objects(self) -> dict[str, SharedObject]:
         datastore = self.container.runtime.get_datastore(_INITIAL_DS)
-        return dict(datastore.channels)
+        return {channel_id: datastore.get_channel(channel_id)
+                for channel_id in datastore.channel_ids()}
 
     @property
     def connected(self) -> bool:

@@ -43,9 +43,14 @@ from .mesh import aggregate_metrics
 
 def _plane_rows(arr, port: "HostPort") -> np.ndarray:
     """Host copy of one state plane's rows in [start, stop) — assembled
-    from addressable shards only (multi-process safe)."""
+    from addressable shards only. A checkpoint must cover the WHOLE
+    range: rows resident on another process's devices cannot be silently
+    zero-filled (restoring zeroed sequencer counters would regress
+    sequence numbers), so partial coverage raises — each process
+    checkpoints its own range."""
     lead = port.stop - port.start
     out = None
+    covered = 0
     for shard in arr.addressable_shards:
         row_slice = shard.index[0]
         lo = row_slice.start if row_slice.start is not None else 0
@@ -57,7 +62,12 @@ def _plane_rows(arr, port: "HostPort") -> np.ndarray:
         if out is None:
             out = np.zeros((lead,) + data.shape[1:], data.dtype)
         out[s - port.start:e - port.start] = data[s - lo:e - lo]
-    assert out is not None, "no addressable rows in the host's range"
+        covered += e - s
+    if out is None or covered < lead:
+        raise ValueError(
+            f"host range [{port.start}, {port.stop}) only has {covered} "
+            "addressable rows on this process; checkpoint each process's "
+            "own range")
     return out
 
 
@@ -101,7 +111,8 @@ class ShardedServing:
 
     def __init__(self, mesh: jax.sharding.Mesh, num_docs: int, k: int,
                  num_hosts: int, num_clients: int = 2,
-                 map_slots: int = 32) -> None:
+                 map_slots: int = 32,
+                 durable_retention_ticks: int = 1024) -> None:
         if num_docs % mesh.devices.size:
             raise ValueError("num_docs must divide over the mesh")
         self.mesh = mesh
@@ -141,6 +152,11 @@ class ShardedServing:
         # checkpoint cadence, not total history.
         self.durable: dict[int, list[dict]] = {}
         self._durable_base: dict[int, int] = {}
+        # Automatic retention: without it an assembly that never
+        # checkpoints would grow the log with total op history (the
+        # unbounded-host-memory failure mode the soak tests guard
+        # against). Checkpoint within the horizon, or trim explicitly.
+        self.durable_retention_ticks = max(1, durable_retention_ticks)
 
 
     def route(self, row: int) -> HostPort:
@@ -237,7 +253,13 @@ class ShardedServing:
             # tick) — the failover replay source.
             rec = records[row]
             rec.update(n_seq=n_ok, first=first_l[row], last=last_l[row])
-            self.durable.setdefault(row, []).append(rec)
+            log = self.durable.setdefault(row, [])
+            log.append(rec)
+            overflow = len(log) - self.durable_retention_ticks
+            if overflow > 0:
+                del log[:overflow]
+                self._durable_base[row] = (
+                    self._durable_base.get(row, 0) + overflow)
         return harvest
 
     def durable_offset(self, row: int) -> int:
@@ -302,7 +324,7 @@ class ShardedServing:
 
     def restore_host(self, checkpoint: dict,
                      durable: dict[int, list[dict]],
-                     durable_base: dict[int, int] | None = None) -> None:
+                     durable_base: dict[int, int]) -> None:
         """Install a dead host's checkpointed rows into THIS assembly and
         replay its durable-log tail through the REAL tick path. The
         restored sequencer counters resume seq assignment exactly where
@@ -325,10 +347,12 @@ class ShardedServing:
         # Replay the tail one logged tick at a time (records of one row
         # are strictly ordered; distinct rows may interleave freely).
         def tail_of(row: int) -> list[dict]:
+            # Offsets in both the checkpoint and the log are ABSOLUTE, so
+            # the source log's base is required — defaulting it would
+            # silently drop replay ops after a retention trim.
             records = durable.get(row, [])
-            start = checkpoint["log_offsets"].get(row, 0)
-            if durable_base is not None:
-                start -= durable_base.get(row, 0)
+            start = (checkpoint["log_offsets"].get(row, 0)
+                     - durable_base.get(row, 0))
             if start < 0:
                 raise ValueError(
                     f"row {row}: durable log trimmed past the checkpoint")

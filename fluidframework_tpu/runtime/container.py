@@ -74,6 +74,8 @@ class Container:
             on_nack=self._on_nack,
         )
         self._mode = "write"
+        # Set by load() when the driver virtualizes channel snapshots.
+        self.snapshot_resolver: Callable[[dict], dict] | None = None
         self.audience = Audience()
         self.on_connected: list[Callable[[str], None]] = []
         self.on_disconnected: list[Callable[[], None]] = []
@@ -105,6 +107,11 @@ class Container:
         pendingStateManager.ts stashed-ops flow), and the remainder
         resubmits after connect."""
         container = cls(document_service, registry)
+        # Virtualizing drivers resolve stubbed channel snapshots lazily at
+        # realization (drivers/virtualized_driver.py); plain drivers have
+        # no resolver and never produce stubs.
+        container.snapshot_resolver = getattr(
+            document_service.storage, "resolve_blob", None)
         snapshot = document_service.storage.get_latest_snapshot()
         if snapshot is not None:
             container.protocol = ProtocolOpHandler.load(snapshot["protocol"])
@@ -163,6 +170,9 @@ class Container:
 
     def _on_member_removed(self, client_id: str) -> None:
         for datastore in self.runtime.datastores.values():
+            # Lazy consensus channels must see the leave (lease release);
+            # other lazy channels stay lazy.
+            datastore.realize_membership_sensitive()
             for channel in datastore.channels.values():
                 on_leave = getattr(channel, "on_client_leave", None)
                 if on_leave is not None:

@@ -38,6 +38,13 @@ class DataStoreRuntime:
         self.parent = parent
         self.registry = registry
         self.channels: dict[str, SharedObject] = {}
+        # Snapshot-loaded channels realize LAZILY on first access
+        # (remoteChannelContext.ts:203's lazy realization): until then the
+        # stored snapshot (possibly a virtualized blob stub —
+        # drivers/virtualized_driver.py) IS the channel's state. Keyed by
+        # channel id; values are channel snapshots or virtual stubs.
+        self._unrealized: dict[str, dict] = {}
+
         # Persisted metadata, e.g. {"type": <data-object type>} — what the
         # reference stores as the data store's package path so the right
         # DataObject class re-instantiates on load (dataStoreContext.ts).
@@ -65,14 +72,26 @@ class DataStoreRuntime:
         re-serializing channel state."""
         from .handles import collect_handle_routes
         from ..protocol.summary import is_handle
-        live = [cid for cid in self.channels
+        live = [cid for cid in (set(self.channels) | set(self._unrealized))
                 if cid not in self._adoption_pending]
-        graph = {f"/{self.id}": [f"/{self.id}/{cid}" for cid in live]}
-        for channel_id in live:
+        graph = {f"/{self.id}": [f"/{self.id}/{cid}"
+                                 for cid in sorted(live)]}
+        for channel_id in sorted(live):
+            if channel_id in self._unrealized:
+                # Routes come from the stored snapshot content — no
+                # realization needed (a virtual stub resolves once, then
+                # memoizes; GC runs on the summarizer, where the first
+                # fetch is warranted).
+                snap = self._stored_snapshot(channel_id)
+                graph[f"/{self.id}/{channel_id}"] = collect_handle_routes(
+                    snap["content"])
+                continue
             channel = self.channels[channel_id]
             node = None if summary is None else \
                 summary["channels"][channel_id]
-            if node is not None and not is_handle(node):
+            from ..drivers.virtualized_driver import is_virtual_stub
+            if node is not None and not is_handle(node) \
+                    and not is_virtual_stub(node):
                 routes = collect_handle_routes(node["content"])
                 # Seed the dirty-bit cache from the inline content so the
                 # NEXT (incremental) summary's GC pass costs nothing for
@@ -88,7 +107,7 @@ class DataStoreRuntime:
     # -- channel lifecycle ----------------------------------------------------
 
     def create_channel(self, channel_id: str, channel_type: str) -> SharedObject:
-        if channel_id in self.channels:
+        if channel_id in self.channels or channel_id in self._unrealized:
             raise ValueError(f"channel {channel_id!r} already exists")
         channel = self.registry.get(channel_type).create(self, channel_id)
         self._bind(channel)
@@ -104,7 +123,65 @@ class DataStoreRuntime:
         return channel
 
     def get_channel(self, channel_id: str) -> SharedObject:
+        if channel_id in self._unrealized:
+            self._realize(channel_id)
         return self.channels[channel_id]
+
+    def channel_ids(self) -> list[str]:
+        """Every channel id, realized or lazy (access via get_channel)."""
+        return sorted(set(self.channels) | set(self._unrealized))
+
+    def _unrealized_type(self, channel_id: str) -> str:
+        """A lazy channel's DDS type WITHOUT realizing (stubs carry it)."""
+        from ..drivers.virtualized_driver import VIRTUAL_KEY, is_virtual_stub
+        snap = self._unrealized[channel_id]
+        if is_virtual_stub(snap):
+            return snap[VIRTUAL_KEY].get("type", "")
+        return snap["attributes"]["type"]
+
+    def realize_membership_sensitive(self) -> None:
+        """Realize lazy channels whose type reacts to quorum membership
+        (e.g. consensus collections releasing a departed client's leases)
+        — they must observe client-leave events even if the app never
+        touched them."""
+        for channel_id in list(self._unrealized):
+            try:
+                cls = self.registry.get(
+                    self._unrealized_type(channel_id)).shared_object_cls
+            except KeyError:
+                continue
+            if hasattr(cls, "on_client_leave"):
+                self._realize(channel_id)
+
+    def _stored_snapshot(self, channel_id: str) -> dict:
+        """A lazy channel's full snapshot; a virtualized stub resolves
+        ONCE and the resolution is memoized back into the store (the
+        content cannot change while unrealized), so repeated GC/summary
+        passes cost no further blob fetches."""
+        from ..drivers.virtualized_driver import is_virtual_stub
+        snapshot = self._unrealized[channel_id]
+        if is_virtual_stub(snapshot):
+            resolver = getattr(self.parent.container, "snapshot_resolver",
+                               None)
+            if resolver is None:
+                raise KeyError(
+                    "virtualized channel snapshot with no blob resolver")
+            snapshot = resolver(snapshot)
+            self._unrealized[channel_id] = snapshot
+        return snapshot
+
+    def _realize(self, channel_id: str) -> None:
+        """First access to a snapshot-loaded channel: resolve its (maybe
+        virtualized) snapshot and construct the live object."""
+        snapshot = self._stored_snapshot(channel_id)
+        self._unrealized.pop(channel_id)
+        channel_type = snapshot["attributes"]["type"]
+        channel = self.registry.get(channel_type).load(
+            self, channel_id, snapshot)
+        self._bind(channel)
+        # last_changed_seq stays at the construction default, exactly as
+        # the eager load path leaves it — summaries must not depend on
+        # WHEN a replica realized a channel.
 
     def _bind(self, channel: SharedObject) -> None:
         self.channels[channel.id] = channel
@@ -133,7 +210,7 @@ class DataStoreRuntime:
             if created is not None:
                 created.last_changed_seq = message.sequence_number
             return
-        channel = self.channels[envelope["address"]]
+        channel = self.get_channel(envelope["address"])
         channel.process(
             replace(message, contents=envelope["contents"]),
             local,
@@ -144,6 +221,10 @@ class DataStoreRuntime:
         if local:
             return
         address = envelope["address"]
+        if address in self._unrealized:
+            # A snapshot-loaded channel is not "new" just because it is
+            # still lazy — realize it so the race logic below sees it.
+            self._realize(address)
         if address not in self.channels:
             self._adopt_channel(address, envelope["snapshot"])
             return
@@ -169,7 +250,7 @@ class DataStoreRuntime:
             # (re-snapshotting here would double-apply them on remotes).
             self.parent.submit_datastore_op(self.id, envelope, None)
             return
-        channel = self.channels[envelope["address"]]
+        channel = self.get_channel(envelope["address"])
         channel.resubmit(envelope["contents"], local_op_metadata)
 
     def adopt(self, snapshot: dict) -> None:
@@ -184,6 +265,9 @@ class DataStoreRuntime:
         exactly the state every remote replica builds."""
         self.attributes = snapshot.get("attributes", {})
         winner_channels = snapshot["channels"]
+        for channel_id in list(self._unrealized):
+            # Lazy channels participate in adoption like realized ones.
+            self._realize(channel_id)
         for channel_id in self.channels:
             if channel_id not in winner_channels:
                 self._adoption_pending.add(channel_id)
@@ -197,6 +281,7 @@ class DataStoreRuntime:
         their echoes apply as remote ops, exactly as every replica applies
         them to the adopted state."""
         self._adoption_pending.discard(channel_id)
+        self._unrealized.pop(channel_id, None)  # superseded before access
         self.parent.void_channel_ops(self.id, channel_id)
         channel_type = snapshot["attributes"]["type"]
         existing = self.channels.get(channel_id)
@@ -232,9 +317,23 @@ class DataStoreRuntime:
         from ..protocol.summary import make_handle
 
         channels: dict[str, dict] = {}
-        for channel_id, channel in sorted(self.channels.items()):
+        ids = sorted(set(self.channels) | set(self._unrealized))
+        for channel_id in ids:
             if channel_id in self._adoption_pending:
                 continue
+            if channel_id in self._unrealized:
+                # Never accessed since load: unchanged by definition. In
+                # incremental mode it stubs like any unchanged channel;
+                # a full summary re-inlines the (resolved) snapshot.
+                if unchanged_before is not None:
+                    channels[channel_id] = make_handle(
+                        f"runtime/datastores/{self.id}/channels/"
+                        f"{channel_id}")
+                else:
+                    channels[channel_id] = self._stored_snapshot(
+                        channel_id)
+                continue
+            channel = self.channels[channel_id]
             if (unchanged_before is not None
                     and channel.last_changed_seq <= unchanged_before):
                 channels[channel_id] = make_handle(
@@ -247,9 +346,9 @@ class DataStoreRuntime:
         }
 
     def load(self, snapshot: dict) -> None:
+        """Defer channel construction: the stored snapshots realize on
+        first access (lazy realization, remoteChannelContext.ts:203) —
+        with a virtualizing driver a stubbed channel's content is not
+        even FETCHED until then."""
         self.attributes = snapshot.get("attributes", {})
-        for channel_id, channel_snapshot in snapshot["channels"].items():
-            channel_type = channel_snapshot["attributes"]["type"]
-            channel = self.registry.get(channel_type).load(
-                self, channel_id, channel_snapshot)
-            self._bind(channel)
+        self._unrealized.update(snapshot["channels"])

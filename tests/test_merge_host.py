@@ -123,6 +123,7 @@ def test_routerlicious_restart_rebuilds_fresh_host_from_op_log():
         assert host2.map_entries(f"doc{d}", "default", "root") == maps[d]
 
 
+@pytest.mark.soak  # ~80s: growth/compaction pressure sweep
 def test_capacity_pressure_compacts_and_grows():
     host = KernelMergeHost(merge_slots=8, map_slots=4, num_props=1,
                            flush_threshold=4)
@@ -146,6 +147,7 @@ def test_capacity_pressure_compacts_and_grows():
     assert host._map_slots > 4  # 12 keys forced map slot growth
 
 
+@pytest.mark.soak  # ~65s: cross-bucket migration sweep
 def test_bucketed_pools_isolate_large_documents():
     """Ragged batching: one hot channel migrating to a bigger bucket must
     not widen the small channels' segment table (SURVEY §5.7)."""
@@ -265,6 +267,7 @@ def test_client_slot_overflow_routes_to_scalar():
     assert host.text("doc", "default", "text") == expected[4:]
 
 
+@pytest.mark.soak  # ~70s: 6000-op memory-bound soak
 def test_soak_host_memory_bounded(monkeypatch):
     """Long-lived channel: the replay log trims at every flush and the
     text pool repacks, so host memory stays bounded by the flush cadence

@@ -152,7 +152,7 @@ def test_host_kill_resume_rebalance(mesh):
             revived.submit(row, words_for(row, t), first_cseq=1 + t * k)
         revived.tick()
     # host 1 rows: checkpoint + tail.
-    revived.restore_host(cp, durable)
+    revived.restore_host(cp, durable, serving._durable_base)
 
     got_rows = revived.map_rows()
     got_seq = np.asarray(revived.seq_state.seq)
