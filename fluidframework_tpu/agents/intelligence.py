@@ -22,10 +22,9 @@ def _document_texts(container) -> list[str]:
     texts = []
     for datastore in container.runtime.datastores.values():
         for channel_id in datastore.channel_ids():
-            if (channel_id in datastore._unrealized
-                    and datastore._unrealized_type(channel_id)
-                    != SharedString.channel_type):
-                continue  # lazy non-string channels stay unrealized
+            if datastore.channel_type(channel_id) \
+                    != SharedString.channel_type:
+                continue  # non-string channels stay unrealized
             channel = datastore.get_channel(channel_id)
             if isinstance(channel, SharedString):
                 texts.append(channel.get_text())

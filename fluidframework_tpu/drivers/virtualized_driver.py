@@ -94,13 +94,23 @@ class _VirtualizedStorage:
             service._blob_cache.move_to_end(blob_id)
             service.stats["blob_cache_hits"] += 1
             return json.loads(cached.decode())
-        data = _with_retry(
-            lambda: service.inner.storage.read_blob(blob_id))
-        expected = hashlib.sha256(data).hexdigest()
-        if expected != blob_id:
-            raise DriverError(
-                f"blob {blob_id} content hash mismatch", can_retry=True)
+        def fetch_verified() -> bytes:
+            data = service.inner.storage.read_blob(blob_id)
+            if hashlib.sha256(data).hexdigest() != blob_id:
+                # Retryable INSIDE the backoff loop: a truncated or
+                # corrupt transfer re-fetches before failing the caller.
+                raise DriverError(
+                    f"blob {blob_id} content hash mismatch",
+                    can_retry=True)
+            return data
+
+        data = _with_retry(fetch_verified)
         service._remember(blob_id, data)
+        # A verified fetch PROVES the server holds this exact content —
+        # the upload manager can reuse the handle without re-sending
+        # (a fresh client's first summary must not re-upload every
+        # realized-but-unchanged channel).
+        service._uploaded.add(blob_id)
         service.stats["blob_fetches"] += 1
         return json.loads(data.decode())
 

@@ -74,6 +74,38 @@ class MatrixOpBatch(NamedTuple):
     client: jax.Array       # i32 client slot
 
 
+class MatrixStepBatch(NamedTuple):
+    """One tick as STEPS: each step is (optional vector op, following
+    CELL RUN). In a sequenced matrix stream ~70% of ops are cell writes,
+    and every consecutive cell between two vector ops resolves its
+    (row, col) -> handle lookup in the SAME visibility frame whenever its
+    ref_seq covers the last structural (vector) op — a host-checkable
+    exactness condition (see make_matrix_step_batch). Batching the run
+    pays the two-axis visibility prefix scan ONCE per run instead of once
+    per cell — the dominant cost of matrix.ts:547's server-side fold.
+
+    Vector-op planes are [B, T] (T = steps); run planes are [B, T, R]
+    (R = max cells per run; longer runs split into vector-less steps)."""
+
+    vec_valid: jax.Array    # bool[B, T]
+    kind: jax.Array         # i32[B, T] MT_INSERT/MT_REMOVE
+    target: jax.Array       # i32[B, T] MX_ROWS/MX_COLS
+    pos: jax.Array          # i32[B, T]
+    end: jax.Array          # i32[B, T]
+    count: jax.Array        # i32[B, T]
+    handle_base: jax.Array  # i32[B, T]
+    seq: jax.Array          # i32[B, T]
+    ref_seq: jax.Array      # i32[B, T]
+    client: jax.Array       # i32[B, T]
+    run_ref: jax.Array      # i32[B, T] shared frame ref of the cell run
+    run_client: jax.Array   # i32[B, T] frame client (exact for 1-cell runs)
+    r_valid: jax.Array      # bool[B, T, R]
+    r_row: jax.Array        # i32[B, T, R]
+    r_col: jax.Array        # i32[B, T, R]
+    r_value: jax.Array      # i32[B, T, R]
+    r_seq: jax.Array        # i32[B, T, R]
+
+
 class _VecOp(NamedTuple):
     """Adapter to the merge-tree kernel's per-op field names."""
 
@@ -188,6 +220,97 @@ def apply_tick(state: MatrixState, ops: MatrixOpBatch) -> MatrixState:
     return jax.vmap(_process_doc)(state, ops)
 
 
+def _handle_lookup(s: mtk.MergeState, vis, cum, pos):
+    """Handle at visible position ``pos`` given a precomputed frame
+    (vis, cum) — the per-cell remainder of _handle_at once the run's
+    shared visibility scan is paid."""
+    inside = (cum <= pos) & (pos < cum + vis)
+    found = jnp.any(inside)
+    idx = jnp.argmax(inside)
+    return jnp.where(found, s.pool_start[idx] + pos - cum[idx], -1)
+
+
+def _apply_matrix_step(s: MatrixState, step) -> MatrixState:
+    """One STEP: masked vector walk, then the cell run in ONE shared
+    visibility frame (exactness argument in MatrixStepBatch's docstring;
+    stale-ref cells arrive as single-cell runs carrying their own exact
+    frame). Cells resolve on the POST-walk tables — with the per-op
+    formulation a cell following a vector op it can see resolves after
+    it, and one it cannot see is excluded by the frame either way."""
+    is_rows = step.target == MX_ROWS
+    is_cols = step.target == MX_COLS
+
+    sel = jax.tree.map(lambda r, c: jnp.where(is_rows, r, c),
+                       s.rows, s.cols)
+    walked = mtk._apply_op(sel, _VecOp(
+        valid=step.vec_valid, kind=step.kind, pos=step.pos, end=step.end,
+        seq=step.seq, ref_seq=step.ref_seq, client=step.client,
+        pool_start=step.handle_base, text_len=step.count,
+        prop_key=jnp.zeros_like(step.kind),
+        prop_val=jnp.zeros_like(step.kind)))
+    rows = jax.tree.map(
+        lambda new, old: jnp.where(step.vec_valid & is_rows, new, old),
+        walked, s.rows)
+    cols = jax.tree.map(
+        lambda new, old: jnp.where(step.vec_valid & is_cols, new, old),
+        walked, s.cols)
+
+    # ONE visibility scan per axis for the whole run.
+    vis_r = mtk._vis_len(rows, step.run_ref, step.run_client)
+    cum_r = jnp.cumsum(vis_r) - vis_r
+    vis_c = mtk._vis_len(cols, step.run_ref, step.run_client)
+    cum_c = jnp.cumsum(vis_c) - vis_c
+    capacity = s.cell_used.shape[0]
+
+    def cell_step(carry, cell):
+        cell_rh, cell_ch, cell_val, cell_seq, cell_used, cell_count = carry
+        valid, row, col, value, seq = cell
+        rh = _handle_lookup(rows, vis_r, cum_r, row)
+        ch = _handle_lookup(cols, vis_c, cum_c, col)
+        write = valid & (rh >= 0) & (ch >= 0)
+        match = cell_used & (cell_rh == rh) & (cell_ch == ch)
+        exists = jnp.any(match)
+        idx = jnp.where(exists, jnp.argmax(match),
+                        jnp.minimum(cell_count, capacity - 1))
+
+        def upd(field, val):
+            return field.at[idx].set(jnp.where(write, val, field[idx]))
+
+        return (upd(cell_rh, rh), upd(cell_ch, ch), upd(cell_val, value),
+                upd(cell_seq, seq), upd(cell_used, True),
+                cell_count + jnp.where(write & ~exists, 1, 0).astype(I32)
+                ), ()
+
+    (cell_rh, cell_ch, cell_val, cell_seq, cell_used, cell_count), _ = \
+        jax.lax.scan(
+            cell_step,
+            (s.cell_rh, s.cell_ch, s.cell_val, s.cell_seq, s.cell_used,
+             s.cell_count),
+            (step.r_valid, step.r_row, step.r_col, step.r_value,
+             step.r_seq))
+    return MatrixState(
+        rows=rows, cols=cols, cell_rh=cell_rh, cell_ch=cell_ch,
+        cell_val=cell_val, cell_seq=cell_seq, cell_used=cell_used,
+        cell_count=cell_count)
+
+
+def _process_doc_steps(state: MatrixState, steps: MatrixStepBatch):
+    def one(s, step_slice):
+        return _apply_matrix_step(s, step_slice), ()
+
+    final, _ = jax.lax.scan(one, state, steps)
+    return final
+
+
+@jax.jit
+def apply_tick_steps(state: MatrixState,
+                     steps: MatrixStepBatch) -> MatrixState:
+    """Apply one tick in the step/run layout — same converged state as
+    :func:`apply_tick` on the equivalent flat stream (differentially
+    pinned by tests/test_matrix_kernel.py)."""
+    return jax.vmap(_process_doc_steps)(state, steps)
+
+
 def capacity_margin(state: MatrixState) -> dict[str, np.ndarray]:
     """Free slots per document per table. Vector ops consume up to 2 vector
     slots; a cell set consumes up to 1 cell slot. Overflow is silent — the
@@ -232,6 +355,82 @@ def make_matrix_op_batch(ops_per_doc: list[list[dict]], num_docs: int,
                 fields[name][d, i] = op.get(name, 0)
     return MatrixOpBatch(valid=jnp.asarray(valid),
                          **{n: jnp.asarray(v) for n, v in fields.items()})
+
+
+def group_matrix_steps(doc_ops: list[dict], r_max: int = 8,
+                       last_vec_seq: int = 0) -> list[dict]:
+    """Group one document's sequenced kernel ops into steps.
+
+    Exactness: only vector ops mutate the axis tables, so every axis
+    segment's insert/remove seq is <= v (the last vector-op seq). A cell
+    with ref_seq >= v therefore sees EVERY axis segment and removal —
+    its visibility frame equals any other such cell's, and the run
+    shares one scan. A cell with ref_seq < v (stale concurrent ref)
+    becomes a single-cell run carrying its own exact (ref, client)
+    frame. ``last_vec_seq`` seeds v for ticks continuing a served
+    document (the host tracks it across flushes).
+    """
+    steps: list[dict] = []
+    v = last_vec_seq
+    cur: dict | None = None
+    for op in doc_ops:
+        if op["target"] != MX_CELL:
+            cur = {"vec": op, "cells": [], "exact": False}
+            steps.append(cur)
+            v = op["seq"]
+            continue
+        fresh = op["ref_seq"] >= v
+        if (cur is None or cur["exact"] or not fresh
+                or len(cur["cells"]) >= r_max):
+            cur = {"vec": None, "cells": [], "exact": not fresh}
+            steps.append(cur)
+        cur["cells"].append(op)
+        if not fresh:
+            cur = None  # a stale-ref cell stays alone in its exact run
+    return steps
+
+
+def make_matrix_step_batch(ops_per_doc: list[list[dict]], num_docs: int,
+                           r_max: int = 8,
+                           last_vec_seq: list[int] | None = None
+                           ) -> MatrixStepBatch:
+    """Encode per-doc op lists into the step/run layout (padded [B, T] +
+    [B, T, R])."""
+    seeds = last_vec_seq or [0] * num_docs
+    grouped = [group_matrix_steps(doc_ops, r_max, seeds[d])
+               for d, doc_ops in enumerate(ops_per_doc)]
+    t = max((len(g) for g in grouped), default=1) or 1
+    r = max((len(s["cells"]) for g in grouped for s in g), default=1) or 1
+    vec_names = ("kind", "target", "pos", "end", "count", "handle_base",
+                 "seq", "ref_seq", "client", "run_ref", "run_client")
+    vec = {n: np.zeros((num_docs, t), np.int32) for n in vec_names}
+    vec_valid = np.zeros((num_docs, t), np.bool_)
+    run_names = ("r_row", "r_col", "r_value", "r_seq")
+    run = {n: np.zeros((num_docs, t, r), np.int32) for n in run_names}
+    r_valid = np.zeros((num_docs, t, r), np.bool_)
+    for d, g in enumerate(grouped):
+        for i, step in enumerate(g):
+            op = step["vec"]
+            if op is not None:
+                vec_valid[d, i] = True
+                for n in ("kind", "target", "pos", "end", "count",
+                          "handle_base", "seq", "ref_seq", "client"):
+                    vec[n][d, i] = op.get(n, 0)
+            cells = step["cells"]
+            if cells:
+                vec["run_ref"][d, i] = min(c["ref_seq"] for c in cells)
+                vec["run_client"][d, i] = cells[0]["client"]
+                for j, c in enumerate(cells):
+                    r_valid[d, i, j] = True
+                    run["r_row"][d, i, j] = c["row"]
+                    run["r_col"][d, i, j] = c["col"]
+                    run["r_value"][d, i, j] = c["value"]
+                    run["r_seq"][d, i, j] = c["seq"]
+    return MatrixStepBatch(
+        vec_valid=jnp.asarray(vec_valid),
+        **{n: jnp.asarray(a) for n, a in vec.items()},
+        r_valid=jnp.asarray(r_valid),
+        **{n: jnp.asarray(a) for n, a in run.items()})
 
 
 def encode_matrix_op(channel_op: dict, base: dict, alloc_rows, alloc_cols,

@@ -324,3 +324,308 @@ def apply_tick_best(state: MatrixState, ops: MatrixOpBatch) -> MatrixState:
     if default_interpret():
         return apply_tick(state, ops)
     return apply_tick_pallas(state, ops)
+
+
+# -- step/run layout (shared-frame cell runs) ---------------------------------
+
+_STEP_VEC = ("vec_valid", "kind", "target", "pos", "end", "count",
+             "handle_base", "seq", "ref_seq", "client", "run_ref",
+             "run_client")
+_STEP_RUN = ("r_valid", "r_row", "r_col", "r_value", "r_seq")
+
+
+def _handle_lookup_vec(p: dict, vis, cum, pos):
+    """Per-cell remainder of _handle_at_vec once the run's shared frame
+    (vis, cum) is paid: one boundary select + two gathers."""
+    inside = (cum <= pos) & (pos < cum + vis)
+    found = jnp.any(inside, axis=-1, keepdims=True)
+    idx = _first_true(inside)
+    base = _gather_lane(p["pool_start"], idx)
+    off = pos - _gather_lane(cum, idx)
+    return jnp.where(found, base + off, -1)
+
+
+def _step_kernel(*refs, num_steps: int, r_max: int, num_cells: int):
+    i = 0
+
+    def take(n):
+        nonlocal i
+        out = refs[i:i + n]
+        i += n
+        return out
+
+    rows_refs = take(7)
+    rows_prop_ref, rows_overlap_ref, rows_count_ref = take(3)
+    cols_refs = take(7)
+    cols_prop_ref, cols_overlap_ref, cols_count_ref = take(3)
+    cell_refs = take(5)
+    cell_count_ref, = take(1)
+    vec_refs = take(len(_STEP_VEC))
+    run_refs = take(len(_STEP_RUN))
+    out_rows = take(7)
+    out_rows_prop, out_rows_overlap, out_rows_count = take(3)
+    out_cols = take(7)
+    out_cols_prop, out_cols_overlap, out_cols_count = take(3)
+    out_cells = take(5)
+    out_cell_count, = take(1)
+
+    rows = {n: r[:] for n, r in zip(_PLANES, rows_refs)}
+    cols = {n: r[:] for n, r in zip(_PLANES, cols_refs)}
+    cells = {n: r[:] for n, r in zip(_CELLS, cell_refs)}
+    vec_vals = {n: r[:] for n, r in zip(_STEP_VEC, vec_refs)}
+    run_vals = {n: r[:] for n, r in zip(_STEP_RUN, run_refs)}
+    step_lane = jax.lax.broadcasted_iota(
+        I32, next(iter(vec_vals.values())).shape, 1)
+    cell_lane = jax.lax.broadcasted_iota(
+        I32, next(iter(run_vals.values())).shape, 1)
+
+    def body(t, carry):
+        (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+         cols_overlap, cols_count, cells, cell_count) = carry
+        step = {n: jnp.sum(jnp.where(step_lane == t, v, 0),
+                           axis=1, keepdims=True)
+                for n, v in vec_vals.items()}
+        opvalid = step["vec_valid"] != 0
+        is_rows = step["target"] == MX_ROWS
+        is_cols = step["target"] == MX_COLS
+
+        def vec_phase(carry):
+            (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+             cols_overlap, cols_count) = carry
+            sel = {name: jnp.where(is_rows, rows[name], cols[name])
+                   for name in _PLANES}
+            sel_prop = jnp.where(is_rows[None], rows_prop, cols_prop)
+            sel_overlap = jnp.where(is_rows[None], rows_overlap,
+                                    cols_overlap)
+            sel_count = jnp.where(is_rows, rows_count, cols_count)
+            zeros = jnp.zeros_like(step["kind"])
+            vec_op = {"valid": step["vec_valid"], "kind": step["kind"],
+                      "pos": step["pos"], "end": step["end"],
+                      "seq": step["seq"], "ref_seq": step["ref_seq"],
+                      "client": step["client"],
+                      "pool_start": step["handle_base"],
+                      "text_len": step["count"],
+                      "prop_key": zeros, "prop_val": zeros}
+            walked, walked_prop, walked_overlap, walked_count = \
+                merge_apply_vec(sel, sel_prop, sel_overlap, sel_count,
+                                vec_op)
+            gate_r = opvalid & is_rows
+            gate_c = opvalid & is_cols
+            return (
+                {n: jnp.where(gate_r, walked[n], rows[n])
+                 for n in _PLANES},
+                jnp.where(gate_r[None], walked_prop, rows_prop),
+                jnp.where(gate_r[None], walked_overlap, rows_overlap),
+                jnp.where(gate_r, walked_count, rows_count),
+                {n: jnp.where(gate_c, walked[n], cols[n])
+                 for n in _PLANES},
+                jnp.where(gate_c[None], walked_prop, cols_prop),
+                jnp.where(gate_c[None], walked_overlap, cols_overlap),
+                jnp.where(gate_c, walked_count, cols_count),
+            )
+
+        (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+         cols_overlap, cols_count) = jax.lax.cond(
+            jnp.any(opvalid), vec_phase, lambda c: c,
+            (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+             cols_overlap, cols_count))
+
+        def run_phase(carry):
+            cells, cell_count = carry
+            # ONE shared visibility frame per axis for the whole run —
+            # resolved on the POST-walk tables (exactness:
+            # matrix_kernel.MatrixStepBatch docstring).
+            vis_r = _vis_len(rows, rows_overlap, step["run_ref"],
+                             step["run_client"])
+            cum_r = _excl_cumsum(vis_r)
+            vis_c = _vis_len(cols, cols_overlap, step["run_ref"],
+                             step["run_client"])
+            cum_c = _excl_cumsum(vis_c)
+            lane_c = jax.lax.broadcasted_iota(
+                I32, cells["cell_used"].shape, 1)
+
+            def cell_body(j, carry):
+                cells, cell_count = carry
+                at_cell = cell_lane == t * r_max + j
+                cell = {n: jnp.sum(jnp.where(at_cell, v, 0),
+                                   axis=1, keepdims=True)
+                        for n, v in run_vals.items()}
+                rh = _handle_lookup_vec(rows, vis_r, cum_r,
+                                        cell["r_row"])
+                ch = _handle_lookup_vec(cols, vis_c, cum_c,
+                                        cell["r_col"])
+                write = (cell["r_valid"] != 0) & (rh >= 0) & (ch >= 0)
+                match = ((cells["cell_used"] != 0)
+                         & (cells["cell_rh"] == rh)
+                         & (cells["cell_ch"] == ch))
+                exists = jnp.any(match, axis=-1, keepdims=True)
+                idx = jnp.where(exists, _first_true(match),
+                                jnp.minimum(cell_count, num_cells - 1))
+                at = write & (lane_c == idx)
+                return ({
+                    "cell_rh": jnp.where(at, rh, cells["cell_rh"]),
+                    "cell_ch": jnp.where(at, ch, cells["cell_ch"]),
+                    "cell_val": jnp.where(at, cell["r_value"],
+                                          cells["cell_val"]),
+                    "cell_seq": jnp.where(at, cell["r_seq"],
+                                          cells["cell_seq"]),
+                    "cell_used": jnp.where(at, 1, cells["cell_used"]),
+                }, cell_count + (write & ~exists).astype(I32))
+
+            return jax.lax.fori_loop(0, r_max, cell_body,
+                                     (cells, cell_count))
+
+        any_cells = jnp.any(jnp.sum(jnp.where(
+            (cell_lane >= t * r_max) & (cell_lane < (t + 1) * r_max),
+            run_vals["r_valid"], 0), axis=1) != 0)
+        cells, cell_count = jax.lax.cond(
+            any_cells, run_phase, lambda c: c, (cells, cell_count))
+        return (rows, rows_prop, rows_overlap, rows_count, cols,
+                cols_prop, cols_overlap, cols_count, cells, cell_count)
+
+    carry = (rows, rows_prop_ref[:], rows_overlap_ref[:],
+             rows_count_ref[:], cols, cols_prop_ref[:],
+             cols_overlap_ref[:], cols_count_ref[:], cells,
+             cell_count_ref[:])
+    last_valid = jnp.max(jnp.where(
+        (vec_vals["vec_valid"] != 0), step_lane + 1, 0))
+    last_run = jnp.max(jnp.where(run_vals["r_valid"] != 0,
+                                 cell_lane // r_max + 1, 0))
+    (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+     cols_overlap, cols_count, cells, cell_count) = jax.lax.fori_loop(
+        0, jnp.minimum(jnp.maximum(last_valid, last_run), num_steps),
+        body, carry)
+    for n, r in zip(_PLANES, out_rows):
+        r[:] = rows[n]
+    out_rows_prop[:] = rows_prop
+    out_rows_overlap[:] = rows_overlap
+    out_rows_count[:] = rows_count
+    for n, r in zip(_PLANES, out_cols):
+        r[:] = cols[n]
+    out_cols_prop[:] = cols_prop
+    out_cols_overlap[:] = cols_overlap
+    out_cols_count[:] = cols_count
+    for n, r in zip(_CELLS, out_cells):
+        r[:] = cells[n]
+    out_cell_count[:] = cell_count
+
+
+@functools.partial(jax.jit, static_argnames=("block_docs", "interpret"))
+def apply_tick_steps_pallas(state: MatrixState, steps,
+                            block_docs: int = 64,
+                            interpret: bool = False) -> MatrixState:
+    """Drop-in replacement for :func:`matrix_kernel.apply_tick_steps`."""
+    b, s = state.rows.length.shape
+    c = state.cell_used.shape[1]
+    t = steps.kind.shape[1]
+    r_max = steps.r_valid.shape[2]
+    p = state.rows.prop_val.shape[2]
+    w = state.rows.rem_overlap.shape[2]
+    d = min(block_docs, max(8, b))
+    bp = -(-b // d) * d
+    sp = -(-s // 128) * 128
+    cp = -(-c // 128) * 128
+
+    def vec_inputs(ms: MergeState):
+        planes = []
+        for name in _PLANES:
+            arr = getattr(ms, name).astype(I32)
+            arr = _pad_to(arr, 0, bp, _VEC_FILL[name])
+            planes.append(_pad_to(arr, 1, sp, _VEC_FILL[name]))
+        prop = jnp.transpose(ms.prop_val, (2, 0, 1))
+        prop = _pad_to(_pad_to(prop, 1, bp, 0), 2, sp, 0)
+        overlap = jnp.transpose(ms.rem_overlap, (2, 0, 1))
+        overlap = _pad_to(_pad_to(overlap, 1, bp, 0), 2, sp, 0)
+        count = _pad_to(ms.count[:, None], 0, bp, 0)
+        return planes, prop, overlap, count
+
+    rows_planes, rows_prop, rows_overlap, rows_count = vec_inputs(state.rows)
+    cols_planes, cols_prop, cols_overlap, cols_count = vec_inputs(state.cols)
+    cell_planes = []
+    for name in _CELLS:
+        arr = getattr(state, name).astype(I32)
+        arr = _pad_to(arr, 0, bp, _CELL_FILL[name])
+        cell_planes.append(_pad_to(arr, 1, cp, _CELL_FILL[name]))
+    cell_count = _pad_to(state.cell_count[:, None], 0, bp, 0)
+    vec_arrays = [_pad_to(getattr(steps, n).astype(I32), 0, bp, 0)
+                  for n in _STEP_VEC]
+    run_arrays = [
+        _pad_to(getattr(steps, n).astype(I32).reshape(b, t * r_max),
+                0, bp, 0)
+        for n in _STEP_RUN]
+
+    grid = (bp // d,)
+    vec_spec = pl.BlockSpec((d, sp), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
+                             memory_space=pltpu.VMEM)
+    overlap_spec = pl.BlockSpec((w, d, sp), lambda i: (0, i, 0),
+                                memory_space=pltpu.VMEM)
+    count_spec = pl.BlockSpec((d, 1), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    cell_spec = pl.BlockSpec((d, cp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    step_spec = pl.BlockSpec((d, t), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    run_spec = pl.BlockSpec((d, t * r_max), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    state_specs = ([vec_spec] * 7
+                   + [prop_spec, overlap_spec, count_spec]) * 2 \
+        + [cell_spec] * 5 + [count_spec]
+    state_shapes = (
+        [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 7
+        + [jax.ShapeDtypeStruct((p, bp, sp), jnp.int32),
+           jax.ShapeDtypeStruct((w, bp, sp), jnp.int32),
+           jax.ShapeDtypeStruct((bp, 1), jnp.int32)]) * 2 \
+        + [jax.ShapeDtypeStruct((bp, cp), jnp.int32)] * 5 \
+        + [jax.ShapeDtypeStruct((bp, 1), jnp.int32)]
+
+    out = pl.pallas_call(
+        functools.partial(_step_kernel, num_steps=t, r_max=r_max,
+                          num_cells=c),
+        grid=grid,
+        in_specs=state_specs + [step_spec] * len(_STEP_VEC)
+        + [run_spec] * len(_STEP_RUN),
+        out_specs=state_specs,
+        out_shape=state_shapes,
+        input_output_aliases={i: i for i in range(26)},
+        interpret=interpret,
+    )(*rows_planes, rows_prop, rows_overlap, rows_count, *cols_planes,
+      cols_prop, cols_overlap, cols_count, *cell_planes, cell_count,
+      *vec_arrays, *run_arrays)
+
+    def vec_state(planes, prop, overlap, count) -> MergeState:
+        named = {n: a[:b, :s] for n, a in zip(_PLANES, planes)}
+        return MergeState(
+            valid=named["valid"] != 0,
+            length=named["length"],
+            ins_seq=named["ins_seq"],
+            ins_client=named["ins_client"],
+            rem_seq=named["rem_seq"],
+            rem_client=named["rem_client"],
+            rem_overlap=jnp.transpose(overlap, (1, 2, 0))[:b, :s],
+            pool_start=named["pool_start"],
+            prop_val=jnp.transpose(prop, (1, 2, 0))[:b, :s],
+            count=count[:b, 0],
+        )
+
+    cells = {n: a[:b, :c] for n, a in zip(_CELLS, out[20:25])}
+    return MatrixState(
+        rows=vec_state(out[0:7], out[7], out[8], out[9]),
+        cols=vec_state(out[10:17], out[17], out[18], out[19]),
+        cell_rh=cells["cell_rh"],
+        cell_ch=cells["cell_ch"],
+        cell_val=cells["cell_val"],
+        cell_seq=cells["cell_seq"],
+        cell_used=cells["cell_used"] != 0,
+        cell_count=out[25][:b, 0],
+    )
+
+
+def apply_tick_steps_best(state: MatrixState, steps) -> MatrixState:
+    """Pallas VMEM step kernel on TPU, XLA step scan elsewhere."""
+    from .matrix_kernel import apply_tick_steps
+    if default_interpret():
+        return apply_tick_steps(state, steps)
+    return apply_tick_steps_pallas(state, steps)

@@ -139,6 +139,13 @@ class DataStoreRuntime:
             return snap[VIRTUAL_KEY].get("type", "")
         return snap["attributes"]["type"]
 
+    def channel_type(self, channel_id: str) -> str:
+        """A channel's DDS type string without forcing realization — the
+        public filter surface for agents/tools walking documents."""
+        if channel_id in self._unrealized:
+            return self._unrealized_type(channel_id)
+        return self.channels[channel_id].attributes.get("type", "")
+
     def realize_membership_sensitive(self) -> None:
         """Realize lazy channels whose type reacts to quorum membership
         (e.g. consensus collections releasing a departed client's leases)
@@ -172,13 +179,17 @@ class DataStoreRuntime:
 
     def _realize(self, channel_id: str) -> None:
         """First access to a snapshot-loaded channel: resolve its (maybe
-        virtualized) snapshot and construct the live object."""
+        virtualized) snapshot and construct the live object. The lazy
+        entry is removed only after construction SUCCEEDS — a failed
+        load (unknown type, bad snapshot) must keep the channel visible
+        to channel_ids()/summarize(), exactly as the eager path failed
+        loudly without losing data."""
         snapshot = self._stored_snapshot(channel_id)
-        self._unrealized.pop(channel_id)
         channel_type = snapshot["attributes"]["type"]
         channel = self.registry.get(channel_type).load(
             self, channel_id, snapshot)
         self._bind(channel)
+        self._unrealized.pop(channel_id)
         # last_changed_seq stays at the construction default, exactly as
         # the eager load path leaves it — summaries must not depend on
         # WHEN a replica realized a channel.
@@ -222,9 +233,12 @@ class DataStoreRuntime:
             return
         address = envelope["address"]
         if address in self._unrealized:
-            # A snapshot-loaded channel is not "new" just because it is
-            # still lazy — realize it so the race logic below sees it.
-            self._realize(address)
+            # A lazy snapshot-loaded channel was never locally pending,
+            # so the remote attach can only lose to it (our channel
+            # already exists on every replica's snapshot) — drop the
+            # stale attach WITHOUT realizing (no blob fetch on the
+            # op-processing path).
+            return
         if address not in self.channels:
             self._adopt_channel(address, envelope["snapshot"])
             return

@@ -109,3 +109,54 @@ def test_matrix_pallas_matches_xla_on_random_streams(seed):
         state_p = mxp.apply_tick_pallas(
             state_p, batch, interpret=mtp.default_interpret())
     _assert_matrix_equal(state_x, state_p, seed)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_matrix_pallas_step_kernel_matches_xla(seed):
+    """The Pallas STEP/RUN kernel (shared-frame cell runs) must be
+    bit-identical to the XLA step scan — live concurrent streams with
+    stale-ref single-cell runs included."""
+    rng = random.Random(300 + seed)
+    server = LocalCollabServer()
+    c1 = make_empty_matrix_doc(server, "doc")
+    others = [Container.load(LocalDocumentService(server, "doc"))
+              for _ in range(2)]
+    containers = [c1] + others
+    get_matrix(c1).insert_rows(0, 2)
+    get_matrix(c1).insert_cols(0, 2)
+    for _round in range(4):
+        paused = [c for c in containers if rng.random() < 0.4]
+        for c in paused:
+            c.inbound.pause()
+        for _ in range(rng.randrange(5, 10)):
+            random_matrix_edit(rng, get_matrix(
+                containers[rng.randrange(len(containers))]))
+        for c in paused:
+            c.inbound.resume()
+
+    rows = mxk.HandleAllocator(1)
+    cols = mxk.HandleAllocator(1)
+    client_slots: dict = {}
+    val_ids: dict = {}
+    stream = mxk.encode_matrix_log(server.get_deltas("doc", 0), 0,
+                                   rows, cols, client_slots, val_ids)
+    state_x = mxk.init_state(1, vec_slots=128, cell_slots=256)
+    state_p = state_x
+    k = 12
+    lvs = [0]
+    for start in range(0, len(stream), k):
+        chunk = [stream[start:start + k]]
+        steps = mxk.make_matrix_step_batch(chunk, 1, r_max=4,
+                                           last_vec_seq=lvs)
+        state_x = mxk.apply_tick_steps(state_x, steps)
+        state_p = mxp.apply_tick_steps_pallas(
+            state_p, steps, interpret=mtp.default_interpret())
+        for op in chunk[0]:
+            if op["target"] != mxk.MX_CELL:
+                lvs[0] = max(lvs[0], op["seq"])
+    _assert_matrix_equal(state_x, state_p, seed)
+    expected = grid_of(get_matrix(containers[0]))
+    val_rev: list = [None] + [None] * len(val_ids)
+    for rep, vid in val_ids.items():
+        val_rev[vid] = eval(rep)
+    assert mxk.materialize_grid(state_p, 0, val_rev) == expected
