@@ -154,7 +154,8 @@ def _cpu_batched_rate(apply_fn, state, batches, ops_per_tick: int) -> float:
     cpu = jax.devices("cpu")[0]
     state = jax.device_put(state, cpu)
     batches = [jax.device_put(b, cpu) for b in batches[:2]]
-    st = apply_fn(state, batches[0])  # compile
+    for batch in batches:  # compile EVERY distinct batch shape untimed
+        st = apply_fn(state, batch)
     jax.block_until_ready(st)
     start = time.perf_counter()
     reps = 2

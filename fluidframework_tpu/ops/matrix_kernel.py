@@ -144,10 +144,7 @@ def _handle_at(s: mtk.MergeState, pos, ref_seq, client):
     (PermutationVector.handle_at / matrix adjustPosition). -1 = no handle."""
     vis = mtk._vis_len(s, ref_seq, client)
     cum = jnp.cumsum(vis) - vis
-    inside = (cum <= pos) & (pos < cum + vis)
-    found = jnp.any(inside)
-    idx = jnp.argmax(inside)
-    return jnp.where(found, s.pool_start[idx] + pos - cum[idx], -1)
+    return _handle_lookup(s, vis, cum, pos)
 
 
 def _vec_op(op) -> _VecOp:
@@ -375,14 +372,13 @@ def group_matrix_steps(doc_ops: list[dict], r_max: int = 8,
     cur: dict | None = None
     for op in doc_ops:
         if op["target"] != MX_CELL:
-            cur = {"vec": op, "cells": [], "exact": False}
+            cur = {"vec": op, "cells": []}
             steps.append(cur)
             v = op["seq"]
             continue
         fresh = op["ref_seq"] >= v
-        if (cur is None or cur["exact"] or not fresh
-                or len(cur["cells"]) >= r_max):
-            cur = {"vec": None, "cells": [], "exact": not fresh}
+        if cur is None or not fresh or len(cur["cells"]) >= r_max:
+            cur = {"vec": None, "cells": []}
             steps.append(cur)
         cur["cells"].append(op)
         if not fresh:

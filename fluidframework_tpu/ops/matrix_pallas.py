@@ -54,12 +54,34 @@ def _handle_at_vec(p: dict, overlap, pos, ref_seq, client):
     """Storage handle at visible position pos, per doc ([D, 1]); -1 none."""
     vis = _vis_len(p, overlap, ref_seq, client)
     cum = _excl_cumsum(vis)
-    inside = (cum <= pos) & (pos < cum + vis)
-    found = jnp.any(inside, axis=-1, keepdims=True)
-    idx = _first_true(inside)
-    base = _gather_lane(p["pool_start"], idx)
-    off = pos - _gather_lane(cum, idx)
-    return jnp.where(found, base + off, -1)
+    return _handle_lookup_vec(p, vis, cum, pos)
+
+
+def _axis_walk(carry, vec_op, opvalid, is_rows, is_cols):
+    """ONE merge walk on the select-merged axis, gated back per target —
+    the shared vector phase of the per-op and step kernels
+    (matrix_kernel._apply_matrix_op)."""
+    (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
+     cols_overlap, cols_count) = carry
+    sel = {name: jnp.where(is_rows, rows[name], cols[name])
+           for name in _PLANES}
+    sel_prop = jnp.where(is_rows[None], rows_prop, cols_prop)
+    sel_overlap = jnp.where(is_rows[None], rows_overlap, cols_overlap)
+    sel_count = jnp.where(is_rows, rows_count, cols_count)
+    walked, walked_prop, walked_overlap, walked_count = merge_apply_vec(
+        sel, sel_prop, sel_overlap, sel_count, vec_op)
+    gate_r = opvalid & is_rows
+    gate_c = opvalid & is_cols
+    return (
+        {n: jnp.where(gate_r, walked[n], rows[n]) for n in _PLANES},
+        jnp.where(gate_r[None], walked_prop, rows_prop),
+        jnp.where(gate_r[None], walked_overlap, rows_overlap),
+        jnp.where(gate_r, walked_count, rows_count),
+        {n: jnp.where(gate_c, walked[n], cols[n]) for n in _PLANES},
+        jnp.where(gate_c[None], walked_prop, cols_prop),
+        jnp.where(gate_c[None], walked_overlap, cols_overlap),
+        jnp.where(gate_c, walked_count, cols_count),
+    )
 
 
 def _matrix_apply_vec(rows, rows_prop, rows_overlap, rows_count,
@@ -77,40 +99,17 @@ def _matrix_apply_vec(rows, rows_prop, rows_overlap, rows_count,
     any_vec = jnp.any(opvalid & ~is_cell)
     any_cell = jnp.any(opvalid & is_cell)
 
-    def vec_phase(carry):
-        (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
-         cols_overlap, cols_count) = carry
-        # ONE merge walk on the select-merged axis
-        # (matrix_kernel._apply_matrix_op).
-        sel = {name: jnp.where(is_rows, rows[name], cols[name])
-               for name in _PLANES}
-        sel_prop = jnp.where(is_rows[None], rows_prop, cols_prop)
-        sel_overlap = jnp.where(is_rows[None], rows_overlap, cols_overlap)
-        sel_count = jnp.where(is_rows, rows_count, cols_count)
-        zeros = jnp.zeros_like(op["kind"])
-        vec_op = {"valid": op["valid"], "kind": op["kind"],
-                  "pos": op["pos"], "end": op["end"], "seq": op["seq"],
-                  "ref_seq": op["ref_seq"], "client": op["client"],
-                  "pool_start": op["handle_base"], "text_len": op["count"],
-                  "prop_key": zeros, "prop_val": zeros}
-        walked, walked_prop, walked_overlap, walked_count = merge_apply_vec(
-            sel, sel_prop, sel_overlap, sel_count, vec_op)
-        gate_r = opvalid & is_rows
-        gate_c = opvalid & is_cols
-        return (
-            {n: jnp.where(gate_r, walked[n], rows[n]) for n in _PLANES},
-            jnp.where(gate_r[None], walked_prop, rows_prop),
-            jnp.where(gate_r[None], walked_overlap, rows_overlap),
-            jnp.where(gate_r, walked_count, rows_count),
-            {n: jnp.where(gate_c, walked[n], cols[n]) for n in _PLANES},
-            jnp.where(gate_c[None], walked_prop, cols_prop),
-            jnp.where(gate_c[None], walked_overlap, cols_overlap),
-            jnp.where(gate_c, walked_count, cols_count),
-        )
-
+    zeros = jnp.zeros_like(op["kind"])
+    vec_op = {"valid": op["valid"], "kind": op["kind"],
+              "pos": op["pos"], "end": op["end"], "seq": op["seq"],
+              "ref_seq": op["ref_seq"], "client": op["client"],
+              "pool_start": op["handle_base"], "text_len": op["count"],
+              "prop_key": zeros, "prop_val": zeros}
     (new_rows, new_rows_prop, new_rows_overlap, new_rows_count, new_cols,
      new_cols_prop, new_cols_overlap, new_cols_count) = jax.lax.cond(
-        any_vec, vec_phase, lambda carry: carry,
+        any_vec,
+        lambda carry: _axis_walk(carry, vec_op, opvalid, is_rows, is_cols),
+        lambda carry: carry,
         (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
          cols_overlap, cols_count))
 
@@ -214,20 +213,12 @@ _CELL_FILL = {"cell_rh": -1, "cell_ch": -1, "cell_val": 0, "cell_seq": 0,
               "cell_used": 0}
 
 
-@functools.partial(jax.jit, static_argnames=("block_docs", "interpret"))
-def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
-                      block_docs: int = 64,
-                      interpret: bool = False) -> MatrixState:
-    """Drop-in replacement for :func:`matrix_kernel.apply_tick`."""
-    b, s = state.rows.length.shape
-    c = state.cell_used.shape[1]
-    k = ops.kind.shape[1]
+def _state_operands(state: MatrixState, d: int, bp: int, sp: int,
+                    cp: int):
+    """Padded state inputs + block specs + out shapes shared by the
+    per-op and step wrappers (aliased input->output, 26 buffers)."""
     p = state.rows.prop_val.shape[2]
     w = state.rows.rem_overlap.shape[2]
-    d = min(block_docs, max(8, b))
-    bp = -(-b // d) * d
-    sp = -(-s // 128) * 128
-    cp = -(-c // 128) * 128
 
     def vec_inputs(ms: MergeState):
         planes = []
@@ -240,20 +231,15 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
         overlap = jnp.transpose(ms.rem_overlap, (2, 0, 1))
         overlap = _pad_to(_pad_to(overlap, 1, bp, 0), 2, sp, 0)
         count = _pad_to(ms.count[:, None], 0, bp, 0)
-        return planes, prop, overlap, count
+        return planes + [prop, overlap, count]
 
-    rows_planes, rows_prop, rows_overlap, rows_count = vec_inputs(state.rows)
-    cols_planes, cols_prop, cols_overlap, cols_count = vec_inputs(state.cols)
-    cell_planes = []
+    inputs = vec_inputs(state.rows) + vec_inputs(state.cols)
     for name in _CELLS:
         arr = getattr(state, name).astype(I32)
         arr = _pad_to(arr, 0, bp, _CELL_FILL[name])
-        cell_planes.append(_pad_to(arr, 1, cp, _CELL_FILL[name]))
-    cell_count = _pad_to(state.cell_count[:, None], 0, bp, 0)
-    op_arrays = [_pad_to(getattr(ops, name).astype(I32), 0, bp, 0)
-                 for name in _MX_OPS]
+        inputs.append(_pad_to(arr, 1, cp, _CELL_FILL[name]))
+    inputs.append(_pad_to(state.cell_count[:, None], 0, bp, 0))
 
-    grid = (bp // d,)
     vec_spec = pl.BlockSpec((d, sp), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
     prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
@@ -264,9 +250,6 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
                               memory_space=pltpu.VMEM)
     cell_spec = pl.BlockSpec((d, cp), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
-    op_spec = pl.BlockSpec((d, k), lambda i: (i, 0),
-                           memory_space=pltpu.VMEM)
-
     state_specs = ([vec_spec] * 7
                    + [prop_spec, overlap_spec, count_spec]) * 2 \
         + [cell_spec] * 5 + [count_spec]
@@ -277,19 +260,10 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
            jax.ShapeDtypeStruct((bp, 1), jnp.int32)]) * 2 \
         + [jax.ShapeDtypeStruct((bp, cp), jnp.int32)] * 5 \
         + [jax.ShapeDtypeStruct((bp, 1), jnp.int32)]
+    return inputs, state_specs, state_shapes
 
-    out = pl.pallas_call(
-        functools.partial(_tick_kernel, num_ops=k, num_cells=c),
-        grid=grid,
-        in_specs=state_specs + [op_spec] * 13,
-        out_specs=state_specs,
-        out_shape=state_shapes,
-        input_output_aliases={i: i for i in range(26)},
-        interpret=interpret,
-    )(*rows_planes, rows_prop, rows_overlap, rows_count, *cols_planes,
-      cols_prop, cols_overlap, cols_count, *cell_planes, cell_count,
-      *op_arrays)
 
+def _unpack_state(out, b: int, s: int, c: int) -> MatrixState:
     def vec_state(planes, prop, overlap, count) -> MergeState:
         named = {n: a[:b, :s] for n, a in zip(_PLANES, planes)}
         return MergeState(
@@ -316,6 +290,38 @@ def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
         cell_used=cells["cell_used"] != 0,
         cell_count=out[25][:b, 0],
     )
+
+
+@functools.partial(jax.jit, static_argnames=("block_docs", "interpret"))
+def apply_tick_pallas(state: MatrixState, ops: MatrixOpBatch,
+                      block_docs: int = 64,
+                      interpret: bool = False) -> MatrixState:
+    """Drop-in replacement for :func:`matrix_kernel.apply_tick`."""
+    b, s = state.rows.length.shape
+    c = state.cell_used.shape[1]
+    k = ops.kind.shape[1]
+    d = min(block_docs, max(8, b))
+    bp = -(-b // d) * d
+    sp = -(-s // 128) * 128
+    cp = -(-c // 128) * 128
+
+    inputs, state_specs, state_shapes = _state_operands(state, d, bp, sp,
+                                                        cp)
+    op_arrays = [_pad_to(getattr(ops, name).astype(I32), 0, bp, 0)
+                 for name in _MX_OPS]
+    op_spec = pl.BlockSpec((d, k), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        functools.partial(_tick_kernel, num_ops=k, num_cells=c),
+        grid=(bp // d,),
+        in_specs=state_specs + [op_spec] * 13,
+        out_specs=state_specs,
+        out_shape=state_shapes,
+        input_output_aliases={i: i for i in range(26)},
+        interpret=interpret,
+    )(*inputs, *op_arrays)
+    return _unpack_state(out, b, s, c)
 
 
 def apply_tick_best(state: MatrixState, ops: MatrixOpBatch) -> MatrixState:
@@ -389,44 +395,19 @@ def _step_kernel(*refs, num_steps: int, r_max: int, num_cells: int):
         is_rows = step["target"] == MX_ROWS
         is_cols = step["target"] == MX_COLS
 
-        def vec_phase(carry):
-            (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
-             cols_overlap, cols_count) = carry
-            sel = {name: jnp.where(is_rows, rows[name], cols[name])
-                   for name in _PLANES}
-            sel_prop = jnp.where(is_rows[None], rows_prop, cols_prop)
-            sel_overlap = jnp.where(is_rows[None], rows_overlap,
-                                    cols_overlap)
-            sel_count = jnp.where(is_rows, rows_count, cols_count)
-            zeros = jnp.zeros_like(step["kind"])
-            vec_op = {"valid": step["vec_valid"], "kind": step["kind"],
-                      "pos": step["pos"], "end": step["end"],
-                      "seq": step["seq"], "ref_seq": step["ref_seq"],
-                      "client": step["client"],
-                      "pool_start": step["handle_base"],
-                      "text_len": step["count"],
-                      "prop_key": zeros, "prop_val": zeros}
-            walked, walked_prop, walked_overlap, walked_count = \
-                merge_apply_vec(sel, sel_prop, sel_overlap, sel_count,
-                                vec_op)
-            gate_r = opvalid & is_rows
-            gate_c = opvalid & is_cols
-            return (
-                {n: jnp.where(gate_r, walked[n], rows[n])
-                 for n in _PLANES},
-                jnp.where(gate_r[None], walked_prop, rows_prop),
-                jnp.where(gate_r[None], walked_overlap, rows_overlap),
-                jnp.where(gate_r, walked_count, rows_count),
-                {n: jnp.where(gate_c, walked[n], cols[n])
-                 for n in _PLANES},
-                jnp.where(gate_c[None], walked_prop, cols_prop),
-                jnp.where(gate_c[None], walked_overlap, cols_overlap),
-                jnp.where(gate_c, walked_count, cols_count),
-            )
-
+        zeros = jnp.zeros_like(step["kind"])
+        vec_op = {"valid": step["vec_valid"], "kind": step["kind"],
+                  "pos": step["pos"], "end": step["end"],
+                  "seq": step["seq"], "ref_seq": step["ref_seq"],
+                  "client": step["client"],
+                  "pool_start": step["handle_base"],
+                  "text_len": step["count"],
+                  "prop_key": zeros, "prop_val": zeros}
         (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
          cols_overlap, cols_count) = jax.lax.cond(
-            jnp.any(opvalid), vec_phase, lambda c: c,
+            jnp.any(opvalid),
+            lambda c: _axis_walk(c, vec_op, opvalid, is_rows, is_cols),
+            lambda c: c,
             (rows, rows_prop, rows_overlap, rows_count, cols, cols_prop,
              cols_overlap, cols_count))
 
@@ -519,108 +500,36 @@ def apply_tick_steps_pallas(state: MatrixState, steps,
     c = state.cell_used.shape[1]
     t = steps.kind.shape[1]
     r_max = steps.r_valid.shape[2]
-    p = state.rows.prop_val.shape[2]
-    w = state.rows.rem_overlap.shape[2]
     d = min(block_docs, max(8, b))
     bp = -(-b // d) * d
     sp = -(-s // 128) * 128
     cp = -(-c // 128) * 128
 
-    def vec_inputs(ms: MergeState):
-        planes = []
-        for name in _PLANES:
-            arr = getattr(ms, name).astype(I32)
-            arr = _pad_to(arr, 0, bp, _VEC_FILL[name])
-            planes.append(_pad_to(arr, 1, sp, _VEC_FILL[name]))
-        prop = jnp.transpose(ms.prop_val, (2, 0, 1))
-        prop = _pad_to(_pad_to(prop, 1, bp, 0), 2, sp, 0)
-        overlap = jnp.transpose(ms.rem_overlap, (2, 0, 1))
-        overlap = _pad_to(_pad_to(overlap, 1, bp, 0), 2, sp, 0)
-        count = _pad_to(ms.count[:, None], 0, bp, 0)
-        return planes, prop, overlap, count
-
-    rows_planes, rows_prop, rows_overlap, rows_count = vec_inputs(state.rows)
-    cols_planes, cols_prop, cols_overlap, cols_count = vec_inputs(state.cols)
-    cell_planes = []
-    for name in _CELLS:
-        arr = getattr(state, name).astype(I32)
-        arr = _pad_to(arr, 0, bp, _CELL_FILL[name])
-        cell_planes.append(_pad_to(arr, 1, cp, _CELL_FILL[name]))
-    cell_count = _pad_to(state.cell_count[:, None], 0, bp, 0)
+    inputs, state_specs, state_shapes = _state_operands(state, d, bp, sp,
+                                                        cp)
     vec_arrays = [_pad_to(getattr(steps, n).astype(I32), 0, bp, 0)
                   for n in _STEP_VEC]
     run_arrays = [
         _pad_to(getattr(steps, n).astype(I32).reshape(b, t * r_max),
                 0, bp, 0)
         for n in _STEP_RUN]
-
-    grid = (bp // d,)
-    vec_spec = pl.BlockSpec((d, sp), lambda i: (i, 0),
-                            memory_space=pltpu.VMEM)
-    prop_spec = pl.BlockSpec((p, d, sp), lambda i: (0, i, 0),
-                             memory_space=pltpu.VMEM)
-    overlap_spec = pl.BlockSpec((w, d, sp), lambda i: (0, i, 0),
-                                memory_space=pltpu.VMEM)
-    count_spec = pl.BlockSpec((d, 1), lambda i: (i, 0),
-                              memory_space=pltpu.VMEM)
-    cell_spec = pl.BlockSpec((d, cp), lambda i: (i, 0),
-                             memory_space=pltpu.VMEM)
     step_spec = pl.BlockSpec((d, t), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
     run_spec = pl.BlockSpec((d, t * r_max), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
 
-    state_specs = ([vec_spec] * 7
-                   + [prop_spec, overlap_spec, count_spec]) * 2 \
-        + [cell_spec] * 5 + [count_spec]
-    state_shapes = (
-        [jax.ShapeDtypeStruct((bp, sp), jnp.int32)] * 7
-        + [jax.ShapeDtypeStruct((p, bp, sp), jnp.int32),
-           jax.ShapeDtypeStruct((w, bp, sp), jnp.int32),
-           jax.ShapeDtypeStruct((bp, 1), jnp.int32)]) * 2 \
-        + [jax.ShapeDtypeStruct((bp, cp), jnp.int32)] * 5 \
-        + [jax.ShapeDtypeStruct((bp, 1), jnp.int32)]
-
     out = pl.pallas_call(
         functools.partial(_step_kernel, num_steps=t, r_max=r_max,
                           num_cells=c),
-        grid=grid,
+        grid=(bp // d,),
         in_specs=state_specs + [step_spec] * len(_STEP_VEC)
         + [run_spec] * len(_STEP_RUN),
         out_specs=state_specs,
         out_shape=state_shapes,
         input_output_aliases={i: i for i in range(26)},
         interpret=interpret,
-    )(*rows_planes, rows_prop, rows_overlap, rows_count, *cols_planes,
-      cols_prop, cols_overlap, cols_count, *cell_planes, cell_count,
-      *vec_arrays, *run_arrays)
-
-    def vec_state(planes, prop, overlap, count) -> MergeState:
-        named = {n: a[:b, :s] for n, a in zip(_PLANES, planes)}
-        return MergeState(
-            valid=named["valid"] != 0,
-            length=named["length"],
-            ins_seq=named["ins_seq"],
-            ins_client=named["ins_client"],
-            rem_seq=named["rem_seq"],
-            rem_client=named["rem_client"],
-            rem_overlap=jnp.transpose(overlap, (1, 2, 0))[:b, :s],
-            pool_start=named["pool_start"],
-            prop_val=jnp.transpose(prop, (1, 2, 0))[:b, :s],
-            count=count[:b, 0],
-        )
-
-    cells = {n: a[:b, :c] for n, a in zip(_CELLS, out[20:25])}
-    return MatrixState(
-        rows=vec_state(out[0:7], out[7], out[8], out[9]),
-        cols=vec_state(out[10:17], out[17], out[18], out[19]),
-        cell_rh=cells["cell_rh"],
-        cell_ch=cells["cell_ch"],
-        cell_val=cells["cell_val"],
-        cell_seq=cells["cell_seq"],
-        cell_used=cells["cell_used"] != 0,
-        cell_count=out[25][:b, 0],
-    )
+    )(*inputs, *vec_arrays, *run_arrays)
+    return _unpack_state(out, b, s, c)
 
 
 def apply_tick_steps_best(state: MatrixState, steps) -> MatrixState:

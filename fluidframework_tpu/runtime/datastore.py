@@ -212,14 +212,18 @@ class DataStoreRuntime:
                 local_op_metadata: Any) -> None:
         envelope = message.contents
         if envelope.get("type") == "attach_channel":
-            self._process_attach(envelope, local)
-            # Stamp the channel's dirty bit on EVERY creation path (local
-            # echo and adoptions included): a channel born after the last
-            # acked summary must summarize inline — a handle stub would
-            # dangle (protocol/summary.py).
-            created = self.channels.get(envelope["address"])
-            if created is not None:
-                created.last_changed_seq = message.sequence_number
+            changed = self._process_attach(envelope, local)
+            # Stamp the dirty bit ONLY when the attach changed channel
+            # state (creation/adoption — such a channel must summarize
+            # inline; a handle stub would dangle, protocol/summary.py).
+            # An IGNORED attach (the existing channel won the race) must
+            # not stamp: whether the loser arrived is unrelated to the
+            # channel's content, and stamping here would make summaries
+            # depend on whether a replica had realized a lazy channel.
+            if changed or local:
+                created = self.channels.get(envelope["address"])
+                if created is not None:
+                    created.last_changed_seq = message.sequence_number
             return
         channel = self.get_channel(envelope["address"])
         channel.process(
@@ -228,9 +232,13 @@ class DataStoreRuntime:
             local_op_metadata,
         )
 
-    def _process_attach(self, envelope: dict, local: bool) -> None:
+    def _process_attach(self, envelope: dict, local: bool) -> bool:
+        """Returns True when the attach CHANGED state (created/adopted a
+        channel) — the caller stamps the dirty bit only then, so the
+        outcome is identical on every replica regardless of lazy
+        realization."""
         if local:
-            return
+            return False
         address = envelope["address"]
         if address in self._unrealized:
             # A lazy snapshot-loaded channel was never locally pending,
@@ -238,16 +246,16 @@ class DataStoreRuntime:
             # already exists on every replica's snapshot) — drop the
             # stale attach WITHOUT realizing (no blob fetch on the
             # op-processing path).
-            return
+            return False
         if address not in self.channels:
             self._adopt_channel(address, envelope["snapshot"])
-            return
+            return True
         if address in self._adoption_pending:
             # Datastore-race leftover: the FIRST sequenced
             # attach_channel for this id (winner's, or our own voided
             # echo) defines its state on every replica.
             self._adopt_channel(address, envelope["snapshot"])
-            return
+            return True
         # Same-id channel create race on a shared datastore: if OUR
         # create of this channel is still pending, the remote
         # attach_channel sequenced first — adopt its snapshot and void
@@ -256,6 +264,8 @@ class DataStoreRuntime:
         # ignore the later one (all replicas do).
         if self.parent.void_channel(self.id, address):
             self._adopt_channel(address, envelope["snapshot"])
+            return True
+        return False
 
     def resubmit(self, envelope: dict, local_op_metadata: Any) -> None:
         if envelope.get("type") == "attach_channel":

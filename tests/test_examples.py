@@ -5,7 +5,10 @@ import argparse
 
 import pytest
 
-from fluidframework_tpu.examples import clicker, collab_text, host, task_board
+from fluidframework_tpu.examples import (clicker, collab_text,
+                                         dice_roller, host,
+                                         table_document, task_board,
+                                         whiteboard)
 
 
 def _args(**overrides):
@@ -29,6 +32,20 @@ class TestExamples:
     def test_task_board_main(self, capsys):
         task_board.main([])
         assert "'done': True" in capsys.readouterr().out
+
+    def test_dice_roller_main(self, capsys):
+        dice_roller.main([])
+        assert "both clients see" in capsys.readouterr().out
+
+    def test_whiteboard_main(self, capsys):
+        whiteboard.main([])
+        out = capsys.readouterr().out
+        assert "2 strokes" in out
+        assert "'x': 30" in out
+
+    def test_table_document_main(self, capsys):
+        table_document.main([])
+        assert "table_document:" in capsys.readouterr().out
 
     def test_exactly_once_claiming_under_race(self):
         with host.open_document("task-board", _args()) as (
