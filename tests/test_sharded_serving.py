@@ -204,3 +204,19 @@ def test_durable_log_trims_to_checkpoint_horizon(mesh):
     third.join_all()
     with pytest.raises(ValueError):
         third.restore_host(stale, serving.durable, serving._durable_base)
+
+
+def test_durable_retention_bounds_log_without_checkpoints(mesh):
+    """An assembly nobody checkpoints must not grow its durable log with
+    total history: automatic retention keeps the tail bounded and the
+    absolute offsets consistent."""
+    serving = ShardedServing(mesh, num_docs=8, k=4, num_hosts=1,
+                             durable_retention_ticks=5)
+    serving.join_all()
+    words = np.array([(7 << 12)], np.uint32)
+    for t in range(12):
+        serving.submit(0, words, first_cseq=1 + t)
+        serving.tick()
+    assert len(serving.durable[0]) == 5
+    assert serving.durable_offset(0) == 12
+    assert serving._durable_base[0] == 7

@@ -284,3 +284,35 @@ def test_storm_tail_frame_drains_on_idle():
         sock.close()
     finally:
         front.close()
+
+
+def test_pipeline_depth_streams_acks_behind_compute():
+    """pipeline_depth > 1 with a STREAMING sender (not ack-gated): acks
+    lag by <= depth ticks while in flight, every frame is eventually
+    acked exactly once, and the map state equals the scalar replay."""
+    service, storm, merge_host = make_service(flush_threshold_docs=2)
+    storm.pipeline_depth = 3
+    docs = ["a", "b"]
+    clients = join_docs(service, docs)
+    rng = np.random.default_rng(5)
+    acks = []
+    k = 8
+    n_ticks = 6
+    for t in range(n_ticks):
+        header = {"rid": t, "docs": [[d, clients[d], 1 + t * k, 1, k]
+                                     for d in docs]}
+        payload = b"".join(make_words(rng, k).tobytes() for _ in docs)
+        # submit_frame auto-flushes at the 2-doc threshold: each frame
+        # IS one tick.
+        storm.submit_frame(acks.append, header, memoryview(payload))
+        # Acks really are deferred: exactly `depth` ticks stay in
+        # flight, and only the ticks behind them have acked.
+        assert len(storm._inflight) == min(t + 1, storm.pipeline_depth)
+        assert len(acks) == max(0, t + 1 - storm.pipeline_depth)
+    storm.flush()  # drain
+    assert storm._inflight == []
+    assert sorted(a["rid"] for a in acks) == list(range(n_ticks))
+    assert storm.stats["sequenced_ops"] == n_ticks * len(docs) * k
+    for d in docs:
+        assert (merge_host.map_entries(d, "default", "root")
+                == replay_oracle(service, d))
