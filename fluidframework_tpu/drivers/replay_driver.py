@@ -37,11 +37,29 @@ class _ReplayConnection:
 
 
 class _ReplaySnapshotStorage:
-    def __init__(self, snapshot: dict | None) -> None:
+    def __init__(self, snapshot: dict | None,
+                 blobs: dict[str, bytes] | None = None) -> None:
         self._snapshot = snapshot
+        self._blobs = blobs or {}
 
     def get_latest_snapshot(self) -> dict | None:
         return self._snapshot
+
+    def read_blob(self, blob_id: str) -> bytes:
+        return self._blobs[blob_id]
+
+    def resolve_blob(self, stub: dict) -> dict:
+        """Virtualized channel stubs in a recorded snapshot resolve from
+        the recording's blobs/ directory (content-verified), so goldens
+        anchor the virtualized wire format too."""
+        import hashlib
+
+        from .virtualized_driver import VIRTUAL_KEY
+        blob_id = stub[VIRTUAL_KEY]["id"]
+        data = self._blobs[blob_id]
+        assert hashlib.sha256(data).hexdigest() == blob_id, \
+            f"recorded blob {blob_id} content mismatch"
+        return json.loads(data.decode())
 
     def upload_snapshot(self, snapshot: dict,
                         parent: str | None = None) -> str:
@@ -71,8 +89,10 @@ class ReplayDocumentService:
 
     def __init__(self, messages: list[SequencedDocumentMessage],
                  snapshot: dict | None = None,
-                 up_to_seq: int | None = None) -> None:
-        self.storage = _ReplaySnapshotStorage(snapshot)
+                 up_to_seq: int | None = None,
+                 blobs: dict[str, bytes] | None = None) -> None:
+        self.blobs = blobs
+        self.storage = _ReplaySnapshotStorage(snapshot, blobs)
         self.delta_storage = _ReplayDeltaStorage(messages, up_to_seq)
 
     def connect(self, handler: IncomingHandler,
@@ -103,13 +123,18 @@ class FileDocumentService(ReplayDocumentService):
 
     def __init__(self, directory: str | Path,
                  up_to_seq: int | None = None) -> None:
-        super().__init__(*load_recorded(directory), up_to_seq)
+        blobs_dir = Path(directory) / "blobs"
+        blobs = ({p.name: p.read_bytes() for p in blobs_dir.iterdir()}
+                 if blobs_dir.is_dir() else None)
+        super().__init__(*load_recorded(directory), up_to_seq, blobs=blobs)
 
 
 def record_document(server, doc_id: str, directory: str | Path,
-                    snapshot: dict | None = None) -> int:
-    """Write a document's full sequenced log (and optional base snapshot)
-    as a replayable directory. Returns the number of recorded ops."""
+                    snapshot: dict | None = None,
+                    blobs: dict[str, bytes] | None = None) -> int:
+    """Write a document's full sequenced log (and optional base snapshot
+    + virtualized blobs) as a replayable directory. Returns the number
+    of recorded ops."""
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     messages = server.get_deltas(doc_id, 0)
@@ -118,4 +143,9 @@ def record_document(server, doc_id: str, directory: str | Path,
     if snapshot is not None:
         (directory / SNAPSHOT_FILE).write_text(json.dumps(
             to_wire(snapshot), indent=1, sort_keys=True))
+    if blobs:
+        blobs_dir = directory / "blobs"
+        blobs_dir.mkdir(exist_ok=True)
+        for blob_id, data in blobs.items():
+            (blobs_dir / blob_id).write_bytes(data)
     return len(messages)

@@ -10,10 +10,12 @@ sequencer: a storm frame carries a whole op batch as packed u32 words
 (4 bytes/op, protocol/codec.py storm framing); the host never touches a
 per-op Python object between the socket and the device. One flush =
 
-  1. deli      — the sequencer kernel tickets every doc's batch
-                 (full NACK/MSN/dup/gap semantics, ops/sequencer.py),
-  2. merger    — the map kernel folds the sequenced ops using the
-                 ticket seqs WITHOUT a host round-trip (fused jit),
+  1. deli      — the CLOSED-FORM storm ticket sequences every doc's
+                 batch (full NACK/MSN/dup/gap semantics collapsed to
+                 O(1)-per-doc algebra, ops/sequencer.py storm_tickets),
+  2. merger    — the Pallas VMEM map fold applies the sequenced ops
+                 using the ticket windows WITHOUT a host round-trip
+                 (fused jit, ops/map_pallas.py),
   3. scriptorium — one durable columnar record per (doc, tick)
                  (the Mongo batch-insert analog; per-op messages are
                  materialized lazily on the read path, see

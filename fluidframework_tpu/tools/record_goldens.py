@@ -162,13 +162,52 @@ def scenario_small_dds(server, doc_id):
     return [c1, c2]
 
 
+def scenario_virtualized(server, doc_id):
+    """Virtualized snapshot head: the big channel is a content-addressed
+    blob stub in the stored tree (drivers/virtualized_driver.py wire
+    format); replay resolves it from the recording's blobs/."""
+    from ..drivers.virtualized_driver import VirtualizedDocumentService
+
+    def virt():
+        return VirtualizedDocumentService(
+            LocalDocumentService(server, doc_id), inline_blob_bytes=256)
+
+    c1 = Container.create_detached(virt())
+    datastore = c1.runtime.create_datastore("default")
+    datastore.create_channel("big", SharedString.channel_type)
+    datastore.create_channel("small", SharedMap.channel_type)
+    _chan(c1, "big").insert_text(0, "virtual " * 80)
+    _chan(c1, "small").set("k", 1)
+    c1.attach()
+    c2 = Container.load(virt())
+    _chan(c2, "big").insert_text(0, "head:")
+    _chan(c1, "big").annotate_range(0, 5, {"mark": True})
+    _chan(c2, "small").set("k", 2)
+    return [c1, c2]
+
+
 SCENARIOS = {
     "string-conflict": scenario_string_conflict,
     "map-directory": scenario_map_directory,
     "matrix-grid": scenario_matrix,
     "tree-edits": scenario_tree,
     "small-dds": scenario_small_dds,
+    "virtualized-snapshot": scenario_virtualized,
 }
+
+
+def _collect_stub_blobs(server, doc_id, snapshot) -> dict | None:
+    """Blob bytes referenced by virtualized stubs in a stored snapshot —
+    recorded next to the golden so replay is self-contained."""
+    from ..drivers.virtualized_driver import VIRTUAL_KEY, is_virtual_stub
+    blobs: dict[str, bytes] = {}
+    runtime = (snapshot or {}).get("runtime") or {}
+    for ds in (runtime.get("datastores") or {}).values():
+        for ch in (ds.get("channels") or {}).values():
+            if is_virtual_stub(ch):
+                blob_id = ch[VIRTUAL_KEY]["id"]
+                blobs[blob_id] = server.read_blob(doc_id, blob_id)
+    return blobs or None
 
 
 def record_corpus(root: str | Path) -> list[str]:
@@ -181,8 +220,10 @@ def record_corpus(root: str | Path) -> list[str]:
         assert all(s == summaries[0] for s in summaries), \
             f"{name}: replicas diverged at record time"
         directory = root / name
-        ops = record_document(server, doc_id, directory,
-                              snapshot=server.get_latest_snapshot(doc_id))
+        head = server.get_latest_snapshot(doc_id)
+        ops = record_document(
+            server, doc_id, directory, snapshot=head,
+            blobs=_collect_stub_blobs(server, doc_id, head))
         (directory / "summary.json").write_text(
             json.dumps(json.loads(summaries[0]), indent=1, sort_keys=True))
         (directory / "meta.json").write_text(json.dumps(

@@ -64,7 +64,8 @@ def verify_golden(directory: str | Path, stress: bool = False,
     for cut in range(stride, last_seq, stride):
         # Summarize at the cut...
         mid = Container.load(
-            ReplayDocumentService(messages, snapshot=base, up_to_seq=cut),
+            ReplayDocumentService(messages, snapshot=base, up_to_seq=cut,
+                                  blobs=service.blobs),
             mode="read")
         snapshot = mid.summarize()
         # ...then load FROM that snapshot + trailing deltas.
