@@ -442,24 +442,42 @@ def compact(state: MergeState, min_seq: jax.Array,
                 jnp.where(keep, length, 0), mode="drop")
             length = jnp.where(keep & ~fold, chain_len, length)
             keep = keep & ~fold
-        order = jnp.cumsum(keep) - 1
-        # Dropped slots scatter out of bounds (mode="drop") so they can
-        # never clobber a kept slot's destination.
-        dst = jnp.where(keep, order, num_slots)
-        def pack(field, fill):
-            out = jnp.full_like(field, fill)
-            return out.at[dst].set(field, mode="drop")
+        # Pack kept slots to the front with ONE stable sort by the drop
+        # flag — XLA lowers TPU scatters to serialized updates, while the
+        # sort is a parallel bitonic network; every plane rides the same
+        # key as an extra sort operand.
+        num_props = s.prop_val.shape[1]
+        num_words = s.rem_overlap.shape[1]
+        sort_key = jnp.where(keep, 0, 1).astype(I32)
+        operands = (
+            [sort_key, length, s.ins_seq, s.ins_client, s.rem_seq,
+             s.rem_client, s.pool_start]
+            + [s.prop_val[:, j] for j in range(num_props)]
+            + [s.rem_overlap[:, j] for j in range(num_words)])
+        packed_ops = jax.lax.sort(tuple(operands), num_keys=1,
+                                  is_stable=True)
+        new_count = jnp.sum(keep).astype(I32)
+        live = iota < new_count
+
+        def tail_fill(arr, fill):
+            return jnp.where(live, arr, fill)
+
+        base = 7
         packed = MergeState(
-            valid=jnp.zeros_like(s.valid).at[dst].set(keep, mode="drop"),
-            length=pack(length, 0),
-            ins_seq=pack(s.ins_seq, 0),
-            ins_client=pack(s.ins_client, -1),
-            rem_seq=pack(s.rem_seq, NONE_SEQ),
-            rem_client=pack(s.rem_client, -1),
-            rem_overlap=pack(s.rem_overlap, 0),
-            pool_start=pack(s.pool_start, 0),
-            prop_val=pack(s.prop_val, 0),
-            count=jnp.sum(keep).astype(I32),
+            valid=live,
+            length=tail_fill(packed_ops[1], 0),
+            ins_seq=tail_fill(packed_ops[2], 0),
+            ins_client=tail_fill(packed_ops[3], -1),
+            rem_seq=tail_fill(packed_ops[4], NONE_SEQ),
+            rem_client=tail_fill(packed_ops[5], -1),
+            pool_start=tail_fill(packed_ops[6], 0),
+            prop_val=jnp.stack(
+                [tail_fill(packed_ops[base + j], 0)
+                 for j in range(num_props)], axis=1),
+            rem_overlap=jnp.stack(
+                [tail_fill(packed_ops[base + num_props + j], 0)
+                 for j in range(num_words)], axis=1),
+            count=new_count,
         )
         return packed
     return jax.vmap(one)(state, min_seq)
