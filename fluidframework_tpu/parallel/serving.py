@@ -15,10 +15,18 @@ assignment is the document axis of a ``jax.sharding.Mesh``:
   [B, K] arrays are mesh-sharded so no host materializes another's rows
   on its devices;
 * ONE fused device program — the same deli+merger tick the
-  single-process storm path runs (server/storm.py ``_storm_tick``) —
-  executes SPMD over the mesh; outputs stay sharded;
+  single-process storm path runs (server/storm.py ``_storm_tick`` /
+  ``_mixed_tick``) — executes SPMD over the mesh; outputs stay sharded;
 * each host harvests ONLY its own rows (addressable shards) for acks,
   durability and broadcast.
+
+ALL op families ride the one tick (the reference's single deltas
+stream — deli/lambda.ts:82 tickets every op type, scriptorium
+lambda.ts:16 consumes them uniformly): a document row can carry a map
+channel (packed u32 words), a merge-tree text channel, a matrix channel
+or a tree channel; the fused program tickets every row's batch with the
+closed-form deli and applies each family's windowed ops in the same
+XLA program, sharded over the mesh.
 
 Single-process deployments (and the virtual-CPU-mesh dryrun) run the
 identical code with simulated hosts: the per-host routing, sharded tick
@@ -29,16 +37,25 @@ global arrays assemble.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import numpy as np
 
 from ..ops import map_kernel as mk
+from ..ops import matrix_kernel as mxk
+from ..ops import mergetree_kernel as mtk
 from ..ops import sequencer as seqk
+from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType
 from . import multihost
 from .mesh import aggregate_metrics
+
+TEXT_FIELDS = ("kind", "pos", "end", "seq", "ref_seq", "client",
+               "pool_start", "text_len", "prop_key", "prop_val")
+MATRIX_FIELDS = ("target", "kind", "pos", "end", "count", "handle_base",
+                 "row", "col", "value", "seq", "ref_seq", "client")
+TREE_FIELDS = ("kind", "node", "parent", "trait", "payload")
 
 
 def _plane_rows(arr, port: "HostPort") -> np.ndarray:
@@ -95,9 +112,29 @@ class HostPort(NamedTuple):
         return self.start <= row < self.stop
 
 
+class _Sub(NamedTuple):
+    """One admitted per-row submission awaiting the tick (and, after it,
+    the payload of the row's durable record — the replay source)."""
+
+    family: str        # "map" | "text" | "matrix" | "tree"
+    planes: Any        # words u32[n] (map) or {field: i32[n]} planes
+    count: int
+    cseq0: int
+    ref: int
+    client: int        # sequencer client slot
+    text: str          # inserted text blob (text family)
+    pool_base: int     # row pool length before this submission's append
+
+
 class ShardedServing:
     """N serving hosts over one docs-sharded mesh, running the fused
-    sequencer+map storm tick as a single SPMD program.
+    sequencer + all-family storm tick as a single SPMD program.
+
+    Every document row has a sequencer lane set; rows carrying map
+    channels use the packed-word :meth:`submit`, text rows
+    :meth:`submit_text`, matrix rows :meth:`submit_matrix`, tree rows
+    :meth:`submit_tree` — one submission per row per tick (per-doc total
+    order), all families sequenced and applied by ONE device program.
 
     Failure story (kafka-service/checkpointManager.ts:24 analog): every
     tick appends one durable columnar record per submitted row to
@@ -112,13 +149,19 @@ class ShardedServing:
     def __init__(self, mesh: jax.sharding.Mesh, num_docs: int, k: int,
                  num_hosts: int, num_clients: int = 2,
                  map_slots: int = 32,
-                 durable_retention_ticks: int = 1024) -> None:
+                 durable_retention_ticks: int = 1024,
+                 text_slots: int = 0, text_k: int = 0, text_props: int = 4,
+                 matrix_vec_slots: int = 0, matrix_cell_slots: int = 0,
+                 matrix_k: int = 0,
+                 tree_slots: int = 0, tree_k: int = 0,
+                 pipeline_depth: int = 0) -> None:
         if num_docs % mesh.devices.size:
             raise ValueError("num_docs must divide over the mesh")
         self.mesh = mesh
         self.num_docs = num_docs
         self.k = k
         self.map_slots = map_slots
+        self.num_clients = num_clients
         # The doc rows THIS PROCESS feeds and harvests. Single-process
         # (simulated hosts): the full range. Real multi-process launch:
         # this process's contiguous slice — every array below assembles
@@ -130,20 +173,54 @@ class ShardedServing:
         # must not allocate the full global [B, ...] arrays just to slice
         # out its own rows.
         b_local = self.local_hi - self.local_lo
-        self.seq_state = multihost.feed(
-            mesh, jax.tree.map(np.asarray,
-                               seqk.init_state(b_local, num_clients + 1)),
-            global_batch=num_docs)
-        self.map_state = multihost.feed(
-            mesh, jax.tree.map(np.asarray,
-                               mk.init_state(b_local, map_slots)),
-            global_batch=num_docs)
+        lift = lambda tree: multihost.feed(
+            mesh, jax.tree.map(np.asarray, tree), global_batch=num_docs)
+        self.seq_state = lift(seqk.init_state(b_local, num_clients + 1))
+        self.map_state = lift(mk.init_state(b_local, map_slots))
+        # Optional channel families — rows share the document axis: row i
+        # of every family state IS document i, so one mesh sharding (and
+        # one host range) covers every family (the reference's
+        # any-document-any-channel contract).
+        overlap_words = mtk.overlap_words_for(num_clients)
+        self.text_slots = text_slots
+        self.text_k = text_k or (k if text_slots else 0)
+        self.merge_state = lift(mtk.init_state(
+            b_local, text_slots, text_props,
+            overlap_words)) if text_slots else None
+        self.matrix_vec_slots = matrix_vec_slots
+        self.matrix_cell_slots = matrix_cell_slots
+        self.matrix_k = matrix_k or (k if matrix_vec_slots else 0)
+        self.matrix_state = lift(mxk.init_state(
+            b_local, matrix_vec_slots, matrix_cell_slots,
+            overlap_words)) if matrix_vec_slots else None
+        self.tree_slots = tree_slots
+        self.tree_k = tree_k or (k if tree_slots else 0)
+        self.tree_state = lift(tk.init_state(
+            b_local, tree_slots)) if tree_slots else None
+        self._mixed = bool(text_slots or matrix_vec_slots or tree_slots)
+        # Host-side text pools + capacity high-water marks for OWNED rows
+        # (device overflow is silent by kernel contract, so admission
+        # checks worst-case growth BEFORE the tick: 2 slots per text op,
+        # 2 vector slots + 1 cell slot per matrix op).
+        local_rows = range(self.local_lo, self.local_hi)
+        self.text_pool = ({row: "" for row in local_rows}
+                          if text_slots else {})
+        self._text_high = ({row: 0 for row in local_rows}
+                           if text_slots else {})
+        self._mx_high = ({row: [0, 0, 0] for row in local_rows}
+                         if matrix_vec_slots else {})  # [rows, cols, cells]
+        # ONE handle counter per doc SHARED by both axes (the
+        # deterministic in-sequence-order rule of dds/matrix.py that
+        # mxk.HandleAllocator mirrors).
+        self._mx_handles = ({row: 0 for row in local_rows}
+                            if matrix_vec_slots else {})
         # Contiguous per-host ranges — what multihost.local_docs reports
         # per process in a real multi-host launch.
         bounds = np.linspace(0, num_docs, num_hosts + 1).astype(int)
         self.hosts = [HostPort(i, int(bounds[i]), int(bounds[i + 1]))
                       for i in range(num_hosts)]
-        self._pending: list[dict] = [dict() for _ in range(num_hosts)]
+        self._pending: list[dict[int, _Sub]] = [dict()
+                                                for _ in range(num_hosts)]
         # Durable columnar tick records per row (the scriptorium leg of
         # the storm pipeline): the replay source for host failover.
         # Offsets in checkpoints are ABSOLUTE record counts; trim_durable
@@ -157,7 +234,16 @@ class ShardedServing:
         # unbounded-host-memory failure mode the soak tests guard
         # against). Checkpoint within the horizon, or trim explicitly.
         self.durable_retention_ticks = max(1, durable_retention_ticks)
-
+        #: row -> overflow count from the last tick's tree leg (rank
+        #: space exhausted — the host must re-rank; tests size to avoid).
+        self.last_tree_overflow: dict[int, int] = {}
+        # Depth-N harvest pipeline (the StormController lesson): a tick's
+        # readbacks start copying at enqueue and are harvested only after
+        # N later ticks are in flight, hiding the device→host round trip
+        # under compute. Depth 0 = synchronous (tick returns its own
+        # harvest — what the failover tests rely on).
+        self.pipeline_depth = max(0, pipeline_depth)
+        self._inflight: list[dict] = []
 
     def route(self, row: int) -> HostPort:
         """The owning host of a document row (front-door routing)."""
@@ -168,13 +254,17 @@ class ShardedServing:
 
     # -- front door ------------------------------------------------------------
 
-    def join_all(self, slot: int = 0) -> None:
+    def join_all(self, slot: int = 0, slots=None) -> None:
         """Sequence a CLIENT_JOIN on every document (through the real
-        sequencer kernel, not state surgery)."""
+        sequencer kernel, not state surgery). ``slots`` joins several
+        client lanes per doc in one batch — text/matrix rows with
+        multiple writers need every writer's lane active."""
+        lanes = tuple(slots) if slots is not None else (slot,)
         b_local = self.local_hi - self.local_lo
         ops = seqk.make_op_batch(
-            [[dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=slot,
-                   timestamp=1)] for _ in range(b_local)], b_local, 1)
+            [[dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=s,
+                   timestamp=1) for s in lanes]
+             for _ in range(b_local)], b_local, len(lanes))
         ops = multihost.feed(self.mesh, jax.tree.map(np.asarray, ops),
                              global_batch=self.num_docs)
         # process_batch is already jitted; wrapping it again would discard
@@ -182,19 +272,160 @@ class ShardedServing:
         self.seq_state, out = seqk.process_batch(self.seq_state, ops)
         jax.block_until_ready(out.kind)
 
-    def submit(self, row: int, words: np.ndarray, first_cseq: int,
-               ref_seq: int = 1) -> None:
-        """One doc's op batch into its OWNING host's buffer — a frame for
-        a foreign row is a routing bug and raises (the bus partition
-        would never deliver it here)."""
+    def _admit(self, row: int, sub: _Sub) -> None:
+        """Common admission: ownership, one-sub-per-row-per-tick, family
+        capacity bookkeeping, pool append. The replay path re-admits
+        recorded subs through here so recovery is the ingest path."""
         port = self.route(row)
-        if len(words) > self.k:
-            raise ValueError(
-                f"batch of {len(words)} ops exceeds tick width {self.k}")
         pending = self._pending[port.host_id]
         if row in pending:
             raise ValueError(f"row {row} already pending this tick")
-        pending[row] = (words, first_cseq, ref_seq)
+        if sub.family == "text":
+            pool = self.text_pool[row]
+            if len(pool) != sub.pool_base:
+                raise ValueError(
+                    f"row {row}: pool length {len(pool)} != submission "
+                    f"base {sub.pool_base} (durable replay out of order?)")
+            high = self._text_high[row] + 2 * sub.count
+            if high > self.text_slots:
+                raise ValueError(
+                    f"row {row}: worst-case {high} segment slots exceeds "
+                    f"{self.text_slots}; run compact_text() first")
+            self._text_high[row] = high
+            self.text_pool[row] = pool + sub.text
+        elif sub.family == "matrix":
+            high = self._mx_high[row]
+            planes = sub.planes
+            # Pre-encoded planes (bulk path / failover replay) carry
+            # their own handle_bases: advance the row's allocator past
+            # them so later submit_matrix allocations never collide.
+            ins = (((planes["target"] == mxk.MX_ROWS)
+                    | (planes["target"] == mxk.MX_COLS))
+                   & (planes["kind"] == mtk.MT_INSERT))[:sub.count]
+            if ins.any():
+                tops = (planes["handle_base"][:sub.count]
+                        + np.maximum(planes["count"][:sub.count], 1))[ins]
+                self._mx_handles[row] = max(self._mx_handles[row],
+                                            int(tops.max()))
+            n_row = int(np.sum((planes["target"] == mxk.MX_ROWS)[:sub.count]))
+            n_col = int(np.sum((planes["target"] == mxk.MX_COLS)[:sub.count]))
+            n_cell = sub.count - n_row - n_col
+            grown = [high[0] + 2 * n_row, high[1] + 2 * n_col,
+                     high[2] + n_cell]
+            if (grown[0] > self.matrix_vec_slots
+                    or grown[1] > self.matrix_vec_slots
+                    or grown[2] > self.matrix_cell_slots):
+                raise ValueError(
+                    f"row {row}: matrix capacity exceeded {grown} vs "
+                    f"({self.matrix_vec_slots}, {self.matrix_vec_slots}, "
+                    f"{self.matrix_cell_slots})")
+            self._mx_high[row] = grown
+        pending[row] = sub
+
+    def submit(self, row: int, words: np.ndarray, first_cseq: int,
+               ref_seq: int = 1, client_slot: int = 0) -> None:
+        """One map row's packed-word op batch into its OWNING host's
+        buffer — a frame for a foreign row is a routing bug and raises
+        (the bus partition would never deliver it here)."""
+        if len(words) > self.k:
+            raise ValueError(
+                f"batch of {len(words)} ops exceeds tick width {self.k}")
+        self._admit(row, _Sub("map", np.asarray(words, np.uint32),
+                              len(words), first_cseq, ref_seq,
+                              client_slot, "", 0))
+
+    def submit_text(self, row: int, ops: list[dict], first_cseq: int,
+                    ref_seq: int = 1, client_slot: int = 0) -> None:
+        """One text row's merge-tree op batch (mtk.MT_* dicts; inserts
+        carry ``text``). The owning host appends inserted text to the
+        row's pool and fills pool_start/text_len; the device assigns seqs
+        at the tick (ops carry NO seq — the ticket does)."""
+        if self.merge_state is None:
+            raise ValueError("assembly built without text_slots")
+        if len(ops) > self.text_k:
+            raise ValueError(f"{len(ops)} text ops exceed tick width "
+                             f"{self.text_k}")
+        pool_base = len(self.text_pool[row])
+        blob: list[str] = []
+        offset = 0
+        encoded = []
+        for op in ops:
+            op = dict(op)
+            if op.get("kind", mtk.MT_INSERT) == mtk.MT_INSERT:
+                text = op.pop("text", "")
+                op.setdefault("pool_start", pool_base + offset)
+                op.setdefault("text_len", len(text))
+                blob.append(text)
+                offset += len(text)
+            op.setdefault("ref_seq", ref_seq)
+            op.setdefault("client", client_slot)
+            encoded.append(op)
+        planes = {f: np.array([op.get(f, 0) for op in encoded], np.int32)
+                  for f in TEXT_FIELDS}
+        self._admit(row, _Sub("text", planes, len(ops), first_cseq,
+                              ref_seq, client_slot, "".join(blob),
+                              pool_base))
+
+    def submit_matrix(self, row: int, ops: list[dict], first_cseq: int,
+                      ref_seq: int = 1, client_slot: int = 0) -> None:
+        """One matrix row's op batch (mxk fields; vector inserts without
+        ``handle_base`` draw from the row's deterministic in-sequence
+        handle counter, mirroring dds/matrix.py)."""
+        if self.matrix_state is None:
+            raise ValueError("assembly built without matrix slots")
+        if len(ops) > self.matrix_k:
+            raise ValueError(f"{len(ops)} matrix ops exceed tick width "
+                             f"{self.matrix_k}")
+        encoded = []
+        for op in ops:
+            op = dict(op)
+            target = op.get("target", mxk.MX_CELL)
+            if (target in (mxk.MX_ROWS, mxk.MX_COLS)
+                    and op.get("kind", 0) == mtk.MT_INSERT
+                    and "handle_base" not in op):
+                op["handle_base"] = self._mx_handles[row]
+                self._mx_handles[row] += op.get("count", 1)
+            op.setdefault("ref_seq", ref_seq)
+            op.setdefault("client", client_slot)
+            encoded.append(op)
+        planes = {f: np.array([op.get(f, 0) for op in encoded], np.int32)
+                  for f in MATRIX_FIELDS}
+        self._admit(row, _Sub("matrix", planes, len(ops), first_cseq,
+                              ref_seq, client_slot, "", 0))
+
+    def submit_tree(self, row: int, ops: list[dict], first_cseq: int,
+                    ref_seq: int = 1, client_slot: int = 0) -> None:
+        """One tree row's op batch (tk.TREE_* dicts; node-slot management
+        is the submitter's, as in the tree channel contract)."""
+        if self.tree_state is None:
+            raise ValueError("assembly built without tree_slots")
+        if len(ops) > self.tree_k:
+            raise ValueError(f"{len(ops)} tree ops exceed tick width "
+                             f"{self.tree_k}")
+        planes = {f: np.array([op.get(f, 0) for op in ops], np.int32)
+                  for f in TREE_FIELDS}
+        self._admit(row, _Sub("tree", planes, len(ops), first_cseq,
+                              ref_seq, client_slot, "", 0))
+
+    def submit_planes(self, row: int, family: str, planes: dict,
+                      count: int, first_cseq: int, ref_seq: int = 1,
+                      client_slot: int = 0, text: str = "",
+                      pool_base: int | None = None) -> None:
+        """Pre-encoded columnar admission — the decoded-frame fast path
+        (the storm-frame analog for the rich op families) and the replay
+        path's re-admission hook. ``planes`` carries the family's field
+        arrays (text planes use ABSOLUTE pool_starts; ``text`` is the
+        blob those offsets expect appended at ``pool_base``, default the
+        row pool's current length)."""
+        width = {"map": self.k, "text": self.text_k,
+                 "matrix": self.matrix_k, "tree": self.tree_k}[family]
+        if count > width:
+            raise ValueError(
+                f"{count} {family} ops exceed tick width {width}")
+        if pool_base is None:
+            pool_base = len(self.text_pool[row]) if family == "text" else 0
+        self._admit(row, _Sub(family, planes, count, first_cseq, ref_seq,
+                              client_slot, text, pool_base))
 
     # -- the sharded tick ------------------------------------------------------
 
@@ -202,65 +433,187 @@ class ShardedServing:
         """Assemble every host's contribution, run the fused SPMD tick,
         and return each host's harvest of ITS OWN rows:
         {host_id: {row: (n_seq, first_seq, last_seq)}}."""
-        from ..server.storm import _storm_tick
+        from ..server import storm as storm_mod
+        from ..server.storm import _mixed_tick, _storm_tick
 
-        b, k = self.num_docs, self.k
-        slot = np.zeros(b, np.int32)
-        cseq0 = np.zeros(b, np.int32)
-        ref = np.zeros(b, np.int32)
-        counts = np.zeros(b, np.int32)
-        words_full = np.zeros((b, k), np.uint32)
-        gather = np.arange(b, dtype=np.int32)
+        b = self.num_docs
+        # Host buffers build at LOCAL size (this process's doc rows) —
+        # never the global [B, ...] shape — exactly like the initial
+        # states: each process feeds only its multihost.local_docs slice.
+        lo, hi = self.local_lo, self.local_hi
+        b_local = hi - lo
+        slot = np.zeros(b_local, np.int32)
+        cseq0 = np.zeros(b_local, np.int32)
+        ref = np.zeros(b_local, np.int32)
+        seq_counts = np.zeros(b_local, np.int32)
+        map_words = np.zeros((b_local, self.k), np.uint32)
+        map_counts = np.zeros(b_local, np.int32)
+        # One packed i32[B_local, F, K] plane stack per configured family
+        # (the tick's one-transfer-per-family feed; field orders pinned
+        # by storm.TEXT_PACK/MATRIX_PACK/TREE_PACK, index 0 = valid).
+        pack_fields = {"text": storm_mod.TEXT_PACK,
+                       "matrix": storm_mod.MATRIX_PACK,
+                       "tree": storm_mod.TREE_PACK}
+        widths = {"text": self.text_k, "matrix": self.matrix_k,
+                  "tree": self.tree_k}
+        enabled = {"text": self.merge_state is not None,
+                   "matrix": self.matrix_state is not None,
+                   "tree": self.tree_state is not None}
+        fam_pack = {
+            name: (np.zeros((b_local, len(pack_fields[name]),
+                             widths[name]), np.int32)
+                   if enabled[name] else None)
+            for name in pack_fields}
+
         submitted: list[tuple[int, int]] = []  # (host, row)
         records: dict[int, dict] = {}
         for port in self.hosts:
-            for row, (words, first_cseq, ref_seq) in \
-                    self._pending[port.host_id].items():
-                counts[row] = len(words)
-                words_full[row, :len(words)] = words
-                cseq0[row] = first_cseq
-                ref[row] = ref_seq
+            for row, sub in self._pending[port.host_id].items():
+                if not lo <= row < hi:
+                    raise ValueError(
+                        f"row {row} outside this process's doc range "
+                        f"[{lo}, {hi}) cannot be fed from here")
+                r = row - lo
+                n = sub.count
+                seq_counts[r] = n
+                cseq0[r] = sub.cseq0
+                ref[r] = sub.ref
+                slot[r] = sub.client
+                if sub.family == "map":
+                    map_counts[r] = n
+                    map_words[r, :n] = sub.planes
+                else:
+                    pack = fam_pack[sub.family]
+                    pack[r, 0, :n] = 1
+                    for i, f in enumerate(pack_fields[sub.family][1:]):
+                        pack[r, i + 1, :n] = sub.planes[f]
                 submitted.append((port.host_id, row))
-                records[row] = dict(words=np.array(words, np.uint32),
-                                    cseq0=first_cseq, ref=ref_seq)
+                rec_planes = (np.array(sub.planes, np.uint32)
+                              if sub.family == "map"
+                              else {f: p.copy()
+                                    for f, p in sub.planes.items()})
+                records[row] = dict(
+                    family=sub.family, planes=rec_planes,
+                    count=n, cseq0=sub.cseq0, ref=sub.ref,
+                    client=sub.client, text=sub.text,
+                    pool_base=sub.pool_base,
+                    # Back-compat alias for the map-words record shape
+                    # (same object — not a second copy).
+                    words=(rec_planes if sub.family == "map" else None))
 
-        lo, hi = self.local_lo, self.local_hi
-        put = lambda a: multihost.feed(self.mesh, a[lo:hi],
-                                       global_batch=b)
-        (self.seq_state, self.map_state, n_seq, first, last,
-         _msn) = _storm_tick(
-            self.seq_state, self.map_state, put(slot), put(cseq0),
-            put(ref), put(np.full(b, now, np.int32)), put(counts),
-            put(gather), put(words_full), put(counts))
+        put = lambda a: multihost.feed(self.mesh, a, global_batch=b)
+        tree_overflow = None
+        if not self._mixed:
+            gather = np.arange(lo, hi, dtype=np.int32)
+            (self.seq_state, self.map_state, n_seq, first, last,
+             _msn) = _storm_tick(
+                self.seq_state, self.map_state, put(slot), put(cseq0),
+                put(ref), put(np.full(b_local, now, np.int32)),
+                put(seq_counts), put(gather), put(map_words),
+                put(map_counts))
+        else:
+            scalars = np.stack(
+                [slot, cseq0, ref, np.full(b_local, now, np.int32),
+                 seq_counts, map_counts], axis=1)
+            (self.seq_state, self.map_state, self.merge_state,
+             self.matrix_state, self.tree_state, n_seq, first, last,
+             _msn, tree_overflow) = _mixed_tick(
+                self.seq_state, self.map_state, self.merge_state,
+                self.matrix_state, self.tree_state,
+                put(scalars), put(map_words),
+                put(fam_pack["text"]) if enabled["text"] else None,
+                put(fam_pack["matrix"]) if enabled["matrix"] else None,
+                put(fam_pack["tree"]) if enabled["tree"] else None)
         # The device program has the batch; only now may buffers drop
         # (at-least-once: an assembly failure above must keep them).
         for port in self.hosts:
             self._pending[port.host_id] = {}
+        # Pipeline: start this tick's device→host readback copies at
+        # enqueue; harvest only once ``pipeline_depth`` later ticks are
+        # in flight behind it (depth 0 = synchronous, the default).
+        rec = dict(submitted=submitted, records=records,
+                   out=(n_seq, first, last), tree_overflow=tree_overflow)
+        probes = rec["out"] + ((tree_overflow,)
+                               if tree_overflow is not None else ())
+        for arr in probes:
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        self._inflight.append(rec)
+        if len(self._inflight) > self.pipeline_depth:
+            return self._harvest_rec(self._inflight.pop(0))
+        return {port.host_id: {} for port in self.hosts}
 
+    def flush(self) -> list[dict[int, dict[int, tuple[int, int, int]]]]:
+        """Drain the harvest pipeline; one {host: {row: ack}} dict per
+        outstanding tick, oldest first (acks must not collapse across
+        ticks — a client matches each to its frame)."""
+        out = []
+        while self._inflight:
+            out.append(self._harvest_rec(self._inflight.pop(0)))
+        return out
+
+    def _harvest_rec(self, rec: dict
+                     ) -> dict[int, dict[int, tuple[int, int, int]]]:
         # Shard-local harvest: each host reads ONLY the rows resident on
         # ITS addressable devices — a multi-process launch cannot (and
         # must not) materialize the global array.
+        n_seq, first, last = rec["out"]
+        records = rec["records"]
         n_seq_l = _addressable_rows(n_seq)
         first_l = _addressable_rows(first)
         last_l = _addressable_rows(last)
         harvest: dict[int, dict[int, tuple[int, int, int]]] = {
             port.host_id: {} for port in self.hosts}
-        for host_id, row in submitted:
+        for host_id, row in rec["submitted"]:
             n_ok = n_seq_l[row]
             harvest[host_id][row] = ((n_ok, first_l[row], last_l[row])
                                      if n_ok > 0 else (0, 0, 0))
             # scriptorium: the durable columnar record for this (row,
             # tick) — the failover replay source.
-            rec = records[row]
-            rec.update(n_seq=n_ok, first=first_l[row], last=last_l[row])
+            row_rec = records[row]
+            row_rec.update(n_seq=n_ok, first=first_l[row],
+                           last=last_l[row])
             log = self.durable.setdefault(row, [])
-            log.append(rec)
+            log.append(row_rec)
             overflow = len(log) - self.durable_retention_ticks
             if overflow > 0:
                 del log[:overflow]
                 self._durable_base[row] = (
                     self._durable_base.get(row, 0) + overflow)
+        if rec["tree_overflow"] is not None:
+            self.last_tree_overflow = {
+                row: n
+                for row, n in _addressable_rows(
+                    rec["tree_overflow"]).items() if n > 0}
+            if self.last_tree_overflow:
+                raise RuntimeError(
+                    f"tree rank overflow on rows "
+                    f"{sorted(self.last_tree_overflow)}; host re-rank "
+                    "required (size tree ranks for the tick width)")
         return harvest
+
+    # -- capacity maintenance --------------------------------------------------
+
+    def compact_text(self) -> None:
+        """Zamboni over every text row (mtk.compact at each doc's device
+        MSN — the collab-window floor the sequencer maintains), then
+        refresh the host's admission high-water marks from the REAL
+        device slot counts."""
+        if self.merge_state is None:
+            raise ValueError("assembly built without text_slots")
+        self.merge_state = mtk.compact(self.merge_state,
+                                       self.seq_state.msn)
+        for row, count in _addressable_rows(self.merge_state.count).items():
+            if row in self._text_high:
+                self._text_high[row] = int(count)
+        # Submissions admitted but not yet ticked kept their worst-case
+        # charge against the PRE-compact mark; re-charge them or the
+        # freed headroom double-counts (silent device overflow).
+        for pending in self._pending:
+            for row, sub in pending.items():
+                if sub.family == "text":
+                    self._text_high[row] += 2 * sub.count
 
     def durable_offset(self, row: int) -> int:
         """Absolute record count of a row's durable log (checkpoint
@@ -284,22 +637,37 @@ class ShardedServing:
 
     # -- failover (checkpointManager.ts:24 analog) -----------------------------
 
+    def _family_states(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"seq": self.seq_state, "map": self.map_state}
+        if self.merge_state is not None:
+            out["text"] = self.merge_state
+        if self.matrix_state is not None:
+            out["matrix"] = self.matrix_state
+        if self.tree_state is not None:
+            out["tree"] = self.tree_state
+        return out
+
     def checkpoint_host(self, host_id: int) -> dict:
-        """Durable snapshot of one host's rows: sequencer scalars +
-        client lanes + map planes + the per-row durable-log offset. The
-        checkpoint/offset pair is consistent BY CONSTRUCTION when taken
-        between ticks (tick() is the only writer)."""
+        """Durable snapshot of one host's rows across EVERY family state
+        (+ text pools + per-row durable-log offsets). The checkpoint/
+        offset pair is consistent BY CONSTRUCTION when taken between
+        ticks (tick() is the only writer)."""
+        self.flush()  # durable log must cover every in-flight tick
         port = self.hosts[host_id]
-        seq_rows = {f: _plane_rows(getattr(self.seq_state, f), port)
-                    for f in self.seq_state._fields}
-        map_rows = {f: _plane_rows(getattr(self.map_state, f), port)
-                    for f in self.map_state._fields}
+        states = {
+            name: jax.tree.map(lambda a: _plane_rows(a, port), state)
+            for name, state in self._family_states().items()}
         return {
             "host_id": host_id,
             "start": port.start,
             "stop": port.stop,
-            "seq": seq_rows,
-            "map": map_rows,
+            "states": states,
+            # Back-compat field-dict views of the two always-on families.
+            "seq": dict(states["seq"]._asdict()),
+            "map": dict(states["map"]._asdict()),
+            "text_pool": {row: self.text_pool[row]
+                          for row in range(port.start, port.stop)
+                          if row in self.text_pool},
             "log_offsets": {row: self.durable_offset(row)
                             for row in range(port.start, port.stop)},
         }
@@ -326,7 +694,8 @@ class ShardedServing:
                      durable: dict[int, list[dict]],
                      durable_base: dict[int, int]) -> None:
         """Install a dead host's checkpointed rows into THIS assembly and
-        replay its durable-log tail through the REAL tick path. The
+        replay its durable-log tail through the REAL tick path — map,
+        text, matrix and tree records alike (one deltas stream). The
         restored sequencer counters resume seq assignment exactly where
         the log ends — no sequence regression — and clientSeq dedup makes
         an overlapping replay idempotent. Submissions route via the
@@ -338,12 +707,55 @@ class ShardedServing:
         idx = np.arange(lo, hi)
 
         def write(state, rows):
-            return type(state)(**{
-                f: getattr(state, f).at[idx].set(rows[f])
-                for f in state._fields})
+            return jax.tree.map(lambda a, r: a.at[idx].set(r), state, rows)
 
-        self.seq_state = write(self.seq_state, checkpoint["seq"])
-        self.map_state = write(self.map_state, checkpoint["map"])
+        states = checkpoint.get("states")
+        if states is None:  # legacy two-family checkpoint shape
+            states = {"seq": type(self.seq_state)(**checkpoint["seq"]),
+                      "map": type(self.map_state)(**checkpoint["map"])}
+        self.seq_state = write(self.seq_state, states["seq"])
+        self.map_state = write(self.map_state, states["map"])
+        if "text" in states:
+            self.merge_state = write(self.merge_state, states["text"])
+        if "matrix" in states:
+            self.matrix_state = write(self.matrix_state, states["matrix"])
+            # Rebuild the host-side handle allocators + admission marks
+            # from the RESTORED device planes: the next free handle is
+            # one past the highest handle any live-or-tombstoned vector
+            # run covers (handle_base lives in pool_start, run length in
+            # length; axes never recycle handles), and the admission
+            # high-water is the real slot count.
+            mx = states["matrix"]
+            for offset in range(hi - lo):
+                row = lo + offset
+                if row not in self._mx_handles:
+                    continue
+                tops = [0]
+                for axis in (mx.rows, mx.cols):
+                    valid = np.asarray(axis.valid[offset])
+                    if valid.any():
+                        tops.append(int(
+                            (np.asarray(axis.pool_start[offset])
+                             + np.asarray(axis.length[offset]))[valid]
+                            .max()))
+                self._mx_handles[row] = max(tops)
+                self._mx_high[row] = [
+                    int(np.asarray(mx.rows.count[offset])),
+                    int(np.asarray(mx.cols.count[offset])),
+                    int(np.asarray(mx.cell_count[offset]))]
+        if "tree" in states:
+            self.tree_state = write(self.tree_state, states["tree"])
+        for row, pool in checkpoint.get("text_pool", {}).items():
+            self.text_pool[row] = pool
+        if self.merge_state is not None and checkpoint.get("text_pool"):
+            # Admission high-water = the restored rows' REAL device slot
+            # counts (exact: the worst-case estimate only ever overshoots
+            # the count plane).
+            counts = _addressable_rows(self.merge_state.count)
+            for row in checkpoint["text_pool"]:
+                if row in self._text_high and row in counts:
+                    self._text_high[row] = counts[row]
+
         # Replay the tail one logged tick at a time (records of one row
         # are strictly ordered; distinct rows may interleave freely).
         def tail_of(row: int) -> list[dict]:
@@ -365,9 +777,21 @@ class ShardedServing:
                 tail = tail_of(row)
                 if i < len(tail):
                     rec = tail[i]
-                    self.submit(row, rec["words"], rec["cseq0"],
-                                rec["ref"])
+                    family = rec.get("family", "map")
+                    if family == "map":
+                        self.submit(row, rec.get("planes", rec["words"]),
+                                    rec["cseq0"], rec["ref"],
+                                    rec.get("client", 0))
+                    else:
+                        # Recorded planes carry absolute pool_starts;
+                        # _admit re-verifies the pool base and re-extends
+                        # the pool with the recorded blob.
+                        self.submit_planes(
+                            row, family, rec["planes"], rec["count"],
+                            rec["cseq0"], rec["ref"], rec["client"],
+                            text=rec["text"], pool_base=rec["pool_base"])
             self.tick()
+        self.flush()
 
     # -- observability ---------------------------------------------------------
 
@@ -397,6 +821,19 @@ class ShardedServing:
             for offset in range(data.shape[0]):
                 out[start + offset] = data[offset]
         return out
+
+    def text_of(self, row: int) -> str:
+        """Materialized visible text of one OWNED text row (host copy of
+        the row's segment table + the host pool) — the verification
+        surface for text serving."""
+        if self.merge_state is None:
+            raise ValueError("assembly built without text_slots")
+        port = HostPort(-1, row, row + 1)
+        state1 = jax.tree.map(lambda a: _plane_rows(a, port),
+                              self.merge_state)
+        pool = mtk.TextPool(1)
+        pool.append(0, self.text_pool[row])
+        return mtk.materialize(state1, pool, 0)
 
 
 __all__ = ["ShardedServing", "HostPort"]

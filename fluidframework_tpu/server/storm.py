@@ -46,8 +46,11 @@ import numpy as np
 
 from ..ops import map_kernel as mk
 from ..ops import map_pallas as mp
+from ..ops import matrix_kernel as mxk
+from ..ops import mergetree_kernel as mtk
 from ..ops import opcodes as oc
 from ..ops import sequencer as seqk
+from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from .kernel_host import KernelSequencerHost, _next_pow2
 from .merge_host import ChannelKey, KernelMergeHost
@@ -60,6 +63,116 @@ class _Frame(NamedTuple):
     rid: Any
     docs: list[tuple[str, str, int, int, int]]  # (doc, client, cseq0, ref, n)
     words: list[np.ndarray]
+
+
+def _map_leg(map_state: mk.MapState, words, lo, hi, seq0_for):
+    """Windowed map LWW fold: the merger leg of the fused tick. ``lo``/
+    ``hi`` bound each row's sequenced op window within ``words``;
+    ``seq0_for`` is the row's doc seq before the first windowed op."""
+    k = words.shape[1]
+    if mp.default_interpret():
+        iota = jnp.arange(k, dtype=I32)[None, :]
+        words_u = words.astype(jnp.uint32)
+        sequenced = (iota >= lo[:, None]) & (iota < hi[:, None])
+        map_ops = mk.MapOpBatch(
+            valid=sequenced,
+            kind=(words_u & 3).astype(I32),
+            slot=((words_u >> 2) & 0x3FF).astype(I32),
+            value=((words_u >> 12) & 0xFFFFF).astype(I32),
+            seq=seq0_for[:, None] + 1 + iota - lo[:, None],
+        )
+        return jax.vmap(mk._apply_doc)(map_state, map_ops)
+    # VMEM LWW fold (ops/map_pallas.py): HBM traffic = planes +
+    # 4 bytes/op; the [B, K, S] dense-winner intermediates of the
+    # XLA path were the fused tick's dominant cost.
+    return mp.fold_words(map_state, words, lo, hi, seq0_for)
+
+
+def _ticket_window(counts, k: int, dups, n_seq_doc, seq_before):
+    """Per-op (in_window, seq) planes from the closed-form ticket: ops
+    [dups, dups+n_seq) of each row's batch sequence as seq_before+1…"""
+    lo = dups
+    hi = jnp.minimum(dups + n_seq_doc, counts)
+    iota = jnp.arange(k, dtype=I32)[None, :]
+    in_win = (iota >= lo[:, None]) & (iota < hi[:, None])
+    seq = seq_before[:, None] + 1 + iota - lo[:, None]
+    return in_win, seq
+
+
+# Packed-plane field orders for the mixed tick's one-array-per-family
+# feed (index 0 is always the submission-valid plane; ``seq`` planes are
+# OMITTED — the on-device ticket assigns them).
+TEXT_PACK = ("valid", "kind", "pos", "end", "ref_seq", "client",
+             "pool_start", "text_len", "prop_key", "prop_val")
+MATRIX_PACK = ("valid", "target", "kind", "pos", "end", "count",
+               "handle_base", "row", "col", "value", "ref_seq", "client")
+TREE_PACK = ("valid", "kind", "node", "parent", "trait", "payload")
+#: Columns of the [B, 6] per-doc scalar pack.
+SCALAR_PACK = ("slot", "cseq0", "ref", "ts", "seq_counts", "map_counts")
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def _mixed_tick(seq_state: seqk.SequencerState,
+                map_state, merge_state, matrix_state, tree_state,
+                scalars, map_words, text_pack, matrix_pack, tree_pack):
+    """ALL-FAMILY fused tick: one closed-form deli ticket sequences every
+    document's batch, then EACH channel family applies its rows' windowed
+    ops in the same device program — map (LWW fold), merge-tree (segment
+    table scan), matrix (two-axis vectors + cells) and tree — exactly the
+    reference's one-deltas-stream-for-all-op-types contract
+    (deli/lambda.ts:82, scriptorium/lambda.ts:16) with the family routing
+    done by per-family valid planes instead of message inspection.
+
+    Family rows share the document axis (row i of every family state IS
+    document i); a family whose valid-plane row is empty no-ops on that
+    document. Families not configured pass ``None`` and trace away. Per
+    family, ALL op planes arrive as ONE packed i32[B, F, K] array (field
+    order ``*_PACK``) and the per-doc sequencer inputs as one i32[B, 6]
+    (``SCALAR_PACK``) — a tick is five host→device transfers total, not
+    one per plane (each transfer pays a dispatch on a tunneled
+    attachment). Ops carry NO seq planes — the ticket assigns seqs on
+    device, so sequencing and application never split across a host
+    round trip.
+    """
+    slot, cseq0, ref, ts, seq_counts, map_counts = (
+        scalars[:, i] for i in range(6))
+    seq_before = seq_state.seq
+    seq_state, dups, n_seq_doc, msn_doc = seqk.storm_tickets(
+        seq_state, slot, cseq0, ref, ts, seq_counts)
+
+    if map_words is not None:
+        lo = dups
+        hi = jnp.minimum(dups + n_seq_doc, map_counts)
+        map_state = _map_leg(map_state, map_words, lo, hi, seq_before)
+
+    def unpack(pack, names):
+        fields = {name: pack[:, i] for i, name in enumerate(names)}
+        valid = fields.pop("valid") != 0
+        counts = jnp.sum(valid.astype(I32), axis=1)
+        win, seqs = _ticket_window(counts, pack.shape[2], dups,
+                                   n_seq_doc, seq_before)
+        return fields, valid & win, seqs
+
+    if text_pack is not None:
+        fields, valid, seqs = unpack(text_pack, TEXT_PACK)
+        ops = mtk.MergeOpBatch(valid=valid, seq=seqs, **fields)
+        merge_state = jax.vmap(mtk._process_doc)(merge_state, ops)
+    if matrix_pack is not None:
+        fields, valid, seqs = unpack(matrix_pack, MATRIX_PACK)
+        ops = mxk.MatrixOpBatch(valid=valid, seq=seqs, **fields)
+        matrix_state = jax.vmap(mxk._process_doc)(matrix_state, ops)
+    tree_overflow = None
+    if tree_pack is not None:
+        fields, valid, _seqs = unpack(tree_pack, TREE_PACK)
+        ops = tk.TreeOpBatch(valid=valid, **fields)
+        tree_state, tree_out = tk.apply_tick(tree_state, ops)
+        tree_overflow = jnp.sum(tree_out.overflow.astype(I32), axis=1)
+
+    n_seq = n_seq_doc
+    first = jnp.where(n_seq > 0, seq_before + 1, oc.INT32_MAX)
+    last = jnp.where(n_seq > 0, seq_before + n_seq, 0)
+    return (seq_state, map_state, merge_state, matrix_state, tree_state,
+            n_seq, first, last, msn_doc, tree_overflow)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -76,7 +189,6 @@ def _storm_tick(seq_state: seqk.SequencerState, map_state: mk.MapState,
     ``map_gather`` maps each map row to its document's sequencer row so
     the ticket seqs feed the map fold without leaving the device.
     """
-    k = words.shape[1]
     seq_before = seq_state.seq
     seq_state, dups, n_seq_doc, msn_doc = seqk.storm_tickets(
         seq_state, slot, cseq0, ref, ts, seq_counts)
@@ -86,23 +198,7 @@ def _storm_tick(seq_state: seqk.SequencerState, map_state: mk.MapState,
     seq0_for = seq_before[map_gather]
     lo = dups_for
     hi = jnp.minimum(dups_for + nseq_for, map_counts)
-    if mp.default_interpret():
-        iota = jnp.arange(k, dtype=I32)[None, :]
-        words_u = words.astype(jnp.uint32)
-        sequenced = (iota >= lo[:, None]) & (iota < hi[:, None])
-        map_ops = mk.MapOpBatch(
-            valid=sequenced,
-            kind=(words_u & 3).astype(I32),
-            slot=((words_u >> 2) & 0x3FF).astype(I32),
-            value=((words_u >> 12) & 0xFFFFF).astype(I32),
-            seq=seq0_for[:, None] + 1 + iota - lo[:, None],
-        )
-        map_state = jax.vmap(mk._apply_doc)(map_state, map_ops)
-    else:
-        # VMEM LWW fold (ops/map_pallas.py): HBM traffic = planes +
-        # 4 bytes/op; the [B, K, S] dense-winner intermediates of the
-        # XLA path were the fused tick's dominant cost.
-        map_state = mp.fold_words(map_state, words, lo, hi, seq0_for)
+    map_state = _map_leg(map_state, words, lo, hi, seq0_for)
 
     n_seq = nseq_for
     first = jnp.where(n_seq > 0, seq0_for + 1, oc.INT32_MAX)

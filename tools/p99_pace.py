@@ -1,0 +1,84 @@
+"""Paced-enqueue cadence experiment: does offering ticks at a fixed
+interval (load < capacity) smooth the completion stream, or does the
+tunnel deliver result copies in bursts regardless?"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main(num_docs=10_240, k=1024, slots=32, ticks=120):
+    import jax
+
+    from fluidframework_tpu.ops import map_kernel as mk
+    from fluidframework_tpu.ops import map_pallas as mpx
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _t in range(12):
+        kinds = rng.choice([mk.MAP_SET, mk.MAP_DELETE, mk.MAP_CLEAR],
+                           p=[0.75, 0.2, 0.05],
+                           size=(num_docs, k)).astype(np.uint32)
+        slot = rng.integers(0, slots, (num_docs, k)).astype(np.uint32)
+        value = rng.integers(1, 1 << 20, (num_docs, k)).astype(np.uint32)
+        words = kinds | (slot << 2) | (value << 12)
+        counts = np.full((num_docs,), k, np.int32)
+        base = np.full((num_docs,), 0, np.int32)
+        batches.append(tuple(jax.device_put(a)
+                             for a in (words, counts, base)))
+    state0 = mk.init_state(num_docs, slots)
+
+    def apply(s, b):
+        return mpx.apply_tick_words_best(s, *b)
+
+    s = apply(state0, batches[0])
+    leaf = jax.tree_util.tree_leaves(s)[0]
+    np.asarray(leaf[(0,) * leaf.ndim])
+
+    for pace_ms in (0, 5, 10, 20):
+        for depth in (16, 48):
+            s = state0
+            inflight = []
+            enq_t = []
+            completions = []
+            lat = []
+            next_t = time.perf_counter()
+            for i in range(ticks + depth):
+                if pace_ms:
+                    now = time.perf_counter()
+                    if now < next_t:
+                        time.sleep(next_t - now)
+                    next_t = max(next_t + pace_ms / 1e3,
+                                 time.perf_counter())
+                s = apply(s, batches[i % len(batches)])
+                leaf = jax.tree_util.tree_leaves(s)[0]
+                probe = leaf[(0,) * leaf.ndim]
+                fn = getattr(probe, "copy_to_host_async", None)
+                if fn is not None:
+                    fn()
+                enq_t.append(time.perf_counter())
+                inflight.append(probe)
+                if len(inflight) > depth:
+                    np.asarray(inflight.pop(0))
+                    t = time.perf_counter()
+                    completions.append(t)
+                    lat.append(t - enq_t[len(completions) - 1])
+            while inflight:
+                np.asarray(inflight.pop(0))
+                t = time.perf_counter()
+                completions.append(t)
+                lat.append(t - enq_t[len(completions) - 1])
+            d = np.diff(np.asarray(completions[:ticks])) * 1000
+            latms = np.asarray(lat[:ticks]) * 1000
+            print(f"pace={pace_ms:2d}ms depth={depth:2d} "
+                  f"cad p50={np.percentile(d, 50):6.2f} "
+                  f"p99={np.percentile(d, 99):7.2f} max={d.max():7.2f} "
+                  f"stalls>25={int((d > 25).sum()):3d} | "
+                  f"lat p50={np.percentile(latms, 50):7.1f} "
+                  f"p99={np.percentile(latms, 99):7.1f}")
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Pipelined-cadence stall hunt (VERDICT r4 weak #1): sweep pipeline
+depth and probe style over >=120-tick series on the map-storm shape and
+print the full interval distribution, to find why the depth-4 pipe
+periodically stalls for a full tunnel RTT (p50 2.3ms vs p99 98ms).
+
+Run on the real TPU attachment:  python tools/p99_probe.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main(num_docs=10_240, k=1024, slots=32, ticks=120):
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops import map_kernel as mk
+    from fluidframework_tpu.ops import map_pallas as mpx
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _t in range(12):
+        kinds = rng.choice([mk.MAP_SET, mk.MAP_DELETE, mk.MAP_CLEAR],
+                           p=[0.75, 0.2, 0.05],
+                           size=(num_docs, k)).astype(np.uint32)
+        slot = rng.integers(0, slots, (num_docs, k)).astype(np.uint32)
+        value = rng.integers(1, 1 << 20, (num_docs, k)).astype(np.uint32)
+        words = kinds | (slot << 2) | (value << 12)
+        counts = np.full((num_docs,), k, np.int32)
+        base = np.full((num_docs,), 0, np.int32)
+        batches.append(tuple(jax.device_put(a)
+                             for a in (words, counts, base)))
+    state0 = mk.init_state(num_docs, slots)
+
+    def apply_plain(s, b):
+        return mpx.apply_tick_words_best(s, *b), None
+
+    @jax.jit
+    def apply_fused_probe(s, words, counts, base):
+        s = mpx.apply_tick_words_best(s, words, counts, base)
+        # Probe scalar computed INSIDE the tick executable: harvesting it
+        # costs no extra launch (the slice-on-host probe is its own tiny
+        # dispatch over the tunnel).
+        return s, s.value[0, 0] + s.vseq[0, 0]
+
+    def apply_fused(s, b):
+        s, probe = apply_fused_probe(s, *b)
+        return s, probe
+
+    for name, apply in (("slice-probe", apply_plain),
+                        ("fused-probe", apply_fused)):
+        for depth in (4, 8, 16, 32):
+            s = state0
+            inflight = []
+            completions = []
+            for i in range(ticks + depth):
+                s, probe = apply(s, batches[i % len(batches)])
+                if probe is None:
+                    leaf = jax.tree_util.tree_leaves(s)[0]
+                    probe = leaf[(0,) * leaf.ndim]
+                copy_async = getattr(probe, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+                inflight.append(probe)
+                if len(inflight) > depth:
+                    np.asarray(inflight.pop(0))
+                    completions.append(time.perf_counter())
+            while inflight:
+                np.asarray(inflight.pop(0))
+                completions.append(time.perf_counter())
+            d = np.diff(np.asarray(completions[:ticks])) * 1000
+            big = np.sort(d)[-8:]
+            print(f"{name} depth={depth:2d} n={len(d)} "
+                  f"p50={np.percentile(d, 50):7.2f} "
+                  f"p90={np.percentile(d, 90):7.2f} "
+                  f"p99={np.percentile(d, 99):7.2f} "
+                  f"max={d.max():7.2f} stalls>{25}ms="
+                  f"{int((d > 25).sum())} top={np.round(big, 1)}")
+            sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
