@@ -27,7 +27,24 @@ Core rules mirrored exactly:
 * Ack (ackPendingSegment:1883): FIFO pending groups get the sequenced seq.
 * Zamboni (mergeTree.ts:1412): on minSeq advance, drop segments removed at
   or below minSeq and coalesce adjacent out-of-window segments —
-  deterministic, so replicas stay structurally identical.
+  deterministic, so replicas stay structurally identical. Large documents
+  amortize the pass over a fixed number of minSeq advances; every
+  OBSERVABLE view (text, positions, snapshots) is identical either way
+  because snapshot() performs the same normalization itself.
+
+Position transforms are sublinear on large documents via a block index —
+the flat-table analog of the reference's B-tree partial lengths
+(mergeTree.ts:350, partialLengths.ts:63). The flat list is partitioned
+into blocks of ~64 segments; each block caches the summed length of its
+SETTLED members (seq <= minSeq, never removed) plus a count of unsettled
+ones. A settled segment is visible in EVERY valid view (the sequencer
+NACKs refSeq < MSN, so every walk's refSeq >= minSeq >= its seq), so a
+fully-settled block contributes a view-independent length and the insert
+walk / boundary split / range scan skip it in O(1) instead of touching
+its 64 segments. Blocks with any unsettled member are scanned segment by
+segment — exactness is only required when the unsettled count is zero,
+and that count never decreases between full rebuilds (zamboni), so
+interior stat drift is harmless by construction.
 """
 
 from __future__ import annotations
@@ -67,6 +84,10 @@ class Segment:
     pending_props: dict[str, list] = field(default_factory=dict)
     # pending-op groups this segment belongs to (split halves share groups)
     groups: list["SegmentGroup"] = field(default_factory=list)
+    # Block-index classification bit (see MergeEngine block index): True
+    # while this segment is counted in its block's settled length. Owned
+    # by the engine; kept exact so block stats never drift.
+    settled_cached: bool = False
 
     @property
     def length(self) -> int:
@@ -94,6 +115,7 @@ class Segment:
             props=dict(self.props) if self.props is not None else None,
             pending_props={k: list(v) for k, v in self.pending_props.items()},
             groups=list(self.groups),
+            settled_cached=self.settled_cached,
         )
         self.content = self.content[:offset]
         for group in tail.groups:
@@ -157,6 +179,161 @@ class MergeEngine:
         # Set by a reconnect identity change; the first regeneration pass
         # consumes it (normalize once per rejoin, not per pending message).
         self._rejoin_normalize_pending = False
+        # Block index (see module docstring): parallel arrays, one entry
+        # per ~_BLK_TARGET-segment block of self.segments. _blk_settled =
+        # summed length of settled members; _blk_unsettled = count of
+        # members NOT known settled (monotone non-decreasing between
+        # rebuilds); _blk_text = local-view text cache for fully-settled
+        # blocks. Rebuilt wholesale by the zamboni; patched incrementally
+        # by every structural/visibility mutation in between.
+        self._blk_counts: list[int] = []
+        self._blk_settled: list[int] = []
+        self._blk_unsettled: list[int] = []
+        self._blk_text: list[str | None] = []
+        self._blk_refresh_min: list[int] = []
+        self._zamboni_debt = 0
+
+    # -- block index -----------------------------------------------------------
+
+    _BLK_TARGET = 64
+
+    def _is_settled(self, seg: Segment) -> bool:
+        """View-independent visibility. Settled-LIVE: inserted at/below the
+        window and never removed (every valid walk's refSeq >= minSeq, so
+        it is visible everywhere; contributes its length). Settled-DEAD: a
+        tombstone removed at/below the window (removed_seq <= minSeq <=
+        every refSeq, so it is invisible everywhere; contributes zero) —
+        it may linger between deferred zamboni passes or while pinned by a
+        pending group, without blocking whole-block skips."""
+        rs = seg.removed_seq
+        if rs is None:
+            return seg.seq != UNASSIGNED and seg.seq <= self.min_seq
+        return rs != UNASSIGNED and rs <= self.min_seq
+
+    @staticmethod
+    def _settled_contrib(seg: Segment) -> int:
+        """Length a settled segment adds to its block (0 for tombstones)."""
+        return seg.length if seg.removed_seq is None else 0
+
+    def _rebuild_index(self) -> None:
+        t = self._BLK_TARGET
+        segs = self.segments
+        counts, settled, unsettled = [], [], []
+        for i in range(0, len(segs), t):
+            chunk = segs[i:i + t]
+            s_len = 0
+            uns = 0
+            for seg in chunk:
+                if self._is_settled(seg):
+                    seg.settled_cached = True
+                    s_len += self._settled_contrib(seg)
+                else:
+                    seg.settled_cached = False
+                    uns += 1
+            counts.append(len(chunk))
+            settled.append(s_len)
+            unsettled.append(uns)
+        self._blk_counts = counts
+        self._blk_settled = settled
+        self._blk_unsettled = unsettled
+        self._blk_text = [None] * len(counts)
+        self._blk_refresh_min = [self.min_seq] * len(counts)
+
+    def _scan_ready(self, b: int, base: int) -> bool:
+        """True if block ``b`` (starting at element ``base``) is fully
+        settled and its stats are exact — i.e. the walk may skip it using
+        the cached length. A block with unsettled members is first
+        RECLASSIFIED once per minSeq value (segments settle as the window
+        advances; removal is the only unsettle path and is patched
+        eagerly), so skipping recovers right after the window moves
+        instead of waiting for the next full zamboni."""
+        if self._blk_unsettled[b] == 0:
+            return True
+        if self._blk_refresh_min[b] == self.min_seq:
+            return False
+        self._blk_refresh_min[b] = self.min_seq
+        s_len = self._blk_settled[b]
+        uns = self._blk_unsettled[b]
+        for i in range(base, base + self._blk_counts[b]):
+            seg = self.segments[i]
+            if not seg.settled_cached and self._is_settled(seg):
+                seg.settled_cached = True
+                s_len += self._settled_contrib(seg)
+                uns -= 1
+        self._blk_settled[b] = s_len
+        self._blk_unsettled[b] = uns
+        if uns == 0:
+            self._blk_text[b] = None  # membership changed; rebuild lazily
+        return uns == 0
+
+    def _check_index(self) -> None:
+        """Lazy validation at every walk entry: external code (merge-host
+        state reconstruction) appends to ``segments`` directly; a length
+        mismatch forces a rebuild. O(#blocks) — noise next to the walk."""
+        if sum(self._blk_counts) != len(self.segments):
+            self._rebuild_index()
+
+    def _block_of_elem(self, index: int) -> int:
+        """Block containing existing element ``index``."""
+        cum = 0
+        for b, c in enumerate(self._blk_counts):
+            cum += c
+            if index < cum:
+                return b
+        return len(self._blk_counts) - 1
+
+    def _index_inserted_at(self, index: int) -> None:
+        """A brand-new segment entered ``segments`` at ``index`` (always
+        unsettled: pending, or sequenced above the window)."""
+        if not self._blk_counts:
+            self._blk_counts = [1]
+            self._blk_settled = [0]
+            self._blk_unsettled = [1]
+            self._blk_text = [None]
+            self._blk_refresh_min = [self.min_seq]
+            return
+        cum = 0
+        b = len(self._blk_counts) - 1
+        for j, c in enumerate(self._blk_counts):
+            cum += c
+            if index <= cum:
+                b = j
+                break
+        self._blk_counts[b] += 1
+        self._blk_unsettled[b] += 1
+        self._blk_text[b] = None
+        self._maybe_split_block(b)
+
+    def _index_unsettle(self, b: int, seg: Segment) -> None:
+        """``seg`` (classified settled, in block ``b``) is about to gain a
+        removal mark: move it out of the settled sum. Call BEFORE mutating
+        removed_seq."""
+        seg.settled_cached = False
+        self._blk_settled[b] -= seg.length
+        self._blk_unsettled[b] += 1
+        self._blk_text[b] = None
+
+    def _maybe_split_block(self, b: int) -> None:
+        if self._blk_counts[b] <= 2 * self._BLK_TARGET:
+            return
+        start = sum(self._blk_counts[:b])
+        cnt = self._blk_counts[b]
+        half = cnt // 2
+        stats = []
+        for lo, hi in ((start, start + half), (start + half, start + cnt)):
+            s_len = 0
+            uns = 0
+            for seg in self.segments[lo:hi]:
+                if seg.settled_cached:
+                    s_len += self._settled_contrib(seg)
+                else:
+                    uns += 1
+            stats.append((hi - lo, s_len, uns))
+        self._blk_counts[b:b + 1] = [stats[0][0], stats[1][0]]
+        self._blk_settled[b:b + 1] = [stats[0][1], stats[1][1]]
+        self._blk_unsettled[b:b + 1] = [stats[0][2], stats[1][2]]
+        self._blk_text[b:b + 1] = [None, None]
+        self._blk_refresh_min[b:b + 1] = [-1, -1]  # force reclassification
 
     # -- views ----------------------------------------------------------------
 
@@ -182,15 +359,44 @@ class MergeEngine:
             ref_seq = self.current_seq
         if client == "__local__":
             client = self.local_client
+        self._check_index()
+        # Settled segments are visible in every view with refSeq >= minSeq,
+        # so fully-settled blocks serve their cached concatenation.
+        cacheable = ref_seq >= self.min_seq
         parts = []
-        for seg in self.segments:
-            if self._vis_len(seg, ref_seq, client) and not seg.is_marker:
-                parts.append(seg.content)
+        base = 0
+        for b, cnt in enumerate(self._blk_counts):
+            if cacheable and self._scan_ready(b, base):
+                cached = self._blk_text[b]
+                if cached is None:
+                    cached = "".join(
+                        s.content for s in self.segments[base:base + cnt]
+                        if not s.is_marker and s.removed_seq is None)
+                    self._blk_text[b] = cached
+                parts.append(cached)
+            else:
+                for i in range(base, base + cnt):
+                    seg = self.segments[i]
+                    if (self._vis_len(seg, ref_seq, client)
+                            and not seg.is_marker):
+                        parts.append(seg.content)
+            base += cnt
         return "".join(parts)
 
     def local_length(self) -> int:
-        return sum(self._vis_len(s, self.current_seq, self.local_client)
-                   for s in self.segments)
+        self._check_index()
+        total = 0
+        base = 0
+        for b, cnt in enumerate(self._blk_counts):
+            if self._scan_ready(b, base):
+                total += self._blk_settled[b]
+            else:
+                total += sum(
+                    self._vis_len(self.segments[i], self.current_seq,
+                                  self.local_client)
+                    for i in range(base, base + cnt))
+            base += cnt
+        return total
 
     def get_position(self, target: Segment, ref_seq: int | None = None,
                      client: str | None = "__local__") -> int:
@@ -212,6 +418,15 @@ class MergeEngine:
         head = self.segments[index]
         tail = head.clone_tail(offset)
         self.segments.insert(index + 1, tail)
+        b = self._block_of_elem(index)
+        self._blk_counts[b] += 1
+        if not head.settled_cached:
+            # Unclassified head -> unclassified tail (clone_tail copies the
+            # bit). A settled head splits into two settled halves whose
+            # lengths sum unchanged — no stat edit either way.
+            self._blk_unsettled[b] += 1
+        self._blk_text[b] = None
+        self._maybe_split_block(b)
         for cb in self.on_split:
             cb(head, tail, offset)
 
@@ -225,21 +440,34 @@ class MergeEngine:
 
     def _resolve_insert(self, pos: int, ref_seq: int, client: str | None,
                         is_local: bool) -> int:
-        """Index at which an insert at `pos` lands (splitting if needed)."""
+        """Index at which an insert at `pos` lands (splitting if needed).
+        Fully-settled blocks strictly before the target position are
+        skipped whole (a settled segment is visible in every valid view,
+        and its _break_tie is True, so the walk never stops inside one
+        while remaining > 0)."""
+        self._check_index()
         remaining = pos
-        i = 0
-        while i < len(self.segments):
-            seg = self.segments[i]
-            vis = self._vis_len(seg, ref_seq, client)
-            if remaining < vis:
-                if remaining == 0:
+        base = 0
+        for b, cnt in enumerate(self._blk_counts):
+            if remaining > 0 and self._scan_ready(b, base):
+                blk_len = self._blk_settled[b]
+                if remaining > blk_len:
+                    remaining -= blk_len
+                    base += cnt
+                    continue
+            for i in range(base, base + cnt):
+                seg = self.segments[i]
+                vis = self._vis_len(seg, ref_seq, client)
+                if remaining < vis:
+                    if remaining == 0:
+                        return i
+                    self._split(i, remaining)
+                    return i + 1
+                if remaining == 0 and self._break_tie(seg, ref_seq,
+                                                      is_local):
                     return i
-                self._split(i, remaining)
-                return i + 1
-            if remaining == 0 and self._break_tie(seg, ref_seq, is_local):
-                return i
-            remaining -= vis
-            i += 1
+                remaining -= vis
+            base += cnt
         if remaining > 0:
             raise IndexError(f"insert position {pos} beyond sequence end")
         return len(self.segments)
@@ -247,29 +475,61 @@ class MergeEngine:
     def _ensure_boundary(self, pos: int, ref_seq: int,
                          client: str | None) -> None:
         """Split so that a segment boundary exists at visible position pos."""
+        self._check_index()
         remaining = pos
-        for i, seg in enumerate(self.segments):
-            vis = self._vis_len(seg, ref_seq, client)
-            if remaining < vis:
-                if remaining > 0:
-                    self._split(i, remaining)
-                return
-            remaining -= vis
+        base = 0
+        for b, cnt in enumerate(self._blk_counts):
+            if self._scan_ready(b, base) and remaining >= self._blk_settled[b]:
+                # Boundary at or past the block's end: no interior split
+                # possible here.
+                remaining -= self._blk_settled[b]
+                base += cnt
+                continue
+            for i in range(base, base + cnt):
+                seg = self.segments[i]
+                vis = self._vis_len(seg, ref_seq, client)
+                if remaining < vis:
+                    if remaining > 0:
+                        self._split(i, remaining)
+                    return
+                remaining -= vis
+            base += cnt
+
+    def _range_blocks(self, start: int, end: int, ref_seq: int,
+                      client: str | None) -> Iterable[tuple[int, Segment]]:
+        """(block, segment) pairs of visible segments covering [start, end)
+        in the (refSeq, client) view, after boundary splits. The block index
+        lets callers patch block stats when they mutate visibility; it stays
+        valid during iteration because visibility mutations never move
+        segments between blocks."""
+        self._ensure_boundary(start, ref_seq, client)
+        self._ensure_boundary(end, ref_seq, client)
+        pos = 0
+        base = 0
+        for b, cnt in enumerate(self._blk_counts):
+            if pos >= end:
+                break
+            if (self._scan_ready(b, base)
+                    and pos + self._blk_settled[b] <= start):
+                pos += self._blk_settled[b]
+                base += cnt
+                continue
+            for i in range(base, base + cnt):
+                if pos >= end:
+                    break
+                seg = self.segments[i]
+                vis = self._vis_len(seg, ref_seq, client)
+                if vis and pos >= start:
+                    yield b, seg
+                pos += vis
+            base += cnt
 
     def _range_segments(self, start: int, end: int, ref_seq: int,
                         client: str | None) -> Iterable[Segment]:
         """Visible segments covering [start, end) in the (refSeq, client)
         view, after boundary splits."""
-        self._ensure_boundary(start, ref_seq, client)
-        self._ensure_boundary(end, ref_seq, client)
-        pos = 0
-        for seg in self.segments:
-            if pos >= end:
-                break
-            vis = self._vis_len(seg, ref_seq, client)
-            if vis and pos >= start:
-                yield seg
-            pos += vis
+        for _b, seg in self._range_blocks(start, end, ref_seq, client):
+            yield seg
 
     # -- local edits -----------------------------------------------------------
 
@@ -291,6 +551,7 @@ class MergeEngine:
         seg.groups.append(group)
         self.pending_groups.append(group)
         self.segments.insert(index, seg)
+        self._index_inserted_at(index)
         op: dict = {"type": "insert", "pos": pos}
         if isinstance(content, str):
             op["text"] = content
@@ -305,9 +566,11 @@ class MergeEngine:
     def remove_local(self, start: int, end: int) -> dict:
         local_seq = self._next_local_seq()
         group = SegmentGroup(op_kind="remove", segments=[], local_seq=local_seq)
-        for seg in self._range_segments(start, end, self.current_seq,
-                                        self.local_client):
+        for b, seg in self._range_blocks(start, end, self.current_seq,
+                                         self.local_client):
             if seg.removed_seq is None:
+                if seg.settled_cached:
+                    self._index_unsettle(b, seg)
                 seg.removed_seq = UNASSIGNED
                 seg.removed_client = self.local_client
                 seg.removed_local_seq = local_seq
@@ -377,10 +640,13 @@ class MergeEngine:
             self.segments.insert(index, Segment(
                 content=content, seq=seq, client=client,
                 props=dict(op["props"]) if op.get("props") else None))
+            self._index_inserted_at(index)
         elif kind == "remove":
-            for seg in self._range_segments(op["start"], op["end"], ref_seq,
-                                            client):
+            for b, seg in self._range_blocks(op["start"], op["end"], ref_seq,
+                                             client):
                 if seg.removed_seq is None:
+                    if seg.settled_cached:
+                        self._index_unsettle(b, seg)
                     seg.removed_seq = seq
                     seg.removed_client = client
                 elif seg.removed_seq == UNASSIGNED:
@@ -529,6 +795,7 @@ class MergeEngine:
                         and right.removed_seq != UNASSIGNED):
                     segs[i], segs[i + 1] = right, left
                     changed = True
+        self._rebuild_index()  # swaps may have crossed block boundaries
 
     def normalize_detached(self) -> None:
         """Detached → attached: local-only segments become baseline (seq 0),
@@ -546,8 +813,17 @@ class MergeEngine:
         self.segments = [s for s in self.segments if s.removed_seq is None]
         self.pending_groups.clear()
         self._local_seq_counter = 0
+        self._rebuild_index()
 
     # -- collab window / zamboni ----------------------------------------------
+
+    # Large documents amortize the O(S) zamboni pass over this many minSeq
+    # advances; small documents (below _ZAMBONI_EAGER_SEGMENTS) compact on
+    # every advance exactly as before. Deferral changes only the in-memory
+    # table's compaction timing — text, positions, and snapshot() output
+    # are identical (snapshot performs the same normalization itself).
+    _ZAMBONI_EVERY = 32
+    _ZAMBONI_EAGER_SEGMENTS = 512
 
     def update_min_seq(self, min_seq: int) -> None:
         """Advance the collab window floor; compact (zamboni, mergeTree:1412).
@@ -555,6 +831,11 @@ class MergeEngine:
         if min_seq <= self.min_seq:
             return
         self.min_seq = min_seq
+        self._zamboni_debt += 1
+        if (len(self.segments) > self._ZAMBONI_EAGER_SEGMENTS
+                and self._zamboni_debt < self._ZAMBONI_EVERY):
+            return
+        self._zamboni_debt = 0
         kept: list[Segment] = []
         # Anchor rebinding for compaction: id(old_seg) -> (replacement,
         # delta). delta None = slide to the replacement's start (offset 0);
@@ -598,6 +879,7 @@ class MergeEngine:
         for dropped in pending_drops:
             rebind[id(dropped)] = (None, None)  # end of sequence
         self.segments = kept
+        self._rebuild_index()
         if rebind:
             # Chase chains (dropped -> coalesced target -> ...).
             for cb in self.on_compact:
@@ -707,4 +989,5 @@ class MergeEngine:
                 removed_overlap=set(entry.get("removed_overlap", ())),
                 props=dict(entry["props"]) if entry.get("props") else None,
             ))
+        engine._rebuild_index()
         return engine

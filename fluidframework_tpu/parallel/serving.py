@@ -51,10 +51,10 @@ from ..protocol.messages import MessageType
 from . import multihost
 from .mesh import aggregate_metrics
 
-TEXT_FIELDS = ("kind", "pos", "end", "seq", "ref_seq", "client",
+TEXT_FIELDS = ("kind", "pos", "end", "ref_seq", "client",
                "pool_start", "text_len", "prop_key", "prop_val")
 MATRIX_FIELDS = ("target", "kind", "pos", "end", "count", "handle_base",
-                 "row", "col", "value", "seq", "ref_seq", "client")
+                 "row", "col", "value", "ref_seq", "client")
 TREE_FIELDS = ("kind", "node", "parent", "trait", "payload")
 
 
@@ -381,10 +381,14 @@ class ShardedServing:
             op = dict(op)
             target = op.get("target", mxk.MX_CELL)
             if (target in (mxk.MX_ROWS, mxk.MX_COLS)
-                    and op.get("kind", 0) == mtk.MT_INSERT
-                    and "handle_base" not in op):
-                op["handle_base"] = self._mx_handles[row]
-                self._mx_handles[row] += op.get("count", 1)
+                    and op.get("kind", 0) == mtk.MT_INSERT):
+                # Pin the count BEFORE both consumers read it: the host
+                # allocator and the encoded device plane must agree, or a
+                # failover-rebuilt allocator re-issues handles.
+                op.setdefault("count", 1)
+                if "handle_base" not in op:
+                    op["handle_base"] = self._mx_handles[row]
+                    self._mx_handles[row] += op["count"]
             op.setdefault("ref_seq", ref_seq)
             op.setdefault("client", client_slot)
             encoded.append(op)
@@ -651,8 +655,11 @@ class ShardedServing:
         """Durable snapshot of one host's rows across EVERY family state
         (+ text pools + per-row durable-log offsets). The checkpoint/
         offset pair is consistent BY CONSTRUCTION when taken between
-        ticks (tick() is the only writer)."""
-        self.flush()  # durable log must cover every in-flight tick
+        ticks (tick() is the only writer). Harvests of ticks that were
+        still in the pipeline are returned under ``"drained"`` — each
+        ack matches a client frame, so the caller must deliver them,
+        not drop them."""
+        drained = self.flush()  # durable log must cover in-flight ticks
         port = self.hosts[host_id]
         states = {
             name: jax.tree.map(lambda a: _plane_rows(a, port), state)
@@ -661,6 +668,7 @@ class ShardedServing:
             "host_id": host_id,
             "start": port.start,
             "stop": port.stop,
+            "drained": drained,
             "states": states,
             # Back-compat field-dict views of the two always-on families.
             "seq": dict(states["seq"]._asdict()),

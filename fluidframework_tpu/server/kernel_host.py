@@ -68,6 +68,14 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _tick_k(max_pending: int) -> int:
+    """Op-batch width for a flush tick: pow2-bucketed with a floor of 32.
+    Padding a short tick with invalid ops costs a few masked scan steps;
+    compiling a fresh device program per tiny k costs seconds — the floor
+    keeps the shape set at {32, 64, 128, ...} across every flush path."""
+    return max(32, _next_pow2(max_pending))
+
+
 class KernelSequencerHost:
     """Device-batched total-order sequencer for many documents.
 

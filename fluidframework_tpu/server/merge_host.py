@@ -57,7 +57,7 @@ from ..ops import mergetree_kernel as mtk
 from ..ops import mergetree_pallas as mtp
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedDocumentMessage
-from .kernel_host import _next_pow2
+from .kernel_host import _next_pow2, _tick_k
 
 _MERGE_OPS = frozenset({"insert", "remove", "annotate", "group"})
 _MAP_OPS = frozenset({"set", "delete", "clear"})
@@ -952,7 +952,7 @@ class KernelMergeHost:
                 cell_extra=cell_extra))
             self._matrix_vec_slots += vec_extra
             self._matrix_cell_slots += cell_extra
-        k = _next_pow2(max(len(r.pending) for r in rows))
+        k = _tick_k(max(len(r.pending) for r in rows))
         per_doc = [[] for _ in range(self._matrix_capacity)]
         for r in rows:
             per_doc[r.row] = r.pending
@@ -1343,7 +1343,7 @@ class KernelMergeHost:
         if not items:
             return
         self._ensure_tree_state()
-        k = _next_pow2(max(len(r.pending) for _, r in items))
+        k = _tick_k(max(len(r.pending) for _, r in items))
         per_doc: list[list[dict]] = [[] for _ in range(self._tree_capacity)]
         for _, r in items:
             per_doc[r.row] = r.pending
@@ -1566,7 +1566,7 @@ class KernelMergeHost:
             max_props = max(len(r.key_slots) for r in pool_rows)
             if max_props > pool.num_props:
                 pool.grow_props(max_props)
-            k = _next_pow2(max(len(r.pending) for r in pool_rows))
+            k = _tick_k(max(len(r.pending) for r in pool_rows))
             per_doc = [[] for _ in range(pool.capacity)]
             for r in pool_rows:
                 per_doc[r.row] = r.pending
@@ -1643,7 +1643,7 @@ class KernelMergeHost:
         max_keys = max(len(r.key_slots) for r in rows)
         if max_keys > self._map_slots:
             self._grow_map_slots(max_keys)
-        k = _next_pow2(max(len(r.pending) for r in rows))
+        k = _tick_k(max(len(r.pending) for r in rows))
         per_doc = [[] for _ in range(self._map_capacity)]
         for r in rows:
             per_doc[r.row] = r.pending

@@ -22,6 +22,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+from fluidframework_tpu.utils import compile_cache  # noqa: E402
+
+# Farms recompile every pool-bucket shape from scratch on a cold run;
+# the persistent cache makes re-runs (and the soak/farm tiers) pay XLA
+# compilation once per shape per machine instead of once per session.
+compile_cache.enable()
+
 import pytest  # noqa: E402
 
 
