@@ -106,6 +106,34 @@ class MatrixStepBatch(NamedTuple):
     r_seq: jax.Array        # i32[B, T, R]
 
 
+class CellRunBatch(NamedTuple):
+    """One tick that is ALL cell writes, one run per document — the
+    BASELINE config-4 storm shape (a settled grid, hundreds of writers,
+    no structural ops in flight). The whole run shares one visibility
+    frame per document: with every vector segment on the device acked at
+    or below ``ref_seq``, handle resolution is client-independent, so a
+    single (ref, client) pair serves all R cells — the host admits this
+    path only when ``last vector seq <= min ref of the run`` (the same
+    exactness condition the step/run layout checks per step).
+
+    The apply is scan-free (see apply_cell_run): resolve all R handles
+    in one [R, S] masked lookup per axis, then append the run to the
+    cell log with a rotate-into-place update — no dedup at all.
+    Duplicate keys (within a tick or across ticks) coexist in the log
+    carrying their seqs; log order is sequenced order, so
+    materialization's fold takes the latest and converged state is
+    unchanged. The log costs one slot per valid cell per tick and is
+    drained by the host at its flush/harvest cadence."""
+
+    valid: jax.Array    # bool[B, R]
+    row: jax.Array      # i32[B, R]
+    col: jax.Array      # i32[B, R]
+    value: jax.Array    # i32[B, R]
+    seq: jax.Array      # i32[B, R]
+    ref_seq: jax.Array  # i32[B] shared frame
+    client: jax.Array   # i32[B]
+
+
 class _VecOp(NamedTuple):
     """Adapter to the merge-tree kernel's per-op field names."""
 
@@ -184,7 +212,12 @@ def _apply_matrix_op(s: MatrixState, op) -> MatrixState:
     match = s.cell_used & (s.cell_rh == rh) & (s.cell_ch == ch)
     exists = jnp.any(match)
     capacity = s.cell_used.shape[0]
-    idx = jnp.where(exists, jnp.argmax(match),
+    # LAST match: entries are unique under this path alone, but the
+    # cell-run fast path appends duplicate keys across ticks in seq
+    # order — overwriting the newest keeps materialize's fold correct
+    # when the paths mix.
+    idx = jnp.where(exists,
+                    capacity - 1 - jnp.argmax(match[::-1]),
                     jnp.minimum(s.cell_count, capacity - 1))
 
     def upd(field, value):
@@ -267,7 +300,10 @@ def _apply_matrix_step(s: MatrixState, step) -> MatrixState:
         write = valid & (rh >= 0) & (ch >= 0)
         match = cell_used & (cell_rh == rh) & (cell_ch == ch)
         exists = jnp.any(match)
-        idx = jnp.where(exists, jnp.argmax(match),
+        # LAST match, for composition with the cell-run append log (see
+        # _apply_matrix_op).
+        idx = jnp.where(exists,
+                        capacity - 1 - jnp.argmax(match[::-1]),
                         jnp.minimum(cell_count, capacity - 1))
 
         def upd(field, val):
@@ -306,6 +342,123 @@ def apply_tick_steps(state: MatrixState,
     :func:`apply_tick` on the equivalent flat stream (differentially
     pinned by tests/test_matrix_kernel.py)."""
     return jax.vmap(_process_doc_steps)(state, steps)
+
+
+def _resolve_run(vec: mtk.MergeState, pos, ref, client):
+    """Vectorized handle resolution for one doc's cell run: [R] positions
+    against an [S] vector table in one shared visibility frame."""
+    vis = mtk._vis_len(vec, ref, client)
+    cum = jnp.cumsum(vis) - vis
+    inside = (cum[None, :] <= pos[:, None]) & (
+        pos[:, None] < (cum + vis)[None, :])
+    handle = jnp.sum(
+        jnp.where(inside,
+                  vec.pool_start[None, :] + pos[:, None] - cum[None, :],
+                  0), axis=1)
+    return jnp.where(jnp.any(inside, axis=1), handle, -1)
+
+
+@jax.jit
+def apply_cell_run(state: MatrixState, run: CellRunBatch) -> MatrixState:
+    """Apply one all-cells tick for every document — the config-4 storm
+    fast path. Converges to the same materialized grid as apply_tick on
+    the equivalent stream.
+
+    Appends the whole [B, R] run tile to the cell log in sequenced order
+    with ONE dynamic_update_slice at a SHARED column offset
+    (``max(cell_count)``) — no dedup, no per-document dynamic indexing
+    (which XLA lowers to a serialized gather on TPU). Duplicate keys
+    coexist in the log carrying their seqs; log order is sequenced
+    order, so materialization's fold takes the latest. Cells whose
+    row/col died concurrently keep their slot with used=False
+    (matrix.ts:547's None-handle drop); documents with shorter runs
+    leave used=False padding up to the shared tile — the log costs one
+    R-wide tile per tick and is drained at the host's flush/harvest
+    cadence (capacity_margin accounts the tile, the host checks it
+    before the tick)."""
+    num_r = run.row.shape[1]
+    capacity = state.cell_used.shape[1]
+
+    rh = jax.vmap(_resolve_run)(state.rows, run.row, run.ref_seq,
+                                run.client)
+    ch = jax.vmap(_resolve_run)(state.cols, run.col, run.ref_seq,
+                                run.client)
+    write = run.valid & (rh >= 0) & (ch >= 0)
+    n_valid = jnp.sum(run.valid, axis=1).astype(I32)
+
+    start = jnp.clip(jnp.max(state.cell_count), 0, capacity - num_r)
+
+    def place(table, plane, fill=None):
+        return jax.lax.dynamic_update_slice(
+            table, plane.astype(table.dtype), (jnp.int32(0), start))
+
+    return state._replace(
+        cell_rh=place(state.cell_rh, rh),
+        cell_ch=place(state.cell_ch, ch),
+        cell_val=place(state.cell_val, run.value),
+        cell_seq=place(state.cell_seq, run.seq),
+        cell_used=place(state.cell_used, write),
+        cell_count=start + n_valid,
+    )
+
+
+def _compact_cells_doc(rh, ch, val, seq, used):
+    """Dedup one doc's cell log: keep the LAST entry per (rh, ch) key
+    (log order is sequenced order) and pack survivors to the front.
+    Stable 2-key sort groups duplicates preserving log order; the
+    log-shift cascade packs without gathers (as in mergetree compact)."""
+    cap = rh.shape[0]
+    iota = jnp.arange(cap)
+    big = jnp.int32(2**31 - 1)
+    k1 = jnp.where(used, rh, big)
+    k2 = jnp.where(used, ch, big)
+    s1, s2, sv, ss, su = jax.lax.sort(
+        (k1, k2, val, seq, used.astype(I32)), num_keys=2, is_stable=True)
+    last = iota == cap - 1
+    n1 = jnp.where(last, big, jnp.roll(s1, -1))
+    n2 = jnp.where(last, big, jnp.roll(s2, -1))
+    win = (su == 1) & ((s1 != n1) | (s2 != n2))
+    planes = mtk.pack_keep([s1, s2, sv, ss], win)
+    count = jnp.sum(win).astype(I32)
+    live = iota < count
+    return (jnp.where(live, planes[0], -1),
+            jnp.where(live, planes[1], -1),
+            jnp.where(live, planes[2], 0),
+            jnp.where(live, planes[3], 0),
+            live, count)
+
+
+@jax.jit
+def compact_cell_log(state: MatrixState) -> MatrixState:
+    """Fold each document's cell log to one entry per (rh, ch) — the
+    capacity-pressure compaction for the append-only cell-run path
+    (dropped duplicates are superseded writes; converged state is
+    unchanged). Also safe on the unique-keyed per-op table."""
+    rh, ch, val, seq, used, count = jax.vmap(_compact_cells_doc)(
+        state.cell_rh, state.cell_ch, state.cell_val, state.cell_seq,
+        state.cell_used)
+    return state._replace(cell_rh=rh, cell_ch=ch, cell_val=val,
+                          cell_seq=seq, cell_used=used, cell_count=count)
+
+
+def make_cell_run_batch(cells_per_doc: list[list[dict]], num_docs: int,
+                        r: int, ref_seq: list[int] | np.ndarray,
+                        client: list[int] | np.ndarray) -> CellRunBatch:
+    """Encode per-doc cell-write lists (dicts with row/col/value/seq)."""
+    fields = {name: np.zeros((num_docs, r), np.int32)
+              for name in ("row", "col", "value", "seq")}
+    valid = np.zeros((num_docs, r), np.bool_)
+    for d, cells in enumerate(cells_per_doc):
+        assert len(cells) <= r, f"run overflow: {len(cells)} > {r}"
+        for i, cell in enumerate(cells):
+            valid[d, i] = True
+            for name in fields:
+                fields[name][d, i] = cell.get(name, 0)
+    return CellRunBatch(
+        valid=jnp.asarray(valid),
+        ref_seq=jnp.asarray(np.asarray(ref_seq, np.int32)),
+        client=jnp.asarray(np.asarray(client, np.int32)),
+        **{n: jnp.asarray(v) for n, v in fields.items()})
 
 
 def capacity_margin(state: MatrixState) -> dict[str, np.ndarray]:

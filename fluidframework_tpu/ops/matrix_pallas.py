@@ -12,7 +12,7 @@ Per sequenced op (vectorized over the doc sublane axis):
   * (row, col) → storage-handle resolution for cell writes = the same
     masked-prefix-sum position lookup, evaluated on the PRE-op axis
     tables (matrix.ts adjustPosition);
-  * the cell LWW write is a first-match-or-append lane scatter on the
+  * the cell LWW write is a last-match-or-append lane scatter on the
     [D, C] cell planes.
 
 Semantics are pinned to :func:`matrix_kernel.apply_tick` by differential
@@ -48,6 +48,14 @@ I32 = jnp.int32
 _CELLS = ("cell_rh", "cell_ch", "cell_val", "cell_seq", "cell_used")
 _MX_OPS = ("valid", "target", "kind", "pos", "end", "count", "handle_base",
            "row", "col", "value", "seq", "ref_seq", "client")
+
+
+def _last_true(mask: jax.Array) -> jax.Array:
+    """Index of the LAST True along lanes; -1 when none. Shape [D, 1].
+    Matches matrix_kernel's last-match rule so per-op writes compose with
+    the cell-run append log (newest duplicate wins)."""
+    lane = jax.lax.broadcasted_iota(I32, mask.shape, mask.ndim - 1)
+    return jnp.max(jnp.where(mask, lane, -1), axis=-1, keepdims=True)
 
 
 def _handle_at_vec(p: dict, overlap, pos, ref_seq, client):
@@ -128,7 +136,7 @@ def _matrix_apply_vec(rows, rows_prop, rows_overlap, rows_count,
         # the padded lanes beyond num_cells are sliced off by the wrapper,
         # so an overflow write must land at num_cells - 1 as the XLA path's
         # does, not vanish into padding.
-        idx = jnp.where(exists, _first_true(match),
+        idx = jnp.where(exists, _last_true(match),
                         jnp.minimum(cell_count, num_cells - 1))
         lane_c = jax.lax.broadcasted_iota(I32, cells["cell_used"].shape, 1)
         at = write & (lane_c == idx)
@@ -440,7 +448,7 @@ def _step_kernel(*refs, num_steps: int, r_max: int, num_cells: int):
                          & (cells["cell_rh"] == rh)
                          & (cells["cell_ch"] == ch))
                 exists = jnp.any(match, axis=-1, keepdims=True)
-                idx = jnp.where(exists, _first_true(match),
+                idx = jnp.where(exists, _last_true(match),
                                 jnp.minimum(cell_count, num_cells - 1))
                 at = write & (lane_c == idx)
                 return ({

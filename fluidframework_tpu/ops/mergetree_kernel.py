@@ -398,6 +398,34 @@ def capacity_margin(state: MergeState) -> np.ndarray:
     return np.asarray(state.valid.shape[1] - state.count)
 
 
+def pack_keep(planes: list[jax.Array], keep: jax.Array
+              ) -> list[jax.Array]:
+    """Stable stream compaction: move kept elements to the front of the
+    last axis in log2(S) conditional-shift stages, LOW bit first. A kept
+    slot's displacement (drops before it) is monotone non-decreasing, so
+    once bits < b are applied two kept slots whose remaining shifts
+    differ at bit b sit >= 2^b apart — the stages never collide. This is
+    several times cheaper than a multi-operand stable sort (a sort
+    network is ~log^2(S) compare-exchange stages over every plane) and
+    avoids TPU-serialized scatters entirely. Tail slots (>= kept count)
+    hold garbage; callers mask them."""
+    num_slots = keep.shape[0]
+    drops_excl = jnp.cumsum(~keep) - (~keep).astype(I32)
+    rem = jnp.where(keep, drops_excl, 0).astype(I32)
+    curk = keep
+    b = 1
+    while b < num_slots:
+        src_k = jnp.roll(curk, -b)
+        src_rem = jnp.roll(rem, -b)
+        arrive = src_k & ((src_rem & b) != 0)
+        stay = curk & ((rem & b) == 0)
+        planes = [jnp.where(arrive, jnp.roll(p, -b), p) for p in planes]
+        rem = jnp.where(arrive, src_rem - b, jnp.where(stay, rem, 0))
+        curk = arrive | stay
+        b <<= 1
+    return planes
+
+
 def compact(state: MergeState, min_seq: jax.Array,
             coalesce: bool = False) -> MergeState:
     """Zamboni: drop tombstones removed at/below min_seq[B] and pack live
@@ -466,39 +494,16 @@ def compact(state: MergeState, min_seq: jax.Array,
             chain_end = jnp.minimum(next_after, cum[-1])
             length = jnp.where(is_head, chain_end - excl, length)
             keep = is_head
-        # Pack kept slots to the front with log2(S) conditional-shift
-        # stages (stable stream compaction). A kept slot's displacement is
-        # the count of drops before it — monotone non-decreasing along the
-        # table — so applying it bit-by-bit (LOW bit first) never
-        # collides: once bits < b are applied, two kept slots whose
-        # remaining shifts differ at bit b sit >= 2^b apart. This replaces
-        # the earlier 17-operand stable sort: a sort network runs
-        # ~log^2(S) compare-exchange stages over every plane, the shift
-        # cascade is log(S) roll-selects — several times less HBM traffic
-        # for the same result. (A scatter would be one pass, but XLA
-        # serializes TPU scatters.)
+        # Pack kept slots to the front (pack_keep: log-shift cascade —
+        # see its docstring for the collision-freedom argument and the
+        # cost comparison to the earlier 17-operand stable sort).
         num_props = s.prop_val.shape[1]
         num_words = s.rem_overlap.shape[1]
-        planes = (
+        planes = pack_keep(
             [length, s.ins_seq, s.ins_client, s.rem_seq,
              s.rem_client, s.pool_start]
             + [s.prop_val[:, j] for j in range(num_props)]
-            + [s.rem_overlap[:, j] for j in range(num_words)])
-        drops_excl = jnp.cumsum(~keep) - (~keep).astype(I32)
-        rem_shift = jnp.where(keep, drops_excl, 0).astype(I32)
-        curk = keep
-        b = 1
-        while b < num_slots:
-            src_k = jnp.roll(curk, -b)
-            src_rem = jnp.roll(rem_shift, -b)
-            arrive = src_k & ((src_rem & b) != 0)
-            stay = curk & ((rem_shift & b) == 0)
-            planes = [jnp.where(arrive, jnp.roll(p, -b), p)
-                      for p in planes]
-            rem_shift = jnp.where(arrive, src_rem - b,
-                                  jnp.where(stay, rem_shift, 0))
-            curk = arrive | stay
-            b <<= 1
+            + [s.rem_overlap[:, j] for j in range(num_words)], keep)
         new_count = jnp.sum(keep).astype(I32)
         live = iota < new_count
 

@@ -131,7 +131,8 @@ class _MapRow:
 class _MatrixRow:
     __slots__ = ("row", "client_slots", "pending", "raw_log", "scalar",
                  "last_seq", "min_seq", "next_row_handle",
-                 "next_col_handle", "applied_seq", "applied_min_seq")
+                 "next_col_handle", "applied_seq", "applied_min_seq",
+                 "last_vec_seq")
 
     def __init__(self, row: int) -> None:
         self.row = row
@@ -148,6 +149,9 @@ class _MatrixRow:
         self.applied_min_seq = 0
         self.next_row_handle = 0
         self.next_col_handle = 0
+        # Seq of the newest structural (vector) op — the cell-run fast
+        # path is exact only when every cell's refSeq covers it.
+        self.last_vec_seq = 0
 
 
 class _TreeRow:
@@ -751,6 +755,9 @@ class KernelMergeHost:
             alloc("next_row_handle"), alloc("next_col_handle"),
             self._intern)
         row.pending.extend(encoded)
+        for enc in encoded:
+            if enc["target"] != mxk.MX_CELL:
+                row.last_vec_seq = max(row.last_vec_seq, enc["seq"])
         self._pending_ops += len(encoded)
 
     def _seed_matrix_scalar(self, row: _MatrixRow) -> tuple:
@@ -953,11 +960,51 @@ class KernelMergeHost:
             self._matrix_vec_slots += vec_extra
             self._matrix_cell_slots += cell_extra
         k = _tick_k(max(len(r.pending) for r in rows))
-        per_doc = [[] for _ in range(self._matrix_capacity)]
-        for r in rows:
-            per_doc[r.row] = r.pending
-        batch = mxk.make_matrix_op_batch(per_doc, self._matrix_capacity, k)
-        self._matrix_state = mxp.apply_tick_best(self._matrix_state, batch)
+        # Config-4 fast path: a flush that is ALL cell writes whose refs
+        # cover every structural op applies scan-free as one [B, k] tile
+        # (apply_cell_run) — the steady state of a settled grid under
+        # concurrent writers. Any vector op in flight falls back to the
+        # exact per-op/step path.
+        if all(op["target"] == mxk.MX_CELL
+               and op["ref_seq"] >= r.last_vec_seq
+               for r in rows for op in r.pending):
+            counts = np.asarray(self._matrix_state.cell_count)
+            deficit = k + 1 - (self._matrix_cell_slots - int(counts.max()))
+            if deficit > 0:
+                # Dedup the append log (superseded writes pack away)
+                # before paying for a bigger table — the cell analog of
+                # the vector zamboni above.
+                self._matrix_state = mxk.compact_cell_log(
+                    self._matrix_state)
+                self.stats["compactions"] += 1
+                counts = np.asarray(self._matrix_state.cell_count)
+                deficit = k + 1 - (self._matrix_cell_slots
+                                   - int(counts.max()))
+            if deficit > 0:
+                extra = _next_pow2(deficit)
+                self._matrix_state = jax.device_put(self._pad_matrix_state(
+                    self._matrix_state, vec_extra=0, cell_extra=extra))
+                self._matrix_cell_slots += extra
+            cells_per_doc: list[list[dict]] = [
+                [] for _ in range(self._matrix_capacity)]
+            refs = np.zeros(self._matrix_capacity, np.int32)
+            clients = np.zeros(self._matrix_capacity, np.int32)
+            for r in rows:
+                cells_per_doc[r.row] = r.pending
+                refs[r.row] = min(op["ref_seq"] for op in r.pending)
+            run = mxk.make_cell_run_batch(
+                cells_per_doc, self._matrix_capacity, k, refs, clients)
+            self._matrix_state = mxk.apply_cell_run(self._matrix_state, run)
+            self.stats["cell_run_ticks"] = (
+                self.stats.get("cell_run_ticks", 0) + 1)
+        else:
+            per_doc = [[] for _ in range(self._matrix_capacity)]
+            for r in rows:
+                per_doc[r.row] = r.pending
+            batch = mxk.make_matrix_op_batch(per_doc,
+                                             self._matrix_capacity, k)
+            self._matrix_state = mxp.apply_tick_best(self._matrix_state,
+                                                     batch)
         self.stats["device_ops"] += sum(len(r.pending) for r in rows)
         self.stats["flushes"] += 1
         for r in rows:

@@ -23,18 +23,20 @@ _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "fluidframework_tpu", "xla")
 
 _enabled = False
+_active_dir: str | None = None
 
 
 def enable(cache_dir: str | None = None) -> str | None:
     """Idempotently turn on the persistent compilation cache.
 
     Returns the cache directory, or None when disabled by env."""
-    global _enabled
+    global _enabled, _active_dir
     if os.environ.get("FFTPU_COMPILE_CACHE", "1") == "0":
         return None
     if _enabled:
-        return cache_dir or os.environ.get("FFTPU_COMPILE_CACHE_DIR",
-                                           _DEFAULT_DIR)
+        # Already configured: report the directory actually in effect —
+        # a different requested dir is NOT adopted mid-process.
+        return _active_dir
     path = (cache_dir or os.environ.get("FFTPU_COMPILE_CACHE_DIR")
             or _DEFAULT_DIR)
     os.makedirs(path, exist_ok=True)
@@ -47,4 +49,5 @@ def enable(cache_dir: str | None = None) -> str | None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _enabled = True
+    _active_dir = path
     return path

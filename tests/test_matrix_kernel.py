@@ -221,3 +221,65 @@ def test_matrix_step_kernel_matches_flat_and_replicas(seed):
     for a, b in zip(jax.tree.leaves(flat_state),
                     jax.tree.leaves(step_state)):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cell_run_kernel_matches_per_op(seed):
+    """The config-4 fast path: an all-cells tick through apply_cell_run
+    materializes the same grid as the per-op scan on the same stream,
+    including within-tick duplicate keys (LWW by seq) and writes to
+    removed rows (dropped)."""
+    rng = random.Random(seed)
+    n_docs, grid = 3, 6
+    setup = []
+    for _ in range(n_docs):
+        setup.append([
+            dict(target=mxk.MX_ROWS, kind=mtk.MT_INSERT, pos=0,
+                 count=grid, handle_base=0, seq=1, ref_seq=0, client=0),
+            dict(target=mxk.MX_COLS, kind=mtk.MT_INSERT, pos=0,
+                 count=grid, handle_base=0, seq=2, ref_seq=1, client=0),
+            # One removed row: cells aimed at it must drop on both paths.
+            dict(target=mxk.MX_ROWS, kind=mtk.MT_REMOVE, pos=1, end=2,
+                 seq=3, ref_seq=2, client=0),
+        ])
+    state_a = mxk.init_state(n_docs, vec_slots=16, cell_slots=256)
+    state_b = mxk.init_state(n_docs, vec_slots=16, cell_slots=256)
+    batch = mxk.make_matrix_op_batch(setup, n_docs, 4)
+    state_a = mxk.apply_tick(state_a, batch)
+    state_b = mxk.apply_tick(state_b, batch)
+
+    seq = 4
+    for _tick in range(3):
+        cells_per_doc = []
+        for d in range(n_docs):
+            cells = []
+            for _ in range(rng.randrange(8, 24)):
+                cells.append(dict(row=rng.randrange(grid - 1),
+                                  col=rng.randrange(grid),
+                                  value=rng.randrange(1, 50), seq=seq))
+                seq += 1
+            cells_per_doc.append(cells)
+        ref = seq  # all vector ops acked well below
+        run = mxk.make_cell_run_batch(cells_per_doc, n_docs, 24,
+                                      [ref] * n_docs, [0] * n_docs)
+        state_a = mxk.apply_cell_run(state_a, run)
+        per_op = [[dict(target=mxk.MX_CELL, ref_seq=ref, client=0, **c)
+                   for c in cells] for cells in cells_per_doc]
+        state_b = mxk.apply_tick(
+            state_b, mxk.make_matrix_op_batch(per_op, n_docs, 24))
+
+    val_rev = list(range(64))
+    for d in range(n_docs):
+        grid_a = mxk.materialize_grid(state_a, d, val_rev)
+        grid_b = mxk.materialize_grid(state_b, d, val_rev)
+        assert grid_a == grid_b, (seed, d)
+
+    # Mixed composition: a per-op tick AFTER cell-run appends must win
+    # over the duplicate log entries.
+    mixed = [[dict(target=mxk.MX_CELL, row=0, col=0, value=60,
+                   seq=seq, ref_seq=seq - 1, client=0)]
+             for _ in range(n_docs)]
+    state_a = mxk.apply_tick(state_a, mxk.make_matrix_op_batch(
+        mixed, n_docs, 1))
+    for d in range(n_docs):
+        assert mxk.materialize_grid(state_a, d, val_rev)[0][0] == 60

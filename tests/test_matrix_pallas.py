@@ -160,3 +160,32 @@ def test_matrix_pallas_step_kernel_matches_xla(seed):
     for rep, vid in val_ids.items():
         val_rev[vid] = eval(rep)
     assert mxk.materialize_grid(state_p, 0, val_rev) == expected
+
+
+def test_pallas_last_match_composes_with_cell_run_log():
+    """A per-op write after cell-run appends must update the NEWEST
+    duplicate (Pallas interpret vs XLA vs scalar expectation)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fluidframework_tpu.ops import matrix_pallas as mxp
+
+    state = mxk.init_state(1, vec_slots=8, cell_slots=32)
+    setup = [[dict(target=mxk.MX_ROWS, kind=0, pos=0, count=2,
+                   handle_base=0, seq=1, ref_seq=0, client=0),
+              dict(target=mxk.MX_COLS, kind=0, pos=0, count=2,
+                   handle_base=0, seq=2, ref_seq=1, client=0)]]
+    state = mxk.apply_tick(state, mxk.make_matrix_op_batch(setup, 1, 2))
+    # Duplicate-key log entries via the cell-run path (seq order 3, 4).
+    run = mxk.make_cell_run_batch(
+        [[dict(row=0, col=0, value=10, seq=3),
+          dict(row=0, col=0, value=20, seq=4)]], 1, 2, [2], [0])
+    state = mxk.apply_cell_run(state, run)
+    per_op = [[dict(target=mxk.MX_CELL, row=0, col=0, value=30,
+                    seq=5, ref_seq=4, client=0)]]
+    batch = mxk.make_matrix_op_batch(per_op, 1, 1)
+    got_xla = mxk.apply_tick(state, batch)
+    got_pallas = mxp.apply_tick_pallas(state, batch, interpret=True)
+    val_rev = list(range(64))
+    assert mxk.materialize_grid(got_xla, 0, val_rev)[0][0] == 30
+    assert mxk.materialize_grid(got_pallas, 0, val_rev)[0][0] == 30

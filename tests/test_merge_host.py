@@ -517,3 +517,37 @@ def test_long_lived_doc_stays_in_bucket_via_coalesce():
     assert row.pool.slots <= 256, row.pool.slots
     assert host.stats["compactions"] > 0
     assert host.text(*key) == oracle.get_text()
+
+
+def test_matrix_cell_run_fast_path_with_compaction():
+    """A settled grid under cell-write storms takes the scan-free
+    cell-run tile path; the append log dedups under capacity pressure;
+    a later structural op falls back to the exact per-op path and still
+    converges (mixed-path composition)."""
+    host = KernelMergeHost(flush_threshold=8)
+    server = LocalCollabServer(merge_host=host)
+    rng = random.Random(3)
+    c1 = Container.create_detached(LocalDocumentService(server, "doc"))
+    c1.runtime.create_datastore("default").create_channel(
+        "grid", SharedMatrix.channel_type)
+    c1.attach()
+    c2 = Container.load(LocalDocumentService(server, "doc"))
+    m1, m2 = get_matrix(c1), get_matrix(c2)
+    m1.insert_rows(0, 8)
+    m1.insert_cols(0, 8)
+    host.flush()
+    # Cell-only storm: repeated keys force log growth + dedup compaction.
+    for _ in range(40):
+        m = m1 if rng.random() < 0.5 else m2
+        m.set_cell(rng.randrange(8), rng.randrange(4), rng.randrange(99))
+    host.flush()
+    assert host.stats.get("cell_run_ticks", 0) > 0, "fast path never taken"
+    assert grid_of(m1) == grid_of(m2)
+    assert host.matrix_grid("doc", "default", "grid") == grid_of(m1)
+    # Structural op -> per-op fallback; cells after it still converge.
+    m1.insert_rows(2, 1)
+    for _ in range(12):
+        m2.set_cell(rng.randrange(9), rng.randrange(8), rng.randrange(99))
+    host.flush()
+    assert grid_of(m1) == grid_of(m2)
+    assert host.matrix_grid("doc", "default", "grid") == grid_of(m1)
