@@ -22,6 +22,8 @@ __main__ without the package import creating a second copy of it.
 _EXPORTS = {
     "Clicker": "clicker", "clicker_factory": "clicker",
     "CollabText": "collab_text", "collab_text_factory": "collab_text",
+    "RichTextEditor": "rich_text_editor",
+    "rich_text_editor_factory": "rich_text_editor",
     "TaskBoard": "task_board", "task_board_factory": "task_board",
 }
 

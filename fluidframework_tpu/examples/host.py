@@ -27,10 +27,12 @@ def _example_factories():
     from .clicker import clicker_factory
     from .collab_text import collab_text_factory
     from .dice_roller import dice_roller_factory
+    from .rich_text_editor import rich_text_editor_factory
     from .table_document import table_document_factory
     from .task_board import task_board_factory
     from .whiteboard import whiteboard_factory
     return {f.type: f for f in (clicker_factory, collab_text_factory,
+                                rich_text_editor_factory,
                                 task_board_factory, dice_roller_factory,
                                 whiteboard_factory,
                                 table_document_factory)}

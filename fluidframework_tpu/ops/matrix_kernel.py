@@ -388,7 +388,7 @@ def apply_cell_run(state: MatrixState, run: CellRunBatch) -> MatrixState:
 
     start = jnp.clip(jnp.max(state.cell_count), 0, capacity - num_r)
 
-    def place(table, plane, fill=None):
+    def place(table, plane):
         return jax.lax.dynamic_update_slice(
             table, plane.astype(table.dtype), (jnp.int32(0), start))
 
@@ -398,7 +398,11 @@ def apply_cell_run(state: MatrixState, run: CellRunBatch) -> MatrixState:
         cell_val=place(state.cell_val, run.value),
         cell_seq=place(state.cell_seq, run.seq),
         cell_used=place(state.cell_used, write),
-        cell_count=start + n_valid,
+        # Idle documents keep their count (an inflated count would
+        # collapse their reported margin); writers move to the shared
+        # tail, preserving every count <= next tick's shared start.
+        cell_count=jnp.where(n_valid > 0, start + n_valid,
+                             state.cell_count),
     )
 
 

@@ -410,6 +410,7 @@ def pack_keep(planes: list[jax.Array], keep: jax.Array
     avoids TPU-serialized scatters entirely. Tail slots (>= kept count)
     hold garbage; callers mask them."""
     num_slots = keep.shape[0]
+    iota = jnp.arange(num_slots)
     drops_excl = jnp.cumsum(~keep) - (~keep).astype(I32)
     rem = jnp.where(keep, drops_excl, 0).astype(I32)
     curk = keep
@@ -417,7 +418,10 @@ def pack_keep(planes: list[jax.Array], keep: jax.Array
     while b < num_slots:
         src_k = jnp.roll(curk, -b)
         src_rem = jnp.roll(rem, -b)
-        arrive = src_k & ((src_rem & b) != 0)
+        # Wrap guard: sources past the end are not real (their wrapped
+        # duplicates could only land in the garbage tail, but keep the
+        # invariant explicit rather than by analysis).
+        arrive = src_k & ((src_rem & b) != 0) & (iota < num_slots - b)
         stay = curk & ((rem & b) == 0)
         planes = [jnp.where(arrive, jnp.roll(p, -b), p) for p in planes]
         rem = jnp.where(arrive, src_rem - b, jnp.where(stay, rem, 0))

@@ -97,6 +97,9 @@ class KernelSequencerHost:
         self._state = seqk.init_state(self._capacity, self._alloc_slots + 1)
         self._rows: dict[str, int] = {}
         self._slots: list[dict[str, int]] = [{} for _ in range(self._capacity)]
+        # Bumped on every client->slot membership change; callers caching
+        # resolved (row, slot) cohorts key on it (server/storm.py).
+        self.membership_gen = 0
         self._pending: list[list[RawOperation]] = [
             [] for _ in range(self._capacity)]
         self._timeout_ms: list[int] = [
@@ -242,6 +245,7 @@ class KernelSequencerHost:
             if raw.client_id is None and raw.type == MessageType.CLIENT_LEAVE:
                 if kind == oc.OUT_SEQUENCED:
                     self._slots[row].pop(raw.data, None)
+                    self.membership_gen += 1
                     joined_ok.discard(raw.data)
             elif raw.client_id is None and raw.type == MessageType.CLIENT_JOIN:
                 # A sequenced join activates the lane; a dup-join (IGNORED)
@@ -252,12 +256,14 @@ class KernelSequencerHost:
                 if kind in (oc.OUT_SEQUENCED, oc.OUT_IGNORED):
                     client_id = getattr(raw.data, "client_id", raw.data)
                     self._slots[row][client_id] = enc["target"]
+                    self.membership_gen += 1
                     joined_ok.add(client_id)
         # Prune allocations that never became an active client: their slot
         # is inactive on device, so keeping the mapping would leak slots.
         for client_id in fresh:
             if client_id not in joined_ok:
                 self._slots[row].pop(client_id, None)
+                self.membership_gen += 1
         return tickets
 
     @staticmethod
@@ -401,6 +407,7 @@ class KernelSequencerHost:
             self._grow_slots(len(cp.clients))
         row = self._row(doc_id)
         self._slots[row] = {}
+        self.membership_gen += 1
         self._pending[row] = []
         self._ready.pop(doc_id, None)
         self._timeout_ms[row] = cp.client_timeout_ms

@@ -941,6 +941,14 @@ class KernelMergeHost:
             return
         self._ensure_matrix_state()
         vec_extra, cell_extra = self._matrix_vec_shortfall(rows)
+        if cell_extra:
+            # Dedup the cell append log before paying for growth on ANY
+            # path — after cell-run storms it is mostly superseded
+            # duplicates (the per-op fallback would otherwise ratchet
+            # device memory that one compaction frees).
+            self._matrix_state = mxk.compact_cell_log(self._matrix_state)
+            self.stats["compactions"] += 1
+            vec_extra, cell_extra = self._matrix_vec_shortfall(rows)
         if vec_extra:
             # Zamboni the permutation vectors before paying for growth —
             # tombstoned row/col segments below the window pack away.

@@ -913,22 +913,19 @@ class RouterliciousService:
         self._maybe_pump()
         log: list[SequencedDocumentMessage] = self.store.get(
             f"ops/{doc_id}", [])
-        storm_records = self.store.get(f"storm_ops/{doc_id}", [])
-        if storm_records:
+        storm = self.storm
+        wanted = (storm.records_overlapping(doc_id, from_seq, to_seq)
+                  if storm is not None else [])
+        if wanted:
             # Columnar scriptorium records (storm fast path) materialize
             # per-op messages lazily — only the catch-up read path pays,
             # and only for records overlapping the requested range (a
             # tip reader must not rebuild the whole history).
             from .storm import materialize_storm_records
-            storm = self.storm
-            wanted = [r for r in storm_records
-                      if r["last_seq"] > from_seq
-                      and (to_seq is None or r["first_seq"] <= to_seq)]
             log = sorted(
                 log + materialize_storm_records(
-                    wanted,
-                    storm.datastore if storm else "default",
-                    storm.channel if storm else "root"),
+                    wanted, storm.datastore, storm.channel,
+                    blob_reader=storm.read_tick_words),
                 key=lambda m: m.sequence_number)
         return [m for m in log
                 if m.sequence_number > from_seq

@@ -1,0 +1,104 @@
+"""Differential pin: the run-batched composite apply (within-tick op
+parallelism, ops/mergetree_runs.py) against the per-op kernel on the
+same sequenced streams."""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.ops import mergetree_runs as mtr
+
+
+def gen_stream(rng, n_ops, annotate=True):
+    ops, length, pool = [], 0, 0
+    for seq in range(1, n_ops + 1):
+        client = rng.randrange(4)
+        r = rng.random()
+        if length > 24 and r < 0.25:
+            start = rng.randrange(length - 8)
+            end = start + rng.randint(1, 6)
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=start, end=end,
+                            seq=seq, ref_seq=seq - 1, client=client))
+            length -= end - start
+        elif annotate and length > 24 and r < 0.40:
+            start = rng.randrange(length - 8)
+            ops.append(dict(kind=mtk.MT_ANNOTATE, pos=start,
+                            end=start + rng.randint(1, 6), seq=seq,
+                            ref_seq=seq - 1, client=client,
+                            prop_key=rng.randrange(2),
+                            prop_val=rng.randrange(1, 50)))
+        else:
+            tlen = rng.randint(1, 6)
+            ops.append(dict(kind=mtk.MT_INSERT,
+                            pos=rng.randint(0, length), seq=seq,
+                            ref_seq=seq - 1, client=client,
+                            pool_start=pool, text_len=tlen))
+            pool += tlen
+            length += tlen
+    return ops
+
+
+def materialize_ids(state, doc):
+    """(pool_start, length) of visible segments in order — the converged
+    text identity without a host pool."""
+    valid = np.asarray(state.valid[doc])
+    length = np.asarray(state.length[doc])
+    rem = np.asarray(state.rem_seq[doc])
+    start = np.asarray(state.pool_start[doc])
+    return [(int(start[i]), int(length[i]))
+            for i in range(valid.shape[0])
+            if valid[i] and rem[i] == mtk.NONE_SEQ and length[i] > 0]
+
+
+def props_view(state, doc):
+    valid = np.asarray(state.valid[doc])
+    rem = np.asarray(state.rem_seq[doc])
+    length = np.asarray(state.length[doc])
+    start = np.asarray(state.pool_start[doc])
+    props = np.asarray(state.prop_val[doc])
+    return [(int(start[i]), int(length[i]), tuple(props[i]))
+            for i in range(valid.shape[0])
+            if valid[i] and rem[i] == mtk.NONE_SEQ and length[i] > 0]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_run_kernel_matches_per_op(seed):
+    rng = random.Random(seed)
+    n_ops = 48
+    stream = gen_stream(rng, n_ops)
+    num_slots = 4 * n_ops + 8
+
+    # Per-op reference.
+    batch = mtk.make_merge_op_batch([stream], 1, n_ops)
+    ref_state = mtk.apply_tick(mtk.init_state(1, num_slots), batch)
+
+    # Run-batched.
+    runs = mtr.pack_runs(stream, r_max=8)
+    rb = mtr.make_run_batch([runs], 1, len(runs), 8)
+    got_state = mtr.apply_tick_runs(mtk.init_state(1, num_slots), rb)
+
+    assert materialize_ids(got_state, 0) == materialize_ids(ref_state, 0)
+    assert props_view(got_state, 0) == props_view(ref_state, 0)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_run_kernel_batched_docs(seed):
+    rng = random.Random(100 + seed)
+    n_docs, n_ops = 4, 32
+    streams = [gen_stream(rng, n_ops) for _ in range(n_docs)]
+    num_slots = 4 * n_ops + 8
+
+    batch = mtk.make_merge_op_batch(streams, n_docs, n_ops)
+    ref_state = mtk.apply_tick(mtk.init_state(n_docs, num_slots), batch)
+
+    runs = [mtr.pack_runs(s, r_max=8) for s in streams]
+    t = max(len(r) for r in runs)
+    rb = mtr.make_run_batch(runs, n_docs, t, 8)
+    got_state = mtr.apply_tick_runs(mtk.init_state(n_docs, num_slots), rb)
+
+    for d in range(n_docs):
+        assert materialize_ids(got_state, d) == \
+            materialize_ids(ref_state, d), d
+        assert props_view(got_state, d) == props_view(ref_state, d), d

@@ -1,0 +1,127 @@
+"""Editor-grade shared-text scenario (VERDICT r4 missing #4 / next #9).
+
+The reference's flagship app class is real rich text
+(examples/data-objects/shared-text + webflow/prosemirror integrations):
+marker-structured paragraphs + formatting annotates + interval comments,
+all riding one SharedString through a live service. These scenarios
+drive that COMBINED shape — the one that stresses annotate planes,
+markers and interval rebinds together — through the device-served
+merge host with multiple clients, asserting structured-render equality,
+not just text equality.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+from fluidframework_tpu.examples import host as example_host
+from fluidframework_tpu.runtime.loader import Loader
+from fluidframework_tpu.server.local_server import LocalCollabServer
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from tests.test_beast import load_corpus
+
+URL = "fluid://localhost/rich-doc"
+
+
+def _open_editor_doc(server):
+    loader = Loader(lambda doc: LocalDocumentService(server, doc),
+                    example_host.build_code_loader())
+    _container, editor = example_host.create_document(
+        loader, "@examples/rich-text-editor", URL,
+        props={"initial_text": ""})
+    return editor, loader
+
+
+def _join(loader):
+    _container, editor = example_host.open_existing(loader, URL)
+    return editor
+
+
+def test_two_editors_converge_structured():
+    host = KernelMergeHost(flush_threshold=64)
+    server = LocalCollabServer(merge_host=host)
+    e1, loader = _open_editor_doc(server)
+    e1.type_text(1, "The opening paragraph about TPU serving.")
+    e2 = _join(loader)
+
+    # Concurrent structure + formatting + comments.
+    e1.set_format(5, 12, bold=True)
+    pid = e2.split_paragraph(len(e2.read()))
+    e2.type_text(len(e2.read()), "A second paragraph from client two.")
+    e1.add_comment(5, 12, "headline")
+    e2.set_format(1, 4, em=True)
+    host.flush()
+    assert e1.render() == e2.render()
+    assert any(p["id"] == pid for p in e1.render())
+    assert e1.comments_overlapping(0, len(e1.read())) == \
+        e2.comments_overlapping(0, len(e2.read()))
+
+    # Comments ride concurrent edits BEFORE their anchor.
+    (start, end, note), = e1.comments_overlapping(0, len(e1.read()))
+    e2.type_text(1, "xxxxx ")
+    host.flush()
+    (s2, e2_, n2), = e1.comments_overlapping(0, len(e1.read()))
+    assert (s2, e2_, n2) == (start + 6, end + 6, note)
+    assert e1.render() == e2.render()
+
+
+@pytest.mark.parametrize("seed", [11])
+def test_editor_corpus_farm(seed):
+    """The beastTest corpus streamed through the EDITOR surface: typed
+    prose + paragraph breaks + formatting + comments from several
+    clients, device-served, structured render converging."""
+    words = load_corpus(40_000)
+    rng = random.Random(seed)
+    host = KernelMergeHost(flush_threshold=128)
+    server = LocalCollabServer(merge_host=host)
+    first, loader = _open_editor_doc(server)
+    editors = [first]
+    for _ in range(3):
+        editors.append(_join(loader))
+
+    cursor = 0
+    live_comments: list[str] = []
+    for step in range(600):
+        ed = editors[rng.randrange(len(editors))]
+        length = len(ed.text)  # position space includes markers
+        roll = rng.random()
+        if roll < 0.55 or length < 64:
+            n = rng.randrange(1, 7)
+            span = " ".join(words[(cursor + i) % len(words)]
+                            for i in range(n)) + " "
+            cursor += n
+            ed.type_text(rng.randrange(1, length + 1), span)
+        elif roll < 0.70:
+            start = rng.randrange(1, length - 16)
+            ed.delete(start, start + rng.randrange(1, 24))
+        elif roll < 0.82:
+            start = rng.randrange(1, length - 8)
+            ed.set_format(start, start + rng.randrange(1, 12),
+                          bold=bool(step % 2), style=step % 5)
+        elif roll < 0.92:
+            ed.split_paragraph(rng.randrange(1, length + 1))
+        else:
+            start = rng.randrange(1, length - 8)
+            cid = ed.add_comment(start, start + rng.randrange(1, 8),
+                                 f"note-{step}")
+            live_comments.append(cid)
+            if len(live_comments) > 8:
+                victim = live_comments.pop(0)
+                ed2 = editors[rng.randrange(len(editors))]
+                try:
+                    ed2.resolve_comment(victim)
+                except KeyError:
+                    pass
+    host.flush()
+    renders = [ed.render() for ed in editors]
+    texts = [ed.read() for ed in editors]
+    assert all(t == texts[0] for t in texts[1:])
+    assert all(r == renders[0] for r in renders[1:]), "renders diverged"
+    # The farm actually exercised the combined shape.
+    assert len(renders[0]) > 10, "no paragraph structure built"
+    assert any(p["comments"] for p in renders[0]) or live_comments
+    assert any(style for _text, style in
+               (run for p in renders[0] for run in p["runs"]))
+    # Device-served end to end: no scalar fallback engaged.
+    assert host.scalar_fraction() == 0.0
