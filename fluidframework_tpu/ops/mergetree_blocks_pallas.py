@@ -1,0 +1,194 @@
+"""Pallas TPU twin of the block-structured merge table — VMEM-resident.
+
+One grid program per document: the program DMAs its doc's [NB, Bk]
+planes + [NB, 1] summary columns into VMEM ONCE, applies all K
+sequenced ops with the SAME per-doc step the XLA path scans
+(:func:`mergetree_blocks.block_apply_doc` — shared body, so the twin
+cannot drift semantically; the differential test still pins every plane
+bit-for-bit), and writes back ONCE. HBM traffic per tick is O(B·S)
+regardless of K, and inside VMEM each op's structural phase moves one
+[Bk] block while position resolution runs over the [NB] summary column
++ one block — the O(S/Bk + Bk) layout contract realized where it
+matters (the flat Pallas kernel still paid O(S) VPU work per op for its
+full-table shifts and length-S scan chains; here the serialized scan
+chains are length NB and Bk).
+
+Only the axis primitives differ from the XLA path (`PltPrims`):
+``pltpu.roll`` for the within-block shifts and a log-shift scan for the
+exclusive prefix sums — integer adds, so both cumsum orders are exact
+and the twin stays bit-identical.
+
+Shapes (see /opt/skills/guides/pallas_guide.md): planes are i32 with
+(8, 128) tiles riding the trailing (NB, Bk) axes — size Bk to a lane
+multiple (the serving pools use Bk = 128) and NB to a sublane multiple
+for efficiency; summaries ride [NB, 1] columns (lane-padded like the
+flat kernel's count column). The per-doc block index is a scalar, so
+the block read/write is a real dynamic slice on the sublane-block axis,
+not a gather.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import mergetree_kernel as mtk
+from .mergetree_blocks import (
+    _OP_FIELDS,
+    _SLOT_PLANES,
+    _SUMM,
+    OVF_NONE,
+    BlockMergeState,
+    block_apply_doc,
+)
+
+I32 = jnp.int32
+
+
+class PltPrims:
+    """Mosaic twins of mergetree_blocks.BlockPrims."""
+
+    @staticmethod
+    def roll(x: jax.Array, shift: int, axis: int) -> jax.Array:
+        return pltpu.roll(x, shift=shift, axis=axis)
+
+    @staticmethod
+    def cumsum_excl(x: jax.Array, axis: int) -> jax.Array:
+        n = x.shape[axis]
+        idx = lax.broadcasted_iota(I32, x.shape, axis)
+        total = x
+        shift = 1
+        while shift < n:
+            total = total + jnp.where(
+                idx >= shift, pltpu.roll(total, shift=shift, axis=axis), 0)
+            shift *= 2
+        return total - x
+
+
+def _tick_kernel(*refs, num_ops: int):
+    plane_refs = refs[:6]
+    prop_ref, overlap_ref = refs[6], refs[7]
+    summ_refs = refs[8:12]
+    count_ref = refs[12]
+    op_refs = refs[13:24]
+    out_plane_refs = refs[24:30]
+    out_prop_ref, out_overlap_ref = refs[30], refs[31]
+    out_summ_refs = refs[32:36]
+    out_count_ref, out_ovf_ref = refs[36], refs[37]
+
+    planes = {name: ref[:] for name, ref in zip(_SLOT_PLANES, plane_refs)}
+    prop = prop_ref[:]
+    overlap = overlap_ref[:]
+    summ = {name: ref[:] for name, ref in zip(_SUMM, summ_refs)}
+    count = count_ref[:]
+    # Mosaic requires 128-aligned dynamic lane slices, so column k of the
+    # op row is selected with a masked reduction instead of a load.
+    op_vals = {name: ref[:] for name, ref in zip(_OP_FIELDS, op_refs)}
+    op_lane = lax.broadcasted_iota(I32, op_vals["kind"].shape, 1)
+
+    def body(k, carry):
+        planes, prop, overlap, summ, count, ovf = carry
+        op = {name: jnp.sum(jnp.where(op_lane == k, v, 0), axis=1,
+                            keepdims=True)
+              for name, v in op_vals.items()}
+        idx = jnp.zeros((1, 1), I32) + k
+        return block_apply_doc(planes, prop, overlap, summ, count, ovf,
+                               op, idx, prims=PltPrims)
+
+    # Serving flushes front-pack ops, so a dynamic trip count skips the
+    # invalid tail at zero per-step cost.
+    last_valid = jnp.max(jnp.where(op_vals["valid"] != 0, op_lane + 1, 0))
+    ovf0 = jnp.full((1, 1), OVF_NONE, I32)
+    planes, prop, overlap, summ, count, ovf = lax.fori_loop(
+        0, jnp.minimum(last_valid, num_ops), body,
+        (planes, prop, overlap, summ, count, ovf0))
+    for name, ref in zip(_SLOT_PLANES, out_plane_refs):
+        ref[:] = planes[name]
+    out_prop_ref[:] = prop
+    out_overlap_ref[:] = overlap
+    for name, ref in zip(_SUMM, out_summ_refs):
+        ref[:] = summ[name]
+    out_count_ref[:] = count
+    out_ovf_ref[:] = ovf
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def apply_tick_blocks_pallas(state: BlockMergeState,
+                             ops: mtk.MergeOpBatch,
+                             interpret: bool = False
+                             ) -> tuple[BlockMergeState, jax.Array]:
+    """Drop-in replacement for mergetree_blocks.apply_tick_blocks.
+    Returns (state', first-overflow op index [B])."""
+    b, nb, bk = state.length.shape
+    p = state.prop_val.shape[3]
+    w = state.rem_overlap.shape[3]
+    k = ops.kind.shape[1]
+
+    planes = [getattr(state, name) for name in _SLOT_PLANES]
+    prop = jnp.transpose(state.prop_val, (3, 0, 1, 2))      # [P, B, NB, Bk]
+    overlap = jnp.transpose(state.rem_overlap, (3, 0, 1, 2))
+    summs = [jnp.transpose(getattr(state, name)) for name in _SUMM]
+    count = state.count[:, None]
+    op_arrays = [getattr(ops, name).astype(I32) for name in _OP_FIELDS]
+
+    grid = (b,)
+    plane_spec = pl.BlockSpec((None, nb, bk), lambda i: (i, 0, 0),
+                              memory_space=pltpu.VMEM)
+    prop_spec = pl.BlockSpec((p, None, nb, bk), lambda i: (0, i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    overlap_spec = pl.BlockSpec((w, None, nb, bk), lambda i: (0, i, 0, 0),
+                                memory_space=pltpu.VMEM)
+    summ_spec = pl.BlockSpec((nb, 1), lambda i: (0, i),
+                             memory_space=pltpu.VMEM)
+    scalar_spec = pl.BlockSpec((1, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    op_spec = pl.BlockSpec((1, k), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        functools.partial(_tick_kernel, num_ops=k),
+        grid=grid,
+        in_specs=[plane_spec] * 6 + [prop_spec, overlap_spec]
+        + [summ_spec] * 4 + [scalar_spec] + [op_spec] * 11,
+        out_specs=[plane_spec] * 6 + [prop_spec, overlap_spec]
+        + [summ_spec] * 4 + [scalar_spec, scalar_spec],
+        out_shape=(
+            [jax.ShapeDtypeStruct((b, nb, bk), I32)] * 6
+            + [jax.ShapeDtypeStruct((p, b, nb, bk), I32),
+               jax.ShapeDtypeStruct((w, b, nb, bk), I32)]
+            + [jax.ShapeDtypeStruct((nb, b), I32)] * 4
+            + [jax.ShapeDtypeStruct((b, 1), I32),
+               jax.ShapeDtypeStruct((b, 1), I32)]),
+        input_output_aliases={i: i for i in range(13)},
+        interpret=interpret,
+    )(*planes, prop, overlap, *summs, count, *op_arrays)
+
+    new = state._replace(
+        **{name: arr for name, arr in zip(_SLOT_PLANES, out[:6])},
+        prop_val=jnp.transpose(out[6], (1, 2, 3, 0)),
+        rem_overlap=jnp.transpose(out[7], (1, 2, 3, 0)),
+        **{name: jnp.transpose(arr)
+           for name, arr in zip(_SUMM, out[8:12])},
+        count=out[12][:, 0])
+    return new, out[13][:, 0]
+
+
+def default_interpret() -> bool:
+    """Pallas TPU kernels need a real TPU; elsewhere run interpreted."""
+    return jax.default_backend() != "tpu"
+
+
+def apply_tick_blocks_best(state: BlockMergeState, ops: mtk.MergeOpBatch
+                           ) -> tuple[BlockMergeState, jax.Array]:
+    """Fastest correct block tick for the current backend: the Pallas
+    VMEM kernel on TPU, the XLA vmap-scan path everywhere else
+    (interpret-mode Pallas only serves the differential tests)."""
+    from .mergetree_blocks import apply_tick_blocks
+    if default_interpret():
+        return apply_tick_blocks(state, ops)
+    return apply_tick_blocks_pallas(state, ops)

@@ -25,6 +25,17 @@ Semantics come from the SAME merge_apply_vec the Pallas kernel runs
 paths cannot drift apart. Differential test:
 tests/test_mergetree_sharded.py (bit-identical to the unsharded kernel
 on live + random streams over the virtual 8-device mesh).
+
+Block-table compatibility: single-chip pools serve from the
+block-structured table (ops/mergetree_blocks.py — O(S/Bk + Bk) per op)
+whose BLOCK axis would not shard meaningfully (a distributed block
+resolve re-introduces the collectives per op the summaries exist to
+avoid), so sequence-parallel pools keep the FLAT layout this module
+shards and documents convert at the pool boundary:
+:func:`from_block_state` packs a block table into the flat layout when
+a document outgrows one chip, and ``mergetree_blocks.from_flat``
+re-blocks it if it ever shrinks back — both exact, pinned by
+tests/test_mergetree_blocks.py.
 """
 
 from __future__ import annotations
@@ -34,6 +45,11 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+try:  # jax >= 0.5 exports it at top level; 0.4.x keeps it experimental
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from .mergetree_kernel import MergeOpBatch, MergeState
 from .mergetree_pallas import _OPS, _PLANES, merge_apply_vec
@@ -168,8 +184,8 @@ def apply_tick_sharded(state: MergeState, ops: MergeOpBatch,
         state.count[:, None].astype(I32),
     ) + tuple(getattr(ops, name).astype(I32) for name in _OPS)
 
-    out = jax.shard_map(tick, mesh=mesh, in_specs=in_specs,
-                        out_specs=out_specs)(*flat_in)
+    out = _shard_map(tick, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)(*flat_in)
 
     named = dict(zip(_PLANES, out[:7]))
     return MergeState(
@@ -184,6 +200,15 @@ def apply_tick_sharded(state: MergeState, ops: MergeOpBatch,
         prop_val=jnp.transpose(out[7], (1, 2, 0)),
         count=out[9][:, 0],
     )
+
+
+def from_block_state(block_state, slots: int | None = None
+                     ) -> MergeState:
+    """Pack a block-structured table into the flat layout this module
+    shards (the doc-outgrew-one-chip migration source). ``slots`` pads
+    to the target sharded pool's segment capacity."""
+    from .mergetree_blocks import to_flat
+    return to_flat(block_state, slots)
 
 
 def shard_merge_state(state: MergeState, mesh: Mesh) -> MergeState:

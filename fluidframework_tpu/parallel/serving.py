@@ -44,6 +44,7 @@ import numpy as np
 
 from ..ops import map_kernel as mk
 from ..ops import matrix_kernel as mxk
+from ..ops import mergetree_blocks as mtb
 from ..ops import mergetree_kernel as mtk
 from ..ops import sequencer as seqk
 from ..ops import tree_kernel as tk
@@ -184,9 +185,13 @@ class ShardedServing:
         overlap_words = mtk.overlap_words_for(num_clients)
         self.text_slots = text_slots
         self.text_k = text_k or (k if text_slots else 0)
-        self.merge_state = lift(mtk.init_state(
-            b_local, text_slots, text_props,
-            overlap_words)) if text_slots else None
+        # Text rows live in the block-structured table (the serving
+        # path, ops/mergetree_blocks.py); geometry guarantees a
+        # capacity-checked tick can never overflow a block given the
+        # per-tick fused rebalance inside _mixed_tick.
+        self.merge_state = lift(mtb.init_state(
+            b_local, *mtb.choose_block_geometry(text_slots, self.text_k),
+            text_props, overlap_words)) if text_slots else None
         self.matrix_vec_slots = matrix_vec_slots
         self.matrix_cell_slots = matrix_cell_slots
         self.matrix_k = matrix_k or (k if matrix_vec_slots else 0)
@@ -507,6 +512,7 @@ class ShardedServing:
 
         put = lambda a: multihost.feed(self.mesh, a, global_batch=b)
         tree_overflow = None
+        text_overflow = None
         if not self._mixed:
             gather = np.arange(lo, hi, dtype=np.int32)
             (self.seq_state, self.map_state, n_seq, first, last,
@@ -521,7 +527,7 @@ class ShardedServing:
                  seq_counts, map_counts], axis=1)
             (self.seq_state, self.map_state, self.merge_state,
              self.matrix_state, self.tree_state, n_seq, first, last,
-             _msn, tree_overflow) = _mixed_tick(
+             _msn, tree_overflow, text_overflow) = _mixed_tick(
                 self.seq_state, self.map_state, self.merge_state,
                 self.matrix_state, self.tree_state,
                 put(scalars), put(map_words),
@@ -536,9 +542,10 @@ class ShardedServing:
         # enqueue; harvest only once ``pipeline_depth`` later ticks are
         # in flight behind it (depth 0 = synchronous, the default).
         rec = dict(submitted=submitted, records=records,
-                   out=(n_seq, first, last), tree_overflow=tree_overflow)
-        probes = rec["out"] + ((tree_overflow,)
-                               if tree_overflow is not None else ())
+                   out=(n_seq, first, last), tree_overflow=tree_overflow,
+                   text_overflow=text_overflow)
+        probes = rec["out"] + tuple(
+            a for a in (tree_overflow, text_overflow) if a is not None)
         for arr in probes:
             copy_async = getattr(arr, "copy_to_host_async", None)
             if copy_async is not None:
@@ -595,6 +602,18 @@ class ShardedServing:
                     f"tree rank overflow on rows "
                     f"{sorted(self.last_tree_overflow)}; host re-rank "
                     "required (size tree ranks for the tick width)")
+        if rec.get("text_overflow") is not None:
+            # choose_block_geometry + the fused per-tick rebalance make
+            # this unreachable for capacity-checked admissions; a hit
+            # means the geometry contract was violated — fail loudly.
+            overflowed = {
+                row: idx for row, idx in _addressable_rows(
+                    rec["text_overflow"]).items()
+                if idx != int(mtb.OVF_NONE)}
+            if overflowed:
+                raise RuntimeError(
+                    f"text block overflow on rows {sorted(overflowed)}; "
+                    "size text blocks for the tick width")
         return harvest
 
     # -- capacity maintenance --------------------------------------------------
@@ -606,8 +625,8 @@ class ShardedServing:
         device slot counts."""
         if self.merge_state is None:
             raise ValueError("assembly built without text_slots")
-        self.merge_state = mtk.compact(self.merge_state,
-                                       self.seq_state.msn)
+        self.merge_state = mtb.rebalance(self.merge_state,
+                                         self.seq_state.msn)
         for row, count in _addressable_rows(self.merge_state.count).items():
             if row in self._text_high:
                 self._text_high[row] = int(count)
@@ -841,7 +860,7 @@ class ShardedServing:
                               self.merge_state)
         pool = mtk.TextPool(1)
         pool.append(0, self.text_pool[row])
-        return mtk.materialize(state1, pool, 0)
+        return mtb.materialize(state1, pool, 0)
 
 
 __all__ = ["ShardedServing", "HostPort"]

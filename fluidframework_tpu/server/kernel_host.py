@@ -41,6 +41,7 @@ from ..ops import opcodes as oc
 from ..ops import sequencer as seqk
 from ..ops import sequencer_pallas as seqp
 from ..protocol.messages import MessageType
+from ..utils import compile_cache
 from .sequencer import (
     DocumentSequencer,
     RawOperation,
@@ -59,6 +60,12 @@ def _step_one(state: seqk.SequencerState, row, ops: seqk.OpBatch):
         lambda a, r: jax.lax.dynamic_update_slice_in_dim(a, r, row, axis=0),
         state, new_row)
     return state, out
+
+
+# Donated + repeatedly executed: must never load from the persistent
+# cache (jaxlib 0.4.37 double-frees donated buffers on the second run of
+# a cache-deserialized executable — compile_cache.bypass docstring).
+_step_one = compile_cache.uncached(_step_one)
 
 
 def _next_pow2(n: int) -> int:

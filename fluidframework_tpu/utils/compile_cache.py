@@ -17,6 +17,8 @@ Call :func:`enable` before first device use. Opt out with
 
 from __future__ import annotations
 
+import contextlib
+import functools
 import os
 
 _DEFAULT_DIR = os.path.join(
@@ -51,3 +53,64 @@ def enable(cache_dir: str | None = None) -> str | None:
     _enabled = True
     _active_dir = path
     return path
+
+
+@contextlib.contextmanager
+def bypass():
+    """Disable the persistent cache for the duration of the block.
+
+    Needed for DONATED serving ticks: jaxlib 0.4.37 mishandles buffer
+    donation on executables DESERIALIZED from the persistent cache —
+    the second execution of such an executable double-frees its donated
+    inputs (glibc "corrupted double-linked list" under the mixed
+    all-DDS tick; reproduced at /tmp with a two-process warm run of any
+    multi-tick mixed assembly, cold compiles unaffected). The donated
+    hot ticks therefore always compile in-process: they trade warm-start
+    seconds for correctness and keep in-place HBM donation."""
+    # The config dir is snapshotted into a singleton at first use, so a
+    # config context is a no-op once any jit compiled; the per-compile
+    # gate jax actually consults is the cached ``_cache_used`` verdict
+    # (compilation_cache.is_cache_used) — flip that for the block.
+    global _enabled, _active_dir
+    try:
+        from jax._src import compilation_cache as cc
+        with cc._cache_initialized_mutex:
+            prev = (cc._cache_checked, cc._cache_used)
+            cc._cache_checked, cc._cache_used = True, False
+    except Exception:
+        # jax internals moved: fail CLOSED. A silently inert guard would
+        # reintroduce the double-free on the next warm start, so turn
+        # the persistent cache off for the whole process (public config
+        # — effective as long as no jit compiled yet) and say so.
+        import warnings
+
+        import jax
+
+        warnings.warn(
+            "compile_cache.bypass: jax internals changed; disabling the "
+            "persistent compilation cache process-wide instead of "
+            "per-call (re-audit the donated-executable double-free "
+            "against this jax version)", RuntimeWarning, stacklevel=3)
+        jax.config.update("jax_compilation_cache_dir", None)
+        _enabled = False
+        _active_dir = None
+        yield
+        return
+    try:
+        yield
+    finally:
+        with cc._cache_initialized_mutex:
+            cc._cache_checked, cc._cache_used = prev
+
+
+def uncached(jitted):
+    """Wrap a donated jitted serving tick so its compile/lookup NEVER
+    touches the persistent cache (see :func:`bypass`). The traced
+    function stays reachable via ``__wrapped__`` (bench re-jits it
+    without donation, which the cache handles fine)."""
+    @functools.wraps(jitted)
+    def call(*args, **kwargs):
+        with bypass():
+            return jitted(*args, **kwargs)
+    call.__wrapped__ = getattr(jitted, "__wrapped__", jitted)
+    return call

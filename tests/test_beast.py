@@ -101,6 +101,7 @@ def test_beast_corpus_farm_small():
 
 
 @pytest.mark.soak
+@pytest.mark.slow
 def test_beast_corpus_farm_full():
     """The full-corpus tier (beastTest scale): 16 clients over the whole
     ~300KB corpus with heavier edit volume."""

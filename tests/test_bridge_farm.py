@@ -20,6 +20,7 @@ from fluidframework_tpu.tools.replay import canonical
 
 pytestmark = [
     pytest.mark.soak,
+    pytest.mark.slow,
     pytest.mark.skipif(
         _load_library() is None, reason="no C++ toolchain for the bridge"),
 ]

@@ -12,7 +12,7 @@ import random
 
 import pytest
 
-pytestmark = pytest.mark.soak
+pytestmark = [pytest.mark.soak, pytest.mark.slow]
 
 from fluidframework_tpu.drivers.local_driver import LocalDocumentService
 from fluidframework_tpu.runtime.container import Container

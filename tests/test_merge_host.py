@@ -124,6 +124,7 @@ def test_routerlicious_restart_rebuilds_fresh_host_from_op_log():
 
 
 @pytest.mark.soak  # ~80s: growth/compaction pressure sweep
+@pytest.mark.slow
 def test_capacity_pressure_compacts_and_grows():
     host = KernelMergeHost(merge_slots=8, map_slots=4, num_props=1,
                            flush_threshold=4)
@@ -148,6 +149,7 @@ def test_capacity_pressure_compacts_and_grows():
 
 
 @pytest.mark.soak  # ~65s: cross-bucket migration sweep
+@pytest.mark.slow
 def test_bucketed_pools_isolate_large_documents():
     """Ragged batching: one hot channel migrating to a bigger bucket must
     not widen the small channels' segment table (SURVEY §5.7)."""
@@ -268,6 +270,7 @@ def test_client_slot_overflow_routes_to_scalar():
 
 
 @pytest.mark.soak  # ~70s: 6000-op memory-bound soak
+@pytest.mark.slow
 def test_soak_host_memory_bounded(monkeypatch):
     """Long-lived channel: the replay log trims at every flush and the
     text pool repacks, so host memory stays bounded by the flush cadence

@@ -193,6 +193,36 @@ class TestEngineSemantics:
 # -- farm harness -------------------------------------------------------------
 
 
+def assert_block_index_exact(engine: MergeEngine) -> None:
+    """Recompute every block's settled length / unsettled count from
+    scratch and assert equality with the cached stats (VERDICT r5 weak
+    #7e): the settled-block index is safety-critical — a drifted
+    ``_blk_settled`` silently corrupts every position the walk skips a
+    block for — and was previously only exercised implicitly."""
+    assert sum(engine._blk_counts) == len(engine.segments)
+    assert (len(engine._blk_counts) == len(engine._blk_settled)
+            == len(engine._blk_unsettled) == len(engine._blk_text))
+    base = 0
+    for b, cnt in enumerate(engine._blk_counts):
+        settled_len = 0
+        unsettled = 0
+        for seg in engine.segments[base:base + cnt]:
+            if seg.settled_cached:
+                # The cached bit must itself be sound: classification is
+                # monotone (segments only settle between rebuilds).
+                assert engine._is_settled(seg), (b, seg)
+                settled_len += engine._settled_contrib(seg)
+            else:
+                unsettled += 1
+        assert engine._blk_settled[b] == settled_len, b
+        assert engine._blk_unsettled[b] == unsettled, b
+        if engine._blk_unsettled[b] == 0 and engine._blk_text[b] is not None:
+            assert engine._blk_text[b] == "".join(
+                s.content for s in engine.segments[base:base + cnt]
+                if not s.is_marker and s.removed_seq is None), b
+        base += cnt
+
+
 def make_string_doc(server, doc_id="doc"):
     service = LocalDocumentService(server, doc_id)
     container = Container.create_detached(service)
@@ -245,6 +275,8 @@ def test_conflict_farm(seed):
             c.inbound.resume()
         texts = [s.get_text() for s in strings]
         assert all(t == texts[0] for t in texts), (seed, _round, texts)
+        for s in strings:
+            assert_block_index_exact(s.engine)
     summaries = [c.summarize() for c in containers]
     assert all(s == summaries[0] for s in summaries), seed
     for c in containers:
@@ -272,5 +304,7 @@ def test_reconnect_farm(seed):
             c.reconnect()
         texts = [s.get_text() for s in strings]
         assert all(t == texts[0] for t in texts), (seed, _round, texts)
+        for s in strings:
+            assert_block_index_exact(s.engine)
     summaries = [c.summarize() for c in containers]
     assert all(s == summaries[0] for s in summaries), seed

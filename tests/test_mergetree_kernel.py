@@ -110,6 +110,7 @@ def test_kernel_matches_replicas(seed):
 
 
 @pytest.mark.soak  # ~60s/seed: the fused-vs-spec oracle runs in the soak tier
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", range(4))
 def test_fused_apply_op_matches_sequential_spec(seed):
     """_apply_op_spec (sequential split/split/place composition) is the

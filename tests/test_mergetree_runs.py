@@ -83,6 +83,80 @@ def test_run_kernel_matches_per_op(seed):
     assert props_view(got_state, 0) == props_view(ref_state, 0)
 
 
+class TestPackRunsRejections:
+    """The host packer's NEGATIVE paths: ops that would interact inside
+    one composite step must NOT pack (ISSUE 2 satellite — the documented
+    negative result stays pinned while mergetree_runs remains the
+    non-serving reference implementation)."""
+
+    @staticmethod
+    def _ins(pos, seq, tlen=3):
+        return dict(kind=mtk.MT_INSERT, pos=pos, seq=seq,
+                    ref_seq=seq - 1, client=0, pool_start=seq * 10,
+                    text_len=tlen)
+
+    @staticmethod
+    def _rm(pos, end, seq, ref=None):
+        return dict(kind=mtk.MT_REMOVE, pos=pos, end=end, seq=seq,
+                    ref_seq=seq - 1 if ref is None else ref, client=0)
+
+    def test_same_position_inserts_never_pack(self):
+        # Two inserts at one boundary have a breakTie interaction (the
+        # second's placement depends on the first's segment): the second
+        # op's position lands inside the first's inserted span.
+        runs = mtr.pack_runs([self._ins(0, 1), self._ins(0, 2)])
+        assert [len(r) for r in runs] == [1, 1]
+
+    def test_range_touching_in_run_insert_never_packs(self):
+        # A remove over a span an in-run insert produced must flush the
+        # run (its boundary split would interact with the placement);
+        # the two removes that follow are mutually independent in the
+        # run-start frame and may pack together — but never with op 1.
+        runs = mtr.pack_runs([self._ins(0, 1, tlen=8),
+                              self._rm(0, 2, 2), self._rm(3, 5, 3)])
+        assert [[op["seq"] for op in r] for r in runs] == [[1], [2, 3]]
+
+    def test_concurrent_ref_never_packs(self):
+        # An op whose ref does not cover every prior seq in the run
+        # needs its exact per-op frame — one shared frame is unsound.
+        # Ops 1+2 are range-independent and pack; op 3 (ref below op
+        # 2's seq) must start a fresh run even though its range is far
+        # from both.
+        ops = [self._ins(0, 1, tlen=4), self._rm(8, 9, 2),
+               self._rm(12, 13, 3, ref=1)]
+        runs = mtr.pack_runs(ops)
+        assert [[op["seq"] for op in r] for r in runs] == [[1, 2], [3]]
+
+    def test_range_spanning_in_run_edit_never_packs(self):
+        # A remove whose frame-0 fold shortens its span touches an
+        # in-run insert — dependent, must flush.
+        runs = mtr.pack_runs([self._ins(4, 1, tlen=3),
+                              self._rm(2, 10, 2)])
+        assert [len(r) for r in runs] == [1, 1]
+
+    def test_insert_into_removed_seam_never_packs(self):
+        # Inserting exactly at a collapsed removed span's seam depends
+        # on the remove's tombstones (breakTie skips them).
+        runs = mtr.pack_runs([self._rm(2, 4, 1), self._ins(2, 2)])
+        assert [len(r) for r in runs] == [1, 1]
+
+    def test_r_max_closes_runs(self):
+        ops = [self._ins(8 * i, i + 1, tlen=1) for i in range(6)]
+        runs = mtr.pack_runs(ops, r_max=2)
+        assert [len(r) for r in runs] == [2, 2, 2]
+
+    def test_rejected_streams_still_apply_exactly(self):
+        # The flush boundaries themselves must not change semantics.
+        ops = [self._ins(0, 1, tlen=4), self._ins(0, 2, tlen=2),
+               self._rm(1, 3, 3), self._ins(3, 4, tlen=1)]
+        batch = mtk.make_merge_op_batch([ops], 1, 4)
+        ref_state = mtk.apply_tick(mtk.init_state(1, 64), batch)
+        runs = mtr.pack_runs(ops, r_max=4)
+        rb = mtr.make_run_batch([runs], 1, len(runs), 4)
+        got = mtr.apply_tick_runs(mtk.init_state(1, 64), rb)
+        assert materialize_ids(got, 0) == materialize_ids(ref_state, 0)
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_run_kernel_batched_docs(seed):
     rng = random.Random(100 + seed)

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from fluidframework_tpu.ops import matrix_kernel as mxk
+from fluidframework_tpu.ops import mergetree_blocks as mtb
 from fluidframework_tpu.ops import mergetree_kernel as mtk
 from fluidframework_tpu.ops import tree_kernel as tk
 from fluidframework_tpu.parallel.mesh import make_mesh
@@ -184,14 +185,29 @@ def test_mixed_population_matches_per_family_kernels(mesh):
     expected_text = mtk.materialize(ref_text, ref_pool, 0)
     assert expected_text  # the script must leave visible text
 
+    first_text = None
     for row in range(num_docs):
         fam = family_of(row)
         if fam == "text":
-            got = row_planes(serving.merge_state, row)
-            for field in mtk.MergeState._fields:
+            # The block serving table rebalances at each tick's MSN, so
+            # plane equality against the flat oracle is not meaningful;
+            # the contract is byte-identical TEXT vs the flat kernel,
+            # bitwise-identical block state across same-traffic rows,
+            # and exact summaries (no incremental drift).
+            got = jax.tree.map(np.asarray,
+                               row_planes(serving.merge_state, row))
+            if first_text is None:
+                first_text = got
+            else:
+                for a, b in zip(jax.tree.leaves(got),
+                                jax.tree.leaves(first_text)):
+                    assert np.array_equal(a, b), row
+            rebuilt = mtb.recompute_summaries(got)
+            for field in ("blk_live_len", "blk_max_seq", "blk_tomb",
+                          "count"):
                 assert np.array_equal(
                     np.asarray(getattr(got, field)),
-                    np.asarray(getattr(ref_text, field))), (row, field)
+                    np.asarray(getattr(rebuilt, field))), (row, field)
             assert serving.text_of(row) == expected_text
         elif fam == "matrix":
             got = row_planes(serving.matrix_state, row)
@@ -235,7 +251,7 @@ def test_mixed_dedup_resend_is_idempotent(mesh):
         merged.update(rows)
     assert merged[row] == (0, 0, 0)
     after = row_planes(serving.merge_state, row)
-    for field in mtk.MergeState._fields:
+    for field in mtb.BlockMergeState._fields:
         assert np.array_equal(np.asarray(getattr(after, field)),
                               np.asarray(getattr(before, field))), field
     assert serving.text_of(row) == text_before
@@ -339,7 +355,7 @@ def test_mixed_kill_resume_rebalance_with_text(mesh):
     assert np.array_equal(np.asarray(revived.seq_state.seq), final_seq)
     for row, text in final_texts.items():
         assert revived.text_of(row) == text, row
-    for field in mtk.MergeState._fields:
+    for field in mtb.BlockMergeState._fields:
         assert np.array_equal(
             np.asarray(getattr(revived.merge_state, field)),
             np.asarray(getattr(serving.merge_state, field))), field
@@ -452,7 +468,7 @@ def test_pipelined_harvest_matches_sync(mesh):
                 want[host].append((row, ack))
     assert sorted(got[0]) == sorted(want[0])
     assert sorted(got[1]) == sorted(want[1])
-    for field in mtk.MergeState._fields:
+    for field in mtb.BlockMergeState._fields:
         assert np.array_equal(
             np.asarray(getattr(piped.merge_state, field)),
             np.asarray(getattr(sync.merge_state, field))), field
