@@ -1174,7 +1174,9 @@ print(json.dumps({"elapsed": time.perf_counter() - t0,
 
 
 def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
-                    n_conns: int = 8, num_slots: int = 32) -> dict:
+                    n_conns: int = 8, num_slots: int = 32,
+                    durability: str | None = None,
+                    spill_dir: str | None = None) -> dict:
     """End-to-end merged-ops/sec through the REAL serving path: client
     processes → framed TCP → C++ bridge front door → alfred dispatch →
     deli (device sequencer kernel, full NACK/MSN semantics) → merger (map
@@ -1197,8 +1199,19 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
     service = RouterliciousService(merge_host=merge_host,
                                    batched_deli_host=seq_host,
                                    auto_pump=False, fanout=make_fanout())
+    # Durability column: None = in-RAM tick records (no WAL);
+    # "group" = the async group-commit WAL (acks withheld until fsync —
+    # the crash-safe production shape); "sync"/"none" = inline append
+    # with/without per-tick fsync ("none" is the round-5 shape whose
+    # synchronous serialize+append sat on the harvest path).
+    owned_spill = None
+    if durability is not None and spill_dir is None:
+        import tempfile
+        spill_dir = owned_spill = tempfile.mkdtemp(prefix="storm-bench-")
     storm = StormController(service, seq_host, merge_host,
-                            flush_threshold_docs=num_docs)
+                            flush_threshold_docs=num_docs,
+                            spill_dir=spill_dir,
+                            durability=durability or "none")
     front = BridgeFrontDoor(service, 0)
 
     # Setup (untimed): one writer joins per document through the service
@@ -1319,6 +1332,7 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
 
     cadence_ms = 1000.0 * np.asarray(storm.harvest_intervals or [0.0])
     out = {
+        "durability": durability if durability is not None else "off",
         "e2e_ops_per_sec": sequenced / elapsed,
         "sequenced_ops": sequenced,
         "elapsed_s": elapsed,
@@ -1344,6 +1358,15 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
                 "sequencer kernel -> map kernel (fused) -> durable log "
                 "+ fanout + acks",
     }
+    # The WAL writer thread/fd and the bench's own tick blobs (~hundreds
+    # of MB at this shape) must not outlive the row.
+    if storm._group_wal is not None:
+        storm._group_wal.close()
+    elif storm._blob_log is not None:
+        storm._blob_log.close()
+    if owned_spill is not None:
+        import shutil
+        shutil.rmtree(owned_spill, ignore_errors=True)
     return out
 
 
@@ -1451,6 +1474,13 @@ def main() -> None:
         "map_storm_10k_docs": bench_map(),
         "map_storm_saturated_k4096": bench_map(k=4096, ticks=6),
         "e2e_storm_10k_docs": bench_e2e_storm(),
+        # Durability-mode column (ISSUE 4): the same e2e path with the
+        # crash-safe WAL ON — group commit must hold the rate while
+        # "sync" shows what per-tick fsync would cost.
+        "e2e_storm_10k_docs_durable_group": bench_e2e_storm(
+            durability="group"),
+        "e2e_storm_10k_docs_durable_sync": bench_e2e_storm(
+            durability="sync"),
         # The reference's FULL load profile (testConfig.json:10-16): 240
         # clients, 10M ops through the real socket path, with RSS + rate
         # series as soak evidence (tools/load_test.py). Needs the C++
@@ -1513,11 +1543,15 @@ def main() -> None:
     head["speedup_vs_numpy_batched_cpu"] = round(
         head["device_ops_per_sec"] / head["numpy_batched_cpu_ops_per_sec"],
         2)
+    for key in ("e2e_storm_10k_docs", "e2e_storm_10k_docs_durable_group",
+                "e2e_storm_10k_docs_durable_sync"):
+        e2e_row = detail[key]
+        e2e_row["fraction_of_kernel_only_rate"] = round(
+            e2e_row["e2e_ops_per_sec"] / head["device_ops_per_sec"], 4)
+        e2e_row["fraction_of_link_ceiling"] = round(
+            e2e_row["e2e_ops_per_sec"]
+            / e2e_row["link_implied_ops_ceiling"], 3)
     e2e = detail["e2e_storm_10k_docs"]
-    e2e["fraction_of_kernel_only_rate"] = round(
-        e2e["e2e_ops_per_sec"] / head["device_ops_per_sec"], 4)
-    e2e["fraction_of_link_ceiling"] = round(
-        e2e["e2e_ops_per_sec"] / e2e["link_implied_ops_ceiling"], 3)
     with open("BENCH_DETAIL.json", "w") as f:
         json.dump(detail, f, indent=2)
     print(json.dumps(detail, indent=2), file=sys.stderr)

@@ -41,12 +41,22 @@ class FlushMode:
 class DeltaManager:
     """Inbound/outbound op pump between a driver connection and a handler."""
 
+    #: Own echoed-but-not-proven-durable ops retained for reconnect
+    #: resubmission. Bounded: the crash race lives at the stream tip (the
+    #: per-op path journals before broadcasting; the storm path fsyncs
+    #: before acking), so ops far behind the tip are durable in every
+    #: non-pathological run — the oldest entry drops when the window
+    #: overflows rather than growing with session length.
+    RESUBMIT_WINDOW = 1024
+
     def __init__(
         self,
         service: DocumentService,
         process_message: Callable[[SequencedDocumentMessage], None],
         process_signal: Callable[[Any], None] | None = None,
         on_nack: Callable[[Any], None] | None = None,
+        on_lost_ops: Callable[[list[SequencedDocumentMessage]], None]
+        | None = None,
     ) -> None:
         self._service = service
         self._connection: Any = None
@@ -54,6 +64,21 @@ class DeltaManager:
         self.client_seq = 0
         self.last_processed_seq = 0   # seq of last message run through handler
         self.last_queued_seq = 0      # seq of last message accepted inbound
+        # Acknowledged-durability watermark: the highest SEQUENCE NUMBER
+        # the service has proven durable. Everything read back from delta
+        # storage is durable by definition (it came from the journal).
+        # NOTE the storm ack's "dw" field is a TICK-count watermark, not
+        # a seq — a storm-aware host must feed note_durable with the
+        # ack's per-doc last_seq once "dw" covers the tick, never "dw"
+        # itself. A live broadcast above the watermark may still be lost
+        # to a server crash — which is why own echoed ops stay in
+        # _undurable until the watermark passes them.
+        self.last_durable_seq = 0
+        # Own ops echoed back (acked) but not yet known durable, oldest
+        # first: the resubmit-on-reconnect candidates after a server
+        # crash loses acked-but-unfsynced ops.
+        self._undurable_own: list[SequencedDocumentMessage] = []
+        self._on_lost_ops = on_lost_ops
         self.flush_mode = FlushMode.IMMEDIATE
         self._parked: dict[int, SequencedDocumentMessage] = {}
         self._fetching = False
@@ -89,9 +114,49 @@ class DeltaManager:
         assert self._connection is None, "already connected"
         for message in self._service.delta_storage.get_deltas(
                 self.last_queued_seq, to_seq):
+            self.note_durable(message.sequence_number)
             self._accept(message)
         self.inbound.resume()  # drain exactly what was accepted
         self.inbound.pause()
+
+    def note_durable(self, seq: int) -> None:
+        """Advance the acknowledged-durability watermark (storage reads
+        and service "dw" acks both feed this) and retire own echoed ops
+        the service has now proven durable."""
+        if seq <= self.last_durable_seq:
+            return
+        self.last_durable_seq = seq
+        while (self._undurable_own
+               and self._undurable_own[0].sequence_number <= seq):
+            self._undurable_own.pop(0)
+
+    def _check_lost_ops(self) -> None:
+        """Resubmit-on-reconnect against the durability watermark: own
+        ops that were ECHOED (acked) but never proven durable may have
+        died with the server. Probe storage for them; any op the
+        recovered journal does not hold is lost — hand it to the
+        ``on_lost_ops`` hook (the runtime resubmits through its own
+        channels, regenerating refs/clientSeqs) rather than silently
+        converging without it."""
+        if not self._undurable_own:
+            return
+        lo = self._undurable_own[0].sequence_number - 1
+        hi = self._undurable_own[-1].sequence_number
+        fetched = self._service.delta_storage.get_deltas(lo, hi)
+        # Identity match, NOT bare sequence number: a recovered server
+        # resumes numbering from its durable tip, so a seq our lost op
+        # once held may now belong to ANOTHER client's post-crash
+        # submission — which must not mask the loss.
+        held = {(m.client_id, m.client_sequence_number) for m in fetched}
+        lost = [m for m in self._undurable_own
+                if (m.client_id, m.client_sequence_number) not in held]
+        self._undurable_own = []
+        if fetched:
+            # The journal is seq-contiguous: holding N proves 1..N.
+            self.last_durable_seq = max(self.last_durable_seq,
+                                        fetched[-1].sequence_number)
+        if lost and self._on_lost_ops is not None:
+            self._on_lost_ops(lost)
 
     def connect(self, mode: str = "write") -> str:
         """Catch up from delta storage, then go live. Returns the client id.
@@ -101,8 +166,10 @@ class DeltaManager:
         """
         assert self._connection is None, "already connected"
         self._read_mode = mode == "read"
+        self._check_lost_ops()
         for message in self._service.delta_storage.get_deltas(
                 self.last_queued_seq):
+            self.note_durable(message.sequence_number)
             self._accept(message)
         connection = self._service.connect(
             self._enqueue_messages,
@@ -199,6 +266,16 @@ class DeltaManager:
             f"inbound queue disorder: got {message.sequence_number}, "
             f"expected {self.last_processed_seq + 1}")
         self.last_processed_seq = message.sequence_number
+        if (message.client_id is not None
+                and message.client_id == self.client_id
+                and message.type == MessageType.OPERATION
+                and message.sequence_number > self.last_durable_seq):
+            # Own op echoed from a LIVE broadcast: acked, but the service
+            # has not yet proven it durable — keep it resubmittable until
+            # the watermark passes it (see _check_lost_ops).
+            if len(self._undurable_own) >= self.RESUBMIT_WINDOW:
+                self._undurable_own.pop(0)
+            self._undurable_own.append(message)
         self._process_message(message)
 
     def _handle_nack(self, nack: Any) -> None:

@@ -79,8 +79,8 @@ class BridgeFrontDoor:
                 # (the batched-cadence operator tick) so connection-skewed
                 # tails never starve waiting for a full cohort.
                 storm = getattr(self.service, "storm", None)
-                if storm is not None and (storm._frames
-                                          or storm._inflight is not None):
+                if storm is not None and (storm._frames or storm._inflight
+                                          or storm._unacked):
                     try:
                         storm.flush()
                     except Exception as err:

@@ -404,6 +404,15 @@ class KernelSequencerHost:
             log_offset=log_offset,
         )
 
+    def checkpoint_all(self) -> dict[str, SequencerCheckpoint]:
+        """Checkpoints for EVERY tracked document off the cached host
+        mirror — one device transfer however many documents (the storm
+        snapshot path; per-doc checkpoint() in a loop would be O(docs)
+        cache probes but this makes the intent explicit and skips the
+        per-call row slicing overhead)."""
+        self._host_view()
+        return {doc_id: self.checkpoint(doc_id) for doc_id in self._rows}
+
     def restore(self, doc_id: str, cp: SequencerCheckpoint) -> None:
         """Load a checkpoint into a document row, OVERWRITING any live row
         for the document: the checkpoint + committed bus offset are the

@@ -59,6 +59,7 @@ from ..ops import mergetree_kernel as mtk
 from ..ops import mergetree_pallas as mtp
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedDocumentMessage
+from ..utils import faults
 from .kernel_host import _next_pow2, _tick_k
 
 _MERGE_OPS = frozenset({"insert", "remove", "annotate", "group"})
@@ -451,6 +452,10 @@ class _BlockMergePool(_MergePool):
         for r in self.members:
             if r is not None:
                 min_seq[r.row] = r.min_seq
+        # Chaos kill class "mid-rebalance": the layout is about to move;
+        # a crash here loses only volatile device state (the durable log
+        # + snapshot replay rebuilds the row byte-identically).
+        faults.crashpoint("pool.mid_rebalance")
         self.state = self.place(mtb.rebalance(self.state,
                                               jnp.asarray(min_seq)))
 
@@ -2094,6 +2099,297 @@ class KernelMergeHost:
                  if k.doc_id == doc_id]
         return {"datastores": datastores,
                 "sequence_number": max(seqs, default=0)}
+
+    # -- snapshot / restore (device-pool checkpoint) ---------------------------
+    #
+    # The crash-consistency leg (ISSUE 4): the device pools are volatile,
+    # so a serving-host restart either replays the WHOLE durable op log
+    # (exact, O(history)) or restores a periodic host-side checkpoint and
+    # replays only the tail. export_state() captures every device plane
+    # (merge pools, map state, matrix state) plus the host-side string/
+    # slot mappings the kernels cannot carry, in a wire-serializable
+    # form (GitSnapshotStore uploads it as chunked content-addressed
+    # blobs). import_state() rebuilds a FRESH host byte-identically —
+    # block pools re-install their exact [B, NB, Bk] planes.
+    #
+    # Scope: merge rows (device AND scalar-routed), the map state, and
+    # matrix rows (device and scalar). Tree channels are NOT snapshotted:
+    # they rebuild from the scriptorium durable-log replay (the merger
+    # lambda already does this on restart); export records their keys so
+    # the caller knows replay is required.
+
+    def export_state(self) -> dict:
+        """Wire-serializable checkpoint of all device pools + host maps.
+        Flushes first so no pending/raw tails need serializing."""
+        self.flush()
+        pools = []
+        pool_index: dict[int, int] = {}
+        for slots, pool in sorted(self._merge_pools.items()):
+            kind = ("sharded" if isinstance(pool, _ShardedMergePool)
+                    else "block" if isinstance(pool, _BlockMergePool)
+                    else "flat")
+            pool_index[id(pool)] = len(pools)
+            pools.append({
+                "kind": kind, "slots": pool.slots,
+                "num_props": pool.num_props,
+                "overlap_words": pool.overlap_words,
+                "capacity": pool.capacity,
+                "planes": {f: _nd_pack(np.asarray(getattr(pool.state, f)))
+                           for f in type(pool.state)._fields},
+                "text": [pool.text.buffer(r) for r in range(pool.capacity)],
+                "text_used": list(pool.text.used),
+                "free": list(pool.free),
+                "n_members": len(pool.members),
+            })
+        merge_rows = []
+        for key, r in self._merge_rows.items():
+            assert not r.pending and not r.raw_log, (
+                "export_state after flush() found pending ops")
+            merge_rows.append({
+                "key": list(key),
+                "pool": (pool_index[id(r.pool)]
+                         if r.pool is not None else None),
+                "row": r.row,
+                "client_slots": r.client_slots,
+                "key_slots": r.key_slots,
+                "min_seq": r.min_seq, "last_seq": r.last_seq,
+                "applied_seq": r.applied_seq,
+                "applied_min_seq": r.applied_min_seq,
+                "repack_at": r.repack_at,
+                "scalar": (_dump_engine(r.scalar)
+                           if r.scalar is not None else None),
+            })
+        map_rows = [{
+            "key": list(key), "row": r.row, "key_slots": r.key_slots,
+            "last_seq": r.last_seq, "literal": r.literal_values,
+        } for key, r in self._map_rows.items()]
+        matrix = None
+        if self._matrix_rows or self._matrix_state is not None:
+            state = None
+            if self._matrix_state is not None:
+                s = self._matrix_state
+                state = {f: _nd_pack(np.asarray(getattr(s, f)))
+                         if f not in ("rows", "cols") else
+                         {g: _nd_pack(np.asarray(getattr(getattr(s, f), g)))
+                          for g in mtk.MergeState._fields}
+                         for f in mxk.MatrixState._fields}
+            matrix = {
+                "capacity": self._matrix_capacity,
+                "vec_slots": self._matrix_vec_slots,
+                "cell_slots": self._matrix_cell_slots,
+                "overlap_words": self._matrix_overlap_words,
+                "state": state,
+                "rows": [{
+                    "key": list(key), "row": r.row,
+                    "client_slots": r.client_slots,
+                    "last_seq": r.last_seq, "min_seq": r.min_seq,
+                    "applied_seq": r.applied_seq,
+                    "applied_min_seq": r.applied_min_seq,
+                    "next_row_handle": r.next_row_handle,
+                    "next_col_handle": r.next_col_handle,
+                    "last_vec_seq": r.last_vec_seq,
+                    "scalar": (_dump_matrix_scalar(r.scalar)
+                               if r.scalar is not None else None),
+                } for key, r in self._matrix_rows.items()],
+            }
+        return {
+            "version": 1,
+            "vals": list(self._val_rev),
+            "merge_pools": pools,
+            "merge_rows": merge_rows,
+            "map": {
+                "capacity": self._map_capacity, "slots": self._map_slots,
+                "planes": {f: _nd_pack(np.asarray(getattr(self._xstate, f)))
+                           for f in mk.MapState._fields},
+                "rows": map_rows,
+            },
+            "matrix": matrix,
+            # Not snapshotted — these channels need a durable-log replay.
+            "tree_keys": [list(k) for k in self._tree_rows],
+            "stats": dict(self.stats),
+        }
+
+    def import_state(self, snap: dict) -> None:
+        """Rebuild a FRESH host from :meth:`export_state` output."""
+        assert not (self._merge_rows or self._map_rows or self._matrix_rows
+                    or self._tree_rows), "import_state needs a fresh host"
+        if snap.get("version") != 1:
+            raise ValueError(f"unknown snapshot version {snap.get('version')}")
+        self._val_rev = list(snap["vals"])
+        self._vals = {repr(v): i for i, v in enumerate(self._val_rev)
+                      if i != 0}
+
+        pools: list[_MergePool] = []
+        for p in snap["merge_pools"]:
+            if p["kind"] == "block":
+                pool: _MergePool = _BlockMergePool(
+                    p["slots"], p["num_props"], p["capacity"],
+                    p["overlap_words"])
+            elif p["kind"] == "flat":
+                pool = _MergePool(p["slots"], p["num_props"], p["capacity"],
+                                  p["overlap_words"])
+            else:  # sharded: needs the mesh the exporting host had
+                if self.seg_mesh is None:
+                    raise ValueError(
+                        "snapshot holds a sequence-parallel pool but this "
+                        "host has no seg_mesh")
+                pool = _ShardedMergePool(p["slots"], p["num_props"],
+                                         self.seg_mesh, p["capacity"],
+                                         p["overlap_words"])
+            cls = type(pool.state)
+            pool.state = pool.place(jax.device_put(cls(
+                **{f: _nd_unpack(p["planes"][f]) for f in cls._fields})))
+            pool.text = mtk.TextPool(p["capacity"])
+            for r, text in enumerate(p["text"]):
+                if text:
+                    pool.text.chunks[r] = [text]
+            pool.text.used = list(p["text_used"])
+            pool.free = list(p["free"])
+            pool.members = [None] * p["n_members"]
+            self._merge_pools[p["slots"]] = pool
+            pools.append(pool)
+
+        for rec in snap["merge_rows"]:
+            r = _MergeRow()
+            r.client_slots = dict(rec["client_slots"])
+            r.key_slots = dict(rec["key_slots"])
+            r.min_seq, r.last_seq = rec["min_seq"], rec["last_seq"]
+            r.applied_seq = rec["applied_seq"]
+            r.applied_min_seq = rec["applied_min_seq"]
+            r.repack_at = rec["repack_at"]
+            if rec["scalar"] is not None:
+                r.scalar = _load_engine(rec["scalar"])
+                r.pool, r.row = None, -1
+            else:
+                r.pool = pools[rec["pool"]]
+                r.row = rec["row"]
+                r.pool.members[r.row] = r
+            self._merge_rows[ChannelKey(*rec["key"])] = r
+
+        m = snap["map"]
+        self._map_capacity, self._map_slots = m["capacity"], m["slots"]
+        self._xstate = jax.device_put(mk.MapState(
+            **{f: _nd_unpack(m["planes"][f]) for f in mk.MapState._fields}))
+        for rec in m["rows"]:
+            row = _MapRow(rec["row"])
+            row.key_slots = dict(rec["key_slots"])
+            row.last_seq = rec["last_seq"]
+            row.literal_values = rec["literal"]
+            self._map_rows[ChannelKey(*rec["key"])] = row
+
+        mx = snap.get("matrix")
+        if mx is not None:
+            self._matrix_capacity = mx["capacity"]
+            self._matrix_vec_slots = mx["vec_slots"]
+            self._matrix_cell_slots = mx["cell_slots"]
+            self._matrix_overlap_words = mx["overlap_words"]
+            if mx["state"] is not None:
+                st = mx["state"]
+                self._matrix_state = jax.device_put(mxk.MatrixState(**{
+                    f: (mtk.MergeState(**{g: _nd_unpack(st[f][g])
+                                          for g in mtk.MergeState._fields})
+                        if f in ("rows", "cols") else _nd_unpack(st[f]))
+                    for f in mxk.MatrixState._fields}))
+            for rec in mx["rows"]:
+                row = _MatrixRow(rec["row"])
+                row.client_slots = dict(rec["client_slots"])
+                row.last_seq, row.min_seq = rec["last_seq"], rec["min_seq"]
+                row.applied_seq = rec["applied_seq"]
+                row.applied_min_seq = rec["applied_min_seq"]
+                row.next_row_handle = rec["next_row_handle"]
+                row.next_col_handle = rec["next_col_handle"]
+                row.last_vec_seq = rec["last_vec_seq"]
+                if rec["scalar"] is not None:
+                    row.scalar = _load_matrix_scalar(rec["scalar"])
+                self._matrix_rows[ChannelKey(*rec["key"])] = row
+
+
+def _nd_pack(a: np.ndarray) -> dict:
+    """ndarray → wire dict (dtype + shape + b64 of the raw bytes)."""
+    import base64
+    a = np.ascontiguousarray(a)
+    return {"d": a.dtype.str, "s": list(a.shape),
+            "b": base64.b64encode(a.tobytes()).decode()}
+
+
+def _nd_unpack(d: dict) -> np.ndarray:
+    import base64
+    return np.frombuffer(base64.b64decode(d["b"]),
+                         np.dtype(d["d"])).reshape(d["s"]).copy()
+
+
+def _dump_content(content) -> Any:
+    if isinstance(content, str):
+        return content
+    if isinstance(content, Marker):
+        return {"marker": [content.ref_type, content.id]}
+    return {"items": list(content)}  # handle / item run
+
+
+def _load_content(data) -> Any:
+    if isinstance(data, str):
+        return data
+    if "marker" in data:
+        return Marker(ref_type=data["marker"][0], id=data["marker"][1])
+    return tuple(data["items"])
+
+
+def _dump_engine(engine: MergeEngine) -> dict:
+    """Serialize a server-side scalar engine (no local pending state —
+    server engines apply remote ops only, so groups/local_seq are empty)."""
+    return {
+        "current_seq": engine.current_seq,
+        "min_seq": engine.min_seq,
+        "segments": [{
+            "content": _dump_content(seg.content),
+            "seq": seg.seq,
+            "client": seg.client,
+            "removed_seq": seg.removed_seq,
+            "removed_client": seg.removed_client,
+            "removed_overlap": sorted(seg.removed_overlap),
+            "props": seg.props,
+        } for seg in engine.segments],
+    }
+
+
+def _load_engine(data: dict) -> MergeEngine:
+    engine = MergeEngine(local_client=None)
+    engine.current_seq = data["current_seq"]
+    engine.min_seq = data["min_seq"]
+    for s in data["segments"]:
+        engine.segments.append(Segment(
+            content=_load_content(s["content"]),
+            seq=s["seq"], client=s["client"],
+            removed_seq=s["removed_seq"],
+            removed_client=s["removed_client"],
+            removed_overlap=set(s["removed_overlap"]),
+            props=dict(s["props"]) if s["props"] else None,
+        ))
+    return engine
+
+
+def _dump_matrix_scalar(scalar: tuple) -> dict:
+    rows_vec, cols_vec, cells = scalar
+    return {
+        "rows": {"engine": _dump_engine(rows_vec.engine),
+                 "next_handle": rows_vec.next_handle},
+        "cols": {"engine": _dump_engine(cols_vec.engine),
+                 "next_handle": cols_vec.next_handle},
+        "cells": [[rh, ch, v] for (rh, ch), v in sorted(cells.items())],
+    }
+
+
+def _load_matrix_scalar(data: dict) -> tuple:
+    from ..dds.matrix import PermutationVector
+
+    def load_vec(d):
+        vec = PermutationVector(None)
+        vec.engine = _load_engine(d["engine"])
+        vec.next_handle = d["next_handle"]
+        return vec
+
+    return (load_vec(data["rows"]), load_vec(data["cols"]),
+            {(rh, ch): v for rh, ch, v in data["cells"]})
 
 
 __all__ = ["KernelMergeHost", "ChannelKey"]

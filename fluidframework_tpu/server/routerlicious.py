@@ -278,7 +278,13 @@ class ScriptoriumDocumentLambda:
         self._store.append(f"ops/{self.doc_id}", [op])
 
     def checkpoint(self, next_offset: int) -> None:
-        pass  # the op log IS the durable state; offsets commit in the pump
+        # The op log IS the durable state; group-commit it here: the whole
+        # batch's appends share one fsync, BEFORE the pump commits the
+        # consumer offset (a committed offset must never claim an op the
+        # journal could still lose). The in-memory StateStore has no sync.
+        sync = getattr(self._store, "sync", None)
+        if sync is not None:
+            sync()
 
 
 class _ScriptoriumFactory:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import base64
 import functools
+import time
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -53,7 +54,7 @@ from ..ops import opcodes as oc
 from ..ops import sequencer as seqk
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedDocumentMessage
-from ..utils import compile_cache
+from ..utils import compile_cache, faults
 from .kernel_host import KernelSequencerHost, _next_pow2
 from .merge_host import ChannelKey, KernelMergeHost
 
@@ -79,6 +80,34 @@ def _malloc_trim() -> None:
             _libc.malloc_trim(0)
         except Exception:
             pass
+
+
+class _TrimGate:
+    """Rate limiter for the RSS-hygiene ``malloc_trim`` — the round-5
+    serving-loop stall suspect (COVERAGE "Round 6 — known regressions"):
+    the call walks every glibc arena and can stall the loop under
+    allocation churn. It now runs at most once per :meth:`due` poll
+    (callers poll once per flush, OFF the per-tick harvest path) and only
+    when BOTH gates open: every ``every`` ticks AND at least ``floor_s``
+    of wall clock since the last trim."""
+
+    def __init__(self, every: int = 32, floor_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.every = max(1, every)
+        self.floor_s = floor_s
+        self._clock = clock
+        self._last_trim = clock()
+        self._trimmed_at_tick = 0
+
+    def due(self, ticks: int) -> bool:
+        if ticks - self._trimmed_at_tick < self.every:
+            return False
+        now = self._clock()
+        if now - self._last_trim < self.floor_s:
+            return False
+        self._last_trim = now
+        self._trimmed_at_tick = ticks
+        return True
 
 
 class _Frame(NamedTuple):
@@ -267,7 +296,10 @@ class StormController:
                  flush_threshold_docs: int = 4096,
                  max_key_slots: int = 64,
                  pipeline_depth: int = 1,
-                 spill_dir: str | None = None) -> None:
+                 spill_dir: str | None = None,
+                 durability: str | None = None,
+                 snapshots=None,
+                 snapshot_interval_ticks: int | None = None) -> None:
         self.service = service
         self.seq_host = seq_host
         self.merge_host = merge_host
@@ -287,22 +319,57 @@ class StormController:
         self._cohort_cache: dict = {}
         self._tick_counter = 0  # tick blob index
         # Tick words blobs: the bulk of the scriptorium payload. With a
-        # spill dir they append to a disk OpLog (the Mongo-storage analog
-        # — serving-host RSS stays bounded however long the run, VERDICT
+        # spill dir they ride the disk WAL (the Mongo-storage analog —
+        # serving-host RSS stays bounded however long the run, VERDICT
         # r4 weak #6); without one they stay in process memory like the
         # rest of the in-memory StateStore.
         self._tick_blobs: dict[int, bytes] = {}
         # doc -> [(first_seq, last_seq, tick_id)] for ticks that
         # sequenced ops — the compact in-RAM index over the tick blobs.
         self._doc_ticks: dict[str, list[tuple[int, int, int]]] = {}
+        # Durability mode of the tick WAL (CRC-framed OpLog either way):
+        #   "group" — async group-commit writer (durable_store.
+        #             GroupCommitLog): the harvest path pays a queue put;
+        #             fsyncs batch on the writer thread; ACKS ARE WITHHELD
+        #             until the durability watermark passes the tick, so
+        #             an acked op can never be lost to a crash.
+        #   "sync"  — append + fdatasync inline per tick (the maximally
+        #             conservative shape; the bench durability column).
+        #   "none"  — append only, no fsync (the round-5 behavior: a
+        #             process kill keeps the data, a host crash may not).
+        # None (default) = "group" when a spill dir is given, else no WAL.
+        # An EXPLICIT "group"/"sync" without a spill dir is a
+        # misconfiguration and must fail loudly — silently serving
+        # without the acked-durable contract the caller asked for would
+        # void the one guarantee this layer exists to give.
+        if durability not in ("group", "sync", "none", None):
+            raise ValueError(f"unknown durability mode {durability!r}")
+        if durability in ("group", "sync") and spill_dir is None:
+            raise ValueError(
+                f"durability={durability!r} needs a spill_dir (the WAL "
+                "lives there); pass durability='none' for WAL-less "
+                "serving")
+        if durability is None:
+            durability = "group" if spill_dir is not None else "none"
+        self.durability = durability
         self._blob_log = None
+        self._group_wal = None
+        # (tick_id, [(frame, ack payload)]) awaiting the durability
+        # watermark — drained in tick order on the serving thread.
+        self._unacked: list[tuple[int, list]] = []
         if spill_dir is not None:
             import pathlib
 
             from ..native import OpLog
+            from .durable_store import GroupCommitLog
             root = pathlib.Path(spill_dir)
             root.mkdir(parents=True, exist_ok=True)
-            self._blob_log = OpLog(root / "storm_tick_words.log")
+            path = root / "storm_tick_words.log"
+            if durability == "group":
+                self._group_wal = GroupCommitLog(path)
+                self._blob_log = self._group_wal
+            else:
+                self._blob_log = OpLog(path)
             # Restart/reuse recovery: the RAM (first, last, tick) index
             # and the tick counter rebuild from the journaled blobs, so
             # catch-up reads survive a serving-host restart and a reused
@@ -316,6 +383,18 @@ class StormController:
                         self._doc_ticks.setdefault(doc, []).append(
                             (fs, ls, tick_id))
             self._tick_counter = len(self._blob_log)
+        # Device-pool snapshot backend (GitSnapshotStore surface). With an
+        # interval, flush() checkpoints every N ticks; recover() restores
+        # the head + replays the WAL tail (see checkpoint()/recover()).
+        self.snapshots = snapshots
+        self.snapshot_interval_ticks = snapshot_interval_ticks
+        self._last_checkpoint_tick = self._tick_counter
+        self._in_checkpoint = False
+        # WAL-replay mode (recover()): reuse THE serving tick verbatim but
+        # pin timestamps to the recorded ones and skip re-persisting.
+        self._replay = False
+        self._replay_ts: int | None = None
+        self._trim_gate = _TrimGate()
         self.stats = {"ticks": 0, "sequenced_ops": 0, "submitted_ops": 0,
                       "nacked_or_ignored_ops": 0}
         self.tick_seconds: list[float] = []  # submit→harvest per round
@@ -393,6 +472,45 @@ class StormController:
                 break
         if force:
             self._harvest()
+            if self._group_wal is not None and self._unacked:
+                # Drain barrier: a forced flush settles everything, so
+                # withheld acks go out now — after their fsync, never
+                # before (the acked-durable contract).
+                self._group_wal.sync()
+                self._drain_durable_acks()
+        if (self.snapshot_interval_ticks is not None
+                and self.snapshots is not None
+                and not self._replay and not self._in_checkpoint
+                and self._tick_counter - self._last_checkpoint_tick
+                >= self.snapshot_interval_ticks):
+            self.checkpoint()
+        # RSS hygiene OFF the per-tick path: at most one arena trim per
+        # flush, gated on tick count AND a wall-clock floor (the round-5
+        # serving-loop stall suspect — see _TrimGate).
+        if self._trim_gate.due(self.stats["ticks"]):
+            _malloc_trim()
+
+    @property
+    def durable_watermark(self) -> int | None:
+        """Ticks proven durable (fsynced): everything below this tick id
+        survives a crash. None = serving without a WAL."""
+        if self._group_wal is not None:
+            return self._group_wal.durable_len
+        if self._blob_log is not None:
+            return len(self._blob_log) if self.durability == "sync" else 0
+        return None
+
+    def _drain_durable_acks(self) -> None:
+        """Push withheld acks whose tick the WAL has fsynced — called on
+        the serving thread (harvest / forced flush), never the writer
+        thread, so session pushes stay single-threaded."""
+        dw = self._group_wal.durable_len
+        while self._unacked and self._unacked[0][0] < dw:
+            _tick, acks = self._unacked.pop(0)
+            faults.crashpoint("storm.pre_ack")
+            for frame, payload in acks:
+                payload["dw"] = dw
+                frame.push(payload)
 
     def _flush_round(self, require_full: bool = False) -> bool:
         """One fused tick over every buffered frame, deferring repeat
@@ -439,7 +557,10 @@ class StormController:
             return True
 
         seq_host, merge_host = self.seq_host, self.merge_host
-        now = self.service._clock()
+        # WAL replay re-runs the tick with its RECORDED timestamp so the
+        # sequencer planes (client last_update) rebuild byte-identically.
+        now = (self._replay_ts if self._replay_ts is not None
+               else self.service._clock())
         k = _next_pow2(max(count for *_, count in descs))
 
         # Rows + slots (the only per-doc Python work on the hot path).
@@ -501,6 +622,10 @@ class StormController:
             jnp.asarray(ref_full), jnp.asarray(ts_full),
             jnp.asarray(seq_counts), jnp.asarray(gather),
             jnp.asarray(words_full), jnp.asarray(map_counts))
+        # Chaos kill class "mid-tick": device state mutated, durable
+        # record NOT yet enqueued — the mutation is volatile and must be
+        # reconstructible from snapshot + WAL replay + client resend.
+        faults.crashpoint("storm.mid_tick")
         # Pipeline: enqueue this tick's device work (and start its
         # device→host copies), then harvest only what has ≥ depth later
         # ticks already in flight behind it.
@@ -548,11 +673,10 @@ class StormController:
         doc_words = rec["doc_words"]
         stacked = rec.get("words_stacked")
         if stacked is not None:
-            words_bytes = stacked.tobytes()
+            word_parts: list = [stacked]
             offsets = range(0, stacked.size * 4, stacked.shape[1] * 4)
         else:
-            words_bytes = b"".join(
-                np.ascontiguousarray(w).tobytes() for w in doc_words)
+            word_parts = [np.ascontiguousarray(w) for w in doc_words]
             offsets = []
             off = 0
             for w in doc_words:
@@ -569,24 +693,38 @@ class StormController:
                 mrow.last_seq = ls
             header_docs.append([doc, client, cseq0, ref, count,
                                 ns, fs, ls, m, w_off])
-            if ns > 0:
+            if ns > 0 and not self._replay:
                 self._doc_ticks.setdefault(doc, []).append(
                     (fs, ls, tick_id))
             # broadcaster: compact tick frame into the pub/sub hop.
-            if fanout is not None:
+            if fanout is not None and not self._replay:
                 fanout.publish(doc, b"\x00storm%d:%d:%d" % (fs, ls, m))
         import json as _json
         import struct as _struct
 
         header = _json.dumps({"ts": now, "docs": header_docs},
                              separators=(",", ":")).encode()
-        blob_bytes = (_struct.pack("<I", len(header)) + header
-                      + words_bytes)
-        if self._blob_log is not None:
+        prefix = _struct.pack("<I", len(header)) + header
+        if self._replay:
+            pass  # the blob IS the replay source; never re-persist it
+        elif self._group_wal is not None:
+            # Async group commit: the hot path pays ONE queue put; the
+            # join + CRC + append + fsync all run on the writer thread.
+            # The tick's acks are withheld until the durability watermark
+            # passes it (_drain_durable_acks) — the sync per-tick blob
+            # write this replaces was the round-5 regression suspect.
+            idx = self._group_wal.append([prefix, *word_parts])
+            assert idx == tick_id, (idx, tick_id)
+        elif self._blob_log is not None:
+            blob_bytes = prefix + b"".join(
+                bytes(memoryview(p)) for p in word_parts)
             idx = self._blob_log.append(blob_bytes)
             assert idx == tick_id, (idx, tick_id)
+            if self.durability == "sync":
+                self._blob_log.sync()
         else:
-            self._tick_blobs[tick_id] = blob_bytes
+            self._tick_blobs[tick_id] = prefix + b"".join(
+                bytes(memoryview(p)) for p in word_parts)
         # Stats BEFORE acks: once an ack leaves the process, this host's
         # bookkeeping must already reflect the tick (clients/tests react
         # to acks immediately).
@@ -602,17 +740,141 @@ class StormController:
         if self._last_harvest is not None:
             self.harvest_intervals.append(done - self._last_harvest)
         self._last_harvest = done
-        # Long-haul RSS hygiene: each tick churns ~frame-sized native
-        # allocations (decode buffers, staging copies); glibc retains
-        # freed arena pages indefinitely, which reads as a slow monotonic
-        # RSS climb under soak (VERDICT r4 weak #6). Return them to the
-        # OS on a coarse cadence — microseconds per call.
-        if self.stats["ticks"] % 32 == 0:
-            _malloc_trim()
-        for frame, idxs in rec["acks"]:
-            if frame.push is not None:
-                frame.push({"rid": frame.rid, "storm": True, "acks": [
+        acks = [(frame, {"rid": frame.rid, "storm": True, "acks": [
                     [ns_l[i], fs_l[i], ls_l[i], m_l[i]] for i in idxs]})
+                for frame, idxs in rec["acks"] if frame.push is not None]
+        if self._group_wal is not None and not self._replay:
+            # Withhold until fsynced — then deliver in tick order with the
+            # durability watermark stamped on (clients resubmit anything
+            # above the watermark after a reconnect).
+            self._unacked.append((tick_id, acks))
+            self._drain_durable_acks()
+        else:
+            dw = self.durable_watermark
+            for frame, payload in acks:
+                faults.crashpoint("storm.pre_ack")
+                payload["dw"] = dw
+                frame.push(payload)
+
+    # -- snapshot / recovery ---------------------------------------------------
+    #
+    # The crash-consistency pair (ISSUE 4 tentpole): checkpoint() writes a
+    # device-pool snapshot (sequencer rows + merge-host pools + the WAL
+    # tick watermark) to the content-addressed snapshot store; recover()
+    # restores the head and replays the WAL tail THROUGH THE SERVING TICK
+    # itself (same fused program, recorded timestamps), so a restarted
+    # controller reconverges byte-identically with an uninterrupted twin.
+    # tools/chaos.py kills the process at every dangerous point and
+    # proves exactly that.
+
+    SNAPSHOT_DOC = "__storm__"
+
+    def checkpoint(self) -> str:
+        """Settle everything (harvest + durability barrier), then publish
+        one snapshot atomically: upload first, flip the head ref last —
+        a crash mid-checkpoint leaves the previous head intact."""
+        assert self.snapshots is not None, "no snapshot store attached"
+        self._in_checkpoint = True
+        try:
+            self.flush()
+            import dataclasses
+            snap = {
+                "kind": "storm-checkpoint",
+                "tick_watermark": self._tick_counter,
+                "sequencer": {
+                    doc: dataclasses.asdict(cp)
+                    for doc, cp in self.seq_host.checkpoint_all().items()},
+                "merge_host": self.merge_host.export_state(),
+            }
+            handle = self.snapshots.upload(self.SNAPSHOT_DOC, snap)
+            faults.crashpoint("snapshot.pre_publish")
+            self.snapshots.set_head(self.SNAPSHOT_DOC, handle)
+            self._last_checkpoint_tick = self._tick_counter
+            return handle
+        finally:
+            self._in_checkpoint = False
+
+    def recover(self) -> dict:
+        """Restore the snapshot head (when one exists) into the sequencer
+        and merge hosts, then replay the WAL ticks past the snapshot's
+        watermark. Call once on a FRESH controller stack, before serving.
+        Without a snapshot the durable tick history is still readable
+        (the __init__ scan) but live state starts empty — the per-op tier
+        then rebuilds from the bus/scriptorium replay instead."""
+        assert not self._frames and not self._inflight, (
+            "recover() on a controller already serving")
+        restored_from = None
+        start = 0
+        if self.snapshots is not None:
+            head = self.snapshots.head(self.SNAPSHOT_DOC)
+            snap = self.snapshots.get(self.SNAPSHOT_DOC, head)
+            if snap is not None:
+                from .sequencer import SequencerCheckpoint
+                for doc, cp in sorted(snap["sequencer"].items()):
+                    self.seq_host.restore(doc, SequencerCheckpoint(**cp))
+                self.merge_host.import_state(snap["merge_host"])
+                start = snap["tick_watermark"]
+                restored_from = head
+            elif self._blob_log is not None and len(self._blob_log) > 0:
+                # The WAL holds durable ticks but no snapshot is
+                # readable (corrupt head/chunks, or a crash before the
+                # first checkpoint). Serving EMPTY live state over a
+                # non-empty acked history would silently diverge from
+                # what clients already saw — fail loudly instead; the
+                # operator restores a snapshot or clears the spill dir.
+                raise RuntimeError(
+                    f"recover(): WAL holds {len(self._blob_log)} durable "
+                    "ticks but no snapshot head is readable; refusing to "
+                    "serve empty state over an acked history")
+        # Memory-only serving with snapshots: tick ids continue past the
+        # watermark (no blob scan set them), so fresh ticks never alias.
+        self._tick_counter = max(self._tick_counter, start)
+        durable = len(self._blob_log) if self._blob_log is not None else 0
+        if self._blob_log is not None and start > durable:
+            # Snapshot watermark ahead of the WAL: an unfsynced tail died
+            # with the host (possible under durability != "group"; the
+            # group mode's checkpoint barrier makes watermark <= durable).
+            # The snapshot itself holds the full state at the watermark,
+            # but tick ids must stay 1:1 with WAL record indices
+            # (_read_blob), so realign by padding empty filler ticks —
+            # they carry no docs, so no index or catch-up read ever
+            # resolves into them.
+            import json as _json
+            import struct as _struct
+            header = _json.dumps({"ts": 0, "docs": []},
+                                 separators=(",", ":")).encode()
+            filler = _struct.pack("<I", len(header)) + header
+            while len(self._blob_log) < start:
+                self._blob_log.append(filler)
+            if self._group_wal is not None:
+                self._group_wal.sync()
+            durable = len(self._blob_log)
+        replayed = 0
+        if restored_from is not None and start < durable:
+            replayed = self._replay_wal(start, durable)
+        self._last_checkpoint_tick = self._tick_counter
+        return {"restored_from": restored_from, "replayed_ticks": replayed}
+
+    def _replay_wal(self, start: int, end: int) -> int:
+        """Re-run ticks [start, end) from their durable blobs through the
+        serving path: same cohorts, same recorded timestamps, no
+        re-persisting (the blob being replayed IS the durable record)."""
+        self._replay = True
+        try:
+            for tick in range(start, end):
+                blob = self._read_blob(tick)
+                header, off = self._parse_header(blob)
+                self._tick_counter = tick
+                self._replay_ts = header["ts"]
+                entries = [e[:5] for e in header["docs"]]
+                self.submit_frame(None, {"docs": entries, "rid": None},
+                                  memoryview(blob)[off:])
+                self.flush()
+        finally:
+            self._replay = False
+            self._replay_ts = None
+        assert self._tick_counter == end, (self._tick_counter, end)
+        return end - start
 
     @staticmethod
     def _parse_header(blob: bytes) -> tuple[dict, int]:
@@ -625,6 +887,15 @@ class StormController:
 
     def _read_blob(self, tick_id: int) -> bytes:
         if self._blob_log is not None:
+            if (self._group_wal is not None
+                    and tick_id >= self._group_wal.durable_len):
+                # Catch-up reads ARE durability proof to clients (the
+                # DeltaManager watermark contract): a record must never
+                # leave this process ahead of its fsync, so reading an
+                # in-flight tick barriers the group commit first. Rare
+                # (tip readers racing the writer thread) and bounded by
+                # one group-commit latency.
+                self._group_wal.sync()
             return bytes(self._blob_log.read(tick_id))
         return self._tick_blobs[tick_id]
 

@@ -1,0 +1,328 @@
+"""Kill-mid-tick chaos harness — the proof of the crash-consistency story.
+
+The paper's convergence guarantee (total order + deterministic rebase ⇒
+byte-identical replicas) is only as strong as the ordering tier's
+durability. This harness tests it the only honest way: it KILLS the
+serving process (``os._exit`` via utils/faults.py crashpoints — no
+atexit, no flushing) at the dangerous points of the serving loop,
+restarts it over the same durable directory, lets the client resend its
+unacked frames (at-least-once; the sequencer's clientSeq dedup absorbs
+duplicates), and then diffs EVERY recovered plane against an
+uninterrupted twin run of the same seeded workload:
+
+* the per-document sequenced history (seq/cseq/ref/msn/type/contents),
+* the converged map state of every storm channel,
+* the sequencer checkpoint of every document (clients, cseqs, msn, …).
+
+Two planes are excluded by design: op ``timestamp`` and client
+``last_update`` record each submission's ARRIVAL clock — a retried tick
+legitimately arrives later than the twin's single attempt. They feed
+idle ejection, never replica state.
+
+The invariant on top of the diff: an op whose frame was ACKED in any
+life must appear in the final history — acks are withheld until the WAL
+fsync precisely so this can never fail.
+
+Run one scenario from the CLI::
+
+    python -m fluidframework_tpu.tools.chaos --workdir /tmp/chaos \
+        --kill-point wal.pre_fsync --kill-hits 2
+
+or the full seeded matrix (every kill point × several seeds)::
+
+    python -m fluidframework_tpu.tools.chaos --workdir /tmp/chaos --matrix
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+#: Kill-point classes exercised by the matrix (see utils/faults.py for
+#: the full registry and where each fires).
+KILL_POINTS = (
+    "wal.pre_fsync",       # records appended, not fsynced
+    "wal.post_fsync",      # durable, acks not yet released
+    "storm.mid_tick",      # device state moved, nothing durable yet
+    "storm.pre_ack",       # durable and drained, ack not yet pushed
+    "snapshot.mid_upload",  # checkpoint chunks partially written
+    "snapshot.pre_publish",  # checkpoint uploaded, head not flipped
+)
+
+#: Smoke subset for tier-1 (one per failure class: volatile-state loss,
+#: torn group commit, torn checkpoint).
+SMOKE_POINTS = ("storm.mid_tick", "wal.pre_fsync", "snapshot.pre_publish")
+
+
+# -- child process (the serving host under test) ------------------------------
+
+
+def _build_stack(data_dir: str, num_docs: int):
+    from ..server.durable_store import (
+        DurableMessageBus,
+        FileStateStore,
+        GitSnapshotStore,
+    )
+    from ..server.kernel_host import KernelSequencerHost
+    from ..server.merge_host import KernelMergeHost
+    from ..server.routerlicious import RouterliciousService
+    from ..server.storm import StormController
+
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=num_docs)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    # Bus and store are the durable pair (deli checkpoints reference bus
+    # offsets); the idle check is parked so no synthetic leaves perturb
+    # the twin diff.
+    service = RouterliciousService(
+        bus=DurableMessageBus(os.path.join(data_dir, "bus")),
+        store=FileStateStore(os.path.join(data_dir, "state")),
+        merge_host=merge_host, batched_deli_host=seq_host,
+        auto_pump=False, idle_check_interval=10**9)
+    storm = StormController(
+        service, seq_host, merge_host, flush_threshold_docs=1,
+        spill_dir=os.path.join(data_dir, "spill"), durability="group",
+        snapshots=GitSnapshotStore(os.path.join(data_dir, "git")))
+    return service, storm, seq_host, merge_host
+
+
+def _tick_words(seed: int, round_no: int, doc_i: int, k: int,
+                num_slots: int = 16):
+    import numpy as np
+    rng = np.random.default_rng([seed, round_no, doc_i])
+    kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
+    slots = rng.integers(0, num_slots, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def _digest(service, storm, seq_host, merge_host, docs: list[str]) -> dict:
+    """Canonical serialization of every compared plane (see module doc
+    for the two excluded arrival-clock planes)."""
+    from ..protocol.codec import to_wire
+
+    out: dict = {"docs": {}}
+    for doc in docs:
+        history = []
+        for m in service.get_deltas(doc, 0):
+            history.append([
+                m.sequence_number, m.client_sequence_number,
+                m.reference_sequence_number, m.minimum_sequence_number,
+                int(m.type),
+                json.dumps(to_wire(m.contents), sort_keys=True)])
+        cp = dataclasses.asdict(seq_host.checkpoint(doc))
+        cp.pop("log_offset", None)
+        for client in cp["clients"]:
+            client["last_update"] = 0  # arrival clock, not replica state
+        out["docs"][doc] = {
+            "history": history,
+            "map": merge_host.map_entries(doc, storm.datastore,
+                                          storm.channel),
+            "sequencer": cp,
+        }
+    return out
+
+
+def child_main(args) -> None:
+    """One serving-process life. Protocol on stdout (parent parses):
+    ``READY`` once serving can start, ``ACKED <round>`` per
+    durably-acked workload round, ``DIGEST <json>`` before a clean
+    exit. A planned crashpoint kill exits with faults.KILL_EXIT_CODE
+    mid-stream."""
+    from ..utils import compile_cache, faults
+
+    compile_cache.enable()
+    docs = [f"chaos-doc-{i}" for i in range(args.docs)]
+    service, storm, seq_host, merge_host = _build_stack(args.dir, args.docs)
+
+    if args.resume_from is None:
+        # Fresh life: joins + the genesis checkpoint (so every recovery
+        # has a snapshot to restore — the harness arms kills only after).
+        clients = {d: service.connect(d, lambda m: None).client_id
+                   for d in docs}
+        service.pump()
+        storm.checkpoint()
+        start = 0
+        print("GENESIS", flush=True)
+    else:
+        info = storm.recover()
+        assert info["restored_from"] is not None, "no snapshot to recover"
+        # Client ids are deterministic: the durable client counter handed
+        # them out join-order in the fresh life.
+        clients = {d: f"client-{i + 1}" for i, d in enumerate(docs)}
+        start = args.resume_from
+    print("READY", flush=True)
+    faults.arm()
+
+    k = args.k
+    for r in range(start, args.ticks):
+        acks: list = []
+        entries = [[d, clients[d], 1 + r * k, 1, k] for d in docs]
+        payload = b"".join(
+            _tick_words(args.seed, r, i, k).tobytes()
+            for i in range(len(docs)))
+        storm.submit_frame(acks.append, {"rid": r, "docs": entries},
+                           memoryview(payload))
+        storm.flush()
+        if acks:
+            print(f"ACKED {r}", flush=True)
+        if (r + 1) % args.cp_every == 0:
+            storm.checkpoint()
+    faults.disarm()
+    digest = _digest(service, storm, seq_host, merge_host, docs)
+    print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
+
+
+# -- parent (kill / restart / diff) -------------------------------------------
+
+
+def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
+                cp_every: int, resume_from: int | None,
+                kill_env: str | None, timeout: float) -> dict:
+    cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
+           "--child", "--dir", data_dir, "--seed", str(seed),
+           "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
+           "--cp-every", str(cp_every)]
+    if resume_from is not None:
+        cmd += ["--resume-from", str(resume_from)]
+    env = dict(os.environ)
+    env.pop("FFTPU_CRASHPOINT", None)
+    if kill_env is not None:
+        env["FFTPU_CRASHPOINT"] = kill_env
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+    acked, digest = [], None
+    for line in proc.stdout.splitlines():
+        if line.startswith("ACKED "):
+            acked.append(int(line.split()[1]))
+        elif line.startswith("DIGEST "):
+            digest = json.loads(line[len("DIGEST "):])
+    return {"returncode": proc.returncode, "acked": acked,
+            "digest": digest, "stderr": proc.stderr}
+
+
+def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
+              seed: int = 0, docs: int = 2, k: int = 8, ticks: int = 5,
+              cp_every: int = 2, timeout: float = 300.0,
+              twin_digest: dict | None = None) -> dict:
+    """One scenario: a twin run, then a killed-and-recovered run, then
+    the plane diff. Returns the report; raises AssertionError on any
+    divergence or lost acked op. ``twin_digest`` lets callers share one
+    twin across scenarios of the same configuration."""
+    from ..utils import faults
+
+    cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every)
+    if twin_digest is None:
+        twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
+                           kill_env=None, timeout=timeout, **cfg)
+        assert twin["returncode"] == 0, twin["stderr"]
+        twin_digest = twin["digest"]
+
+    chaos_dir = os.path.join(workdir, f"chaos-{kill_point}-{kill_hits}")
+    acked: set[int] = set()
+    lives = 0
+    life = _spawn_life(chaos_dir, resume_from=None,
+                       kill_env=f"{kill_point}:{kill_hits}",
+                       timeout=timeout, **cfg)
+    acked.update(life["acked"])
+    lives += 1
+    killed = life["returncode"] == faults.KILL_EXIT_CODE
+    # Restart lives (no further kills) until a clean finish. The resend
+    # window starts at the first round never durably acked.
+    while life["returncode"] != 0:
+        assert life["returncode"] == faults.KILL_EXIT_CODE, life["stderr"]
+        resume = max(acked) + 1 if acked else 0
+        life = _spawn_life(chaos_dir, resume_from=resume,
+                           kill_env=None, timeout=timeout, **cfg)
+        acked.update(life["acked"])
+        lives += 1
+        assert lives <= 8, "chaos run did not converge to a clean life"
+    digest = life["digest"]
+
+    report = {"kill_point": kill_point, "kill_hits": kill_hits,
+              "killed": killed, "lives": lives,
+              "acked_rounds": sorted(acked), **cfg}
+    assert json.dumps(digest, sort_keys=True) == json.dumps(
+        twin_digest, sort_keys=True), (
+        f"recovered state diverged from the twin at {kill_point}:"
+        f"{kill_hits}\n twin: {json.dumps(twin_digest, sort_keys=True)}\n"
+        f"chaos: {json.dumps(digest, sort_keys=True)}")
+    # No acked-durable op may be lost: every acked round's client seqs
+    # must appear in the final history of every doc.
+    from ..protocol.messages import MessageType
+    for doc, planes in digest["docs"].items():
+        cseqs = {h[1] for h in planes["history"]
+                 if h[4] == int(MessageType.OPERATION)}
+        for r in acked:
+            # An ack with zero sequenced ops (dup resend) still covers
+            # its round — the ops were sequenced by an earlier life.
+            want = set(range(1 + r * k, 1 + (r + 1) * k))
+            missing = want - cseqs
+            assert not missing, (
+                f"acked round {r} lost ops {sorted(missing)[:4]}… "
+                f"for {doc}")
+    report["twin_digest"] = twin_digest
+    return report
+
+
+def run_matrix(workdir: str, points=KILL_POINTS, seeds=(0, 1),
+               hit_positions=(1, 2), **cfg) -> list[dict]:
+    """The full randomized matrix: every kill point × seed × hit count.
+    A kill plan that never fires (e.g. a snapshot point when the round
+    count never reaches a checkpoint) still asserts twin equality."""
+    reports = []
+    twins: dict[tuple, dict] = {}
+    for seed in seeds:
+        for point in points:
+            for hits in hit_positions:
+                key = (seed,)
+                sub = os.path.join(workdir, f"s{seed}")
+                report = run_chaos(
+                    sub, point, kill_hits=hits, seed=seed,
+                    twin_digest=twins.get(key), **cfg)
+                twins[key] = report["twin_digest"]
+                reports.append(report)
+    return reports
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--child", action="store_true")
+    parser.add_argument("--dir", default=None)
+    parser.add_argument("--workdir", default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--docs", type=int, default=2)
+    parser.add_argument("--k", type=int, default=8)
+    parser.add_argument("--ticks", type=int, default=5)
+    parser.add_argument("--cp-every", type=int, default=2)
+    parser.add_argument("--resume-from", type=int, default=None)
+    parser.add_argument("--kill-point", default=None)
+    parser.add_argument("--kill-hits", type=int, default=1)
+    parser.add_argument("--matrix", action="store_true")
+    args = parser.parse_args(argv)
+    if args.child:
+        child_main(args)
+        return
+    assert args.workdir, "--workdir required"
+    if args.matrix:
+        reports = run_matrix(args.workdir, docs=args.docs, k=args.k,
+                             ticks=args.ticks, cp_every=args.cp_every)
+        for r in reports:
+            r.pop("twin_digest", None)
+            print(json.dumps(r))
+        return
+    assert args.kill_point, "--kill-point or --matrix required"
+    report = run_chaos(args.workdir, args.kill_point, args.kill_hits,
+                       seed=args.seed, docs=args.docs, k=args.k,
+                       ticks=args.ticks, cp_every=args.cp_every)
+    report.pop("twin_digest", None)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Seeded fault injection — named crashpoints on the durability paths.
+
+The chaos harness (tools/chaos.py) proves the crash-consistency story by
+actually killing the serving process at the points where a crash is
+dangerous and diffing the recovered state against an uninterrupted twin.
+The serving code declares those points by calling :func:`crashpoint`
+with a stable name; a *plan* (installed from the environment or
+programmatically) hard-kills the process — ``os._exit``, no atexit, no
+buffer flushing, no destructors — at the N-th hit of one named point.
+
+Registered points (grep for ``crashpoint(`` to audit):
+
+==========================  ==================================================
+``wal.pre_fsync``           group-commit writer: records appended, NOT yet
+                            fsynced (the torn-batch window)
+``wal.post_fsync``          records durable, completion callbacks / acks NOT
+                            yet fired (durable-but-unacknowledged window)
+``storm.mid_tick``          device state mutated by the fused tick, durable
+                            record NOT yet enqueued (volatile-state window)
+``storm.pre_ack``           durable record fsynced, ack NOT yet pushed
+``pool.mid_rebalance``      block merge pool mid-rebalance (layout moving)
+``snapshot.mid_upload``     snapshot chunks partially written
+``snapshot.pre_publish``    snapshot uploaded, head ref NOT yet flipped
+==========================  ==================================================
+
+A plan is inert until :func:`arm` — the harness arms only after its
+setup phase (joins, genesis checkpoint) so kills always land inside the
+serving window under test. With no plan installed, :func:`crashpoint`
+is one attribute load and a ``None`` check.
+
+Environment protocol (used by the chaos child process)::
+
+    FFTPU_CRASHPOINT="wal.pre_fsync:3"   # kill at the 3rd hit
+
+``install_from_env()`` runs at import; the child calls ``arm()`` itself.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+#: Exit status of a planned kill — distinguishes an injected crash from a
+#: real failure in the parent harness (128 + SIGKILL, the conventional
+#: "killed" status).
+KILL_EXIT_CODE = 137
+
+_plan: tuple[str, int] | None = None  # (point name, kill at N-th hit)
+_armed = False
+_hits = 0
+#: Per-point fire counts while a plan is installed (tests introspect
+#: these; the no-plan hot path never touches the dict).
+fired: dict[str, int] = {}
+
+
+def install(point: str, hits: int = 1) -> None:
+    """Install a kill plan: die at the ``hits``-th hit of ``point``."""
+    global _plan, _hits
+    if hits < 1:
+        raise ValueError(f"hits must be >= 1, got {hits}")
+    _plan = (point, hits)
+    _hits = 0
+    fired.clear()
+
+
+def install_from_env() -> None:
+    spec = os.environ.get("FFTPU_CRASHPOINT")
+    if not spec:
+        return
+    point, _, hits = spec.partition(":")
+    install(point, int(hits) if hits else 1)
+
+
+def arm() -> None:
+    global _armed
+    _armed = True
+
+
+def disarm() -> None:
+    global _armed
+    _armed = False
+
+
+def clear() -> None:
+    global _plan, _armed, _hits
+    _plan, _armed, _hits = None, False, 0
+    fired.clear()
+
+
+def crashpoint(name: str) -> None:
+    """Declare a named kill point. No plan installed = near-free."""
+    global _hits
+    if _plan is None:
+        return
+    fired[name] = fired.get(name, 0) + 1
+    if not _armed or name != _plan[0]:
+        return
+    _hits += 1
+    if _hits >= _plan[1]:
+        # A REAL crash: no cleanup, no flushing, no thread joins — the
+        # recovery story must not depend on any graceful-shutdown path.
+        sys.stderr.write(f"crashpoint {name} hit {_hits}: killing\n")
+        sys.stderr.flush()
+        os._exit(KILL_EXIT_CODE)
+
+
+install_from_env()

@@ -1,0 +1,74 @@
+"""Donated-tick compile-cache bypass (utils/compile_cache.bypass):
+jaxlib 0.4.37 double-frees donated buffers on the SECOND run of an
+executable deserialized from the persistent cache, so every donated
+serving tick compiles through `uncached`. These tests pin the
+workaround so a jax upgrade cannot silently regress it (ADVICE item 1):
+the bypass must actually suppress persistent-cache use, the guarded
+donated tick must run repeatedly with identical results, and the
+fail-closed warning must NOT fire on this jax version — when jax's
+internals move, the warning test fails loudly and the double-free needs
+re-auditing."""
+
+import functools
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.utils import compile_cache
+
+
+def donated_tick():
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def tick(state, words):
+        return state + jnp.sum(words), state * 0 + words
+    return compile_cache.uncached(tick)
+
+
+def test_cache_round_tripped_donated_tick_runs_twice_identically():
+    """The serving shape: a donated jit executed repeatedly under the
+    bypass (cache enabled process-wide by conftest). Two executions of
+    the same executable — exactly the shape that double-freed — must
+    succeed with identical results."""
+    tick = donated_tick()
+    w = jnp.arange(8, dtype=jnp.int32)
+    s1, out1 = tick(jnp.zeros(8, jnp.int32), w)
+    s2, out2 = tick(s1, w)  # second run of the SAME executable
+    s3, out3 = tick(s2, w)
+    assert np.array_equal(np.asarray(out1), np.asarray(out3))
+    assert np.asarray(s3)[0] == 3 * 28
+    # The undonated re-jit escape hatch (bench uses it) stays reachable.
+    assert callable(tick.__wrapped__)
+
+
+def test_bypass_actually_suppresses_persistent_cache():
+    """While inside bypass(), jax's per-compile gate must report the
+    persistent cache unused; outside, the process-wide enable() state is
+    restored untouched."""
+    cc = pytest.importorskip("jax._src.compilation_cache")
+    before = (cc._cache_checked, cc._cache_used)
+    with compile_cache.bypass():
+        assert (cc._cache_checked, cc._cache_used) == (True, False)
+    assert (cc._cache_checked, cc._cache_used) == before
+
+
+def test_bypass_does_not_fail_closed_on_this_jax():
+    """The fail-closed path (jax internals moved → disable the cache
+    process-wide + warn) must NOT trigger today. When jax moves and this
+    fails, re-audit the donated-executable double-free before removing
+    the bypass (ADVICE item 1)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        with compile_cache.bypass():
+            pass
+
+
+def test_sequencer_and_storm_ticks_are_wrapped():
+    """The REAL donated serving ticks stay behind the bypass wrapper."""
+    from fluidframework_tpu.server import kernel_host, storm
+
+    for fn in (storm._storm_tick, storm._mixed_tick,
+               kernel_host._step_one):
+        assert getattr(fn, "__wrapped__", None) is not None, fn

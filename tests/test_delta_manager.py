@@ -190,3 +190,114 @@ def test_reconnect_mid_gap_stays_consistent():
     m1.set("b", 2)
     m2.set("c", 3)
     assert c1.summarize() == c2.summarize()
+
+
+class _StubStorage:
+    def __init__(self):
+        self.log = []
+
+    def get_deltas(self, from_seq, to_seq=None):
+        return [m for m in self.log
+                if m.sequence_number > from_seq
+                and (to_seq is None or m.sequence_number <= to_seq)]
+
+
+class _StubConnection:
+    def __init__(self, client_id):
+        self.client_id = client_id
+
+    def close(self):
+        pass
+
+
+class _StubService:
+    """Bare DocumentService: a durable log we control + live handlers."""
+
+    def __init__(self):
+        self.delta_storage = _StubStorage()
+        self.handler = None
+
+    def connect(self, handler, on_nack=None, on_signal=None, mode="write"):
+        self.handler = handler
+        return _StubConnection("client-1")
+
+
+def _own_op(seq, payload):
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedDocumentMessage,
+    )
+    return SequencedDocumentMessage(
+        client_id="client-1", sequence_number=seq,
+        minimum_sequence_number=0, client_sequence_number=seq,
+        reference_sequence_number=seq - 1, type=MessageType.OPERATION,
+        contents={"payload": payload}, timestamp=0, data=None)
+
+
+class TestDurabilityWatermark:
+    """Resubmit-on-reconnect against the durability watermark (ISSUE 4):
+    own ops echoed from the live stream stay resubmittable until the
+    service proves them durable; a reconnect after a server crash that
+    lost acked-but-unfsynced ops surfaces exactly the lost ones."""
+
+    def _manager(self, service, lost_sink):
+        from fluidframework_tpu.runtime.delta_manager import DeltaManager
+        return DeltaManager(service, process_message=lambda m: None,
+                            on_lost_ops=lost_sink.extend)
+
+    def test_storage_reads_advance_the_watermark(self):
+        service = _StubService()
+        lost = []
+        dm = self._manager(service, lost)
+        service.delta_storage.log = [_own_op(1, "a"), _own_op(2, "b")]
+        dm.connect()
+        assert dm.last_durable_seq == 2  # journal reads are durable proof
+        assert dm._undurable_own == []   # catch-up ops never enter the ring
+
+    def test_live_echoes_stay_resubmittable_until_durable(self):
+        service = _StubService()
+        lost = []
+        dm = self._manager(service, lost)
+        dm.connect()
+        service.handler([_own_op(1, "a"), _own_op(2, "b"), _own_op(3, "c")])
+        assert [m.sequence_number for m in dm._undurable_own] == [1, 2, 3]
+        dm.note_durable(2)  # a seq-unit watermark (e.g. a storm ack's per-doc last_seq)
+        assert [m.sequence_number for m in dm._undurable_own] == [3]
+
+    def test_reconnect_surfaces_ops_the_crashed_server_lost(self):
+        service = _StubService()
+        lost = []
+        dm = self._manager(service, lost)
+        dm.connect()
+        service.handler([_own_op(1, "a"), _own_op(2, "b"), _own_op(3, "c")])
+        # Server crash: the recovered journal holds only seq 1.
+        service.delta_storage.log = [_own_op(1, "a")]
+        dm.disconnect()
+        dm.connect()
+        assert [m.sequence_number for m in lost] == [2, 3]
+        assert [m.contents["payload"] for m in lost] == ["b", "c"]
+        assert dm._undurable_own == []  # handed to the resubmit hook
+
+    def test_reconnect_with_intact_journal_resubmits_nothing(self):
+        service = _StubService()
+        lost = []
+        dm = self._manager(service, lost)
+        dm.connect()
+        msgs = [_own_op(1, "a"), _own_op(2, "b")]
+        service.handler(msgs)
+        service.delta_storage.log = list(msgs)  # journal kept everything
+        dm.disconnect()
+        dm.connect()
+        assert lost == []
+        assert dm._undurable_own == []
+        assert dm.last_durable_seq == 2
+
+    def test_ring_is_bounded(self):
+        from fluidframework_tpu.runtime.delta_manager import DeltaManager
+        service = _StubService()
+        dm = self._manager(service, [])
+        dm.connect()
+        n = DeltaManager.RESUBMIT_WINDOW + 10
+        service.handler([_own_op(i, i) for i in range(1, n + 1)])
+        assert len(dm._undurable_own) == DeltaManager.RESUBMIT_WINDOW
+        assert dm._undurable_own[-1].sequence_number == n

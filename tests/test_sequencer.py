@@ -418,3 +418,78 @@ def test_storm_tickets_matches_process_batch(seed):
         got_seqs = seq[d] + 1 + np.arange(n_seq[d])
         assert np.array_equal(got_seqs, want_seqs), d
     assert np.array_equal(np.asarray(msn2), np.asarray(got_state.msn))
+
+
+class TestReplayIdempotency:
+    """Duplicate-delivery dedup (ISSUE 4 satellite): an already-committed
+    op replayed from the WAL, or a client double-submitting after a
+    reconnect, must be clientSeq-deduped by the sequencer — never
+    re-sequenced. Proven for the scalar oracle AND the device host, and
+    across a checkpoint/restore boundary (the restart shape)."""
+
+    def _stream(self):
+        return [op("a", 1, 1), op("a", 2, 1), op("a", 3, 2)]
+
+    def test_double_submit_ignored_scalar(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        firsts = [s.ticket(o) for o in self._stream()]
+        assert [t.kind for t in firsts] == [oc.OUT_SEQUENCED] * 3
+        cp_before = s.checkpoint()
+        replays = [s.ticket(o) for o in self._stream()]
+        assert [t.kind for t in replays] == [oc.OUT_IGNORED] * 3
+        # Dedup changed NOTHING except the clock-free planes.
+        assert s.checkpoint() == cp_before
+        # The client continues at the expected number afterwards.
+        assert s.ticket(op("a", 4, 3)).kind == oc.OUT_SEQUENCED
+
+    def test_double_submit_ignored_kernel_host(self):
+        from fluidframework_tpu.server.kernel_host import (
+            KernelSequencerHost,
+        )
+
+        host = KernelSequencerHost(num_slots=4, initial_capacity=2)
+        host.sequence("doc", join("a"))
+        for o in self._stream():
+            assert host.sequence("doc", o).kind == oc.OUT_SEQUENCED
+        cp = host.checkpoint("doc")
+        for o in self._stream():  # verbatim resend, no ack seen
+            assert host.sequence("doc", o).kind == oc.OUT_IGNORED
+        assert host.checkpoint("doc") == cp
+
+    def test_replay_after_restart_is_deduped(self):
+        """The WAL-replay shape: restore a checkpoint into a FRESH host,
+        then replay ops the checkpoint already covers — all deduped; the
+        first genuinely-new op sequences at the next number."""
+        from fluidframework_tpu.server.kernel_host import (
+            KernelSequencerHost,
+        )
+
+        host = KernelSequencerHost(num_slots=4, initial_capacity=2)
+        host.sequence("doc", join("a"))
+        for o in self._stream():
+            host.sequence("doc", o)
+        cp = host.checkpoint("doc")
+
+        fresh = KernelSequencerHost(num_slots=4, initial_capacity=2)
+        fresh.restore("doc", cp)
+        # Replay from below the watermark: already-committed ops drop.
+        for o in self._stream():
+            assert fresh.sequence("doc", o).kind == oc.OUT_IGNORED
+        assert fresh.checkpoint("doc") == cp
+        # Post-watermark traffic sequences exactly where the original
+        # host would have put it.
+        t_fresh = fresh.sequence("doc", op("a", 4, 3))
+        t_orig = host.sequence("doc", op("a", 4, 3))
+        assert (t_fresh.kind, t_fresh.seq, t_fresh.msn) \
+            == (t_orig.kind, t_orig.seq, t_orig.msn)
+
+    def test_replay_after_scalar_restore_is_deduped(self):
+        s = DocumentSequencer()
+        s.ticket(join("a"))
+        for o in self._stream():
+            s.ticket(o)
+        s2 = DocumentSequencer.restore(s.checkpoint())
+        assert [s2.ticket(o).kind for o in self._stream()] \
+            == [oc.OUT_IGNORED] * 3
+        assert s2.ticket(op("a", 4, 3)).kind == oc.OUT_SEQUENCED
