@@ -1457,6 +1457,55 @@ def rngless(i: int) -> int:
     return (i * 7919) % 5
 
 
+def bench_overload(num_docs: int = 256, k: int = 64,
+                   rounds: int = 16) -> dict:
+    """Overload column (ISSUE 5): graceful-degradation figures of merit
+    from the chaos scenarios themselves — the bench IS the invariant run,
+    so a regression fails loudly instead of drifting silently.
+
+    * shed_rate / p99 ratio at 2x the bounded tick-ingress capacity
+      (tools/chaos.run_overload: every overflow frame busy-nacked, the
+      admitted cohort's p99 within 2x the unloaded bar);
+    * quarantine recovery: wall-clock of the from-snapshot readmit of a
+      poisoned doc (run_poison_quarantine, byte-identical bar inside);
+    * reconnect storm: 1k simultaneous redials under a 100/s token
+      bucket (run_reconnect_storm: peak attempt rate under the limit).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.tools import chaos
+
+    workdir = tempfile.mkdtemp(prefix="bench-overload-")
+    try:
+        ov = chaos.run_overload(os.path.join(workdir, "ov"),
+                                num_docs=num_docs, k=k, rounds=rounds)
+        pq = chaos.run_poison_quarantine(os.path.join(workdir, "pq"),
+                                         num_docs=8, k=32, rounds=6)
+        storm = chaos.run_reconnect_storm(n_clients=1000)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "offered_x_capacity": ov["offered_x_capacity"],
+        "shed_rate": ov["shed_rate"],
+        "tick_ms_p99_unloaded": round(ov["tick_ms_p99_unloaded"], 2),
+        "tick_ms_p99_at_2x": round(ov["tick_ms_p99_loaded"], 2),
+        "p99_ratio_at_2x": round(ov["tick_ms_p99_loaded"]
+                                 / max(ov["tick_ms_p99_unloaded"], 1e-9),
+                                 3),
+        "quarantine_recovery_ms": pq["readmit_ms"],
+        "quarantine_replayed_ticks": pq["replayed_ticks"],
+        "reconnect_storm_1k_makespan_s": storm["makespan_s"],
+        "reconnect_storm_peak_attempts_per_s": storm[
+            "peak_attempts_per_s_after_wave"],
+        "reconnect_storm_window_limit": storm["window_limit"],
+        "num_docs": num_docs,
+        "ops_per_tick": num_docs * k,
+        "rounds": rounds,
+    }
+
+
 def _service_load_full() -> dict:
     from fluidframework_tpu.native.bridge import _load_library
     from fluidframework_tpu.tools.load_test import run_storm_load
@@ -1486,6 +1535,9 @@ def main() -> None:
         # series as soak evidence (tools/load_test.py). Needs the C++
         # bridge; skipped (not crashed) without a toolchain.
         "service_load_full_profile": _service_load_full(),
+        # Overload column (ISSUE 5): shed rate + p99 at 2x admission
+        # capacity, quarantine recovery, reconnect-storm convergence.
+        "overload": bench_overload(),
         "mixed_all_dds_serving": bench_mixed_serving(),
         "mergetree_stress": bench_mergetree(),
         "mergetree_128_writers": bench_mergetree(num_docs=4096,

@@ -112,10 +112,39 @@ class NetworkDocumentService:
         self.delta_storage = _NetworkDeltaStorage(self)
         self._scopes = scopes
         self._timeout = timeout
+        self._addr = (host, port)
+        self._auto_dispatch = auto_dispatch
+        # Stable per-client admission identity, carried on connect: the
+        # front door keys its per-client connect bucket AND claimable
+        # reservations on it. Stable across reconnect() (same driver
+        # instance = same client), unlike the ephemeral socket peername;
+        # self-chosen is fine — it buys fairness/ladder slots, not auth.
+        import uuid
+        self._client_key = uuid.uuid4().hex
         self.dispatch_lock = threading.RLock()
         self.events = TypedEventEmitter()  # "disconnect" on socket loss
 
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._send_lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: dict[int, queue.Queue] = {}
+        self._handlers: dict[str, Callable] = {}
+        # The reader thread must never block on dispatch_lock (a caller may
+        # hold it while awaiting an RPC response only the reader can
+        # deliver), so pushed events drain through a separate dispatcher
+        # thread; RPC responses route directly from the reader.
+        self._events: queue.Queue = queue.Queue()
+        # Transport generation: each (re)dial bumps it; a superseded
+        # reader that dies late must not post teardown events into the
+        # NEW session's queue.
+        self._generation = 0
+        self._open_transport()
+
+    def _open_transport(self) -> None:
+        """Dial the socket and start the reader/dispatcher pair — split
+        out of __init__ so :meth:`reconnect` re-establishes the SAME
+        session object over a fresh socket."""
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
         # The timeout above covers connection ESTABLISHMENT only. Left in
         # place it would also bound the reader thread's recv, tearing the
         # connection down after `timeout` seconds of idle (no inbound
@@ -127,25 +156,69 @@ class NetworkDocumentService:
         self._sock.settimeout(None)
         self._sock.setsockopt(
             socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-            struct.pack("ll", int(timeout),
-                        int((timeout % 1.0) * 1_000_000)))
-        self._send_lock = threading.Lock()
-        self._rid = itertools.count(1)
-        self._pending: dict[int, queue.Queue] = {}
-        self._handlers: dict[str, Callable] = {}
+            struct.pack("ll", int(self._timeout),
+                        int((self._timeout % 1.0) * 1_000_000)))
         self._closed = False
-        # The reader thread must never block on dispatch_lock (a caller may
-        # hold it while awaiting an RPC response only the reader can
-        # deliver), so pushed events drain through a separate dispatcher
-        # thread; RPC responses route directly from the reader.
-        self._events: queue.Queue = queue.Queue()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._generation += 1
+        self._reader = threading.Thread(target=self._read_loop,
+                                        args=(self._generation,),
+                                        daemon=True)
         self._reader.start()
         self._dispatcher = None
-        if auto_dispatch:
+        if self._auto_dispatch:
+            # Bound to THIS session's queue object (not the attribute):
+            # after a reconnect swaps self._events, a still-winding-down
+            # old dispatcher must never steal events from the new queue.
             self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                                args=(self._events,),
                                                 daemon=True)
             self._dispatcher.start()
+
+    def reconnect(self) -> None:
+        """Re-dial a lost transport: tears down the dead socket (no-op if
+        already gone) and opens a fresh one. The caller then re-issues
+        ``connect`` (DeltaManager.connect does the catch-up + resubmit
+        dance). Safe only after the old reader has disconnected."""
+        self._closed = True
+        # Supersede the old reader FIRST: however late it dies, its
+        # teardown path (generation-checked) can no longer touch the new
+        # session's waiters or event queue.
+        self._generation += 1
+        try:
+            # shutdown() (not just close) reliably wakes a reader still
+            # blocked in recv; close alone may leave it parked past the
+            # join timeout below.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        old_reader = self._reader
+        # The old reader must be out of its recv before a new one starts
+        # (two readers would interleave frame halves).
+        if (old_reader.is_alive()
+                and old_reader is not threading.current_thread()):
+            old_reader.join(timeout=self._timeout)
+        # Wind down the old dispatcher through ITS queue (it may have
+        # missed the reader's sentinel if the reader outlived the join),
+        # then drop the dead session's backlog.
+        self._events.put({"event": "__stop__"})
+        self._events = queue.Queue()
+        # Fail-and-forget the dead transport's RPC waiters: their rids
+        # can never be answered, and a long-lived auto-reconnecting
+        # client must not accumulate one dict entry per lost RPC.
+        for waiter in self._pending.values():
+            waiter.put_nowait(ConnectionError("connection lost"))
+        self._pending.clear()
+        self._open_transport()
+
+    @property
+    def closed(self) -> bool:
+        """True once the transport is down (deliberately or by socket
+        death) — reconnect() is needed before further RPCs."""
+        return self._closed
 
     # -- framing --------------------------------------------------------------
 
@@ -163,7 +236,7 @@ class NetworkDocumentService:
             buf += chunk
         return buf
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, generation: int) -> None:
         try:
             while True:
                 header = self._recv_exact(4)
@@ -173,10 +246,22 @@ class NetworkDocumentService:
                 payload = decode_body(self._recv_exact(length))
                 self._dispatch(payload)
         except (ConnectionError, OSError):
+            # The reader must never die SILENTLY on a broken socket: fail
+            # every waiter and surface a disconnect event so the host
+            # (DeltaManager/Container) degrades to disconnected/readonly
+            # instead of hanging on a transport that will never speak
+            # again. A deliberate close() (self._closed already set) is
+            # not a disconnect — no event then. A SUPERSEDED reader (a
+            # reconnect() already dialed a newer transport) exits
+            # without touching the new session's waiters or queue.
+            if generation != self._generation:
+                return
+            intentional = self._closed
             self._closed = True
             for q in self._pending.values():
                 q.put_nowait(ConnectionError("connection lost"))
-            self._events.put({"event": "__disconnect__"})
+            self._events.put({"event": "__disconnect__" if not intentional
+                              else "__stop__"})
 
     def _dispatch(self, payload: dict) -> None:
         rid = payload.get("rid")
@@ -189,6 +274,8 @@ class NetworkDocumentService:
 
     def _deliver(self, payload: dict) -> bool:
         """Run one pushed event's handler; False once disconnected."""
+        if payload.get("event") == "__stop__":
+            return False  # deliberate close: wind down, no disconnect event
         if payload.get("event") == "__disconnect__":
             with self.dispatch_lock:
                 self.events.emit("disconnect")
@@ -199,9 +286,9 @@ class NetworkDocumentService:
                 handler(payload)
         return True
 
-    def _dispatch_loop(self) -> None:
+    def _dispatch_loop(self, events: queue.Queue) -> None:
         while True:
-            if not self._deliver(self._events.get()):
+            if not self._deliver(events.get()):
                 return
 
     def pump_events(self) -> int:
@@ -249,7 +336,8 @@ class NetworkDocumentService:
             self._handlers["nack"] = lambda p: on_nack(p["nack"])
         if on_signal is not None:
             self._handlers["signal"] = lambda p: on_signal(p["signal"])
-        req: dict = {"op": "connect", "mode": mode}
+        req: dict = {"op": "connect", "mode": mode,
+                     "client_key": self._client_key}
         if self._scopes is not None:
             req["scopes"] = list(self._scopes)
         if self._token is not None:

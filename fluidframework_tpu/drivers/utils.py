@@ -51,6 +51,45 @@ class ThrottlingError(DriverError):
                          retry_after_s=retry_after_s)
 
 
+class ReconnectPolicy:
+    """Reconnect pacing: exponential backoff with full jitter, honoring
+    server ``retry_after_s`` hints (deltaManager.ts reconnect delays +
+    the NACK retryAfter contract).
+
+    ``next_delay(attempt, retry_after_s)`` is pure given the seeded rng:
+    ``min(max_s, base * mult^attempt)`` scaled into ``[1-jitter, 1]`` of
+    itself, then floored at the server hint (the hint is a promise the
+    server will still be busy sooner — honoring it keeps the retry from
+    being sheddable-on-arrival). Jitter is what dissolves a reconnect
+    storm: 1k clients killed at the same instant spread their N-th
+    retries over ``jitter * backoff`` rather than re-converging on one
+    tick. Seed per client (e.g. a hash of the client id) for determinism
+    in tests and simulation."""
+
+    def __init__(self, base_s: float = 0.1, max_s: float = 30.0,
+                 multiplier: float = 2.0, jitter: float = 0.5,
+                 seed: int | None = None) -> None:
+        import random
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.base_s = base_s
+        self.max_s = max_s
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+
+    def next_delay(self, attempt: int,
+                   retry_after_s: float | None = None) -> float:
+        raw = min(self.max_s, self.base_s * self.multiplier ** attempt)
+        delay = raw * (1.0 - self.jitter * self._rng.random())
+        if retry_after_s is not None:
+            # Honor the hint as a FLOOR, keeping this client's jitter on
+            # top — everyone nacked in the same window must not all come
+            # back exactly retry_after_s later.
+            delay = retry_after_s + delay
+        return delay
+
+
 def run_with_retry(fn: Callable[[], T], *, max_retries: int = 5,
                    base_delay_s: float = 0.05, max_delay_s: float = 8.0,
                    retriable: tuple[type[BaseException], ...]

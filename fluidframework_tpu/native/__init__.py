@@ -82,7 +82,12 @@ class _NativeOpLog:
         return buf.raw
 
     def sync(self) -> None:
-        self._lib.oplog_sync(self._handle)
+        # A swallowed -1 here would be catastrophic: the group-commit
+        # writer would advance the durability watermark (and release
+        # withheld acks) over bytes that never reached disk, and the
+        # WAL fsync circuit breaker could never open on a real failure.
+        if self._lib.oplog_sync(self._handle) < 0:
+            raise OSError("oplog_sync (fdatasync) failed")
 
     def close(self) -> None:
         if self._handle:

@@ -90,6 +90,25 @@ class Container:
         # Offline resume: the previous session's client id while its
         # stashed ops may still arrive sequenced (cleared after catch-up).
         self._stashed_client_id: str | None = None
+        # Transport-loss surfacing (ISSUE 5 satellite): drivers with an
+        # event emitter (network driver "disconnect" on socket death)
+        # degrade the container to disconnected/readonly instead of
+        # leaving it hung on a dead socket.
+        events = getattr(document_service, "events", None)
+        if events is not None:
+            events.on("disconnect", self._on_transport_lost)
+
+    def _on_transport_lost(self) -> None:
+        """The driver's transport died underneath us: drop the connection
+        WITHOUT the disconnect RPC (no socket to carry it) and fire the
+        disconnected callbacks. The container keeps serving local reads
+        (readonly degradation); connect()/reconnect() — or an
+        AutoReconnector — restores write mode."""
+        if not self.connected:
+            return
+        self.delta_manager.handle_connection_lost()
+        for cb in self.on_disconnected:
+            cb()
 
     # -- load -----------------------------------------------------------------
 

@@ -105,7 +105,23 @@ class DeltaManager:
 
     @property
     def readonly(self) -> bool:
-        return self._read_mode
+        """True in read mode AND while the connection is down — readonly
+        degradation is the offline contract (deltaManager.ts readonly):
+        a disconnected container reads its local state but cannot claim
+        client seqs until the transport is back."""
+        return self._read_mode or self._connection is None
+
+    def handle_connection_lost(self) -> None:
+        """Transport-level disconnect (dead socket, server kill): degrade
+        to disconnected/readonly WITHOUT the disconnect RPC — there is no
+        live socket to send it on. Pending local ops stay stashed for the
+        post-reconnect replay; own echoed-but-unproven ops stay in the
+        resubmit ring (the durability-watermark probe on the next
+        connect() decides what the crashed server lost)."""
+        if self._connection is None:
+            return
+        self._connection.open = False  # poison further submits locally
+        self._teardown_session()
 
     def catch_up_to(self, to_seq: int) -> None:
         """Process stored deltas up to ``to_seq`` while still offline —
@@ -189,6 +205,12 @@ class DeltaManager:
         if self._connection is None:
             return
         self._connection.close()
+        self._teardown_session()
+
+    def _teardown_session(self) -> None:
+        """Shared tail of disconnect()/handle_connection_lost(): forget
+        the connection and park the queues (the two paths differ only in
+        whether the transport could carry a goodbye)."""
         self._connection = None
         self.client_id = None
         self._batch = []
@@ -319,3 +341,131 @@ class DeltaManager:
     def submit_signal(self, content: Any) -> None:
         assert self._connection is not None, "signal while disconnected"
         self._connection.signal(content)
+
+
+class AutoReconnector:
+    """Automatic reconnect with exponential backoff + jitter for a
+    DeltaManager over a re-dialable transport (drivers exposing
+    ``reconnect()``, e.g. NetworkDocumentService).
+
+    On the service's "disconnect" event the DeltaManager degrades to
+    disconnected/readonly immediately (handle_connection_lost), then the
+    retry loop re-dials on a
+    :class:`~fluidframework_tpu.drivers.utils.ReconnectPolicy` schedule —
+    honoring server ``retry_after_s`` hints from busy-nacks, so a
+    reconnect storm self-spreads under the admission limit instead of
+    hammering the front door. A successful connect() runs the usual
+    catch-up + durability-watermark probe, then ``on_reconnected`` (the
+    container replays pending ops there).
+
+    ``spawn_thread=False`` leaves the loop to the caller (deterministic
+    tests / simulations drive :meth:`run` with a fake sleep).
+    """
+
+    def __init__(self, delta_manager: DeltaManager, service,
+                 policy=None, mode: str = "write",
+                 max_attempts: int = 64,
+                 sleep=None,
+                 on_reconnected: Callable[[str], None] | None = None,
+                 on_gave_up: Callable[[], None] | None = None,
+                 spawn_thread: bool = True) -> None:
+        import time
+
+        from ..drivers.utils import ReconnectPolicy
+        self.delta_manager = delta_manager
+        self.service = service
+        self.policy = policy if policy is not None else ReconnectPolicy()
+        self.mode = mode
+        self.max_attempts = max_attempts
+        self.on_reconnected = on_reconnected
+        # Fired (once per exhausted loop) when max_attempts runs out —
+        # in spawned-thread mode the ConnectionError below dies with the
+        # daemon thread, so this hook (plus the `gave_up` flag) is the
+        # application's only signal that redialing was abandoned.
+        self.on_gave_up = on_gave_up
+        self.gave_up = False
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._spawn_thread = spawn_thread
+        # One redial loop at a time: a disconnect fired DURING a redial
+        # (the fresh socket dying mid-connect) must not start a second
+        # loop racing the first through the driver's reconnect().
+        import threading
+        self._run_guard = threading.Lock()
+        # Set by every disconnect, cleared when a redial loop takes over:
+        # a disconnect landing in the tail of a finishing run() (after
+        # its connect succeeded, before the guard released) must not be
+        # dropped — the finishing loop re-spawns if this is still set.
+        self._redial_needed = False
+        self.disconnects = 0
+        self.attempts = 0  # attempts spent on the LAST successful redial
+        service.events.on("disconnect", self._on_disconnect)
+
+    def _on_disconnect(self) -> None:
+        # Runs on the driver's dispatcher thread (holding dispatch_lock):
+        # degrade NOW, retry elsewhere — the redial loop does RPCs that
+        # need this thread free.
+        self.delta_manager.handle_connection_lost()
+        self.disconnects += 1
+        self._redial_needed = True
+        self._maybe_spawn()
+
+    def _maybe_spawn(self) -> None:
+        if self._spawn_thread and not self._run_guard.locked():
+            import threading
+            threading.Thread(target=self.run, daemon=True).start()
+
+    def run(self) -> str | None:
+        """The redial loop; returns the new client id, None when another
+        loop already holds the redial (it will finish the job) or the
+        connection is already back, or raises after ``max_attempts``.
+        Connection refusals retry; throttling nacks retry after honoring
+        the server's hint; non-retriable driver errors (auth)
+        propagate."""
+        from ..drivers.utils import DriverError
+        if not self._run_guard.acquire(blocking=False):
+            return None  # a concurrent loop is already redialing
+        try:
+            self._redial_needed = False
+            if self.delta_manager.connected:
+                return self.delta_manager.client_id  # nothing to redial
+            retry_hint: float | None = None
+            for attempt in range(self.max_attempts):
+                self._sleep(self.policy.next_delay(attempt, retry_hint))
+                retry_hint = None
+                try:
+                    # Re-dial only a DEAD transport: a connect refused by
+                    # admission (throttled) arrives over a healthy fresh
+                    # socket — tearing it down per retry would multiply
+                    # front-door handshake churn by the attempt count,
+                    # the very load the admission ladder bounds.
+                    if getattr(self.service, "closed", True):
+                        self.service.reconnect()
+                    client_id = self.delta_manager.connect(self.mode)
+                except DriverError as err:
+                    if not err.can_retry:
+                        # Auth-class failure: redialing cannot help. In
+                        # spawned-thread mode the raise dies with the
+                        # daemon thread, so signal abandonment first.
+                        self.gave_up = True
+                        if self.on_gave_up is not None:
+                            self.on_gave_up()
+                        raise
+                    retry_hint = err.retry_after_s
+                    continue
+                except (ConnectionError, OSError):
+                    continue  # server still down; back off further
+                self.attempts = attempt + 1
+                self.gave_up = False
+                if self.on_reconnected is not None:
+                    self.on_reconnected(client_id)
+                return client_id
+            self.gave_up = True
+            if self.on_gave_up is not None:
+                self.on_gave_up()
+            raise ConnectionError(
+                f"reconnect gave up after {self.max_attempts} attempts")
+        finally:
+            self._run_guard.release()
+            if self._redial_needed:
+                # A disconnect raced the tail of this loop: pick it up.
+                self._maybe_spawn()

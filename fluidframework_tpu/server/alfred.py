@@ -65,6 +65,7 @@ class RequestSession:
         self.server = server
         self.connection = None  # service-side live connection
         self.doc_id: str | None = None
+        self.tenant_id = "default"  # set from token claims on connect
 
     def push(self, payload: dict) -> None:
         raise NotImplementedError
@@ -87,7 +88,12 @@ class RequestSession:
         except Exception as err:
             return {"rid": None, "error": f"bad storm frame: {err!r}"}
         try:
-            storm.submit_frame(self.push, header, payload)
+            # Admission identities come from the SESSION (validated
+            # tenant, service-assigned client id), never the frame's
+            # client-controlled header.
+            storm.submit_frame(
+                self.push, header, payload, tenant_id=self.tenant_id,
+                client_id=getattr(self.connection, "client_id", None))
         except Exception as err:
             # The error must answer the offending frame and keep the
             # socket alive — exactly like the JSON request path.
@@ -114,9 +120,23 @@ class RequestSession:
                 claims = self.server.tenants.validate_token(
                     token, document_id=self.doc_id)
                 kwargs["scopes"] = tuple(claims["scopes"])
+                self.tenant_id = claims.get("tenantId", "default")
             elif req.get("scopes") is not None:
                 kwargs["scopes"] = tuple(req["scopes"])
-            if self.server.throttler is not None:
+            admission = self.server.admission
+            if admission is not None:
+                # The client-tier key is the driver's stable per-client
+                # id (claimable reservations must survive a redial's new
+                # socket AND must not be shared by a doc's other clients
+                # — a doc-keyed reservation would let neighbours steal a
+                # refused client's slot). Absent (old clients), fall
+                # back to tenant-only admission.
+                retry = admission.admit_connect(self.tenant_id,
+                                                req.get("client_key"))
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
+            elif self.server.throttler is not None:
                 retry = self.server.throttler.try_consume(
                     f"connect/{self.doc_id}")
                 if retry is not None:
@@ -133,7 +153,14 @@ class RequestSession:
             self.connection.on_closed = self.drop
             return {"rid": rid, "client_id": self.connection.client_id}
         if op == "submit":
-            if self.server.throttler is not None:
+            if self.server.admission is not None:
+                retry = self.server.admission.admit_write(
+                    self.tenant_id, self.connection.client_id,
+                    weight=len(req["messages"]))
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
+            elif self.server.throttler is not None:
                 retry = self.server.throttler.try_consume(
                     f"submit/{self.connection.client_id}",
                     weight=len(req["messages"]))
@@ -143,9 +170,24 @@ class RequestSession:
             self.connection.submit(req["messages"])
             return {"rid": rid, "ok": True}
         if op == "signal":
+            if self.server.admission is not None:
+                # Deterministic shed order: signals are the FIRST class
+                # dropped under queue pressure (they are transient by
+                # contract — a shed signal loses nothing durable).
+                retry = self.server.admission.admit_signal(self.tenant_id)
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
             self.connection.signal(req["content"])
             return {"rid": rid, "ok": True}
         if op == "get_deltas":
+            if self.server.admission is not None:
+                # Reads shed second (before writes): a catch-up read can
+                # retry; an admitted write the tick can't absorb cannot.
+                retry = self.server.admission.admit_read(self.tenant_id)
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid, "messages": service.get_deltas(
                 doc, req["from_seq"], req.get("to_seq"))}
@@ -250,16 +292,20 @@ class AlfredServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
                  logger: TelemetryLogger | None = None,
                  metrics: MetricsRegistry | None = None,
-                 tenants=None, throttler=None) -> None:
+                 tenants=None, throttler=None, admission=None) -> None:
         self.service = service
         self.host = host
         self.port = port
         self.logger = logger if logger is not None else NullLogger()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # Optional riddler integration: a TenantManager enforces token auth
-        # on connect; a Throttler rate-limits connects/submits.
+        # on connect; an AdmissionController (token buckets + pressure
+        # shed) rate-limits connects/submits/reads/signals. ``throttler``
+        # (the legacy fixed-window surface) is honored when no admission
+        # controller is given.
         self.tenants = tenants
         self.throttler = throttler
+        self.admission = admission
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:

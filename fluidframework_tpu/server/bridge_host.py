@@ -51,7 +51,7 @@ class BridgeFrontDoor:
     def __init__(self, service, port: int = 0,
                  logger: TelemetryLogger | None = None,
                  metrics: MetricsRegistry | None = None,
-                 tenants=None, throttler=None) -> None:
+                 tenants=None, throttler=None, admission=None) -> None:
         bridge = start_bridge(port)
         if bridge is None:
             raise RuntimeError("native bridge unavailable (no toolchain)")
@@ -60,6 +60,8 @@ class BridgeFrontDoor:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.tenants = tenants
         self.throttler = throttler
+        # Same admission seam as AlfredServer (RequestSession reads it).
+        self.admission = admission
         self._bridge = bridge
         self.port = bridge.port
         self._sessions: dict[int, _BridgeSession] = {}

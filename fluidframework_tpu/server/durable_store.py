@@ -60,6 +60,86 @@ def _load(data: bytes) -> Any:
 # -- group-commit WAL writer --------------------------------------------------
 
 
+class WalDegradedError(RuntimeError):
+    """A durability barrier was requested while the WAL's circuit breaker
+    is open (sustained fsync failure) — the caller must degrade to
+    read-only serving instead of blocking on a disk that is not coming
+    back this instant."""
+
+
+class CircuitBreaker:
+    """Closed → open → half-open breaker for the WAL writer (the pattern
+    every storage-backed service front: shed fast while the disk is sick,
+    probe periodically, heal without a restart).
+
+    * **closed** — healthy; failures count toward ``failure_threshold``.
+    * **open** — shedding; for ``cooldown_s`` after the last failure all
+      probes are refused, then ONE probe is allowed (half-open).
+    * **half-open** — the single in-flight probe decides: success closes
+      the breaker (and resets the count), failure re-opens it for another
+      cooldown.
+
+    Thread-safe via a single mutex; every method is O(1).
+    """
+
+    def __init__(self, failure_threshold: int = 1,
+                 cooldown_s: float = 0.25,
+                 clock: Callable[[], float] = None) -> None:
+        import time as _time
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown_s = cooldown_s
+        self._clock = clock if clock is not None else _time.monotonic
+        self._mutex = threading.Lock()
+        self._failures = 0
+        self._opened_at: float | None = None
+        self._probing = False
+        self.stats = {"opens": 0, "probes": 0, "closes": 0}
+
+    @property
+    def state(self) -> str:
+        with self._mutex:
+            if self._opened_at is None:
+                return "closed"
+            return "half-open" if self._probing else "open"
+
+    @property
+    def is_open(self) -> bool:
+        with self._mutex:
+            return self._opened_at is not None
+
+    def record_failure(self) -> None:
+        with self._mutex:
+            self._failures += 1
+            self._probing = False
+            if self._failures >= self.failure_threshold:
+                if self._opened_at is None:
+                    self.stats["opens"] += 1
+                self._opened_at = self._clock()
+
+    def record_success(self) -> None:
+        with self._mutex:
+            if self._opened_at is not None:
+                self.stats["closes"] += 1
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def allow(self) -> bool:
+        """May the protected operation run now? True while closed; while
+        open, True exactly once per elapsed cooldown (the half-open
+        probe)."""
+        with self._mutex:
+            if self._opened_at is None:
+                return True
+            if self._probing:
+                return False
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                self._probing = True
+                self.stats["probes"] += 1
+                return True
+            return False
+
+
 class GroupCommitLog:
     """Async group-commit writer over a CRC-framed :class:`OpLog`.
 
@@ -89,7 +169,8 @@ class GroupCommitLog:
     """
 
     def __init__(self, path: str | os.PathLike, max_queue: int = 256,
-                 fsync: bool = True) -> None:
+                 fsync: bool = True,
+                 breaker: CircuitBreaker | None = None) -> None:
         self._log = OpLog(path)
         self._fsync = fsync
         # Serializes ALL OpLog access: neither backend is thread-safe
@@ -104,8 +185,21 @@ class GroupCommitLog:
         self._callbacks: dict[int, Callable[[int], None]] = {}
         self._next = len(self._log)
         self._durable = self._next  # reopened records are durable history
+        # Records written to the OS file but not yet fsynced: a retry
+        # after a failed fsync must never re-append them (duplicate
+        # records would shift every later index).
+        self._appended_next = self._next
         self._max_queue = max(1, max_queue)
         self._error: BaseException | None = None
+        # Fsync-failure circuit breaker: a failed batch stays queued and
+        # the writer RETRIES on the breaker's half-open cadence instead
+        # of dying — the WAL degrades and heals, it does not brick.
+        # Callers poll `breaker.is_open` to enter/leave read-only mode.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        #: TERMINAL writer death (non-I/O failure; the breaker stays
+        #: open and will never heal) — callers distinguish this from a
+        #: sick-disk outage and stop telling clients to retry.
+        self.failed = False
         self._stop = False
         self._thread = threading.Thread(target=self._writer_loop,
                                         name="group-commit-wal", daemon=True)
@@ -125,13 +219,18 @@ class GroupCommitLog:
     def append(self, data: bytes | bytearray | memoryview | list,
                on_durable: Callable[[int], None] | None = None) -> int:
         """Enqueue one record; returns its index immediately. Blocks only
-        when the bounded queue is full (backpressure, not unbounded RAM)."""
+        when the bounded queue is full (backpressure, not unbounded RAM).
+        With the breaker OPEN a full queue raises WalDegradedError
+        instead of waiting — the writer is in its probe cycle and space
+        is not freeing on any bounded schedule; the caller must shed."""
         parts = list(data) if isinstance(data, list) else [data]
         with self._lock:
-            self._raise_if_failed()
             while len(self._queued) >= self._max_queue:
+                if self.breaker.is_open:
+                    raise WalDegradedError(
+                        "WAL queue full while the fsync breaker is open"
+                    ) from self._error
                 self._lock.wait(timeout=1.0)
-                self._raise_if_failed()
             idx = self._next
             self._next += 1
             self._queued[idx] = parts
@@ -149,13 +248,19 @@ class GroupCommitLog:
             return self._log.read(index)
 
     def sync(self) -> None:
-        """Barrier: returns once every record appended so far is durable."""
+        """Barrier: returns once every record appended so far is durable.
+        Raises :class:`WalDegradedError` (without waiting out the outage)
+        when the breaker is open — durability is not coming on a bounded
+        schedule, and callers holding the serving thread must degrade to
+        read-only rather than block on it."""
         with self._lock:
             target = self._next
             while self._durable < target:
-                self._raise_if_failed()
+                if self.breaker.is_open:
+                    raise WalDegradedError(
+                        "WAL fsync breaker is open; durability barrier "
+                        "unavailable") from self._error
                 self._lock.wait(timeout=1.0)
-            self._raise_if_failed()
 
     def close(self) -> None:
         with self._lock:
@@ -163,10 +268,6 @@ class GroupCommitLog:
             self._lock.notify_all()
         self._thread.join(timeout=10)
         self._log.close()
-
-    def _raise_if_failed(self) -> None:
-        if self._error is not None:
-            raise RuntimeError("group-commit writer failed") from self._error
 
     def _writer_loop(self) -> None:
         while True:
@@ -177,22 +278,58 @@ class GroupCommitLog:
                     return
                 batch = sorted(self._queued)
                 parts_of = {i: self._queued[i] for i in batch}
+            if not self.breaker.allow():
+                # Open breaker, cooldown not yet elapsed: hold the batch
+                # (records stay queued and readable) and poll again.
+                with self._lock:
+                    if self._stop:
+                        return  # close() during an outage abandons the tail
+                    self._lock.wait(timeout=min(0.05,
+                                                self.breaker.cooldown_s))
+                continue
             try:
                 with self._io:
                     for idx in batch:
+                        if idx < self._appended_next:
+                            continue  # appended before a failed fsync
                         data = b"".join(bytes(p) for p in parts_of[idx])
                         got = self._log.append(data)
+                        # Advance BEFORE asserting: the record is on the
+                        # file either way, and a retry after the assert
+                        # must never append it twice.
+                        self._appended_next = max(self._appended_next,
+                                                  got + 1)
                         assert got == idx, (got, idx)
                     faults.crashpoint("wal.pre_fsync")
                     if self._fsync:
+                        faults.failpoint("wal.fsync")
                         self._log.sync()
                 faults.crashpoint("wal.post_fsync")
-            except BaseException as err:  # surface on the caller's thread
+            except OSError as err:
+                # Transient I/O (the breaker's whole domain): keep the
+                # records queued and retry on the half-open cadence.
+                # Callers observe breaker.is_open and shed.
                 with self._lock:
                     self._error = err
                     self._lock.notify_all()
+                self.breaker.record_failure()
+                continue
+            except BaseException as err:
+                # Deterministic / non-I/O failure (index skew, bad
+                # payload types): retrying would loop forever or
+                # duplicate records. The writer exits; the breaker is
+                # forced open permanently so sync()/append() surface
+                # WalDegradedError instead of hanging.
+                with self._lock:
+                    self._error = err
+                    self._lock.notify_all()
+                self.failed = True
+                while not self.breaker.is_open:
+                    self.breaker.record_failure()
                 return
+            self.breaker.record_success()
             with self._lock:
+                self._error = None
                 for idx in batch:
                     del self._queued[idx]
                 self._durable = batch[-1] + 1

@@ -577,7 +577,8 @@ class KernelMergeHost:
         self.stats = {"device_ops": 0, "scalar_ops": 0, "flushes": 0,
                       "compactions": 0, "overflow_routed": 0,
                       "migrations": 0, "readmissions": 0,
-                      "block_overflow_replays": 0}
+                      "block_overflow_replays": 0,
+                      "quarantined_channels": 0}
 
     # -- interning -------------------------------------------------------------
 
@@ -839,15 +840,23 @@ class KernelMergeHost:
         engine = self._seed_merge_engine(row)
         for op, seq, ref_seq, client in row.raw_log:
             engine.apply_remote(op, seq, ref_seq, client)
+        self._pending_ops -= len(row.pending)
+        self.stats["overflow_routed"] += 1
+        self._demote_row_to_scalar(row, engine)
+
+    def _demote_row_to_scalar(self, row: _MergeRow, engine) -> None:
+        """Shared tail of the device→scalar escapes (slot overflow and
+        per-row quarantine): the engine becomes the channel state and
+        the device row is surrendered."""
         row.scalar = engine
         row.raw_log = []  # the engine IS the state from here on
-        self._pending_ops -= len(row.pending)
         row.pending = []
+        row.applied_seq = row.last_seq
+        row.applied_min_seq = row.min_seq
         # Release the abandoned device row: blanking its valid mask keeps
         # later apply_tick/compact passes from dragging stale segments.
         row.pool.release(row.row)
         row.pool, row.row = None, -1
-        self.stats["overflow_routed"] += 1
         self._export_stats()
 
     # -- matrix channels (matrix.ts:547 behind the service) --------------------
@@ -1783,11 +1792,28 @@ class KernelMergeHost:
                     if idx != int(mtb.OVF_NONE):
                         # Block full mid-tick: the device froze the row
                         # at op ``idx``; replay the tail exactly through
-                        # the flat kernel and re-block.
-                        self._replay_block_overflow(r, r.pending[idx:])
+                        # the flat kernel and re-block. A replay that
+                        # FAILS quarantines only this channel (scalar
+                        # route) — one poisoned doc must never abort the
+                        # whole bucket's flush.
+                        src_pool, src_row = r.pool, r.row
+                        try:
+                            self._replay_block_overflow(r, r.pending[idx:])
+                        except Exception as err:
+                            if r.pool is not src_pool or r.row != src_row:
+                                # Died mid-migration: the half-written
+                                # destination row is abandoned; the
+                                # frozen source row is still intact.
+                                r.pool.release(r.row)
+                                r.pool, r.row = src_pool, src_row
+                                src_pool.members[src_row] = r
+                            self._quarantine_merge_row(
+                                r, r.pending[idx:], err)
             self.stats["device_ops"] += sum(
                 len(r.pending) for r in pool_rows)
             for r in pool_rows:
+                if r.pool is None:
+                    continue  # quarantined above; already settled
                 r.pending = []
                 # The device row now reflects everything in raw_log; the
                 # tail resets so host memory per channel stays bounded.
@@ -1854,6 +1880,59 @@ class KernelMergeHost:
         else:
             pool.write_row(row.row, out)
         self.stats["block_overflow_replays"] += 1
+
+    def _decode_pending_op(self, row: _MergeRow, enc: dict,
+                           slot_rev: dict[int, str],
+                           key_rev: dict[int, str]
+                           ) -> tuple[dict, int, int, str | None]:
+        """Invert :meth:`_ingest_merge`'s encoding of one pending op back
+        to a (channel_op, seq, ref_seq, client) tuple the scalar engine
+        applies — the quarantine path's exact-tail replay input. Marker/
+        item runs reconstruct exactly as :meth:`_seed_merge_engine` does
+        (position-space-exact placeholders). The caller builds the
+        reverse maps once per row, not once per op."""
+        client = slot_rev.get(enc["client"])
+        if enc["kind"] == mtk.MT_INSERT:
+            start = enc["pool_start"]
+            text = row.pool.text.buffer(row.row)[
+                start:start + enc["text_len"]]
+            op: dict[str, Any] = {"type": "insert", "pos": enc["pos"]}
+            if text and text == _MARKER_CHAR * len(text):
+                if len(text) == 1:
+                    op["marker"] = {"ref_type": "simple", "id": None}
+                else:
+                    op["items"] = [None] * len(text)
+            else:
+                op["text"] = text
+        elif enc["kind"] == mtk.MT_REMOVE:
+            op = {"type": "remove", "start": enc["pos"], "end": enc["end"]}
+        else:  # MT_ANNOTATE — one encoded op per (key, value)
+            op = {"type": "annotate", "start": enc["pos"],
+                  "end": enc["end"],
+                  "props": {key_rev[enc["prop_key"]]:
+                            self._val_rev[enc["prop_val"]]}}
+        return op, enc["seq"], enc["ref_seq"], client
+
+    def _quarantine_merge_row(self, row: _MergeRow, rest: list[dict],
+                              err: Exception) -> None:
+        """The generalized per-doc escape hatch (ISSUE 5 tentpole): ANY
+        per-row tick failure — overflow replay included — seeds the
+        scalar engine from the frozen last-good device table, replays the
+        unapplied tail through it, and serves the channel scalar from
+        here on; the rest of the batch never sees the failure. The
+        channel readmits to the device through the existing
+        :meth:`_readmit_scalar_rows` path once its window compacts."""
+        self.metrics.counter("merge_host.quarantines").inc()
+        engine = self._seed_merge_engine(row)
+        slot_rev = {s: c for c, s in row.client_slots.items()}
+        key_rev = {s: k for k, s in row.key_slots.items()}
+        for enc in rest:
+            op, seq, ref_seq, client = self._decode_pending_op(
+                row, enc, slot_rev, key_rev)
+            engine.apply_remote(op, seq, ref_seq, client)
+        engine.update_min_seq(row.min_seq)
+        self.stats["quarantined_channels"] += 1
+        self._demote_row_to_scalar(row, engine)
 
     def _repack_text_pool(self, row: _MergeRow) -> None:
         """Zamboni for text bytes: the pool is append-only, so a long-lived

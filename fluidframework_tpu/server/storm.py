@@ -275,17 +275,52 @@ def _storm_tick(seq_state: seqk.SequencerState, map_state: mk.MapState,
     first = jnp.where(n_seq > 0, seq0_for + 1, oc.INT32_MAX)
     last = jnp.where(n_seq > 0, seq0_for + n_seq, 0)
     msn = jnp.where(map_counts > 0, msn_doc[map_gather], 0)
-    return seq_state, map_state, n_seq, first, last, msn
+    # Per-doc poison sentinel (summary-drift / invariant violation): a
+    # healthy map row never carries a vseq above its doc's post-tick seq,
+    # and present slots never hold negative vseq/value. One cheap VPU
+    # reduce per row that rides the existing readback batch — the
+    # quarantine plane's detection input (harvest freezes flagged docs
+    # only; the rest of the batch keeps serving).
+    seq_after = seq_state.seq[map_gather]
+    drift = jnp.max(jnp.where(map_state.present, map_state.vseq, -1),
+                    axis=1) > seq_after
+    corrupt = jnp.any(map_state.present
+                      & ((map_state.vseq < 0) | (map_state.value < 0)),
+                      axis=1)
+    bad = drift | corrupt
+    return seq_state, map_state, n_seq, first, last, msn, bad
 
 
 _storm_tick = compile_cache.uncached(_storm_tick)
+
+
+#: Format version stamped on every storm WAL tick header ("v") and on
+#: storm snapshot records ("format_version"). Version 0 = the pre-stamp
+#: round-7 format (no field); readers accept 0..CURRENT and refuse
+#: anything newer (a downgrade must fail loudly, not misparse).
+STORM_WAL_VERSION = 1
+STORM_SNAPSHOT_VERSION = 1
 
 
 class StormController:
     """Buffers storm frames and runs the fused tick over the REAL hosts:
     the service's batched deli (KernelSequencerHost) and merge host
     (KernelMergeHost map rows) — the storm path and the per-op path share
-    one sequencer state and one map state per document."""
+    one sequencer state and one map state per document.
+
+    Overload behavior (the graceful-degradation tentpole): the inbound
+    frame queue is bounded (``max_pending_docs``) and an optional
+    :class:`~fluidframework_tpu.server.riddler.AdmissionController`
+    gates the tick ingress; refused frames get a busy-nack carrying
+    ``retry_after_s`` instead of queueing without bound. A per-doc
+    poison (device sentinel tripping on a tick output) quarantines ONLY
+    that document — its in-flight ops nack retryable, catch-up reads
+    keep serving from the (poison-free) durable records with
+    :meth:`quarantined_map_entries` as the server-side scalar fold, and
+    :meth:`readmit_doc` rebuilds it from snapshot + WAL replay while
+    every other doc keeps full-rate serving. A WAL whose fsync breaker
+    opens degrades the controller to read-only broadcast mode until the
+    half-open probes heal it."""
 
     #: Per-op count sanity bound (one doc's batch within one frame).
     MAX_COUNT = 1 << 16
@@ -299,7 +334,10 @@ class StormController:
                  spill_dir: str | None = None,
                  durability: str | None = None,
                  snapshots=None,
-                 snapshot_interval_ticks: int | None = None) -> None:
+                 snapshot_interval_ticks: int | None = None,
+                 admission=None,
+                 max_pending_docs: int | None = None,
+                 busy_retry_s: float = 0.05) -> None:
         self.service = service
         self.seq_host = seq_host
         self.merge_host = merge_host
@@ -395,8 +433,27 @@ class StormController:
         self._replay = False
         self._replay_ts: int | None = None
         self._trim_gate = _TrimGate()
+        # Tick-ingress admission (the alfred/deli throttle seam moved to
+        # where batching amplifies it): a bounded inbound queue + token
+        # buckets; refusals are busy-nacks, never silent drops or OOM.
+        self.admission = admission
+        self.max_pending_docs = max_pending_docs
+        self.busy_retry_s = busy_retry_s
+        if admission is not None and max_pending_docs is not None:
+            admission.add_pressure_probe(
+                lambda: self._pending_docs / max(1, self.max_pending_docs))
+        # Quarantine plane: doc -> {"reason", "tick"}. A quarantined doc
+        # is frozen out of cohorts (submits nack retryable) and serves
+        # reads through the scalar record fold until readmit_doc().
+        self.quarantined: dict[str, dict] = {}
+        #: Ticks each doc participated in (telemetry: the zero-lost-ticks
+        #: invariant for a quarantined doc's batch peers asserts on this).
+        self.doc_tick_counts: dict[str, int] = {}
         self.stats = {"ticks": 0, "sequenced_ops": 0, "submitted_ops": 0,
-                      "nacked_or_ignored_ops": 0}
+                      "nacked_or_ignored_ops": 0,
+                      "shed_frames": 0, "shed_ops": 0,
+                      "quarantined_docs": 0, "readmitted_docs": 0,
+                      "degraded_rejects": 0}
         self.tick_seconds: list[float] = []  # submit→harvest per round
         self.harvest_intervals: list[float] = []  # completion cadence
         # Depth-N pipeline (SURVEY §7 hard part (c)): a tick's readbacks,
@@ -416,11 +473,19 @@ class StormController:
     # -- front-door entry ------------------------------------------------------
 
     def submit_frame(self, push: Callable[[dict], None] | None,
-                     header: dict, payload: memoryview) -> None:
+                     header: dict, payload: memoryview,
+                     tenant_id: str = "default",
+                     client_id: str | None = None) -> None:
         """One decoded storm frame from a session; ack is pushed after the
         tick that sequences it. Malformed frames raise ValueError BEFORE
         anything is buffered — a bad frame must fail alone, never poison
-        co-buffered frames from other sessions."""
+        co-buffered frames from other sessions.
+
+        ``tenant_id``/``client_id`` are the admission identities and must
+        come from the SESSION (token-validated tenant, service-assigned
+        client id) — never from the frame header, which the client
+        controls (a self-stamped tenant would mint itself a fresh bucket
+        per frame)."""
         entries = header.get("docs")
         if not isinstance(entries, list) or not entries:
             raise ValueError("storm frame without docs")
@@ -454,6 +519,14 @@ class StormController:
             raise ValueError(
                 f"storm key slot {max_slot} >= max_key_slots "
                 f"{self.max_key_slots}")
+        # Admission gates run AFTER validation (a malformed frame is the
+        # sender's error, not overload) and only on live traffic — replay
+        # (recovery / readmit) re-runs already-admitted history.
+        if not self._replay:
+            retry = self._admit(push, header, docs, offset,
+                                tenant_id, client_id)
+            if retry is not None:
+                return
         self._frames.append(_Frame(push, header.get("rid"), docs, words))
         self._pending_docs += len(docs)
         self.stats["submitted_ops"] += offset
@@ -462,6 +535,63 @@ class StormController:
             # (next tick's early frames) waits for its cohort instead of
             # fragmenting into tiny device ticks.
             self.flush(force=False)
+
+    def _admit(self, push, header: dict, docs: list, n_ops: int,
+               tenant_id: str, client_id: str | None) -> float | None:
+        """Shed checks for one validated frame, in deterministic order:
+        quarantine, degraded (WAL breaker open), bounded queue, token
+        buckets. A refusal pushes ONE busy-nack with ``retry_after_s``
+        and returns the hint; None admits."""
+        qdocs = [d for d, *_ in docs if d in self.quarantined]
+        if qdocs:
+            # The WHOLE frame is refused (acks are positional per frame,
+            # so it cannot be split): "docs" lists everything dropped,
+            # "quarantined" the offending subset — the client resubmits
+            # the healthy docs in their own frame immediately and the
+            # quarantined ones after readmission.
+            return self._shed(push, header, n_ops, "quarantined",
+                              self.busy_retry_s,
+                              docs=[d for d, *_ in docs],
+                              quarantined=qdocs)
+        if self.wal_degraded:
+            self.stats["degraded_rejects"] += 1
+            if self._group_wal.failed:
+                # TERMINAL writer death (index skew, bad payload — not a
+                # disk that may heal): retrying is pointless; say so
+                # instead of promising a cooldown that never ends.
+                return self._shed(push, header, n_ops, "wal-failed",
+                                  self.busy_retry_s, retryable=False)
+            cooldown = self._group_wal.breaker.cooldown_s
+            return self._shed(push, header, n_ops, "degraded",
+                              max(cooldown, self.busy_retry_s))
+        if (self.max_pending_docs is not None
+                and self._pending_docs + len(docs) > self.max_pending_docs):
+            return self._shed(push, header, n_ops, "busy",
+                              self.busy_retry_s)
+        if self.admission is not None:
+            retry = self.admission.admit_write(tenant_id, client_id,
+                                               weight=n_ops)
+            if retry is not None:
+                return self._shed(push, header, n_ops, "throttled", retry)
+        return None
+
+    def _shed(self, push, header: dict, n_ops: int, code: str,
+              retry_after_s: float, docs: list | None = None,
+              quarantined: list | None = None,
+              retryable: bool = True) -> float:
+        self.stats["shed_frames"] += 1
+        self.stats["shed_ops"] += n_ops
+        self.merge_host.metrics.counter("storm.shed_ops").inc(n_ops)
+        if push is not None:
+            nack = {"rid": header.get("rid"), "storm": True,
+                    "error": code, "retryable": retryable,
+                    "retry_after_s": retry_after_s}
+            if docs:
+                nack["docs"] = docs  # EVERY doc whose ops were dropped
+            if quarantined:
+                nack["quarantined"] = quarantined
+            push(nack)
+        return retry_after_s
 
     # -- the tick --------------------------------------------------------------
 
@@ -473,14 +603,25 @@ class StormController:
         if force:
             self._harvest()
             if self._group_wal is not None and self._unacked:
-                # Drain barrier: a forced flush settles everything, so
-                # withheld acks go out now — after their fsync, never
-                # before (the acked-durable contract).
-                self._group_wal.sync()
-                self._drain_durable_acks()
+                from .durable_store import WalDegradedError
+                try:
+                    # Drain barrier: a forced flush settles everything, so
+                    # withheld acks go out now — after their fsync, never
+                    # before (the acked-durable contract).
+                    self._group_wal.sync()
+                except WalDegradedError:
+                    # Fsync breaker open: acks STAY withheld (they are
+                    # not durable) and the controller serves read-only —
+                    # new writes nack at _admit until the half-open
+                    # probes heal the WAL and a later flush drains here.
+                    self.merge_host.metrics.counter(
+                        "storm.degraded_flushes").inc()
+                else:
+                    self._drain_durable_acks()
         if (self.snapshot_interval_ticks is not None
                 and self.snapshots is not None
                 and not self._replay and not self._in_checkpoint
+                and not self.wal_degraded and not self.quarantined
                 and self._tick_counter - self._last_checkpoint_tick
                 >= self.snapshot_interval_ticks):
             self.checkpoint()
@@ -489,6 +630,15 @@ class StormController:
         # serving-loop stall suspect — see _TrimGate).
         if self._trim_gate.due(self.stats["ticks"]):
             _malloc_trim()
+
+    @property
+    def wal_degraded(self) -> bool:
+        """True while the WAL writer's fsync circuit breaker is open:
+        the controller serves reads and withholds acks, and _admit nacks
+        every write with a retryable "degraded" code. Clears itself when
+        a half-open probe fsyncs successfully."""
+        return (self._group_wal is not None
+                and self._group_wal.breaker.is_open)
 
     @property
     def durable_watermark(self) -> int | None:
@@ -521,6 +671,14 @@ class StormController:
         early must not fragment the cohort into undersized device ticks."""
         import time as _time
 
+        if self.wal_degraded and not self._replay:
+            # Breaker open: do NOT advance device state ahead of a WAL
+            # that cannot journal it — frames stay queued (new ones are
+            # already nacked at _admit) and at most the in-flight
+            # pipeline's few ticks still need WAL appends, so the
+            # bounded group-commit queue can never overflow into the
+            # harvest path mid-outage.
+            return False
         round_start = _time.perf_counter()
         frames, self._frames, self._pending_docs = self._frames, [], 0
         # Bus-path ops already admitted must sequence first (per-doc total
@@ -616,7 +774,7 @@ class StormController:
 
         seq_host._host_state = None  # device state is about to move
         (seq_host._state, merge_host._xstate, n_seq, first, last,
-         msn) = _storm_tick(
+         msn, bad) = _storm_tick(
             seq_host._state, merge_host._xstate,
             jnp.asarray(slot_full), jnp.asarray(cseq0_full),
             jnp.asarray(ref_full), jnp.asarray(ts_full),
@@ -633,7 +791,7 @@ class StormController:
             descs=descs, doc_words=doc_words, map_rows=map_rows,
             words_stacked=words_stacked,
             acks=acks, now=now, submitted=int(desc_arr[:, 2].sum()),
-            out=(n_seq, first, last, msn), start=round_start)
+            out=(n_seq, first, last, msn, bad), start=round_start)
         for out_arr in rec["out"]:
             copy_async = getattr(out_arr, "copy_to_host_async", None)
             if copy_async is not None:
@@ -650,7 +808,7 @@ class StormController:
     def _harvest_one(self, rec: dict) -> None:
         import time as _time
 
-        n_seq, first, last, msn = (np.asarray(a) for a in rec["out"])
+        n_seq, first, last, msn, bad = (np.asarray(a) for a in rec["out"])
         map_rows = rec["map_rows"]
         # Columnar → Python exactly once (int() per device element inside
         # the doc loop would dominate the harvest).
@@ -658,6 +816,7 @@ class StormController:
         fs_l = first[map_rows].tolist()
         ls_l = last[map_rows].tolist()
         m_l = msn[map_rows].tolist()
+        bad_l = bad[map_rows].tolist()
         fanout = self.service.fanout
         total_seq = 0
         now = rec["now"]
@@ -696,13 +855,21 @@ class StormController:
             if ns > 0 and not self._replay:
                 self._doc_ticks.setdefault(doc, []).append(
                     (fs, ls, tick_id))
+            if not self._replay:
+                # Telemetry for the quarantine blast-radius invariant:
+                # batch peers of a quarantined doc lose zero ticks.
+                self.doc_tick_counts[doc] = \
+                    self.doc_tick_counts.get(doc, 0) + 1
+                if bad_l[i] and doc not in self.quarantined:
+                    self._quarantine_doc(doc, "sentinel", tick_id)
             # broadcaster: compact tick frame into the pub/sub hop.
             if fanout is not None and not self._replay:
                 fanout.publish(doc, b"\x00storm%d:%d:%d" % (fs, ls, m))
         import json as _json
         import struct as _struct
 
-        header = _json.dumps({"ts": now, "docs": header_docs},
+        header = _json.dumps({"v": STORM_WAL_VERSION, "ts": now,
+                              "docs": header_docs},
                              separators=(",", ":")).encode()
         prefix = _struct.pack("<I", len(header)) + header
         if self._replay:
@@ -740,9 +907,21 @@ class StormController:
         if self._last_harvest is not None:
             self.harvest_intervals.append(done - self._last_harvest)
         self._last_harvest = done
-        acks = [(frame, {"rid": frame.rid, "storm": True, "acks": [
-                    [ns_l[i], fs_l[i], ls_l[i], m_l[i]] for i in idxs]})
-                for frame, idxs in rec["acks"] if frame.push is not None]
+        acks = []
+        for frame, idxs in rec["acks"]:
+            if frame.push is None:
+                continue
+            payload = {"rid": frame.rid, "storm": True, "acks": [
+                [ns_l[i], fs_l[i], ls_l[i], m_l[i]] for i in idxs]}
+            qdocs = [rec["descs"][i][0] for i in idxs if bad_l[i]]
+            if qdocs:
+                # The tick's sequencing is durable and correct (the
+                # ticket is exact; the poison is in the served planes) —
+                # the ack stands, but the client learns its doc is
+                # frozen: further submits nack until readmission.
+                payload["quarantined"] = qdocs
+                payload["retry_after_s"] = self.busy_retry_s
+            acks.append((frame, payload))
         if self._group_wal is not None and not self._replay:
             # Withhold until fsynced — then deliver in tick order with the
             # durability watermark stamped on (clients resubmit anything
@@ -774,12 +953,44 @@ class StormController:
         one snapshot atomically: upload first, flip the head ref last —
         a crash mid-checkpoint leaves the previous head intact."""
         assert self.snapshots is not None, "no snapshot store attached"
+        if self.wal_degraded:
+            from .durable_store import WalDegradedError
+            raise WalDegradedError(
+                "checkpoint() while the WAL fsync breaker is open: the "
+                "snapshot watermark cannot barrier on durability")
+        if self.quarantined:
+            # A snapshot taken now would capture the quarantined docs'
+            # POISONED device rows — and readmit_doc rebuilds from the
+            # snapshot head, so the poison would become the rebuild
+            # source and the freeze unliftable. Readmit first.
+            raise RuntimeError(
+                f"checkpoint() with quarantined docs "
+                f"{sorted(self.quarantined)}: readmit them first (a "
+                "snapshot would capture their poisoned rows)")
         self._in_checkpoint = True
         try:
             self.flush()
+            if self.wal_degraded:
+                # Re-check AFTER the flush: the breaker may have opened
+                # during it (flush swallows the barrier failure to keep
+                # serving) — publishing now would stamp a tick_watermark
+                # the WAL never made durable.
+                from .durable_store import WalDegradedError
+                raise WalDegradedError(
+                    "WAL fsync breaker opened during the checkpoint "
+                    "flush; snapshot watermark would not be durable")
+            if self.quarantined:
+                # Same re-check for quarantine: the settle flush itself
+                # may have tripped the sentinel, and the poisoned row
+                # must never become a rebuild source.
+                raise RuntimeError(
+                    f"sentinel quarantined {sorted(self.quarantined)} "
+                    "during the checkpoint flush; readmit before "
+                    "snapshotting")
             import dataclasses
             snap = {
                 "kind": "storm-checkpoint",
+                "format_version": STORM_SNAPSHOT_VERSION,
                 "tick_watermark": self._tick_counter,
                 "sequencer": {
                     doc: dataclasses.asdict(cp)
@@ -809,6 +1020,11 @@ class StormController:
             head = self.snapshots.head(self.SNAPSHOT_DOC)
             snap = self.snapshots.get(self.SNAPSHOT_DOC, head)
             if snap is not None:
+                version = snap.get("format_version", 0)
+                if not 0 <= version <= STORM_SNAPSHOT_VERSION:
+                    raise ValueError(
+                        f"storm snapshot format v{version} is newer than "
+                        f"this reader (max v{STORM_SNAPSHOT_VERSION})")
                 from .sequencer import SequencerCheckpoint
                 for doc, cp in sorted(snap["sequencer"].items()):
                     self.seq_host.restore(doc, SequencerCheckpoint(**cp))
@@ -876,14 +1092,163 @@ class StormController:
         assert self._tick_counter == end, (self._tick_counter, end)
         return end - start
 
+    # -- per-doc quarantine ----------------------------------------------------
+    #
+    # The blast-radius tentpole: one poisoned document must never take
+    # its batch down. Detection is the device sentinel in _storm_tick
+    # (vseq drift / negative planes); _quarantine_doc freezes ONLY the
+    # flagged doc — buffered frames touching it nack retryable, new
+    # submits shed at _admit, reads serve through the scalar fold of the
+    # durable records — and readmit_doc() rebuilds it from the snapshot
+    # head + its own WAL tail (exact: storm tickets are per-doc
+    # independent) while every other row keeps full-rate serving.
+
+    def _quarantine_doc(self, doc_id: str, reason: str,
+                        tick_id: int) -> None:
+        self.quarantined[doc_id] = {"reason": reason, "tick": tick_id}
+        self.stats["quarantined_docs"] += 1
+        self.merge_host.metrics.counter("storm.quarantines").inc()
+        # In-flight ops: nack every BUFFERED frame touching the doc with
+        # a retryable code (the client resubmits after readmission; cseq
+        # dedup absorbs any overlap). Frames NOT touching the doc stay
+        # queued; a frame sharing it is dropped whole (acks are
+        # positional per frame) with every dropped doc listed, so the
+        # client resubmits its healthy docs immediately.
+        kept: list[_Frame] = []
+        for frame in self._frames:
+            if not any(d == doc_id for d, *_ in frame.docs):
+                kept.append(frame)
+                continue
+            self._pending_docs -= len(frame.docs)
+            self._shed(frame.push, {"rid": frame.rid},
+                       sum(n for *_, n in frame.docs), "quarantined",
+                       self.busy_retry_s,
+                       docs=[d for d, *_ in frame.docs],
+                       quarantined=[doc_id])
+        self._frames = kept
+
+    def quarantined_map_entries(self, doc_id: str) -> dict:
+        """Scalar-engine serving for a quarantined doc: fold the durable
+        columnar records (poison-free by construction — the ticket plane
+        is exact even when the served planes corrupt) into the converged
+        map. The doc stays readable at scalar cost while frozen."""
+        from ..dds.map_data import MapData
+        records = self.records_overlapping(doc_id, 0)
+        data = MapData()
+        for m in materialize_storm_records(records, self.datastore,
+                                           self.channel,
+                                           blob_reader=self.read_tick_words):
+            data.process(m.contents["contents"]["contents"], False, None)
+        return dict(data.items())
+
+    def readmit_doc(self, doc_id: str, verify: bool = True) -> dict:
+        """From-snapshot rebuild of ONE quarantined document: restore its
+        sequencer row and map row from the snapshot head, replay its WAL
+        tail through the serving tick (recorded timestamps, single-doc
+        cohorts), verify against the scalar fold, and lift the freeze.
+        The rest of the batch serves normally throughout."""
+        assert doc_id in self.quarantined, f"{doc_id!r} not quarantined"
+        assert self.snapshots is not None, \
+            "readmit_doc needs a snapshot store"
+        self.flush()  # settle peers; the doc itself has nothing buffered
+        head = self.snapshots.head(self.SNAPSHOT_DOC)
+        snap = self.snapshots.get(self.SNAPSHOT_DOC, head)
+        assert snap is not None, "no readable snapshot head to rebuild from"
+        from .sequencer import SequencerCheckpoint
+        cp = snap["sequencer"].get(doc_id)
+        assert cp is not None, f"snapshot holds no sequencer row for {doc_id}"
+        self.seq_host.restore(doc_id, SequencerCheckpoint(**cp))
+        self._restore_map_row(doc_id, snap["merge_host"])
+        start = snap["tick_watermark"]
+        end = saved_counter = self._tick_counter
+        replayed = 0
+        self._replay = True
+        try:
+            for tick in range(start, end):
+                blob = self._read_blob(tick)
+                header, off = self._parse_header(blob)
+                for entry in header["docs"]:
+                    doc, client, cseq0, ref, count = entry[:5]
+                    if doc != doc_id or count <= 0:
+                        continue
+                    w_off = entry[9]
+                    self._tick_counter = tick
+                    self._replay_ts = header["ts"]
+                    words = memoryview(blob)[off + w_off:
+                                             off + w_off + count * 4]
+                    self.submit_frame(
+                        None, {"docs": [[doc, client, cseq0, ref, count]],
+                               "rid": None}, words)
+                    self.flush()
+                    replayed += 1
+                    break
+        finally:
+            self._replay = False
+            self._replay_ts = None
+            self._tick_counter = saved_counter
+        if verify:
+            rebuilt = self.merge_host.map_entries(doc_id, self.datastore,
+                                                  self.channel)
+            shadow = self.quarantined_map_entries(doc_id)
+            assert rebuilt == shadow, (
+                f"readmit of {doc_id!r} diverged from the durable-record "
+                f"fold: {rebuilt} != {shadow}")
+        info = self.quarantined.pop(doc_id)
+        self.stats["readmitted_docs"] += 1
+        self.merge_host.metrics.counter("storm.readmits").inc()
+        return {"doc": doc_id, "reason": info["reason"],
+                "replayed_ticks": replayed, "snapshot": head}
+
+    def _restore_map_row(self, doc_id: str, host_snap: dict) -> None:
+        """Overwrite the doc's LIVE device map row with its snapshot row
+        (or init defaults when the snapshot predates the row) — the map
+        half of the per-doc from-snapshot rebuild; peers' rows are
+        untouched."""
+        live_row = self._storm_map_row(doc_id)
+        from .merge_host import _nd_unpack
+        m = host_snap["map"]
+        snap_row = None
+        for rec in m["rows"]:
+            if list(rec["key"]) == [doc_id, self.datastore, self.channel]:
+                snap_row = rec["row"]
+                break
+        xs = self.merge_host._xstate
+        s_live = xs.present.shape[1]
+        vals = {"present": np.zeros(s_live, np.bool_),
+                "value": np.zeros(s_live, np.int32),
+                "vseq": np.full(s_live, -1, np.int32),
+                "cleared_seq": np.int32(-1)}
+        if snap_row is not None:
+            planes = {f: _nd_unpack(m["planes"][f])
+                      for f in mk.MapState._fields}
+            s_snap = planes["present"].shape[1]
+            assert s_snap <= s_live, (
+                f"snapshot map row wider than live ({s_snap} > {s_live})")
+            for f in ("present", "value", "vseq"):
+                vals[f][:s_snap] = planes[f][snap_row]
+            vals["cleared_seq"] = planes["cleared_seq"][snap_row]
+        self.merge_host._xstate = mk.MapState(
+            **{f: getattr(xs, f).at[live_row].set(vals[f])
+               for f in mk.MapState._fields})
+
     @staticmethod
     def _parse_header(blob: bytes) -> tuple[dict, int]:
-        """(header, words byte offset) — no copy of the words region."""
+        """(header, words byte offset) — no copy of the words region.
+        Validates the tick format version: headers without "v" are the
+        committed pre-version (v0) format and parse identically; a
+        version NEWER than this reader refuses loudly (a rolled-back
+        binary must not misparse a newer WAL)."""
         import json as _json
         import struct as _struct
 
         hlen = _struct.unpack_from("<I", blob)[0]
-        return _json.loads(blob[4:4 + hlen].decode()), 4 + hlen
+        header = _json.loads(blob[4:4 + hlen].decode())
+        version = header.get("v", 0)
+        if not 0 <= version <= STORM_WAL_VERSION:
+            raise ValueError(
+                f"storm WAL tick format v{version} is newer than this "
+                f"reader (max v{STORM_WAL_VERSION})")
+        return header, 4 + hlen
 
     def _read_blob(self, tick_id: int) -> bytes:
         if self._blob_log is not None:
@@ -894,7 +1259,12 @@ class StormController:
                 # leave this process ahead of its fsync, so reading an
                 # in-flight tick barriers the group commit first. Rare
                 # (tip readers racing the writer thread) and bounded by
-                # one group-commit latency.
+                # one group-commit latency. With the fsync breaker OPEN
+                # this raises WalDegradedError rather than waiting out
+                # the outage OR serving unfsynced bytes as durable —
+                # reads below the watermark keep serving; tip reads fail
+                # retryably (the front door answers the request with an
+                # error and keeps the socket).
                 self._group_wal.sync()
             return bytes(self._blob_log.read(tick_id))
         return self._tick_blobs[tick_id]

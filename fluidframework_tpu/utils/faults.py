@@ -33,6 +33,21 @@ Environment protocol (used by the chaos child process)::
     FFTPU_CRASHPOINT="wal.pre_fsync:3"   # kill at the 3rd hit
 
 ``install_from_env()`` runs at import; the child calls ``arm()`` itself.
+
+Besides kill plans there are *failure plans* — named :func:`failpoint`
+hooks that RAISE an injected exception for the next N hits instead of
+killing the process (the overload/robustness fault classes: a failing
+fsync is survivable-by-design, a kill is not). Registered failpoints:
+
+==========================  ==================================================
+``wal.fsync``               group-commit writer, just before the batch fsync
+                            (an injected OSError here drives the WAL circuit
+                            breaker into its degraded/half-open cycle)
+==========================  ==================================================
+
+Environment protocol: ``FFTPU_FAILPOINT="wal.fsync:3"`` fails the next
+3 hits, then heals. Failure plans share the :func:`arm` gate with kill
+plans.
 """
 
 from __future__ import annotations
@@ -51,6 +66,15 @@ _hits = 0
 #: Per-point fire counts while a plan is installed (tests introspect
 #: these; the no-plan hot path never touches the dict).
 fired: dict[str, int] = {}
+#: Failure plans: point name -> remaining armed-hit count. Emptiness is
+#: the hot-path gate (one dict truthiness check when nothing installed).
+_fail_plans: dict[str, int] = {}
+
+
+class InjectedFault(OSError):
+    """The exception a :func:`failpoint` raises — an OSError subclass so
+    injected fsync/IO failures travel the same except paths real ones do,
+    while staying distinguishable in assertions."""
 
 
 def install(point: str, hits: int = 1) -> None:
@@ -63,12 +87,23 @@ def install(point: str, hits: int = 1) -> None:
     fired.clear()
 
 
+def install_failure(point: str, times: int = 1) -> None:
+    """Install a failure plan: the next ``times`` armed hits of ``point``
+    raise :class:`InjectedFault`, then the point heals."""
+    if times < 1:
+        raise ValueError(f"times must be >= 1, got {times}")
+    _fail_plans[point] = times
+
+
 def install_from_env() -> None:
     spec = os.environ.get("FFTPU_CRASHPOINT")
-    if not spec:
-        return
-    point, _, hits = spec.partition(":")
-    install(point, int(hits) if hits else 1)
+    if spec:
+        point, _, hits = spec.partition(":")
+        install(point, int(hits) if hits else 1)
+    spec = os.environ.get("FFTPU_FAILPOINT")
+    if spec:
+        point, _, times = spec.partition(":")
+        install_failure(point, int(times) if times else 1)
 
 
 def arm() -> None:
@@ -85,6 +120,7 @@ def clear() -> None:
     global _plan, _armed, _hits
     _plan, _armed, _hits = None, False, 0
     fired.clear()
+    _fail_plans.clear()
 
 
 def crashpoint(name: str) -> None:
@@ -102,6 +138,25 @@ def crashpoint(name: str) -> None:
         sys.stderr.write(f"crashpoint {name} hit {_hits}: killing\n")
         sys.stderr.flush()
         os._exit(KILL_EXIT_CODE)
+
+
+def failpoint(name: str) -> None:
+    """Declare a named injectable failure. With an armed plan for
+    ``name``, raises :class:`InjectedFault` and burns one planned hit;
+    otherwise (the production path) it is one dict truthiness check."""
+    if not _fail_plans:
+        return
+    fired[name] = fired.get(name, 0) + 1
+    if not _armed:
+        return
+    remaining = _fail_plans.get(name)
+    if remaining is None:
+        return
+    if remaining <= 1:
+        del _fail_plans[name]
+    else:
+        _fail_plans[name] = remaining - 1
+    raise InjectedFault(f"injected fault at {name}")
 
 
 install_from_env()

@@ -86,6 +86,61 @@ def test_kill_exit_code_is_distinct():
     assert faults.KILL_EXIT_CODE == 137
 
 
+# -- overload fault classes (ISSUE 5): tier-1 smoke + slow matrix --------------
+
+
+class TestOverloadSmoke:
+    """One fast scenario per new fault class. Each run_* raises on any
+    violated invariant; the asserts here double-check the report shape."""
+
+    def test_throttle_under_storm(self, tmp_path):
+        report = chaos.run_overload(str(tmp_path), num_docs=8, k=16,
+                                    rounds=6)
+        assert report["shed_rate"] == 0.5  # exactly the 2x overflow shed
+        assert report["acked_frames"] == report["shed_frames"] == 48
+
+    def test_wal_fsync_failure(self, tmp_path):
+        report = chaos.run_fsync_failure(str(tmp_path), num_docs=2, k=8,
+                                         rounds=2)
+        assert report["events"] == {"degraded_entered": True,
+                                    "acks_withheld": True,
+                                    "healed": True,
+                                    "acks_after_heal": 2}
+        assert report["breaker_opens"] >= 1
+
+    def test_reconnect_storm_1k_clients(self):
+        report = chaos.run_reconnect_storm(n_clients=1000)
+        assert report["peak_attempts_per_s_after_wave"] \
+            <= report["window_limit"]
+        # Bounded recovery: within 1.5x the ideal drain of the herd.
+        assert report["makespan_s"] <= 1.5 * report["ideal_drain_s"]
+
+    def test_poison_doc_quarantine(self, tmp_path):
+        report = chaos.run_poison_quarantine(str(tmp_path), num_docs=3,
+                                             k=8, rounds=4)
+        assert report["stats"] == {"quarantined_docs": 1,
+                                   "readmitted_docs": 1}
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_overload_full_matrix(seed, tmp_path):
+    """The slow tier: every overload fault class at larger shapes and
+    multiple seeds (the kill-point matrix has its own soak above).
+    The overload shape uses serving-sized ticks (128x128) so the latency
+    ratio measures device work, not per-frame Python overhead — tiny
+    ticks make the fixed shed cost look like a latency regression."""
+    chaos.run_overload(str(tmp_path / "ov"), num_docs=128, k=128,
+                       rounds=12, seed=seed)
+    chaos.run_fsync_failure(str(tmp_path / "fs"), num_docs=8, k=32,
+                            rounds=4, fail_times=5, seed=seed)
+    chaos.run_poison_quarantine(str(tmp_path / "pq"), num_docs=8, k=32,
+                                rounds=6, seed=seed)
+    for n in (1000, 2000):
+        chaos.run_reconnect_storm(n_clients=n, seed=seed)
+
+
 _REBALANCE_CHILD = """
 import sys
 from fluidframework_tpu.dds.sequence import SharedString

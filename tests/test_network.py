@@ -162,6 +162,44 @@ class TestCrossProcess:
         svc1.close()
         svc2.close()
 
+    def test_dead_socket_surfaces_disconnect_and_degrades_readonly(
+            self, alfred_port):
+        """ISSUE 5 satellite: a socket dying under the reader must NOT
+        hang the container — the disconnect event degrades it to
+        disconnected/readonly, and an AutoReconnector redials."""
+        from fluidframework_tpu.drivers.utils import ReconnectPolicy
+        from fluidframework_tpu.runtime.delta_manager import AutoReconnector
+
+        svc = NetworkDocumentService("127.0.0.1", alfred_port, "dropdoc")
+        container = Container.create_detached(svc)
+        container.runtime.create_datastore("default").create_channel(
+            "root", SharedMap.channel_type)
+        with svc.dispatch_lock:
+            container.attach()
+        assert container.connected
+        reconnected: list[str] = []
+        recon = AutoReconnector(
+            container.delta_manager, svc,
+            policy=ReconnectPolicy(base_s=0.01, max_s=0.1, seed=1),
+            on_reconnected=reconnected.append, spawn_thread=False)
+        # Kill the transport out from under the reader (the server sees
+        # a close; the client side must notice, not hang).
+        svc._sock.shutdown(__import__("socket").SHUT_RDWR)
+        wait_until(lambda: recon.disconnects == 1)
+        assert not container.connected
+        assert container.delta_manager.readonly
+        assert container.allocate_client_seq() is None
+        # The redial loop restores write mode over a fresh socket.
+        recon.run()
+        assert reconnected and container.connected
+        assert not container.delta_manager.readonly
+        with svc.dispatch_lock:
+            container.runtime.get_datastore("default").get_channel(
+                "root").set("after-reconnect", 1)
+        wait_until(lambda: container.runtime.get_datastore("default")
+                   .get_channel("root").get("after-reconnect") == 1)
+        svc.close()
+
     def test_nack_round_trip(self, alfred_port):
         """A raw protocol-level bad op gets a NACK event back over TCP."""
         doc_id = "nackdoc"

@@ -1,0 +1,746 @@
+"""Graceful degradation under overload (ISSUE 5): token-bucket admission
+at the front doors and the batched tick ingress, deterministic shed
+(signals/reads before writes), the WAL fsync circuit breaker with
+half-open probes, the per-doc quarantine plane, client reconnect
+backoff+jitter, and the storm WAL/snapshot format-version compat.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server.riddler import (
+    AdmissionController,
+    Throttler,
+    TokenBucket,
+)
+
+#: WAL-format goldens live beside (not inside) the DDS replay corpus —
+#: tests/goldens is scanned as replayable documents.
+GOLDENS = Path(__file__).parent / "goldens_wal"
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- token bucket vs the fixed window -----------------------------------------
+
+
+class TestBoundaryBurst:
+    """The satellite regression: a fixed window admits 2x its budget
+    across a window edge; the token bucket must not."""
+
+    BUDGET = 10
+
+    def _offered_across_edge(self, limiter) -> int:
+        """Touch the key at t=0 (anchoring any window there), then offer
+        BUDGET requests just before the t=1 edge and BUDGET just after;
+        return how many were admitted inside that ~10ms burst."""
+        self.clock.t = 0.0
+        limiter.try_consume("k", weight=0)  # anchor the window at t=0
+        admitted = 0
+        self.clock.t = 0.995  # last instant of window 0
+        for _ in range(self.BUDGET):
+            if limiter.try_consume("k") is None:
+                admitted += 1
+        self.clock.t = 1.005  # first instant of window 1
+        for _ in range(self.BUDGET):
+            if limiter.try_consume("k") is None:
+                admitted += 1
+        return admitted
+
+    def test_fixed_window_admits_double_budget_at_the_edge(self):
+        """Pins the DEFECT (kept as the regression reference): 2x the
+        per-second budget lands inside ~2ms of wall clock."""
+        self.clock = FakeClock()
+        throttler = Throttler(rate_per_interval=self.BUDGET,
+                              interval_s=1.0, clock=self.clock)
+        assert self._offered_across_edge(throttler) == 2 * self.BUDGET
+
+    def test_token_bucket_is_burst_safe_at_the_edge(self):
+        self.clock = FakeClock()
+        bucket = TokenBucket(rate_per_s=self.BUDGET, burst=self.BUDGET,
+                             clock=self.clock)
+        # burst + rate * 0.002s — no window edge to slip through.
+        assert self._offered_across_edge(bucket) <= self.BUDGET + 1
+
+    def test_token_bucket_bounds_any_interval(self):
+        """Over ANY window of T seconds admitted weight <= burst+rate*T
+        (the property the fixed window lacks), probed at adversarial
+        offsets."""
+        clock = FakeClock()
+        bucket = TokenBucket(rate_per_s=100, burst=20, clock=clock)
+        admitted_at: list[float] = []
+        for step in range(2000):
+            clock.t = step * 0.003
+            if bucket.try_consume("k") is None:
+                admitted_at.append(clock.t)
+        times = np.asarray(admitted_at)
+        for T in (0.01, 0.1, 0.5, 1.0):
+            counts = [(times >= t0) & (times < t0 + T)
+                      for t0 in np.arange(0, 5.5, 0.05)]
+            worst = max(int(c.sum()) for c in counts)
+            assert worst <= 20 + 100 * T + 1, (T, worst)
+
+
+class TestTokenBucket:
+    def test_refill_and_retry_hint(self):
+        clock = FakeClock()
+        bucket = TokenBucket(rate_per_s=10, burst=2, clock=clock)
+        assert bucket.try_consume("k") is None
+        assert bucket.try_consume("k") is None
+        retry = bucket.try_consume("k")
+        assert retry == pytest.approx(0.1)
+        clock.t += retry
+        assert bucket.try_consume("k") is None
+
+    def test_keys_are_independent_and_refund_restores(self):
+        bucket = TokenBucket(rate_per_s=1, burst=1,
+                             clock=FakeClock())
+        assert bucket.try_consume("a") is None
+        assert bucket.try_consume("b") is None
+        assert bucket.try_consume("a") is not None
+        bucket.refund("a")
+        assert bucket.try_consume("a") is None
+
+    def test_oversized_weight_admits_at_full_bucket_never_livelocks(self):
+        """weight > burst can never fit the bucket; it must admit at a
+        FULL bucket (carrying the deficit as debt) instead of returning
+        a finite hint the caller can never satisfy."""
+        clock = FakeClock()
+        bucket = TokenBucket(rate_per_s=10, burst=10, clock=clock)
+        assert bucket.try_consume("k", weight=30) is None  # full: admit
+        retry = bucket.try_consume("k")  # debt: -20 tokens outstanding
+        assert retry == pytest.approx(2.1)
+        clock.t = 10.0  # long-run rate holds: only now is it full again
+        assert bucket.try_consume("k", weight=30) is None
+        # Below-full refusals of an oversize request hint time-to-FULL.
+        clock.t = 12.0  # debt repaid, bucket at 0 of 10
+        assert bucket.try_consume("k", weight=30) == pytest.approx(1.0)
+
+    def test_reserve_ladders_a_synchronized_herd(self):
+        """N refusals in one instant get hints laddering at the drain
+        rate — the anti-thundering-herd property admit_connect uses."""
+        bucket = TokenBucket(rate_per_s=10, burst=1, clock=FakeClock())
+        assert bucket.reserve("k") == (None, False)  # burst
+        refusals = [bucket.reserve("k") for _ in range(5)]
+        assert all(reserved for _hint, reserved in refusals)
+        hints = [hint for hint, _reserved in refusals]
+        assert hints == sorted(hints)
+        steps = np.diff([0.0] + hints)
+        assert np.allclose(steps, 0.1), hints
+
+    def test_reserve_past_the_horizon_debits_nothing(self):
+        """Beyond RESERVE_HORIZON_S of outstanding debt, refusals are
+        hint-only: no debit, flagged not-reserved — admit_connect must
+        not record them as claimable."""
+        bucket = TokenBucket(rate_per_s=1, burst=1, clock=FakeClock())
+        bucket.reserve("k")  # burst
+        for _ in range(int(TokenBucket.RESERVE_HORIZON_S)):
+            bucket.reserve("k")
+        hint1, reserved1 = bucket.reserve("k")
+        hint2, reserved2 = bucket.reserve("k")
+        assert not reserved1 and not reserved2
+        assert hint1 == hint2  # the tail stopped growing
+
+
+class TestAdmissionController:
+    def _controller(self, **kw):
+        self.clock = FakeClock()
+        kw.setdefault("connect_rate_per_s", 10)
+        kw.setdefault("write_rate_per_s", 100)
+        return AdmissionController(clock=self.clock, **kw)
+
+    def test_shed_order_is_signals_reads_writes(self):
+        """The deterministic shed policy: as queue pressure rises,
+        signals shed first, then reads, writes only at a full queue."""
+        adm = self._controller()
+        pressure = {"v": 0.0}
+        adm.add_pressure_probe(lambda: pressure["v"])
+        assert adm.admit_signal("t") is None
+        assert adm.admit_read("t") is None
+        assert adm.admit_write("t", "c") is None
+        pressure["v"] = 0.6  # past SHED_SIGNALS_AT
+        assert adm.admit_signal("t") is not None
+        assert adm.admit_read("t") is None
+        assert adm.admit_write("t", "c") is None
+        pressure["v"] = 0.8  # past SHED_READS_AT
+        assert adm.admit_signal("t") is not None
+        assert adm.admit_read("t") is not None
+        assert adm.admit_write("t", "c") is None
+        pressure["v"] = 1.0  # full queue
+        assert adm.admit_write("t", "c") is not None
+        assert adm.stats["shed_signals"] == 2
+        assert adm.stats["shed_reads"] == 1
+        assert adm.stats["shed_writes"] == 1
+
+    def test_client_tier_refusal_refunds_the_tenant(self):
+        """One hot client must not drain its tenant's shared bucket."""
+        adm = self._controller(write_rate_per_s=100, write_burst=100,
+                               client_write_rate_per_s=10,
+                               client_write_burst=10)
+        assert adm.admit_write("t", "hot", weight=10) is None
+        assert adm.admit_write("t", "hot", weight=10) is not None
+        # The tenant bucket was refunded: a neighbour still has budget.
+        assert adm.admit_write("t", "calm", weight=10) is None
+
+    def test_connect_reservation_is_claimable_not_redebited(self):
+        adm = self._controller(connect_rate_per_s=10, connect_burst=1)
+        assert adm.admit_connect("t", "c0") is None  # burst
+        retry = adm.admit_connect("t", "c1")
+        assert retry == pytest.approx(0.1)
+        # Coming back EARLY re-issues the same slot, no new debit.
+        early = adm.admit_connect("t", "c1")
+        assert early == pytest.approx(retry)
+        self.clock.t = retry
+        assert adm.admit_connect("t", "c1") is None  # claims the slot
+
+    def test_client_tier_connect_refusal_records_no_free_reservation(self):
+        """A client-bucket refusal refunds the tenant and must NOT leave
+        a claimable reservation — an unbacked one would admit for free
+        at claim time, bypassing both buckets' limits."""
+        adm = self._controller(connect_rate_per_s=10, connect_burst=10)
+        # Drain client K's own bucket via tenant B.
+        assert adm.admit_connect("B", "K") is None
+        while adm.admit_connect("B", "K") is None:
+            pass
+        # (A, K): tenant A has budget, client K refuses -> refund, no
+        # reservation recorded.
+        retry = adm.admit_connect("A", "K")
+        assert retry is not None
+        assert ("A", "K") not in adm._connect_reservations
+        # Tenant A's bucket was refunded: a different client admits, and
+        # repeating the refused pair stays rate-bound (no free claims).
+        assert adm.admit_connect("A", "other") is None
+        admitted = sum(adm.admit_connect("A", "K") is None
+                       for _ in range(50))
+        assert admitted == 0  # client K's bucket is dry; no bypass
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_closed_open_halfopen_cycle(self):
+        from fluidframework_tpu.server.durable_store import CircuitBreaker
+        clock = FakeClock()
+        breaker = CircuitBreaker(cooldown_s=1.0, clock=clock)
+        assert breaker.state == "closed" and breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == "open"
+        assert not breaker.allow()  # cooldown not elapsed
+        clock.t = 1.5
+        assert breaker.allow()      # the single half-open probe
+        assert breaker.state == "half-open"
+        assert not breaker.allow()  # only ONE probe in flight
+        breaker.record_failure()    # probe failed: re-open
+        assert breaker.state == "open"
+        clock.t = 3.0
+        assert breaker.allow()
+        breaker.record_success()
+        assert breaker.state == "closed" and breaker.allow()
+        assert breaker.stats == {"opens": 1, "probes": 2, "closes": 1}
+
+    def test_failure_threshold(self):
+        from fluidframework_tpu.server.durable_store import CircuitBreaker
+        breaker = CircuitBreaker(failure_threshold=3, clock=FakeClock())
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.stats["opens"] == 0
+        breaker.record_failure()
+        assert breaker.stats["opens"] == 1
+
+
+def test_wal_breaker_degrades_and_heals(tmp_path):
+    """GroupCommitLog under injected fsync failure: barrier raises
+    WalDegradedError while open; half-open probes heal; queued records
+    survive the outage (nothing durable is lost, nothing re-appended)."""
+    import time
+
+    from fluidframework_tpu.server.durable_store import (
+        GroupCommitLog,
+        WalDegradedError,
+    )
+    from fluidframework_tpu.utils import faults
+
+    log = GroupCommitLog(tmp_path / "wal.log")
+    log.breaker.cooldown_s = 0.02
+    log.append(b"healthy")
+    log.sync()
+    faults.install_failure("wal.fsync", times=2)
+    faults.arm()
+    try:
+        log.append(b"through-the-outage")
+        deadline = time.monotonic() + 30
+        while not log.breaker.is_open and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert log.breaker.is_open
+        with pytest.raises(WalDegradedError):
+            log.sync()
+        # Queued records stay readable during the outage.
+        assert log.read(1) == b"through-the-outage"
+        while log.breaker.is_open and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not log.breaker.is_open
+        log.sync()
+        assert log.durable_len == 2
+    finally:
+        faults.clear()
+        log.close()
+    # Reopen: exactly the two records, no duplicate from the retry path.
+    log = GroupCommitLog(tmp_path / "wal.log")
+    assert len(log) == 2
+    assert [log.read(i) for i in range(2)] == [b"healthy",
+                                               b"through-the-outage"]
+    log.close()
+
+
+# -- storm tick-ingress admission ----------------------------------------------
+
+
+def _storm_stack(num_docs=4, **kw):
+    from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+    from fluidframework_tpu.server.merge_host import KernelMergeHost
+    from fluidframework_tpu.server.routerlicious import RouterliciousService
+    from fluidframework_tpu.server.storm import StormController
+
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=num_docs)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False)
+    kw.setdefault("flush_threshold_docs", 10**9)
+    storm = StormController(service, seq_host, merge_host, **kw)
+    clients = {}
+    docs = [f"doc{i}" for i in range(num_docs)]
+    for d in docs:
+        clients[d] = service.connect(d, lambda m: None).client_id
+    service.pump()
+    return service, storm, docs, clients
+
+
+def _frame(storm, sink, doc, client, cseq0, k=8, rid=0, seed=0):
+    rng = np.random.default_rng([seed, cseq0])
+    words = ((rng.integers(0, 16, k).astype(np.uint32) << 2)
+             | (rng.integers(0, 1 << 20, k).astype(np.uint32) << 12))
+    storm.submit_frame(sink, {"rid": rid,
+                              "docs": [[doc, client, cseq0, 1, k]]},
+                       memoryview(words.tobytes()))
+
+
+class TestStormIngress:
+    def test_bounded_queue_sheds_with_busy_nack(self):
+        service, storm, docs, clients = _storm_stack(
+            num_docs=4, max_pending_docs=2)
+        acks, nacks = [], []
+        sink = lambda p: (nacks if p.get("error") else acks).append(p)
+        for i, d in enumerate(docs):
+            _frame(storm, sink, d, clients[d], 1, rid=i)
+        # Bound = 2: the third and fourth frames shed deterministically.
+        assert storm._pending_docs == 2
+        assert len(nacks) == 2
+        assert all(n["error"] == "busy" and n["retryable"]
+                   and n["retry_after_s"] > 0 for n in nacks)
+        assert storm.stats["shed_frames"] == 2
+        storm.flush()
+        assert len(acks) == 2  # the admitted cohort served normally
+        # Queue drained: the shed docs' retry now admits.
+        _frame(storm, sink, docs[2], clients[docs[2]], 1, rid=9)
+        storm.flush()
+        assert len(acks) == 3
+
+    def test_quarantined_doc_in_mixed_frame_nacks_every_dropped_doc(self):
+        """A frame sharing a quarantined doc is refused WHOLE (acks are
+        positional per frame) — the nack must list every dropped doc,
+        not just the quarantined one, or the client silently loses the
+        healthy docs' ops."""
+        service, storm, docs, clients = _storm_stack(num_docs=2)
+        storm.quarantined["doc0"] = {"reason": "test", "tick": 0}
+        nacks = []
+        rng = np.random.default_rng(5)
+        words = ((rng.integers(0, 16, 8).astype(np.uint32) << 2)
+                 | (rng.integers(0, 1 << 20, 8).astype(np.uint32) << 12))
+        storm.submit_frame(
+            nacks.append,
+            {"rid": 7, "docs": [["doc0", clients["doc0"], 1, 1, 8],
+                                ["doc1", clients["doc1"], 1, 1, 8]]},
+            memoryview(words.tobytes() * 2))
+        assert len(nacks) == 1
+        assert nacks[0]["error"] == "quarantined"
+        assert nacks[0]["docs"] == ["doc0", "doc1"]  # both were dropped
+        assert nacks[0]["quarantined"] == ["doc0"]
+        assert storm._pending_docs == 0
+
+    def test_admission_bucket_sheds_writes_with_retry_hint(self):
+        clock = FakeClock()
+        admission = AdmissionController(write_rate_per_s=8,
+                                        write_burst=8,
+                                        client_write_rate_per_s=8,
+                                        client_write_burst=8, clock=clock)
+        service, storm, docs, clients = _storm_stack(
+            num_docs=2, admission=admission, max_pending_docs=64)
+        acks, nacks = [], []
+        sink = lambda p: (nacks if p.get("error") else acks).append(p)
+        _frame(storm, sink, docs[0], clients[docs[0]], 1, k=8)
+        _frame(storm, sink, docs[1], clients[docs[1]], 1, k=8)
+        assert [n["error"] for n in nacks] == ["throttled"]
+        assert nacks[0]["retry_after_s"] > 0
+        storm.flush()
+        assert len(acks) == 1
+
+    def test_replay_bypasses_admission(self, tmp_path):
+        """Recovery replay re-runs already-admitted history: the gates
+        must not shed it (a throttled recovery would be a self-DoS)."""
+        from fluidframework_tpu.server.durable_store import GitSnapshotStore
+        service, storm, docs, clients = _storm_stack(
+            num_docs=2,
+            spill_dir=str(tmp_path / "spill"), durability="group",
+            snapshots=GitSnapshotStore(str(tmp_path / "git")))
+        storm.checkpoint()
+        acks, nacks = [], []
+        sink = lambda p: (nacks if p.get("error") else acks).append(p)
+        _frame(storm, sink, docs[0], clients[docs[0]], 1, k=8)
+        storm.flush()
+        assert len(acks) == 1 and not nacks
+        # Fresh stack over the same dirs: recover() replays the WAL tail
+        # through submit_frame with the bucket EMPTY — must not shed.
+        storm._group_wal.close()
+        service2, storm2, _, _ = (None, None, None, None)
+        from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+        from fluidframework_tpu.server.merge_host import KernelMergeHost
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService,
+        )
+        from fluidframework_tpu.server.storm import StormController
+        seq_host = KernelSequencerHost(num_slots=2, initial_capacity=2)
+        merge_host = KernelMergeHost(flush_threshold=10**9)
+        service2 = RouterliciousService(merge_host=merge_host,
+                                        batched_deli_host=seq_host,
+                                        auto_pump=False)
+        storm2 = StormController(
+            service2, seq_host, merge_host, flush_threshold_docs=10**9,
+            spill_dir=str(tmp_path / "spill"), durability="group",
+            snapshots=GitSnapshotStore(str(tmp_path / "git")),
+            admission=AdmissionController(write_rate_per_s=1,
+                                          write_burst=1,
+                                          clock=FakeClock()))
+        info = storm2.recover()
+        assert info["replayed_ticks"] == 1
+        assert storm2.stats["shed_frames"] == 0
+        storm2._group_wal.close()
+
+
+# -- quarantine invariants (satellite) ----------------------------------------
+
+
+class TestQuarantineInvariants:
+    def test_poisoned_doc_recovers_byte_identical_peers_lose_zero_ticks(
+            self, tmp_path):
+        """The satellite's two invariants, proven by the chaos scenario:
+        (1) the quarantined doc's state — scalar shadow AND post-readmit
+        device row — is byte-identical to an uninterrupted twin; (2) its
+        batch peers lose zero throughput ticks (telemetry counters)."""
+        from fluidframework_tpu.tools import chaos
+        report = chaos.run_poison_quarantine(str(tmp_path), num_docs=3,
+                                             k=8, rounds=4)
+        assert report["stats"] == {"quarantined_docs": 1,
+                                   "readmitted_docs": 1}
+        assert report["replayed_ticks"] >= 1
+
+    def test_merge_channel_tick_failure_routes_to_scalar(self, monkeypatch):
+        """The generalized per-op-path escape hatch: a failing overflow
+        replay quarantines ONE channel onto its scalar engine (exact
+        tail replay); the flush survives and peers stay device-served."""
+        from fluidframework_tpu.dds.mergetree import MergeEngine
+        from fluidframework_tpu.server.merge_host import KernelMergeHost
+
+        host = KernelMergeHost(flush_threshold=10**9)
+        oracle = MergeEngine(local_client=None)
+
+        def feed(host_key, seq, op):
+            from fluidframework_tpu.protocol.messages import (
+                MessageType,
+                SequencedDocumentMessage,
+            )
+            host.ingest("doc", SequencedDocumentMessage(
+                client_id="c1", sequence_number=seq,
+                minimum_sequence_number=0, client_sequence_number=seq,
+                reference_sequence_number=seq - 1,
+                type=MessageType.OPERATION,
+                contents={"address": "default",
+                          "contents": {"address": host_key,
+                                       "contents": op}},
+                timestamp=1.0))
+
+        seq = 0
+        for i in range(6):
+            seq += 1
+            op = {"type": "insert", "pos": 0, "text": f"t{i} "}
+            feed("text", seq, op)
+            oracle.apply_remote(op, seq, seq - 1, "c1")
+            seq += 1
+            feed("peer", seq, {"type": "set", "key": "k", "value": i})
+        # Simulate a poisoned per-row tick: the device "freezes" the row
+        # before op 0 (apply returns the state unchanged, the overflow
+        # plane reports index 0) and the overflow replay itself FAILS —
+        # the quarantine path must absorb it.
+        def boom(row, rest):
+            raise RuntimeError("injected per-row tick failure")
+        monkeypatch.setattr(host, "_replay_block_overflow", boom)
+        from fluidframework_tpu.server.merge_host import ChannelKey
+        target = host._merge_rows[ChannelKey("doc", "default", "text")]
+
+        def frozen_apply(pool_self, batch):
+            return pool_self.state
+        monkeypatch.setattr(type(target.pool), "apply", frozen_apply)
+
+        def fake_take(pool_self):
+            from fluidframework_tpu.ops import mergetree_blocks as mtb
+            out = np.full(pool_self.capacity, int(mtb.OVF_NONE), np.int32)
+            if target.pool is pool_self:
+                out[target.row] = 0  # frozen before the first pending op
+            return out
+        monkeypatch.setattr(type(target.pool), "take_overflow", fake_take)
+        host.flush()
+        assert target.scalar is not None, "channel not quarantined"
+        assert target.pool is None
+        assert host.stats["quarantined_channels"] == 1
+        # Blast radius: the doc's MAP channel (a batch peer on another
+        # plane) stayed device-served and converged.
+        assert host.map_entries("doc", "default", "peer") == {"k": 5}
+        # Byte-identical: the quarantined channel's scalar text equals
+        # the oracle replay of the same sequenced stream.
+        assert host.text("doc", "default", "text") == oracle.get_text()
+        # And the channel keeps serving scalar-side.
+        seq += 1
+        op = {"type": "insert", "pos": 0, "text": "after "}
+        feed("text", seq, op)
+        oracle.apply_remote(op, seq, seq - 1, "c1")
+        assert host.text("doc", "default", "text") == oracle.get_text()
+
+
+# -- WAL / snapshot format versioning (satellite) ------------------------------
+
+
+class TestFormatVersioning:
+    def test_new_wal_headers_carry_the_version(self, tmp_path):
+        from fluidframework_tpu.server.storm import STORM_WAL_VERSION
+        service, storm, docs, clients = _storm_stack(
+            num_docs=1, spill_dir=str(tmp_path), durability="sync")
+        _frame(storm, lambda p: None, docs[0], clients[docs[0]], 1)
+        storm.flush()
+        header, _off = storm._parse_header(storm._read_blob(0))
+        assert header["v"] == STORM_WAL_VERSION
+        storm._blob_log.close()
+
+    def test_pre_version_golden_replays_through_the_new_reader(
+            self, tmp_path):
+        """The committed v0 golden (round-7 format, no "v" field) must
+        parse, index and materialize identically under the new reader."""
+        import shutil
+
+        from fluidframework_tpu.server.storm import (
+            materialize_storm_records,
+        )
+        golden = GOLDENS / "storm-wal-v0"
+        expected = json.loads((golden / "expected.json").read_text())
+        spill = tmp_path / "spill"
+        spill.mkdir()
+        shutil.copy(golden / "storm_tick_words.log",
+                    spill / "storm_tick_words.log")
+        service, storm, _docs, _clients = _storm_stack(
+            num_docs=1, spill_dir=str(spill), durability="none")
+        # The __init__ scan indexed the golden ticks.
+        assert storm._tick_counter == expected["ticks"]
+        for doc, want in expected["docs"].items():
+            records = storm.records_overlapping(doc, 0)
+            assert len(records) == expected["ticks"]
+            msgs = materialize_storm_records(
+                records, storm.datastore, storm.channel,
+                blob_reader=storm.read_tick_words)
+            got = [[m.sequence_number, m.client_sequence_number,
+                    m.contents["contents"]["contents"]] for m in msgs]
+            assert got == want, doc
+        storm._blob_log.close()
+
+    def test_newer_wal_version_is_refused(self, tmp_path):
+        from fluidframework_tpu.native import OpLog
+        from fluidframework_tpu.server.storm import STORM_WAL_VERSION
+        header = json.dumps({"v": STORM_WAL_VERSION + 1, "ts": 0,
+                             "docs": []}).encode()
+        log = OpLog(tmp_path / "storm_tick_words.log")
+        log.append(struct.pack("<I", len(header)) + header)
+        log.sync()
+        log.close()
+        with pytest.raises(ValueError, match="newer than this reader"):
+            _storm_stack(num_docs=1, spill_dir=str(tmp_path),
+                         durability="none")
+
+    def test_snapshot_version_stamped_and_v0_accepted(self, tmp_path):
+        from fluidframework_tpu.server.durable_store import GitSnapshotStore
+        from fluidframework_tpu.server.storm import (
+            STORM_SNAPSHOT_VERSION,
+        )
+        snapshots = GitSnapshotStore(str(tmp_path / "git"))
+        service, storm, docs, clients = _storm_stack(
+            num_docs=1, spill_dir=str(tmp_path / "spill"),
+            durability="group", snapshots=snapshots)
+        _frame(storm, lambda p: None, docs[0], clients[docs[0]], 1)
+        storm.flush()
+        handle = storm.checkpoint()
+        snap = snapshots.get(storm.SNAPSHOT_DOC, handle)
+        assert snap["format_version"] == STORM_SNAPSHOT_VERSION
+        # A pre-version snapshot (field absent — the committed round-7
+        # shape) must restore: strip the stamp and republish.
+        snap.pop("format_version")
+        snapshots.set_head(storm.SNAPSHOT_DOC,
+                           snapshots.upload(storm.SNAPSHOT_DOC, snap))
+        storm._group_wal.close()
+        from fluidframework_tpu.server.kernel_host import (
+            KernelSequencerHost,
+        )
+        from fluidframework_tpu.server.merge_host import KernelMergeHost
+        from fluidframework_tpu.server.routerlicious import (
+            RouterliciousService,
+        )
+        from fluidframework_tpu.server.storm import StormController
+        seq_host = KernelSequencerHost(num_slots=2, initial_capacity=1)
+        merge_host = KernelMergeHost(flush_threshold=10**9)
+        service2 = RouterliciousService(merge_host=merge_host,
+                                        batched_deli_host=seq_host,
+                                        auto_pump=False)
+        storm2 = StormController(
+            service2, seq_host, merge_host, flush_threshold_docs=10**9,
+            spill_dir=str(tmp_path / "spill"), durability="group",
+            snapshots=snapshots)
+        info = storm2.recover()
+        assert info["restored_from"] is not None
+        storm2._group_wal.close()
+
+
+# -- reconnect policy / auto reconnector ---------------------------------------
+
+
+class TestReconnectPolicy:
+    def test_deterministic_and_bounded(self):
+        from fluidframework_tpu.drivers.utils import ReconnectPolicy
+        a = ReconnectPolicy(base_s=0.1, max_s=5.0, jitter=0.5, seed=7)
+        b = ReconnectPolicy(base_s=0.1, max_s=5.0, jitter=0.5, seed=7)
+        delays = [a.next_delay(i) for i in range(10)]
+        assert delays == [b.next_delay(i) for i in range(10)]
+        for i, d in enumerate(delays):
+            raw = min(5.0, 0.1 * 2 ** i)
+            assert raw * 0.5 <= d <= raw
+
+    def test_retry_after_is_a_floor_with_jitter_on_top(self):
+        from fluidframework_tpu.drivers.utils import ReconnectPolicy
+        policy = ReconnectPolicy(base_s=0.1, jitter=0.5, seed=3)
+        d = policy.next_delay(0, retry_after_s=2.0)
+        assert 2.0 < d <= 2.0 + 0.1
+
+    def test_different_seeds_spread(self):
+        from fluidframework_tpu.drivers.utils import ReconnectPolicy
+        delays = {round(ReconnectPolicy(jitter=0.9,
+                                        seed=s).next_delay(3), 6)
+                  for s in range(32)}
+        assert len(delays) > 24  # jitter actually de-synchronizes
+
+
+class _FakeReconnectService:
+    """Driver double: scripted connect outcomes, a real event emitter."""
+
+    def __init__(self, script) -> None:
+        from fluidframework_tpu.utils.events import TypedEventEmitter
+        self.events = TypedEventEmitter()
+        self.script = list(script)
+        self.redials = 0
+        self.delta_storage = self
+        self.connected_modes: list[str] = []
+
+    def get_deltas(self, from_seq, to_seq=None):
+        return []
+
+    def reconnect(self):
+        self.redials += 1
+
+    def connect(self, handler, on_nack=None, on_signal=None, mode="write"):
+        outcome = self.script.pop(0)
+        if isinstance(outcome, Exception):
+            raise outcome
+        self.connected_modes.append(mode)
+
+        class _Conn:
+            client_id = outcome
+            open = True
+
+            def close(self):
+                self.open = False
+        return _Conn()
+
+
+class TestAutoReconnector:
+    def test_disconnect_degrades_then_backoff_honors_retry_after(self):
+        from fluidframework_tpu.drivers.utils import (
+            ReconnectPolicy,
+            ThrottlingError,
+        )
+        from fluidframework_tpu.runtime.delta_manager import (
+            AutoReconnector,
+            DeltaManager,
+        )
+        service = _FakeReconnectService([
+            "cid-1",                                   # initial connect
+            ThrottlingError("busy", retry_after_s=3.0),  # redial 1
+            ConnectionError("still down"),               # redial 2
+            "cid-2",                                     # redial 3
+        ])
+        dm = DeltaManager(service, process_message=lambda m: None)
+        dm.connect()
+        assert dm.connected and not dm.readonly
+        sleeps: list[float] = []
+        recon = AutoReconnector(
+            dm, service,
+            policy=ReconnectPolicy(base_s=0.1, jitter=0.0, seed=0),
+            sleep=sleeps.append, spawn_thread=False)
+        service.events.emit("disconnect")
+        # Degraded immediately: disconnected AND readonly, no RPC sent.
+        assert not dm.connected and dm.readonly
+        assert dm.allocate_client_seq() is None
+        client_id = recon.run()
+        assert client_id == "cid-2" and dm.client_id == "cid-2"
+        assert dm.connected and not dm.readonly
+        assert service.redials == 3
+        # Delay 2 honored the server hint as a floor (3.0 + backoff).
+        assert sleeps[0] == pytest.approx(0.1)
+        assert sleeps[1] >= 3.0
+        assert sleeps[2] == pytest.approx(0.4)
+
+    def test_auth_errors_do_not_retry(self):
+        from fluidframework_tpu.drivers.utils import (
+            AuthorizationError,
+            ReconnectPolicy,
+        )
+        from fluidframework_tpu.runtime.delta_manager import (
+            AutoReconnector,
+            DeltaManager,
+        )
+        service = _FakeReconnectService([
+            "cid-1", AuthorizationError("token revoked")])
+        dm = DeltaManager(service, process_message=lambda m: None)
+        dm.connect()
+        recon = AutoReconnector(dm, service,
+                                policy=ReconnectPolicy(seed=0),
+                                sleep=lambda s: None, spawn_thread=False)
+        dm.handle_connection_lost()
+        with pytest.raises(AuthorizationError):
+            recon.run()
