@@ -1154,7 +1154,17 @@ def recv_exact(n):
 
 def read_ack():
     length = struct.unpack(">I", recv_exact(4))[0]
-    return json.loads(recv_exact(length).decode())
+    body = recv_exact(length)
+    if body[:1] == b"\x00":
+        # Binary columnar storm ack: header JSON + i32[n,4] rows. The
+        # client only needs the header (no per-doc JSON parse on the
+        # ack path).
+        hlen = struct.unpack_from("<I", body, 2)[0]
+        hdr = json.loads(body[6:6 + hlen].decode())
+        if hdr.get("op") == "storm_ack":
+            hdr["storm"] = True
+        return hdr
+    return json.loads(body.decode())
 
 frames = [frame(t) for t in range(cfg["ticks"])]  # pre-built, untimed
 print("READY", flush=True)
@@ -1185,6 +1195,12 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
     kernel-only map number: this pays framing, sockets, host scatter,
     host→device transfer and durability on every tick."""
     import subprocess
+
+    from fluidframework_tpu.native.bridge import _load_library
+    if _load_library() is None:
+        # Fail-soft: the e2e path NEEDS the C++ bridge; report the skip
+        # instead of crashing the whole bench run.
+        return {"skipped": "no C++ toolchain / prebuilt native bridge"}
 
     from fluidframework_tpu.native.fanout import make_fanout
     from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
@@ -1347,6 +1363,7 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
         "tick_cadence_ms_p50": float(np.percentile(cadence_ms, 50)),
         "tick_cadence_ms_p99": float(np.percentile(cadence_ms, 99)),
         "ack_interval_ms_p50": float(np.percentile(ack_gaps, 50)) * 1000,
+        "ack_interval_ms_p99": float(np.percentile(ack_gaps, 99)) * 1000,
         # Fraction of serving-path channel ops that ran on the scalar
         # fallback (0.0 = fully device-served) — the silent-degradation
         # gauge (VERDICT r3 weak #6).
@@ -1358,6 +1375,8 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
                 "sequencer kernel -> map kernel (fused) -> durable log "
                 "+ fanout + acks",
     }
+    out["fraction_of_link_ceiling"] = round(
+        out["e2e_ops_per_sec"] / out["link_implied_ops_ceiling"], 3)
     # The WAL writer thread/fd and the bench's own tick blobs (~hundreds
     # of MB at this shape) must not outlive the row.
     if storm._group_wal is not None:
@@ -1515,6 +1534,64 @@ def _service_load_full() -> dict:
     return run_storm_load(10_000_000, num_docs=240, k=256)
 
 
+def emit_round9(path: str = "BENCH_r09.json") -> dict:
+    """ISSUE 6 acceptance bars: re-measure the e2e storm path WITH
+    DURABILITY ON after the zero-copy transport work and write the
+    link-normalized columns (fraction_of_link_ceiling,
+    ack_interval_ms_{p50,p99}) to BENCH_r09.json. Fail-soft: when the
+    native libs aren't built the rows record the skip instead of
+    crashing."""
+    import jax
+
+    from fluidframework_tpu.utils import compile_cache
+
+    compile_cache.enable()
+    backend = jax.default_backend()
+    out: dict = {"round": 9, "environment": {"backend": backend}}
+    # The acceptance-named row: the 10k-doc shape, durability ON (group
+    # commit — the crash-safe production mode), through the full socket
+    # path. On a TPU-attached harness the link is the axon tunnel; on
+    # CPU the "link" is a host memcpy, so the ceiling is enormous and
+    # the fraction correspondingly small — the note records which.
+    full = bench_e2e_storm(durability="group")
+    out["e2e_storm_10k_docs"] = full
+    # Round-7 comparability row: the identical CPU-scaled shape r07
+    # measured its durability column on (2048 x 256 x 8 ticks, 4 conns),
+    # isolating the host-path win from shape effects.
+    out["e2e_storm_cpu_2048x256_durable_group"] = bench_e2e_storm(
+        num_docs=2048, k=256, ticks=8, n_conns=4, durability="group")
+    out["e2e_storm_cpu_2048x256_off"] = bench_e2e_storm(
+        num_docs=2048, k=256, ticks=8, n_conns=4)
+    skipped = "skipped" in full
+    if not skipped:
+        r07_group_rate = 3_112_974.0  # BENCH_r07 durable-group, same path
+        scaled = out["e2e_storm_cpu_2048x256_durable_group"]
+        if "skipped" not in scaled:
+            scaled["speedup_vs_r07_same_shape"] = round(
+                scaled["e2e_ops_per_sec"] / r07_group_rate, 2)
+        out["environment"]["note"] = (
+            "Backend %s. The round-9 tentpole is host-side: zero-copy "
+            "storm ingress (memoryview-through codec -> bridge -> "
+            "submit_frame, no per-doc frombuffer, scatter straight from "
+            "the receive buffer), columnar binary acks (one i32[n,4] "
+            "slice per frame instead of per-doc JSON lists), and "
+            "broadcast fan-out as ONE native fanout_publish_batch call "
+            "per tick. fraction_of_link_ceiling divides the e2e rate by "
+            "the MEASURED host->device link at 4 bytes/op on THIS "
+            "attachment; on a CPU backend the link is a memcpy "
+            "(GB/s-class), so the ceiling is ~100x a tunneled TPU "
+            "attachment's and the fraction is not comparable to the "
+            "round-6 tunneled figure of 0.245 — the like-for-like "
+            "evidence is the r07-shape durable-group row and the "
+            "ack-interval bars." % backend)
+    else:
+        out["environment"]["note"] = (
+            "native bridge unavailable; e2e rows skipped (fail-soft)")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
 def main() -> None:
     from fluidframework_tpu.utils import compile_cache
 
@@ -1598,12 +1675,17 @@ def main() -> None:
     for key in ("e2e_storm_10k_docs", "e2e_storm_10k_docs_durable_group",
                 "e2e_storm_10k_docs_durable_sync"):
         e2e_row = detail[key]
+        if "skipped" in e2e_row:
+            continue  # fail-soft: no native bridge on this machine
         e2e_row["fraction_of_kernel_only_rate"] = round(
             e2e_row["e2e_ops_per_sec"] / head["device_ops_per_sec"], 4)
         e2e_row["fraction_of_link_ceiling"] = round(
             e2e_row["e2e_ops_per_sec"]
             / e2e_row["link_implied_ops_ceiling"], 3)
     e2e = detail["e2e_storm_10k_docs"]
+    if "skipped" in e2e:
+        e2e = {"tick_ms_p99": 0.0, "e2e_ops_per_sec": 0.0,
+               "fraction_of_kernel_only_rate": 0.0}
     with open("BENCH_DETAIL.json", "w") as f:
         json.dump(detail, f, indent=2)
     print(json.dumps(detail, indent=2), file=sys.stderr)
@@ -1626,4 +1708,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--e2e-r09" in sys.argv:
+        res = emit_round9()
+        row = res["e2e_storm_10k_docs"]
+        print(json.dumps({
+            "metric": "e2e storm ops/sec, durability ON (BENCH_r09)",
+            "value": round(row.get("e2e_ops_per_sec", 0.0), 1),
+            "unit": "ops/s",
+            "fraction_of_link_ceiling": row.get("fraction_of_link_ceiling"),
+            "ack_interval_ms_p50": row.get("ack_interval_ms_p50"),
+        }))
+    else:
+        main()

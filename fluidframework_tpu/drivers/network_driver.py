@@ -31,7 +31,13 @@ import struct
 import threading
 from typing import Any, Callable
 
-from ..protocol.codec import MAX_FRAME, decode_body, encode_frame
+from ..protocol.codec import (
+    MAX_FRAME,
+    decode_body,
+    decode_storm_push,
+    encode_frame,
+    is_storm_body,
+)
 from ..protocol.messages import DocumentMessage, NackMessage, SequencedDocumentMessage
 from ..utils.events import TypedEventEmitter
 from .base import IncomingHandler
@@ -243,7 +249,26 @@ class NetworkDocumentService:
                 length = _LEN.unpack(header)[0]
                 if length > MAX_FRAME:
                     raise ConnectionError(f"oversized frame: {length}")
-                payload = decode_body(self._recv_exact(length))
+                body = self._recv_exact(length)
+                try:
+                    storm = is_storm_body(body)
+                    payload = (decode_storm_push(body) if storm
+                               else decode_body(body))
+                except ValueError as err:
+                    # Undecodable frame (corrupt storm body, bad JSON):
+                    # a protocol error is a dead transport, not a silent
+                    # reader death — route through the ConnectionError
+                    # teardown below so waiters fail and the host sees
+                    # the disconnect event.
+                    raise ConnectionError(
+                        f"undecodable frame: {err!r}") from err
+                if storm:
+                    # Binary storm push (columnar acks): dispatched as a
+                    # pushed event (the "storm_ack" handler key), never
+                    # into the RPC waiters — its rid is the sender's
+                    # tick id, not an RPC correlation id.
+                    self._events.put(payload)
+                    continue
                 self._dispatch(payload)
         except (ConnectionError, OSError):
             # The reader must never die SILENTLY on a broken socket: fail

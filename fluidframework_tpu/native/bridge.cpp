@@ -21,6 +21,7 @@
 //       kind: 0 = OPEN, 1 = DATA (body = one frame), 2 = CLOSE
 //   bridge_send(handle, conn, data, len)  enqueue one framed body
 //       (0 ok, -1 unknown/closing, -2 outbox full — caller should close)
+//   bridge_set_max_outbox(handle, n)      tune the -2 threshold
 //   bridge_close(handle, conn)            server-side disconnect
 //   bridge_stop(handle)
 //
@@ -76,6 +77,9 @@ struct Conn {
 struct Bridge {
     int listen_fd = -1;
     int port = 0;
+    // Outbox bound (kMaxOutbox default); tunable so hosts/tests can pick
+    // the point where a stalled reader trips -2 instead of buffering on.
+    std::atomic<size_t> max_outbox{kMaxOutbox};
     std::atomic<bool> stopping{false};
     std::thread acceptor;
     std::mutex mu;  // guards conns, events, inbound_depth
@@ -278,11 +282,17 @@ int bridge_send(void* handle, int64_t conn, const char* data,
     {
         std::lock_guard<std::mutex> out_lock(c->out_mu);
         if (c->closing) return -1;
-        if (c->outbox.size() >= kMaxOutbox) return -2;
+        if (c->outbox.size() >= b->max_outbox.load()) return -2;
         c->outbox.emplace_back(data, len);
     }
     c->out_cv.notify_one();
     return 0;
+}
+
+void bridge_set_max_outbox(void* handle, int64_t n) {
+    if (n > 0)
+        static_cast<Bridge*>(handle)->max_outbox.store(
+            static_cast<size_t>(n));
 }
 
 int bridge_close(void* handle, int64_t conn) {

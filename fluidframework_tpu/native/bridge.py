@@ -37,13 +37,17 @@ def _load_library() -> ctypes.CDLL | None:
     lib.bridge_next_size.restype = ctypes.c_int64
     lib.bridge_next_size.argtypes = [ctypes.c_void_p]
     lib.bridge_poll.restype = ctypes.c_int64
-    lib.bridge_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+    # POINTER(c_char) (not c_char_p): poll fills a caller-owned bytearray
+    # so the event body can be returned as a zero-copy memoryview.
+    lib.bridge_poll.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_char),
                                 ctypes.c_int64]
     lib.bridge_poll_wait.restype = ctypes.c_int64
     lib.bridge_poll_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.bridge_send.restype = ctypes.c_int
     lib.bridge_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                 ctypes.c_char_p, ctypes.c_uint32]
+    lib.bridge_set_max_outbox.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.bridge_close.restype = ctypes.c_int
     lib.bridge_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.bridge_stop.argtypes = [ctypes.c_void_p]
@@ -59,9 +63,13 @@ class NativeBridge:
         self._handle = handle
         self.port = int(lib.bridge_port(handle))
 
-    def poll(self, wait_ms: int = 0) -> tuple[int, int, bytes] | None:
+    def poll(self, wait_ms: int = 0) -> tuple[int, int, memoryview] | None:
         """Pop the next event; with wait_ms > 0 block until one arrives
-        (condition variable in the C++ side — no busy polling)."""
+        (condition variable in the C++ side — no busy polling). The body
+        is a memoryview over the event's own buffer: the storm ingress
+        path parses it IN PLACE (codec.decode_storm_body →
+        StormController.submit_frame) with no further Python-level
+        copies."""
         if not self._handle:
             return None
         if wait_ms > 0:
@@ -70,22 +78,33 @@ class NativeBridge:
             size = self._lib.bridge_next_size(self._handle)
         if size < 0:
             return None
-        buf = ctypes.create_string_buffer(int(size))
-        got = self._lib.bridge_poll(self._handle, buf, size)
+        raw = bytearray(int(size))
+        cbuf = (ctypes.c_char * len(raw)).from_buffer(raw)
+        got = self._lib.bridge_poll(self._handle, cbuf, size)
         if got < 12:
             return None
-        conn, kind = struct.unpack_from("<qi", buf.raw, 0)
-        return conn, kind, buf.raw[12:got]
+        conn, kind = struct.unpack_from("<qi", raw, 0)
+        return conn, kind, memoryview(raw)[12:got]
 
-    def send(self, conn: int, body: bytes) -> bool:
+    def send(self, conn: int, body) -> int:
+        """Enqueue one framed body. Returns the native rc: 0 ok, -1
+        unknown/closing connection, -2 outbox full (the peer stopped
+        reading) — the CALLER owns the slow-consumer policy (bridge_host
+        disconnects it; silently dropping the frame is never ok)."""
         if not self._handle:
-            return False
-        rc = self._lib.bridge_send(self._handle, conn, body, len(body))
-        if rc == -2:
-            # Peer stopped reading and its outbox is full: drop it
-            # (slow-consumer backpressure) instead of buffering forever.
-            self.close_conn(conn)
-        return rc == 0
+            return -1
+        if not isinstance(body, bytes):
+            # bytes subclasses (RawBody) pass through uncopied — a
+            # bytes(body) here would re-copy the shared broadcast body
+            # once per subscriber, exactly what encode-once avoids.
+            body = bytes(body)
+        return int(self._lib.bridge_send(self._handle, conn,
+                                         body, len(body)))
+
+    def set_max_outbox(self, n: int) -> None:
+        """Tune the per-connection outbox bound at which send returns -2."""
+        if self._handle:
+            self._lib.bridge_set_max_outbox(self._handle, n)
 
     def close_conn(self, conn: int) -> None:
         if self._handle:

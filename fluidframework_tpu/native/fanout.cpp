@@ -55,6 +55,33 @@ void drop_subscriber(Fanout* f, int64_t sub) {
     f->queues.erase(sub);
 }
 
+// Caller holds f->mu. Returns queues appended (the publish body shared
+// by fanout_publish and fanout_publish_batch).
+int64_t publish_locked(Fanout* f, const std::string& room,
+                       const char* data, uint32_t data_len) {
+    auto room_it = f->rooms.find(room);
+    if (room_it == f->rooms.end()) return 0;
+    std::string payload(data, data_len);
+    int64_t count = 0;
+    std::vector<int64_t> over;
+    for (int64_t sub : room_it->second) {
+        auto queue_it = f->queues.find(sub);
+        if (queue_it == f->queues.end()) continue;
+        if (queue_it->second.size() >= kMaxQueue) {
+            over.push_back(sub);
+            continue;
+        }
+        queue_it->second.push_back(payload);
+        ++count;
+    }
+    for (int64_t sub : over) {
+        drop_subscriber(f, sub);
+        f->evicted.insert(sub);
+    }
+    f->delivered += count;
+    return count;
+}
+
 }  // namespace
 
 extern "C" {
@@ -112,27 +139,35 @@ int64_t fanout_publish(void* handle, const char* room, uint32_t room_len,
                        const char* data, uint32_t data_len) {
     Fanout* f = static_cast<Fanout*>(handle);
     std::lock_guard<std::mutex> lock(f->mu);
-    auto room_it = f->rooms.find(std::string(room, room_len));
-    if (room_it == f->rooms.end()) return 0;
-    std::string payload(data, data_len);
-    int64_t count = 0;
-    std::vector<int64_t> over;
-    for (int64_t sub : room_it->second) {
-        auto queue_it = f->queues.find(sub);
-        if (queue_it == f->queues.end()) continue;
-        if (queue_it->second.size() >= kMaxQueue) {
-            over.push_back(sub);
-            continue;
-        }
-        queue_it->second.push_back(payload);
-        ++count;
+    return publish_locked(f, std::string(room, room_len), data, data_len);
+}
+
+// Batched publish — ONE native call + one lock for a whole serving
+// tick's broadcasts (the storm harvest's per-doc fan-out hop). ``buf``
+// holds ``n`` records of [u32 room_len][room][u32 data_len][data].
+// Returns total deliveries across records, -1 on a malformed buffer.
+int64_t fanout_publish_batch(void* handle, const char* buf, int64_t len,
+                             int64_t n) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t total = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t room_len, data_len;
+        if (p + 4 > end) return -1;
+        std::memcpy(&room_len, p, 4);
+        p += 4;
+        if (p + room_len + 4 > end) return -1;
+        std::string room(p, room_len);
+        p += room_len;
+        std::memcpy(&data_len, p, 4);
+        p += 4;
+        if (p + data_len > end) return -1;
+        total += publish_locked(f, room, p, data_len);
+        p += data_len;
     }
-    for (int64_t sub : over) {
-        drop_subscriber(f, sub);
-        f->evicted.insert(sub);
-    }
-    f->delivered += count;
-    return count;
+    return total;
 }
 
 // 1 if the subscriber was dropped for slow consumption, else 0.

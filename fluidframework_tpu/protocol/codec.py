@@ -147,28 +147,39 @@ def from_wire(obj: Any) -> Any:
 #   [6+H:]   concatenated per-doc op words, u32 LE (4 bytes/op — the
 #            map kernel's kind|slot<<2|value<<12 wire format)
 #
+# The same framing carries server→client pushes: a header with
+# ``op: "storm_ack"`` and an i32[n, 4] payload of per-doc
+# (n_seq, first_seq, last_seq, msn) rows is the columnar ack
+# (see :class:`StormAck` / :func:`decode_storm_push`).
+#
 # This is the rdkafka-batching analog of SURVEY §2.9: the hot path never
 # touches per-op Python objects between the socket and the device.
 
 STORM_MAGIC = 0x00
 _STORM_HDR = struct.Struct("<I")
+STORM_ACK_OP = "storm_ack"
 
 
-def is_storm_body(body: bytes) -> bool:
+def is_storm_body(body) -> bool:
     return len(body) > 6 and body[0] == STORM_MAGIC
 
 
-def encode_storm_body(header: dict, payload: bytes) -> bytes:
+def _storm_parts(header: dict, payload) -> tuple[bytes, bytes, int]:
     head = json.dumps(header, separators=(",", ":")).encode()
-    body = (bytes((STORM_MAGIC, 1)) + _STORM_HDR.pack(len(head))
-            + head + payload)
-    assert len(body) <= MAX_FRAME, f"storm frame too large: {len(body)}"
-    return body
+    size = 6 + len(head) + len(payload)
+    assert size <= MAX_FRAME, f"storm frame too large: {size}"
+    return head, bytes((STORM_MAGIC, 1)) + _STORM_HDR.pack(len(head)), size
 
 
-def encode_storm_frame(header: dict, payload: bytes) -> bytes:
-    body = encode_storm_body(header, payload)
-    return _LEN.pack(len(body)) + body
+def encode_storm_body(header: dict, payload) -> bytes:
+    head, prefix, _size = _storm_parts(header, payload)
+    return b"".join((prefix, head, payload))
+
+
+def encode_storm_frame(header: dict, payload) -> bytes:
+    # One join builds the whole frame: no intermediate body copy.
+    head, prefix, size = _storm_parts(header, payload)
+    return b"".join((_LEN.pack(size), prefix, head, payload))
 
 
 def pack_map_words(kinds, slots, values):
@@ -182,12 +193,169 @@ def pack_map_words(kinds, slots, values):
             | (np.asarray(values, np.uint32) << 12))
 
 
-def decode_storm_body(body: bytes) -> tuple[dict, memoryview]:
-    if body[0] != STORM_MAGIC or body[1] != 1:
+def decode_storm_body(body) -> tuple[dict, memoryview]:
+    """(header decoded once, payload view) — the payload memoryview
+    ALIASES ``body`` (zero-copy through to ``np.frombuffer`` on the
+    ingress path); only the small JSON header is materialized."""
+    view = body if isinstance(body, memoryview) else memoryview(body)
+    if len(view) > MAX_FRAME:
+        raise ValueError(f"oversized storm frame: {len(view)}")
+    if len(view) < 6 or view[0] != STORM_MAGIC or view[1] != 1:
         raise ValueError("not a v1 storm frame")
-    hlen = _STORM_HDR.unpack_from(body, 2)[0]
-    header = json.loads(bytes(body[6:6 + hlen]).decode())
-    return header, memoryview(body)[6 + hlen:]
+    hlen = _STORM_HDR.unpack_from(view, 2)[0]
+    if 6 + hlen > len(view):
+        raise ValueError(
+            f"truncated storm frame: header claims {hlen} bytes, "
+            f"{len(view) - 6} available")
+    header = json.loads(bytes(view[6:6 + hlen]).decode())
+    return header, view[6 + hlen:]
+
+
+# -- server→client push payloads ----------------------------------------------
+
+
+class RawBody(bytes):
+    """A pre-encoded frame body: session push paths write it verbatim
+    (length-prefixed by the transport) instead of JSON-encoding a dict."""
+
+    __slots__ = ()
+
+
+class StormAck(dict):
+    """One tick's ack for one storm frame, held COLUMNAR: ``rows`` is an
+    i32[n, 4] array of per-doc (n_seq, first_seq, last_seq, msn). Session
+    push paths encode it as ONE binary storm_ack frame without ever
+    materializing per-doc Python lists; in-process consumers index it
+    like the legacy dict payload — the ``"acks"`` lists materialize
+    lazily on first access."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self, rid: Any, rows) -> None:
+        super().__init__(rid=rid, storm=True)
+        self.rows = rows
+
+    def _materialize(self):
+        if not dict.__contains__(self, "acks"):
+            dict.__setitem__(self, "acks", self.rows.tolist())
+
+    def __missing__(self, key):
+        if key == "acks":
+            self._materialize()
+            return dict.__getitem__(self, "acks")
+        raise KeyError(key)
+
+    # The lazy key must be invisible ONLY to the wire fast path
+    # (encode_push reads .rows directly); every dict-protocol read an
+    # in-process consumer might use materializes it first. NOTE
+    # json.dumps on a dict subclass bypasses these overrides — push
+    # payloads go to the wire via encode_push, never raw json.dumps.
+    def get(self, key, default=None):
+        if key == "acks":
+            self._materialize()
+        return dict.get(self, key, default)
+
+    def __contains__(self, key):
+        if key == "acks":
+            return True
+        return dict.__contains__(self, key)
+
+    def keys(self):
+        self._materialize()
+        return dict.keys(self)
+
+    def values(self):
+        self._materialize()
+        return dict.values(self)
+
+    def items(self):
+        self._materialize()
+        return dict.items(self)
+
+    def __iter__(self):
+        self._materialize()
+        return dict.__iter__(self)
+
+    def __len__(self):
+        self._materialize()
+        return dict.__len__(self)
+
+    def copy(self):
+        self._materialize()
+        return dict(dict.items(self))
+
+
+def encode_storm_ack_body(ack: StormAck) -> bytes:
+    header = {"op": STORM_ACK_OP}
+    # dict.items bypasses StormAck's materializing override — the wire
+    # path must never build the per-doc lists.
+    header.update((k, v) for k, v in dict.items(ack) if k != "acks")
+    import numpy as np
+
+    rows = np.ascontiguousarray(ack.rows, np.dtype("<i4"))
+    return encode_storm_body(header, rows.tobytes())
+
+
+def decode_storm_push(body) -> dict:
+    """Decode a server→client binary storm push into the legacy dict
+    shape ({"rid", "storm", "acks", "dw", ...}); non-ack storm headers
+    pass through as-is."""
+    header, payload = decode_storm_body(body)
+    if header.get("op") != STORM_ACK_OP:
+        return header
+    if len(payload) % 16:
+        raise ValueError(f"storm ack payload not i32[n, 4]: "
+                         f"{len(payload)} bytes")
+    import numpy as np
+
+    out = {k: v for k, v in header.items() if k != "op"}
+    out["event"] = STORM_ACK_OP
+    out["storm"] = True
+    out["acks"] = np.frombuffer(payload, "<i4").reshape(-1, 4).tolist()
+    return out
+
+
+class BroadcastBatch(list):
+    """A sequenced-op batch shared by EVERY subscriber of a document:
+    the first session push encodes the ops event once and caches the
+    bytes here, so fanning one tick out to N connections costs one
+    encode + N writes instead of N encode+writes."""
+
+    __slots__ = ("_ops_body",)
+
+
+#: Encodes actually performed by encode_ops_event (the delivered-bytes /
+#: encode-count invariant pins on the delta of this counter).
+_ops_event_encodes = 0
+
+
+def ops_event_encode_count() -> int:
+    return _ops_event_encodes
+
+
+def encode_ops_event(messages) -> RawBody:
+    """Wire body of one {"event": "ops"} push — encoded at most once per
+    :class:`BroadcastBatch` however many subscribers it fans out to."""
+    global _ops_event_encodes
+    if isinstance(messages, BroadcastBatch):
+        body = getattr(messages, "_ops_body", None)
+        if body is None:
+            _ops_event_encodes += 1
+            body = RawBody(encode_body({"event": "ops",
+                                        "messages": messages}))
+            messages._ops_body = body
+        return body
+    _ops_event_encodes += 1
+    return RawBody(encode_body({"event": "ops", "messages": messages}))
+
+
+def encode_push(payload) -> bytes:
+    """Body bytes for one server→client push of any payload kind."""
+    if isinstance(payload, RawBody):
+        return payload
+    if isinstance(payload, StormAck):
+        return encode_storm_ack_body(payload)
+    return encode_body(payload)
 
 
 def encode_body(payload: Any) -> bytes:
@@ -203,5 +371,12 @@ def encode_frame(payload: Any) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
-def decode_body(body: bytes) -> Any:
+def frame_body(body: bytes) -> bytes:
+    """Length-prefix an already-encoded body (the push fast paths)."""
+    return _LEN.pack(len(body)) + body
+
+
+def decode_body(body) -> Any:
+    if isinstance(body, memoryview):
+        body = bytes(body)  # JSON control frames are small; copying is fine
     return from_wire(json.loads(body.decode()))

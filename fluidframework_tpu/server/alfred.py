@@ -37,7 +37,9 @@ from typing import Any
 from ..protocol.codec import (
     MAX_FRAME,
     decode_body,
-    encode_frame,
+    encode_ops_event,
+    encode_push,
+    frame_body,
     is_storm_body,
 )
 from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
@@ -69,6 +71,13 @@ class RequestSession:
 
     def push(self, payload: dict) -> None:
         raise NotImplementedError
+
+    def push_ops(self, messages) -> None:
+        """Broadcast one sequenced-op batch. A BroadcastBatch shared by
+        many sessions is serialized ONCE (codec.encode_ops_event caches
+        the body on the batch); every subscriber then pays only a
+        transport write."""
+        self.push(encode_ops_event(messages))
 
     def drop(self) -> None:
         """Close this session's transport (service-initiated disconnect,
@@ -144,7 +153,7 @@ class RequestSession:
                             "retry_after_s": retry}
             self.connection = service.connect(
                 self.doc_id,
-                lambda msgs: self.push({"event": "ops", "messages": msgs}),
+                self.push_ops,
                 on_nack=lambda n: self.push({"event": "nack", "nack": n}),
                 on_signal=lambda s: self.push({"event": "signal",
                                               "signal": s}),
@@ -274,7 +283,9 @@ class _ClientSession(RequestSession):
             payload = await self.outbox.get()
             if payload is None:
                 break
-            self.writer.write(encode_frame(payload))
+            # encode_push: pre-encoded RawBody / columnar StormAck go out
+            # without a JSON pass; plain dicts encode as before.
+            self.writer.write(frame_body(encode_push(payload)))
             await self.writer.drain()
 
     def drop(self) -> None:

@@ -22,7 +22,7 @@ import time
 from typing import Any
 
 from ..native.bridge import EV_CLOSE, EV_DATA, EV_OPEN, start_bridge
-from ..protocol.codec import decode_body, encode_body, is_storm_body
+from ..protocol.codec import decode_body, encode_push, is_storm_body
 from ..utils import MetricsRegistry, NullLogger, TelemetryLogger
 from .alfred import RequestSession
 
@@ -37,7 +37,21 @@ class _BridgeSession(RequestSession):
     def push(self, payload: dict) -> None:
         if payload is None:
             return
-        self.server._bridge.send(self.conn_id, encode_body(payload))
+        rc = self.server._bridge.send(self.conn_id, encode_push(payload))
+        if rc == -2:
+            # Outbox full: the peer stopped reading. A frame we cannot
+            # deliver must never be dropped SILENTLY under a connection
+            # that stays up — disconnect the slow consumer (its reconnect
+            # path resyncs from the durable log) and close the service
+            # side now rather than waiting for the reaped EV_CLOSE.
+            self.server.metrics.counter(
+                "bridge.slow_consumer_drops").inc()
+            self.server.logger.send_event("BridgeSlowConsumerDropped",
+                                          conn=self.conn_id)
+            self.drop()
+            if self.connection is not None:
+                connection, self.connection = self.connection, None
+                connection.close()
 
     def drop(self) -> None:
         # Service-initiated disconnect: close the native connection; the

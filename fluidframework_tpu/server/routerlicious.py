@@ -363,13 +363,20 @@ class BroadcasterDocumentLambda:
         self._deliver_op(value["message"])
 
     def _deliver_op(self, op: SequencedDocumentMessage) -> None:
+        # ONE shared batch for every subscriber: sessions serialize the
+        # broadcast body once per doc (codec.BroadcastBatch caches the
+        # encoded frame), not once per connection.
+        from ..protocol.codec import BroadcastBatch
+        batch = None
         for client_id, conn in list(self._connections.items()):
             if not conn.open:
                 continue
             if op.sequence_number <= self._delivered_seq.get(client_id, 0):
                 continue
             self._delivered_seq[client_id] = op.sequence_number
-            conn.handler([op])
+            if batch is None:
+                batch = BroadcastBatch((op,))
+            conn.handler(batch)
 
     def checkpoint(self, next_offset: int) -> None:
         pass  # live fan-out has no durable state

@@ -114,7 +114,9 @@ class _Frame(NamedTuple):
     push: Callable[[dict], None] | None
     rid: Any
     docs: list[tuple[str, str, int, int, int]]  # (doc, client, cseq0, ref, n)
-    words: list[np.ndarray]
+    words: np.ndarray   # u32[sum(counts)] VIEW aliasing the receive buffer
+    counts: np.ndarray  # i32[n_docs] per-doc op counts
+    meta: np.ndarray    # i32[n_docs, 3] (cseq0, ref, count) columns
 
 
 def _map_leg(map_state: mk.MapState, words, lo, hi, seq0_for):
@@ -490,9 +492,7 @@ class StormController:
         if not isinstance(entries, list) or not entries:
             raise ValueError("storm frame without docs")
         docs: list[tuple[str, str, int, int, int]] = []
-        words: list[np.ndarray] = []
         seen: set[str] = set()
-        offset = 0
         for entry in entries:
             if not (isinstance(entry, (list, tuple)) and len(entry) == 5):
                 raise ValueError(f"bad storm doc entry: {entry!r}")
@@ -506,15 +506,19 @@ class StormController:
                 # drop the first batch while acking it as sequenced.
                 raise ValueError(f"doc {doc_id!r} repeats within one frame")
             seen.add(doc_id)
-            if (offset + count) * 4 > len(payload):
-                raise ValueError("storm payload shorter than doc counts")
             docs.append((str(doc_id), str(client_id), int(cseq0),
                          int(ref_seq), count))
-            words.append(np.frombuffer(payload, np.uint32, count,
-                                       offset * 4))
-            offset += count
-        arr = np.frombuffer(payload, np.uint32, offset)
-        max_slot = int(((arr & 0xFFF) >> 2).max()) if offset else 0
+        # Columnar from here down: ONE payload view + per-doc count/meta
+        # arrays — no per-doc np.frombuffer, no byte copy (the words view
+        # aliases the receive buffer all the way into the tick scatter).
+        meta = np.array([entry[2:] for entry in docs], np.int32)
+        counts = meta[:, 2]
+        offset = int(counts.sum())
+        if offset * 4 > len(payload):
+            raise ValueError("storm payload shorter than doc counts")
+        words = np.frombuffer(payload, np.uint32, offset)
+        max_slot = int((words & np.uint32(0xFFC)).max()) >> 2 \
+            if offset else 0
         if max_slot >= self.max_key_slots:
             raise ValueError(
                 f"storm key slot {max_slot} >= max_key_slots "
@@ -527,7 +531,8 @@ class StormController:
                                 tenant_id, client_id)
             if retry is not None:
                 return
-        self._frames.append(_Frame(push, header.get("rid"), docs, words))
+        self._frames.append(_Frame(push, header.get("rid"), docs, words,
+                                   counts, meta))
         self._pending_docs += len(docs)
         self.stats["submitted_ops"] += offset
         if self._pending_docs >= self.flush_threshold_docs:
@@ -686,23 +691,25 @@ class StormController:
         self.service.pump()
         self.seq_host._flush_pending()
 
-        taken: dict[str, int] = {}  # doc -> index into descriptor arrays
+        taken: set[str] = set()
         descs: list[tuple[str, str, int, int, int]] = []
-        doc_words: list[np.ndarray] = []
-        acks: list[tuple[_Frame, list[int]]] = []  # frame -> desc indices
+        frame_words: list[np.ndarray] = []   # one payload view per frame
+        frame_counts: list[np.ndarray] = []
+        metas: list[np.ndarray] = []
+        acks: list[tuple[_Frame, int, int]] = []  # frame -> desc [i0, i1)
         deferred: list[_Frame] = []
         for frame in frames:
-            if any(doc in taken for doc, *_ in frame.docs):
+            fdocs = {doc for doc, *_ in frame.docs}
+            if not taken.isdisjoint(fdocs):
                 deferred.append(frame)
                 continue
-            idxs = []
-            for (doc, client, cseq0, ref, count), w in zip(frame.docs,
-                                                           frame.words):
-                taken[doc] = len(descs)
-                idxs.append(len(descs))
-                descs.append((doc, client, cseq0, ref, count))
-                doc_words.append(w)
-            acks.append((frame, idxs))
+            i0 = len(descs)
+            descs.extend(frame.docs)
+            taken |= fdocs
+            frame_words.append(frame.words)
+            frame_counts.append(frame.counts)
+            metas.append(frame.meta)
+            acks.append((frame, i0, len(descs)))
         if require_full and len(descs) < self.flush_threshold_docs:
             # Undersized cohort: put everything back; the idle drain (or
             # the cohort completing) will run it.
@@ -719,7 +726,9 @@ class StormController:
         # sequencer planes (client last_update) rebuild byte-identically.
         now = (self._replay_ts if self._replay_ts is not None
                else self.service._clock())
-        k = _next_pow2(max(count for *_, count in descs))
+        desc_arr = metas[0] if len(metas) == 1 else np.concatenate(metas)
+        counts_col = desc_arr[:, 2]
+        k = _next_pow2(int(counts_col.max()))
 
         # Rows + slots (the only per-doc Python work on the hot path).
         # Storm cohorts repeat tick after tick (the same docs stream
@@ -730,18 +739,22 @@ class StormController:
                       tuple((d, c) for d, c, *_ in descs))
         cached = self._cohort_cache.get(cohort_key)
         if cached is not None:
-            seq_rows, slots, map_rows = cached
+            seq_rows, slots, map_rows, mrows = cached
         else:
             seq_rows = np.empty(len(descs), np.int32)
             slots = np.empty(len(descs), np.int32)
             map_rows = np.empty(len(descs), np.int32)
+            mrows = []
             for i, (doc, client, _cseq0, _ref, _count) in enumerate(descs):
                 row = seq_host._row(doc)
                 seq_rows[i] = row
                 slots[i] = seq_host._slots[row].get(client,
                                                     seq_host._ghost)
-                map_rows[i] = self._storm_map_row(doc)
-            self._cohort_cache = {cohort_key: (seq_rows, slots, map_rows)}
+                mrow = self._storm_mrow(doc)
+                map_rows[i] = mrow.row
+                mrows.append(mrow)
+            self._cohort_cache = {
+                cohort_key: (seq_rows, slots, map_rows, mrows)}
 
         b_seq = seq_host._capacity
         b_map = merge_host._map_capacity
@@ -753,24 +766,30 @@ class StormController:
         words_full = np.zeros((b_map, k), np.uint32)
         map_counts = np.zeros(b_map, np.int32)
         gather = np.zeros(b_map, np.int32)
-        desc_arr = np.array([(c0, r, n) for _, _, c0, r, n in descs],
-                            np.int32)
         slot_full[seq_rows] = slots
         cseq0_full[seq_rows] = desc_arr[:, 0]
         ref_full[seq_rows] = desc_arr[:, 1]
         seq_counts[seq_rows] = desc_arr[:, 2]
         map_counts[map_rows] = desc_arr[:, 2]
         gather[map_rows] = seq_rows
-        counts_col = desc_arr[:, 2]
-        words_stacked = None
         if counts_col.min() == counts_col.max() == k:
-            # Uniform storm (the common shape): ONE fancy-index scatter
-            # instead of a 10k-iteration Python loop.
-            words_stacked = np.stack(doc_words)
-            words_full[map_rows] = words_stacked
+            # Uniform storm (the common shape): one fancy-index scatter
+            # PER FRAME, reading straight from each frame's receive
+            # buffer (a reshape view) — no np.stack copy, no per-doc
+            # Python loop between the socket and the device staging.
+            pos = 0
+            for fw, fc in zip(frame_words, frame_counts):
+                n = len(fc)
+                words_full[map_rows[pos:pos + n]] = fw.reshape(n, k)
+                pos += n
         else:
-            for i, w in enumerate(doc_words):
-                words_full[map_rows[i], :len(w)] = w
+            pos = 0
+            for fw, fc in zip(frame_words, frame_counts):
+                off = 0
+                for n in fc.tolist():
+                    words_full[map_rows[pos], :n] = fw[off:off + n]
+                    off += n
+                    pos += 1
 
         seq_host._host_state = None  # device state is about to move
         (seq_host._state, merge_host._xstate, n_seq, first, last,
@@ -788,9 +807,9 @@ class StormController:
         # device→host copies), then harvest only what has ≥ depth later
         # ticks already in flight behind it.
         rec = dict(
-            descs=descs, doc_words=doc_words, map_rows=map_rows,
-            words_stacked=words_stacked,
-            acks=acks, now=now, submitted=int(desc_arr[:, 2].sum()),
+            descs=descs, frame_words=frame_words, counts=counts_col,
+            map_rows=map_rows, mrows=mrows,
+            acks=acks, now=now, submitted=int(counts_col.sum()),
             out=(n_seq, first, last, msn, bad), start=round_start)
         for out_arr in rec["out"]:
             copy_async = getattr(out_arr, "copy_to_host_async", None)
@@ -810,17 +829,24 @@ class StormController:
 
         n_seq, first, last, msn, bad = (np.asarray(a) for a in rec["out"])
         map_rows = rec["map_rows"]
-        # Columnar → Python exactly once (int() per device element inside
-        # the doc loop would dominate the harvest).
-        ns_l = n_seq[map_rows].tolist()
-        fs_l = first[map_rows].tolist()
-        ls_l = last[map_rows].tolist()
-        m_l = msn[map_rows].tolist()
-        bad_l = bad[map_rows].tolist()
+        # ONE batched gather+pack builds the tick's per-doc ack matrix
+        # (n_seq, first, last, msn) — the columnar twin of
+        # pack_map_words; the WAL-header lists and every frame's ack are
+        # derived from it (columnar → Python exactly once; int() per
+        # device element inside the doc loop would dominate the harvest).
+        ack_rows = np.stack(
+            (n_seq[map_rows], first[map_rows], last[map_rows],
+             msn[map_rows]), axis=1).astype(np.int32, copy=False)
+        ns_l = ack_rows[:, 0].tolist()
+        fs_l = ack_rows[:, 1].tolist()
+        ls_l = ack_rows[:, 2].tolist()
+        m_l = ack_rows[:, 3].tolist()
+        bad_rows = bad[map_rows]
+        any_bad = bool(bad_rows.any())
+        bad_l = bad_rows.tolist()
         fanout = self.service.fanout
-        total_seq = 0
         now = rec["now"]
-        map_row_objs = self.merge_host._map_rows
+        mrows = rec["mrows"]
         # scriptorium tick record: ONE blob per tick — a json header of
         # every document's columnar record followed by the raw words.
         # RAM keeps only a compact (first_seq, last_seq, tick) triplet
@@ -829,42 +855,47 @@ class StormController:
         # the blob rides the disk oplog — the Mongo-storage analog).
         tick_id = self._tick_counter
         self._tick_counter += 1
-        doc_words = rec["doc_words"]
-        stacked = rec.get("words_stacked")
-        if stacked is not None:
-            word_parts: list = [stacked]
-            offsets = range(0, stacked.size * 4, stacked.shape[1] * 4)
-        else:
-            word_parts = [np.ascontiguousarray(w) for w in doc_words]
-            offsets = []
-            off = 0
-            for w in doc_words:
-                offsets.append(off)
-                off += w.nbytes
+        # The WAL words region is the frames' receive-buffer views,
+        # appended as-is; per-doc byte offsets are one cumsum, not a loop.
+        counts_col = rec["counts"]
+        word_parts: list = rec["frame_words"]
+        total_seq = int(sum(ns_l))
+        w_offs = np.zeros(len(counts_col), np.int64)
+        w_offs[1:] = np.cumsum(counts_col[:-1].astype(np.int64) * 4)
+        offsets = w_offs.tolist()
         header_docs = []
-        for i, ((doc, client, cseq0, ref, count), w_off) in enumerate(
-                zip(rec["descs"], offsets)):
+        replaying = self._replay
+        doc_tick_counts = self.doc_tick_counts
+        pubs: list = [] if fanout is not None and not replaying else None
+        for i, (doc, client, cseq0, ref, count) in enumerate(rec["descs"]):
             ns, fs, ls, m = ns_l[i], fs_l[i], ls_l[i], m_l[i]
-            total_seq += ns
-            mrow = map_row_objs[ChannelKey(doc, self.datastore,
-                                           self.channel)]
+            mrow = mrows[i]
             if ls > mrow.last_seq:
                 mrow.last_seq = ls
             header_docs.append([doc, client, cseq0, ref, count,
-                                ns, fs, ls, m, w_off])
-            if ns > 0 and not self._replay:
-                self._doc_ticks.setdefault(doc, []).append(
-                    (fs, ls, tick_id))
-            if not self._replay:
+                                ns, fs, ls, m, offsets[i]])
+            if not replaying:
+                if ns > 0:
+                    self._doc_ticks.setdefault(doc, []).append(
+                        (fs, ls, tick_id))
                 # Telemetry for the quarantine blast-radius invariant:
                 # batch peers of a quarantined doc lose zero ticks.
-                self.doc_tick_counts[doc] = \
-                    self.doc_tick_counts.get(doc, 0) + 1
-                if bad_l[i] and doc not in self.quarantined:
+                doc_tick_counts[doc] = doc_tick_counts.get(doc, 0) + 1
+                if any_bad and bad_l[i] and doc not in self.quarantined:
                     self._quarantine_doc(doc, "sentinel", tick_id)
-            # broadcaster: compact tick frame into the pub/sub hop.
-            if fanout is not None and not self._replay:
-                fanout.publish(doc, b"\x00storm%d:%d:%d" % (fs, ls, m))
+                # broadcaster: compact tick frame into the pub/sub hop.
+                if pubs is not None:
+                    pubs.append((doc, b"\x00storm%d:%d:%d" % (fs, ls, m)))
+        if pubs:
+            # O(batch) broadcast: the whole tick's room publishes go down
+            # in ONE native call (fanout_publish_batch) — never one
+            # Python write per subscriber connection.
+            batch_pub = getattr(fanout, "publish_batch", None)
+            if batch_pub is not None:
+                batch_pub(pubs)
+            else:  # duck-typed fanout without the batch surface
+                for room, body in pubs:
+                    fanout.publish(room, body)
         import json as _json
         import struct as _struct
 
@@ -907,19 +938,22 @@ class StormController:
         if self._last_harvest is not None:
             self.harvest_intervals.append(done - self._last_harvest)
         self._last_harvest = done
+        # Each frame's ack is a contiguous row slice of the tick's ack
+        # matrix — a StormAck that session push paths binary-encode
+        # without ever building per-doc dicts.
+        from ..protocol.codec import StormAck
         acks = []
-        for frame, idxs in rec["acks"]:
+        for frame, i0, i1 in rec["acks"]:
             if frame.push is None:
                 continue
-            payload = {"rid": frame.rid, "storm": True, "acks": [
-                [ns_l[i], fs_l[i], ls_l[i], m_l[i]] for i in idxs]}
-            qdocs = [rec["descs"][i][0] for i in idxs if bad_l[i]]
-            if qdocs:
+            payload = StormAck(frame.rid, ack_rows[i0:i1])
+            if any_bad and bad_rows[i0:i1].any():
                 # The tick's sequencing is durable and correct (the
                 # ticket is exact; the poison is in the served planes) —
                 # the ack stands, but the client learns its doc is
                 # frozen: further submits nack until readmission.
-                payload["quarantined"] = qdocs
+                payload["quarantined"] = [
+                    rec["descs"][i][0] for i in range(i0, i1) if bad_l[i]]
                 payload["retry_after_s"] = self.busy_retry_s
             acks.append((frame, payload))
         if self._group_wal is not None and not self._replay:
@@ -1299,7 +1333,9 @@ class StormController:
                     break
         return out
 
-    def _storm_map_row(self, doc_id: str):
+    def _storm_mrow(self, doc_id: str):
+        """The doc's map-row OBJECT (cohort resolution caches it so the
+        harvest's last_seq updates never re-key the row dict per doc)."""
         key = ChannelKey(doc_id, self.datastore, self.channel)
         mrow = self.merge_host._map_rows.get(key)
         if mrow is None:
@@ -1313,7 +1349,10 @@ class StormController:
             raise ValueError(
                 f"channel {key} already serves dict-path ops; storm and "
                 "dict traffic cannot mix on one channel")
-        return mrow.row
+        return mrow
+
+    def _storm_map_row(self, doc_id: str):
+        return self._storm_mrow(doc_id).row
 
 
 def materialize_storm_records(records: list[dict], datastore: str,

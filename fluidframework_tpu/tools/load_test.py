@@ -106,7 +106,8 @@ def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
                    for d in docs}
         service.pump()
 
-        from ..protocol.codec import pack_map_words
+        from ..protocol.codec import (
+            decode_storm_push, is_storm_body, pack_map_words)
 
         sock = socket.create_connection(("127.0.0.1", front.port))
         sock.settimeout(600)
@@ -135,7 +136,11 @@ def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
             # MSG_WAITALL is ignored on a socket with a timeout (the fd
             # goes non-blocking) — exact reads must loop.
             length = struct.unpack(">I", _recv_exact(sock, 4))[0]
-            json.loads(_recv_exact(sock, length).decode())
+            ack_body = _recv_exact(sock, length)
+            if is_storm_body(ack_body):
+                decode_storm_push(ack_body)  # binary columnar ack
+            else:
+                json.loads(ack_body.decode())
             if (tick + 1) % sample_every == 0 or tick == ticks - 1:
                 t = time.perf_counter() - start
                 rss_series.append((tick + 1, round(_rss_mb(), 1)))

@@ -47,7 +47,7 @@ def test_bridge_echo_roundtrip():
                     data = event
             assert opened is not None and data is not None
             assert data[0] == opened and data[2] == b"hello"
-            assert bridge.send(opened, b"world")
+            assert bridge.send(opened, b"world") == 0
             header = sock.recv(4)
             assert int.from_bytes(header, "big") == 5
             assert sock.recv(5) == b"world"
@@ -161,3 +161,59 @@ def test_bridge_standalone_service():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_stalled_reader_is_disconnected_not_silently_dropped():
+    """bridge_send rc -2 (outbox full behind a reader that stopped
+    reading): the front door must DISCONNECT the slow consumer — close
+    its socket, close its service connection, count the drop — never
+    drop the frame while leaving the connection up and silently deaf."""
+    import socket
+
+    from fluidframework_tpu.server.routerlicious import (
+        RouterliciousService as Service,
+    )
+
+    service = Service()
+    front = BridgeFrontDoor(service)
+    try:
+        front._bridge.set_max_outbox(4)  # trip -2 fast
+        sock = socket.create_connection(("127.0.0.1", front.port))
+        sock.settimeout(30)
+        # Shrink the receive window so pushed frames back up quickly
+        # behind a reader that never reads.
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        body = (b'{"rid": 1, "op": "connect", "doc_id": "slowdoc"}')
+        sock.sendall(len(body).to_bytes(4, "big") + body)
+        # Wait for the session + connection to exist server-side.
+        deadline = time.monotonic() + 15
+        session = None
+        while time.monotonic() < deadline:
+            sessions = list(front._sessions.values())
+            if sessions and sessions[0].connection is not None:
+                session = sessions[0]
+                break
+            time.sleep(0.01)
+        assert session is not None, "connect never reached the service"
+        # Stall: never read the socket again; push until the outbox bound
+        # trips. The kernel buffers absorb the first frames, then sends
+        # queue in the bridge outbox up to the (shrunk) bound.
+        payload = {"event": "signal", "signal": {"pad": "x" * 8192}}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and session.connection is not None:
+            session.push(payload)
+        assert session.connection is None, \
+            "slow consumer was never disconnected"
+        assert front.metrics.counter("bridge.slow_consumer_drops").value >= 1
+        # The transport really closed: the client's next read sees EOF.
+        sock.settimeout(15)
+        got = b"\x00"
+        try:
+            while got:  # drain whatever was delivered pre-drop
+                got = sock.recv(65536)
+        except (ConnectionResetError, socket.timeout):
+            got = b""  # RST instead of FIN is an equally real close
+        assert got == b""
+        sock.close()
+    finally:
+        front.close()

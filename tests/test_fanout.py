@@ -79,6 +79,29 @@ class TestFanoutCore:
         fanout.publish("r", b"z")
         assert fanout.delivered_total() == before + 2
 
+    def test_publish_batch_matches_sequential_publishes(self, fanout):
+        """One batched call == the same per-room publishes, in order —
+        the O(batch) broadcast hop of a storm tick."""
+        a = fanout.connect()
+        b = fanout.connect()
+        fanout.join(a, "batch-1")
+        fanout.join(b, "batch-1")
+        fanout.join(b, "batch-2")
+        delivered = fanout.publish_batch([
+            ("batch-1", b"\x00storm1:8:1"),
+            ("batch-2", b"\x00storm9:16:2"),
+            ("batch-empty-room", b"zzz"),
+            ("batch-1", b""),  # empty payloads stay legal in a batch
+        ])
+        assert delivered == 2 + 1 + 0 + 2
+        assert fanout.poll(a) == b"\x00storm1:8:1"
+        assert fanout.poll(a) == b""
+        assert [fanout.poll(b) for _ in range(3)] == [
+            b"\x00storm1:8:1", b"\x00storm9:16:2", b""]
+        assert fanout.publish_batch([]) == 0
+        fanout.disconnect(a)
+        fanout.disconnect(b)
+
 
 @pytest.mark.parametrize("fanout", _impls(),
                          ids=lambda f: "native" if f.is_native else "python")

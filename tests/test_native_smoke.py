@@ -1,0 +1,106 @@
+"""Tier-1 native smoke: build (or detect) the `native/_build` artifacts
+and exercise bridge + fanout TOGETHER once through the real serving
+path, so CI catches native/Python frame-layout drift — a bridge whose
+event layout, framing, or send rc contract silently diverged from
+bridge.py, or a fanout whose batch-publish record layout diverged from
+fanout.py, fails here rather than only under bench load."""
+
+from __future__ import annotations
+
+import socket
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.native import _loader
+from fluidframework_tpu.native.bridge import _load_library as load_bridge
+from fluidframework_tpu.native.fanout import _load_library as load_fanout
+
+pytestmark = pytest.mark.skipif(
+    load_bridge() is None or load_fanout() is None,
+    reason="no C++ toolchain and no prebuilt native artifacts")
+
+
+def test_build_artifacts_match_current_sources():
+    """Every loaded native lib is the hash-keyed artifact of the CURRENT
+    .cpp next to it — a stale or foreign .so must never serve."""
+    import hashlib
+
+    native = Path(_loader.__file__).parent
+    for name in ("bridge", "fanout"):
+        src = native / f"{name}.cpp"
+        digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+        artifact = native / "_build" / f"lib{name}.{digest}.so"
+        assert artifact.exists(), (
+            f"{name}: no artifact for the current source hash {digest} — "
+            "build_and_load should have produced it")
+
+
+def test_bridge_and_fanout_serve_one_storm_tick_together():
+    """One real tick over both native components: a storm frame enters
+    through the C++ bridge socket, sequences on the device, broadcasts
+    through the C++ fanout rooms in one batched publish, and acks back
+    over the wire as a binary columnar frame."""
+    from fluidframework_tpu.native.fanout import NativeFanout, make_fanout
+    from fluidframework_tpu.protocol.codec import (
+        decode_storm_push,
+        encode_storm_frame,
+        is_storm_body,
+        pack_map_words,
+    )
+    from fluidframework_tpu.server.bridge_host import BridgeFrontDoor
+    from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+    from fluidframework_tpu.server.merge_host import KernelMergeHost
+    from fluidframework_tpu.server.routerlicious import RouterliciousService
+    from fluidframework_tpu.server.storm import StormController
+
+    fanout = make_fanout()
+    assert isinstance(fanout, NativeFanout) and fanout.is_native
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=4)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False, fanout=fanout)
+    storm = StormController(service, seq_host, merge_host,
+                            flush_threshold_docs=2)
+    front = BridgeFrontDoor(service, 0)
+    try:
+        docs = ["smoke-a", "smoke-b"]
+        clients = {d: service.connect(d, lambda m: None).client_id
+                   for d in docs}
+        service.pump()
+        # A read-only audience subscriber on each doc's fanout room.
+        subs = {d: fanout.connect() for d in docs}
+        for d, sub in subs.items():
+            fanout.join(sub, d)
+
+        k = 8
+        words = pack_map_words([0] * k, list(range(k)), [7] * k)
+        sock = socket.create_connection(("127.0.0.1", front.port))
+        sock.settimeout(30)
+        sock.sendall(encode_storm_frame(
+            {"op": "storm", "rid": 1,
+             "docs": [[d, clients[d], 1, 1, k] for d in docs]},
+            words.astype(np.uint32).tobytes() * len(docs)))
+
+        import struct
+        length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
+        body = sock.recv(length, socket.MSG_WAITALL)
+        assert is_storm_body(body), "ack must be a binary storm push"
+        ack = decode_storm_push(body)
+        assert ack["rid"] == 1
+        assert [a[0] for a in ack["acks"]] == [k, k]
+
+        # The batched room publish reached every subscriber.
+        deadline = time.monotonic() + 10
+        while (any(fanout.pending(s) == 0 for s in subs.values())
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        for d, sub in subs.items():
+            payload = fanout.poll(sub)
+            assert payload is not None and bytes(payload[:1]) == b"\x00", d
+        sock.close()
+    finally:
+        front.close()

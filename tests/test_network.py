@@ -213,3 +213,44 @@ class TestCrossProcess:
         wait_until(lambda: len(nacks) > 0)
         assert nacks[0].operation.client_sequence_number == 999
         svc.close()
+
+
+def test_malformed_storm_push_fails_loudly_not_silently():
+    """A corrupt binary storm push must tear the transport down through
+    the normal disconnect path — waiters fail, the disconnect event
+    fires — never kill the reader thread silently (the would-be hang:
+    every later _request blocks forever on a dead reader)."""
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        # Read the connect request frame, then answer it...
+        hdr = conn.recv(4, socket.MSG_WAITALL)
+        n = int.from_bytes(hdr, "big")
+        req = json.loads(conn.recv(n, socket.MSG_WAITALL).decode())
+        resp = json.dumps({"rid": req["rid"], "client_id": "c1"}).encode()
+        conn.sendall(len(resp).to_bytes(4, "big") + resp)
+        # ...then push a CORRUPT storm body (bad version byte).
+        bad = b"\x00\x09" + b"\x02\x00\x00\x00{}"
+        conn.sendall(len(bad).to_bytes(4, "big") + bad)
+        # Leave the socket open: only the client-side decode failure can
+        # end this session.
+        threading.Event().wait(10)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    svc = NetworkDocumentService("127.0.0.1", port, "doc")
+    dropped = []
+    svc.events.on("disconnect", lambda: dropped.append(True))
+    svc.connect(lambda msgs: None)
+    wait_until(lambda: dropped, timeout=10)
+    assert svc.closed
+    with pytest.raises((ConnectionError, RuntimeError)):
+        svc._request({"op": "get_deltas", "from_seq": 0})
+    srv.close()

@@ -18,6 +18,7 @@ import pytest
 from fluidframework_tpu.dds.map_data import MapData
 from fluidframework_tpu.protocol.codec import (
     decode_storm_body,
+    decode_storm_push,
     encode_storm_body,
     encode_storm_frame,
     is_storm_body,
@@ -52,6 +53,16 @@ def make_words(rng, k, num_slots=16):
     slots = rng.integers(0, num_slots, k).astype(np.uint32)
     vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
     return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def read_push(sock):
+    """One server push off the wire: binary storm acks decode through
+    the codec; JSON control frames through json."""
+    length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
+    body = sock.recv(length, socket.MSG_WAITALL)
+    if is_storm_body(body):
+        return decode_storm_push(body)
+    return json.loads(body.decode())
 
 
 def replay_oracle(service, doc_id):
@@ -198,8 +209,7 @@ def test_storm_over_bridge_wire():
         hdr = {"op": "storm", "rid": 7,
                "docs": [[d, clients[d], 1, 1, k] for d in docs]}
         sock.sendall(encode_storm_frame(hdr, words.tobytes() * len(docs)))
-        length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
-        ack = json.loads(sock.recv(length, socket.MSG_WAITALL).decode())
+        ack = read_push(sock)
         assert ack["rid"] == 7 and all(a[0] == k for a in ack["acks"])
         for d in docs:
             assert merge_host.map_entries(d, "default", "root") \
@@ -223,8 +233,7 @@ def test_malformed_storm_frames_fail_alone():
 
         def roundtrip(hdr, payload):
             sock.sendall(encode_storm_frame(hdr, payload))
-            n = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
-            return json.loads(sock.recv(n, socket.MSG_WAITALL).decode())
+            return read_push(sock)
 
         w4 = np.zeros(4, np.uint32).tobytes()
         # count exceeding the payload
@@ -278,8 +287,7 @@ def test_storm_tail_frame_drains_on_idle():
             {"op": "storm", "rid": 1,
              "docs": [["doc0", clients["doc0"], 1, 1, 4]]},
             words.tobytes()))
-        length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
-        ack = json.loads(sock.recv(length, socket.MSG_WAITALL).decode())
+        ack = read_push(sock)
         assert ack["acks"][0][0] == 4
         sock.close()
     finally:
@@ -363,3 +371,146 @@ def test_spill_log_restart_recovers_history(tmp_path):
         np.frombuffer(storm2.read_tick_words(recs[0]["tick"]), np.uint32,
                       recs[0]["count"], recs[0]["w_off"]))
     assert (words2 == words).all()
+
+
+def test_ingress_is_zero_copy_through_codec_and_submit():
+    """THE zero-copy acceptance bar: the payload handed to submit_frame
+    is parsed in place — the buffered frame's word view ALIASES the
+    receive buffer (codec → submit_frame with no Python-level byte
+    copy), and the only staging write is the tick scatter itself."""
+    service, storm, merge_host = make_service()
+    clients = join_docs(service, ["a", "b"])
+    k = 16
+    rng = np.random.default_rng(11)
+    payload = b"".join(make_words(rng, k).tobytes() for _ in range(2))
+    buf = bytearray(encode_storm_body(
+        {"op": "storm", "rid": 1,
+         "docs": [["a", clients["a"], 1, 1, k],
+                  ["b", clients["b"], 1, 1, k]]}, payload))
+    header, view = decode_storm_body(buf)
+    assert view.obj is buf  # codec: memoryview-through
+    storm.submit_frame(None, header, view)
+    frame = storm._frames[0]
+    base = np.frombuffer(buf, np.uint8)
+    # submit_frame: ONE frombuffer view over the receive buffer — no
+    # per-doc slicing copies, no re-parse.
+    assert np.shares_memory(frame.words, base)
+    storm.flush()
+    assert storm.stats["sequenced_ops"] == 2 * k
+    for d in ("a", "b"):
+        assert merge_host.map_entries(d, "default", "root") \
+            == replay_oracle(service, d)
+
+
+def test_broadcast_fanout_is_batched_native_publishes():
+    """O(batch) fan-out acceptance bar: one serving tick's broadcasts go
+    through the fan-out service as ONE batched publish call (covering
+    every doc), never one Python write per subscriber connection."""
+    from fluidframework_tpu.native.fanout import make_fanout
+
+    class CountingFanout:
+        def __init__(self):
+            self.inner = make_fanout(force_python=True)
+            self.publish_calls = 0
+            self.batch_calls = 0
+
+        def publish(self, room, payload):
+            self.publish_calls += 1
+            return self.inner.publish(room, payload)
+
+        def publish_batch(self, items):
+            self.batch_calls += 1
+            return self.inner.publish_batch(items)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+    from fluidframework_tpu.server.merge_host import KernelMergeHost
+
+    fanout = CountingFanout()
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=8)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False, fanout=fanout)
+    storm = StormController(service, seq_host, merge_host,
+                            flush_threshold_docs=10**9)
+    docs = [f"d{i}" for i in range(6)]
+    clients = join_docs(service, docs)
+    # N read-only subscribers per doc on the fan-out rooms.
+    subs = []
+    for d in docs:
+        for _ in range(4):
+            sub = fanout.connect()
+            fanout.join(sub, d)
+            subs.append(sub)
+    rng = np.random.default_rng(12)
+    k = 8
+    payload = b"".join(make_words(rng, k).tobytes() for _ in docs)
+    fanout.batch_calls = fanout.publish_calls = 0
+    storm.submit_frame(None, {
+        "op": "storm", "docs": [[d, clients[d], 1, 1, k] for d in docs]},
+        memoryview(payload))
+    storm.flush()
+    # ONE native batch call for the whole tick; zero per-room Python
+    # publishes on the storm path.
+    assert fanout.batch_calls == 1
+    assert fanout.publish_calls == 0
+    # ...and it really fanned out: every subscriber queue got its doc's
+    # compact tick frame.
+    for sub in subs:
+        assert fanout.pending(sub) == 1
+        assert fanout.poll(sub)[:1] == b"\x00"
+
+
+def test_sequenced_broadcast_serialized_once_per_doc():
+    """Satellite pin (delivered-bytes / encode-count): one sequenced op
+    fanned to N subscriber sessions is JSON-encoded ONCE — every session
+    pushes the SAME cached body bytes."""
+    from fluidframework_tpu.protocol.codec import (
+        BroadcastBatch,
+        encode_ops_event,
+        ops_event_encode_count,
+    )
+    from fluidframework_tpu.server.alfred import RequestSession
+
+    class SinkSession(RequestSession):
+        def __init__(self, server):
+            super().__init__(server)
+            self.sent = []
+
+        def push(self, payload):
+            self.sent.append(payload)
+
+    service = RouterliciousService()
+    server = type("S", (), {"service": service})()
+    sessions = [SinkSession(server) for _ in range(5)]
+
+    # The broadcaster hands EVERY subscriber the same BroadcastBatch
+    # object (identity-shared per op delivery)...
+    received = []
+    for i in range(3):
+        service.connect("doc", received.append)
+    conn = service.connect("doc", received.append)
+    received.clear()
+    from fluidframework_tpu.protocol.messages import DocumentMessage
+    conn.submit([DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"k": 1})])
+    assert received, "no broadcast delivered"
+    batches = [b for b in received if isinstance(b, BroadcastBatch)]
+    assert batches, "broadcast batches are not shared BroadcastBatch objects"
+    first = batches[0]
+    assert sum(1 for b in batches if b is first) >= 3  # same object, all subs
+
+    # ...so the session push path encodes once however many sessions fan
+    # it out, and each delivers the identical bytes.
+    before = ops_event_encode_count()
+    for s in sessions:
+        s.push_ops(first)
+    assert ops_event_encode_count() - before == 1
+    bodies = [s.sent[0] for s in sessions]
+    assert all(b is bodies[0] for b in bodies)
+    delivered_bytes = sum(len(b) for b in bodies)
+    assert delivered_bytes == len(bodies[0]) * len(sessions)

@@ -1,0 +1,158 @@
+"""Storm wire codec (protocol/codec.py binary frames): round-trip
+properties, the ZERO-COPY contract (the decoded payload memoryview
+aliases the receive buffer), malformed-frame rejection, and the columnar
+storm-ack push format the session fast paths emit."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.protocol.codec import (
+    MAX_FRAME,
+    BroadcastBatch,
+    RawBody,
+    StormAck,
+    decode_storm_body,
+    decode_storm_push,
+    encode_ops_event,
+    encode_push,
+    encode_storm_body,
+    encode_storm_frame,
+    is_storm_body,
+    ops_event_encode_count,
+)
+
+
+class TestStormFrameRoundTrip:
+    def test_roundtrip_property(self):
+        """Random headers x random payload sizes survive encode→decode
+        byte-identically, framed and unframed."""
+        rng = np.random.default_rng(42)
+        for trial in range(25):
+            n = int(rng.integers(0, 512))
+            words = rng.integers(0, 1 << 32, n, dtype=np.uint64)
+            payload = words.astype(np.uint32).tobytes()
+            header = {"op": "storm", "rid": int(rng.integers(0, 1 << 30)),
+                      "docs": [[f"d{i}", f"c{i}", int(rng.integers(1, 99)),
+                                1, n] for i in range(int(rng.integers(1, 5)))],
+                      "trial": trial}
+            body = encode_storm_body(header, payload)
+            assert is_storm_body(body) or n == 0 and len(body) <= 6
+            got_header, got_payload = decode_storm_body(body)
+            assert got_header == header
+            assert bytes(got_payload) == payload
+            # Framed variant = 4-byte BE length + the identical body.
+            frame = encode_storm_frame(header, payload)
+            assert struct.unpack(">I", frame[:4])[0] == len(body)
+            assert frame[4:] == body
+
+    def test_empty_payload_roundtrip(self):
+        header, payload = decode_storm_body(
+            encode_storm_body({"op": "storm", "docs": []}, b""))
+        assert header["docs"] == [] and len(payload) == 0
+
+    def test_decode_is_zero_copy(self):
+        """The payload memoryview ALIASES the receive buffer — no byte
+        copy between the socket read and np.frombuffer."""
+        words = np.arange(64, dtype=np.uint32)
+        buf = bytearray(encode_storm_body({"op": "storm"}, words.tobytes()))
+        _header, payload = decode_storm_body(buf)
+        assert isinstance(payload, memoryview)
+        assert payload.obj is buf  # alias, not a copy
+        arr = np.frombuffer(payload, np.uint32)
+        assert np.shares_memory(arr, np.frombuffer(buf, np.uint8))
+        # Writes through the buffer are visible in the decoded view —
+        # only possible when nothing was copied.
+        buf[-4:] = (np.uint32(0xDEADBEEF)).tobytes()
+        assert arr[-1] == 0xDEADBEEF
+
+    def test_decode_of_memoryview_input_stays_zero_copy(self):
+        buf = bytearray(encode_storm_body({"a": 1}, b"\x01\x02\x03\x04"))
+        _h, payload = decode_storm_body(memoryview(buf))
+        assert payload.obj is buf
+
+
+class TestStormFrameRejection:
+    def test_wrong_magic_or_version(self):
+        good = bytearray(encode_storm_body({"x": 1}, b"\0\0\0\0"))
+        bad_magic = bytes([1]) + bytes(good[1:])
+        with pytest.raises(ValueError, match="not a v1 storm frame"):
+            decode_storm_body(bad_magic)
+        bad_version = bytes(good[:1]) + bytes([9]) + bytes(good[2:])
+        with pytest.raises(ValueError, match="not a v1 storm frame"):
+            decode_storm_body(bad_version)
+
+    def test_truncated_bodies_rejected(self):
+        body = encode_storm_body({"op": "storm", "pad": "x" * 32}, b"")
+        for cut in (0, 1, 5, 6, 10, len(body) - 1):
+            with pytest.raises(ValueError):
+                decode_storm_body(body[:cut])
+
+    def test_header_length_past_buffer_rejected(self):
+        # A header-length field pointing past the body must fail loudly,
+        # never slice into nonsense.
+        body = bytes((0, 1)) + struct.pack("<I", 1 << 20) + b"{}"
+        with pytest.raises(ValueError, match="truncated"):
+            decode_storm_body(body)
+
+    def test_oversize_frame_rejected_both_directions(self):
+        with pytest.raises(AssertionError, match="too large"):
+            encode_storm_body({}, b"\0" * (MAX_FRAME + 1))
+        # Decode side: an attacker-length buffer above MAX_FRAME is
+        # refused before any header parse.
+        fake = bytearray(MAX_FRAME + 7)
+        fake[0] = 0
+        fake[1] = 1
+        with pytest.raises(ValueError, match="oversized"):
+            decode_storm_body(fake)
+
+
+class TestStormAckCodec:
+    def test_columnar_ack_roundtrip(self):
+        rows = np.array([[8, 2, 9, 1], [0, 2**31 - 1, 0, 0], [3, 10, 12, 5]],
+                        np.int32)
+        ack = StormAck(7, rows)
+        ack["dw"] = 42
+        body = encode_push(ack)
+        assert is_storm_body(body)
+        out = decode_storm_push(body)
+        assert out["rid"] == 7 and out["storm"] and out["dw"] == 42
+        assert out["acks"] == rows.tolist()
+
+    def test_ack_quarantine_fields_ride_the_header(self):
+        ack = StormAck(None, np.zeros((1, 4), np.int32))
+        ack["quarantined"] = ["doc-x"]
+        ack["retry_after_s"] = 0.05
+        out = decode_storm_push(encode_push(ack))
+        assert out["quarantined"] == ["doc-x"]
+        assert out["retry_after_s"] == 0.05
+
+    def test_inprocess_ack_is_legacy_dict_shaped(self):
+        """In-process consumers (chaos, tests) index the ack like the
+        round-8 dict payload; the lists materialize lazily."""
+        rows = np.array([[4, 1, 4, 1]], np.int32)
+        ack = StormAck(3, rows)
+        assert ack.get("storm") is True and ack["rid"] == 3
+        assert ack["acks"] == [[4, 1, 4, 1]]
+
+    def test_malformed_ack_payload_rejected(self):
+        body = encode_storm_body({"op": "storm_ack"}, b"\0" * 10)
+        with pytest.raises(ValueError, match="i32"):
+            decode_storm_push(body)
+
+
+class TestBroadcastEncodeOnce:
+    def test_shared_batch_encodes_once(self):
+        batch = BroadcastBatch(({"fake": "op"},))
+        before = ops_event_encode_count()
+        bodies = [encode_ops_event(batch) for _ in range(5)]
+        assert ops_event_encode_count() - before == 1
+        assert all(b is bodies[0] for b in bodies)  # the SAME bytes object
+        assert isinstance(bodies[0], RawBody)
+
+    def test_unshared_list_encodes_each_time(self):
+        before = ops_event_encode_count()
+        encode_ops_event([{"fake": "op"}])
+        encode_ops_event([{"fake": "op"}])
+        assert ops_event_encode_count() - before == 2
