@@ -1126,9 +1126,13 @@ sock = socket.create_connection(("127.0.0.1", cfg["port"]))
 rng = np.random.default_rng(cfg["seed"])
 docs = cfg["docs"]  # [[doc_id, client_id], ...]
 k = cfg["k"]
+trace_every = cfg.get("trace_every", 0)
 cseqs = {d: c0 for (d, _cl), c0 in zip(docs, cfg["cseq0"])}
 
 def frame(rid):
+    # (bytes, tc): every trace_every-th frame carries a sampled trace
+    # id ("tc" header field); the server timestamps it at every hop and
+    # the traced ack carries the joined marks back.
     hdr_docs, chunks = [], []
     for doc_id, client_id in docs:
         kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
@@ -1137,11 +1141,15 @@ def frame(rid):
         chunks.append(kinds | (slots << 2) | (vals << 12))
         hdr_docs.append([doc_id, client_id, cseqs[doc_id], 1, k])
         cseqs[doc_id] += k
-    head = json.dumps({"op": "storm", "rid": rid, "docs": hdr_docs},
-                      separators=(",", ":")).encode()
+    header = {"op": "storm", "rid": rid, "docs": hdr_docs}
+    tc = None
+    if trace_every and rid % trace_every == 0:
+        tc = cfg["seed"] * 1_000_000 + rid
+        header["tc"] = tc
+    head = json.dumps(header, separators=(",", ":")).encode()
     body = (bytes((0, 1)) + struct.pack("<I", len(head)) + head
             + b"".join(c.tobytes() for c in chunks))
-    return struct.pack(">I", len(body)) + body
+    return struct.pack(">I", len(body)) + body, tc
 
 def recv_exact(n):
     raw = b""
@@ -1170,23 +1178,37 @@ frames = [frame(t) for t in range(cfg["ticks"])]  # pre-built, untimed
 print("READY", flush=True)
 assert sys.stdin.readline().strip() == "GO"
 t0 = time.perf_counter()
-for data in frames:          # pipelined: the bridge buffers inbound
+send_ns = {}
+for data, tc in frames:      # pipelined: the bridge buffers inbound
+    if tc is not None:
+        send_ns[tc] = time.monotonic_ns()  # server hops share this clock
     sock.sendall(data)
-ack_times, acked = [], 0
+ack_times, acked, hop_rows = [], 0, []
 while acked < cfg["ticks"]:
     ack = read_ack()
+    rx_ns = time.monotonic_ns()
     if ack.get("storm"):
         acked += 1
         ack_times.append(time.perf_counter() - t0)
+        tc, hops = ack.get("tc"), ack.get("hops")
+        if tc in send_ns and hops:
+            # End-to-end join: client send -> server hop marks -> client
+            # rx, one monotonic clock domain (same host), ms per hop.
+            marks = ([("client_send", send_ns.pop(tc))]
+                     + list(hops.items()) + [("client_rx", rx_ns)])
+            hop_rows.append({"%s_to_%s" % (a, b): (tb - ta) / 1e6
+                             for (a, ta), (b, tb) in zip(marks, marks[1:])})
 print(json.dumps({"elapsed": time.perf_counter() - t0,
-                  "ack_times": ack_times}), flush=True)
+                  "ack_times": ack_times, "hop_rows": hop_rows}),
+      flush=True)
 """
 
 
 def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
                     n_conns: int = 8, num_slots: int = 32,
                     durability: str | None = None,
-                    spill_dir: str | None = None) -> dict:
+                    spill_dir: str | None = None,
+                    trace_every: int = 0) -> dict:
     """End-to-end merged-ops/sec through the REAL serving path: client
     processes → framed TCP → C++ bridge front door → alfred dispatch →
     deli (device sequencer kernel, full NACK/MSN semantics) → merger (map
@@ -1251,6 +1273,7 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
     assert storm.stats["sequenced_ops"] == num_docs * k
     storm.tick_seconds.clear()
     storm.harvest_intervals.clear()
+    storm.ledger.clear()  # the compile tick would skew attribution
     storm._last_harvest = None  # the client-setup gap is not a cadence
 
     # Timed run: client processes (no GIL sharing with the server) send
@@ -1264,7 +1287,7 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
         proc.stdin.write(json.dumps({
             "port": front.port, "k": k, "ticks": ticks, "seed": c,
-            "num_slots": num_slots,
+            "num_slots": num_slots, "trace_every": trace_every,
             "docs": [[d, clients[d]] for d in conn_docs],
             "cseq0": [k + 1] * len(conn_docs),
         }) + "\n")
@@ -1371,12 +1394,31 @@ def bench_e2e_storm(num_docs: int = 10_240, k: int = 512, ticks: int = 10,
         "num_docs": num_docs,
         "ops_per_tick": num_docs * k,
         "ticks": int(storm.stats["ticks"] - ticks_before),
+        "trace_every": trace_every,
         "path": "client procs -> TCP -> C++ bridge -> alfred -> "
                 "sequencer kernel -> map kernel (fused) -> durable log "
                 "+ fanout + acks",
     }
     out["fraction_of_link_ceiling"] = round(
         out["e2e_ops_per_sec"] / out["link_implied_ops_ceiling"], 3)
+    # Stage-attribution columns (the round-10 ledger): per-stage share of
+    # the tick's attributed time + p50/p99 over the measured window.
+    out["stage_attribution"] = storm.ledger.attribution()
+    # Sampled per-op hop decomposition of ack latency: client send →
+    # bridge ingress → admit → dispatch → sequenced → durable → ack tx →
+    # client rx, joined across processes in one monotonic clock domain.
+    hop_rows = [r for res in results for r in res.get("hop_rows", [])]
+    if hop_rows:
+        from fluidframework_tpu.utils.metrics import percentile
+        names = sorted({name for r in hop_rows for name in r})
+        decomp = {}
+        for name in names:
+            vals = sorted(r[name] for r in hop_rows if name in r)
+            decomp[name] = {
+                "p50_ms": round(percentile(vals, 0.50), 3),
+                "p99_ms": round(percentile(vals, 0.99), 3),
+                "count": len(vals)}
+        out["ack_hop_decomposition_ms"] = decomp
     # The WAL writer thread/fd and the bench's own tick blobs (~hundreds
     # of MB at this shape) must not outlive the row.
     if storm._group_wal is not None:
@@ -1592,6 +1634,77 @@ def emit_round9(path: str = "BENCH_r09.json") -> dict:
     return out
 
 
+def emit_round10(path: str = "BENCH_r10.json") -> dict:
+    """ISSUE 7 acceptance bars: the durable-ON e2e storm run with the
+    round-10 observability plane live — per-stage attribution columns
+    (which hop of the tick eats the budget), the sampled per-op hop
+    decomposition of ack latency, and the tracing overhead measured
+    trace-off vs trace-EVERY-frame on the same shape (the <2% bar at a
+    far denser sample than the 1-in-N default). Fail-soft: without the
+    native bridge the rows record the skip instead of crashing."""
+    import jax
+
+    from fluidframework_tpu.utils import compile_cache
+
+    compile_cache.enable()
+    backend = jax.default_backend()
+    out: dict = {"round": 10, "environment": {"backend": backend}}
+    # The acceptance-named row: 10k docs, durability ON (group commit),
+    # tracing at 1-in-4 frames for decomposition coverage. (The r09 and
+    # main() rows keep trace_every=0 — their recorded baselines ran
+    # trace-free, so re-runs stay comparable.)
+    full = bench_e2e_storm(durability="group", trace_every=4)
+    out["e2e_storm_10k_docs"] = full
+    skipped = "skipped" in full
+    if not skipped:
+        # Overhead pair at the r07-comparability shape: identical runs,
+        # tracing off vs tracing EVERY frame (strictly worse than the
+        # default sample). The arms INTERLEAVE (off, on, off, on, ...)
+        # and score best-of-3: a long-lived bench process drifts slower
+        # run over run (page cache, allocator fragmentation), so
+        # running all of one arm first would bill the drift to whichever
+        # arm went second — measured at ~16% fake "overhead" once.
+        rows: dict = {0: [], 1: []}
+        for _ in range(3):
+            for te in (0, 1):
+                rows[te].append(bench_e2e_storm(
+                    num_docs=2048, k=256, ticks=8, n_conns=4,
+                    durability="group", trace_every=te))
+
+        def best(te):
+            return max(rows[te],
+                       key=lambda r: r.get("e2e_ops_per_sec", 0.0))
+
+        off = best(0)
+        on = best(1)
+        out["e2e_storm_cpu_2048x256_trace_off"] = off
+        out["e2e_storm_cpu_2048x256_trace_on"] = on
+        out["tracing_overhead_pct"] = round(
+            100.0 * (off["e2e_ops_per_sec"] / on["e2e_ops_per_sec"] - 1.0),
+            2)
+        out["environment"]["note"] = (
+            "Backend %s. Round-10 tentpole is observability: "
+            "stage_attribution = per-tick stage ledger (share of "
+            "attributed tick time + p50/p99 per stage over the measured "
+            "window; ingress decode -> admission -> scatter -> device "
+            "dispatch -> readback -> WAL append/commit-wait -> ack pack "
+            "-> fanout publish). ack_hop_decomposition_ms = sampled "
+            "per-op trace joins (client send -> bridge ingress -> admit "
+            "-> dispatch -> sequenced -> durable -> ack tx -> client "
+            "rx; same-host monotonic clock domain). "
+            "tracing_overhead_pct compares trace-off vs trace-EVERY-"
+            "frame on the identical shape, arms interleaved and scored "
+            "best-of-3 to cancel process drift (the 1-in-N default "
+            "costs proportionally less); negative = under run noise."
+            % backend)
+    else:
+        out["environment"]["note"] = (
+            "native bridge unavailable; e2e rows skipped (fail-soft)")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
 def main() -> None:
     from fluidframework_tpu.utils import compile_cache
 
@@ -1708,7 +1821,21 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--e2e-r09" in sys.argv:
+    if "--e2e-r10" in sys.argv:
+        res = emit_round10()
+        row = res["e2e_storm_10k_docs"]
+        att = row.get("stage_attribution", {})
+        print(json.dumps({
+            "metric": "e2e storm ops/sec, durability ON, stage-attributed "
+                      "(BENCH_r10)",
+            "value": round(row.get("e2e_ops_per_sec", 0.0), 1),
+            "unit": "ops/s",
+            "stage_shares": {s: v["share"] for s, v in att.items()
+                             if s != "_window"},
+            "ack_hops": row.get("ack_hop_decomposition_ms"),
+            "tracing_overhead_pct": res.get("tracing_overhead_pct"),
+        }))
+    elif "--e2e-r09" in sys.argv:
         res = emit_round9()
         row = res["e2e_storm_10k_docs"]
         print(json.dumps({

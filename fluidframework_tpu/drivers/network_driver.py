@@ -29,6 +29,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable
 
 from ..protocol.codec import (
@@ -36,7 +37,9 @@ from ..protocol.codec import (
     decode_body,
     decode_storm_push,
     encode_frame,
+    encode_storm_frame,
     is_storm_body,
+    stamp_trace,
 )
 from ..protocol.messages import DocumentMessage, NackMessage, SequencedDocumentMessage
 from ..utils.events import TypedEventEmitter
@@ -127,6 +130,9 @@ class NetworkDocumentService:
         # self-chosen is fine — it buys fairness/ladder slots, not auth.
         import uuid
         self._client_key = uuid.uuid4().hex
+        # Set by StormStream: gates the reader-thread rx-timestamp stamp
+        # on storm pushes (plain handlers see the wire payload as-is).
+        self._stamp_storm_rx = False
         self.dispatch_lock = threading.RLock()
         self.events = TypedEventEmitter()  # "disconnect" on socket loss
 
@@ -233,6 +239,13 @@ class NetworkDocumentService:
         with self._send_lock:
             self._sock.sendall(data)
 
+    def send_storm(self, header: dict, payload) -> None:
+        """One binary storm frame down the shared socket (fire-and-
+        forget; the columnar ack arrives as a "storm_ack" pushed event)."""
+        data = encode_storm_frame(header, payload)
+        with self._send_lock:
+            self._sock.sendall(data)
+
     def _recv_exact(self, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
@@ -266,7 +279,14 @@ class NetworkDocumentService:
                     # Binary storm push (columnar acks): dispatched as a
                     # pushed event (the "storm_ack" handler key), never
                     # into the RPC waiters — its rid is the sender's
-                    # tick id, not an RPC correlation id.
+                    # tick id, not an RPC correlation id. When a trace
+                    # consumer (StormStream) is attached, the receive
+                    # timestamp is stamped HERE (reader thread) so a
+                    # traced ack's rx hop excludes dispatch queueing;
+                    # handlers without one see the wire payload
+                    # untouched.
+                    if self._stamp_storm_rx:
+                        payload["_rx_ns"] = time.monotonic_ns()
                     self._events.put(payload)
                     continue
                 self._dispatch(payload)
@@ -390,3 +410,76 @@ class NetworkDocumentService:
             self._sock.close()
         except OSError:
             pass
+
+
+class StormStream:
+    """Client half of the sampled per-op tracing plane
+    (connectionTelemetry.ts op round-trip latency, columnar): sends
+    storm frames over a :class:`NetworkDocumentService` socket and
+    stamps a trace id on every ``sample_every``-th frame
+    (``sample_every=0`` disables tracing). When the traced ack returns,
+    the server's hop marks (monotonic ns, same host clock domain) join
+    with the client's own send/receive timestamps into one end-to-end
+    span on :attr:`tracer` — ack latency decomposed into
+    send→ingress→admit→dispatch→sequenced[→durable]→ack_tx→rx.
+
+    Registers itself as the service's ``storm_ack`` handler; pass
+    ``on_ack`` to also observe every ack payload (traced or not).
+    """
+
+    def __init__(self, service: NetworkDocumentService,
+                 sample_every: int = 64,
+                 on_ack: Callable[[dict], None] | None = None) -> None:
+        from ..utils import TraceSpans
+        self._service = service
+        self.sample_every = max(0, sample_every)
+        self._on_ack = on_ack
+        self._sent = 0
+        self._next_tc = itertools.count(1)
+        # Guarded: submit() runs on the app thread while _handle_ack
+        # pops on the dispatcher thread.
+        self._send_lock = threading.Lock()
+        self._send_ns: dict[Any, int] = {}
+        self.tracer = TraceSpans()
+        self.acked = 0
+        service._handlers["storm_ack"] = self._handle_ack
+        service._stamp_storm_rx = True
+
+    #: Outstanding traced sends kept at most this many: a sampled frame
+    #: whose ack never comes back (admission nack, disconnect) must not
+    #: leak its send timestamp forever.
+    MAX_PENDING_TRACES = 1024
+
+    def submit(self, docs: list, payload, rid=None):
+        """One storm frame: ``docs`` is the header doc list
+        ([[doc_id, client_id, cseq0, ref_seq, count], ...]), ``payload``
+        the packed op words. Returns the trace id when this frame drew
+        the sample, else None."""
+        header = {"op": "storm", "rid": rid, "docs": docs}
+        tc = None
+        if self.sample_every and self._sent % self.sample_every == 0:
+            tc = next(self._next_tc)
+            stamp_trace(header, tc)
+            with self._send_lock:
+                while len(self._send_ns) >= self.MAX_PENDING_TRACES:
+                    self._send_ns.pop(next(iter(self._send_ns)), None)
+                self._send_ns[tc] = time.monotonic_ns()
+        self._sent += 1
+        self._service.send_storm(header, payload)
+        return tc
+
+    def _handle_ack(self, payload: dict) -> None:
+        rx_ns = payload.pop("_rx_ns", None) or time.monotonic_ns()
+        self.acked += 1
+        tc = payload.get("tc")
+        with self._send_lock:
+            send_ns = self._send_ns.pop(tc, None) if tc is not None \
+                else None
+        if send_ns is not None and isinstance(payload.get("hops"), dict):
+            self.tracer.mark(tc, "client_send", send_ns)
+            for hop, t_ns in payload["hops"].items():
+                self.tracer.mark(tc, hop, t_ns)
+            self.tracer.mark(tc, "client_rx", rx_ns)
+            self.tracer.finish(tc, rid=payload.get("rid"))
+        if self._on_ack is not None:
+            self._on_ack(payload)

@@ -516,7 +516,7 @@ class ShardedServing:
         if not self._mixed:
             gather = np.arange(lo, hi, dtype=np.int32)
             (self.seq_state, self.map_state, n_seq, first, last,
-             _msn, _bad) = _storm_tick(
+             _msn, _bad, _kstats) = _storm_tick(
                 self.seq_state, self.map_state, put(slot), put(cseq0),
                 put(ref), put(np.full(b_local, now, np.int32)),
                 put(seq_counts), put(gather), put(map_words),

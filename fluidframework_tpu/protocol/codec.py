@@ -159,6 +159,28 @@ STORM_MAGIC = 0x00
 _STORM_HDR = struct.Struct("<I")
 STORM_ACK_OP = "storm_ack"
 
+#: Trace-context header field: 1-in-N sampled storm frames carry an
+#: opaque trace id under this key; the serving stack timestamps the
+#: frame at every hop and the traced ack carries the joined marks back
+#: ("tc" + "hops" in the ack header). Version tolerance is BY
+#: CONSTRUCTION: the storm header is JSON, so a decoder that predates
+#: the field carries it through untouched and a consumer that predates
+#: it ignores it — no frame-format version bump (the binary layout is
+#: unchanged; see tests/test_storm_codec.py trace-context suite).
+TRACE_KEY = "tc"
+
+
+def stamp_trace(header: dict, trace_id) -> dict:
+    """Stamp a trace context onto a storm frame header (client side of
+    the sampled per-op tracing plane); returns the header for chaining."""
+    header[TRACE_KEY] = trace_id
+    return header
+
+
+def trace_context(header: dict):
+    """The frame's sampled trace id, or None when untraced."""
+    return header.get(TRACE_KEY)
+
 
 def is_storm_body(body) -> bool:
     return len(body) > 6 and body[0] == STORM_MAGIC

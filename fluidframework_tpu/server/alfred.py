@@ -32,6 +32,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
+import time
 from typing import Any
 
 from ..protocol.codec import (
@@ -83,10 +84,14 @@ class RequestSession:
         """Close this session's transport (service-initiated disconnect,
         e.g. slow-consumer eviction). Subclasses owning a socket override."""
 
-    def handle_binary(self, body: bytes) -> dict | None:
+    def handle_binary(self, body: bytes,
+                      ingress_ns: int | None = None) -> dict | None:
         """A storm frame (codec.is_storm_body): columnar op batch into the
         service's fast path. The ack is pushed after the tick that
-        sequences it; None = no immediate response."""
+        sequences it; None = no immediate response. ``ingress_ns`` is the
+        transport's receive timestamp (monotonic ns) so the stage ledger
+        attributes the codec decode to ingress_decode (None is fine —
+        submit_frame defaults to its own entry time)."""
         from ..protocol.codec import decode_storm_body
 
         storm = getattr(self.server.service, "storm", None)
@@ -102,7 +107,8 @@ class RequestSession:
             # client-controlled header.
             storm.submit_frame(
                 self.push, header, payload, tenant_id=self.tenant_id,
-                client_id=getattr(self.connection, "client_id", None))
+                client_id=getattr(self.connection, "client_id", None),
+                ingress_ns=ingress_ns)
         except Exception as err:
             # The error must answer the offending frame and keep the
             # socket alive — exactly like the JSON request path.
@@ -347,7 +353,8 @@ class AlfredServer:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 if is_storm_body(body):
-                    resp = session.handle_binary(body)
+                    resp = session.handle_binary(
+                        body, ingress_ns=time.monotonic_ns())
                     if resp is not None:
                         session.push(resp)
                     continue

@@ -120,12 +120,16 @@ class BridgeFrontDoor:
             self._handle_data(conn_id, body)
 
     def _handle_data(self, conn_id: int, body: bytes) -> None:
+        # Bridge-ingress timestamp: stamped BEFORE the codec decode so a
+        # sampled trace's first hop (and the ledger's ingress_decode
+        # split) covers the decode itself.
+        t_rx = time.monotonic_ns()
         session = self._sessions.get(conn_id)
         if session is None:
             return
         if is_storm_body(body):
             try:
-                resp = session.handle_binary(body)
+                resp = session.handle_binary(body, ingress_ns=t_rx)
             except Exception as err:
                 self.logger.send_error("BridgeStormFailed", err)
                 resp = {"rid": None, "error": repr(err)}

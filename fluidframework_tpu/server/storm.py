@@ -53,6 +53,7 @@ from ..ops import mergetree_kernel as mtk
 from ..ops import opcodes as oc
 from ..ops import sequencer as seqk
 from ..ops import tree_kernel as tk
+from ..protocol.codec import TRACE_KEY, trace_context
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from ..utils import compile_cache, faults
 from .kernel_host import KernelSequencerHost, _next_pow2
@@ -117,6 +118,8 @@ class _Frame(NamedTuple):
     words: np.ndarray   # u32[sum(counts)] VIEW aliasing the receive buffer
     counts: np.ndarray  # i32[n_docs] per-doc op counts
     meta: np.ndarray    # i32[n_docs, 3] (cseq0, ref, count) columns
+    trace: Any = None   # (client tc, session scope) tracer key or None
+    staged_ns: tuple = (0, 0)  # (decode, admit) ns refunded on shed
 
 
 def _map_leg(map_state: mk.MapState, words, lo, hi, seq0_for):
@@ -290,7 +293,17 @@ def _storm_tick(seq_state: seqk.SequencerState, map_state: mk.MapState,
                       & ((map_state.vseq < 0) | (map_state.value < 0)),
                       axis=1)
     bad = drift | corrupt
-    return seq_state, map_state, n_seq, first, last, msn, bad
+    # Device-side kernel counter plane: three VPU reduces packed into ONE
+    # tiny i32[3] output riding the tick's EXISTING readback batch (zero
+    # extra device syncs) — total sequenced, duplicate-dropped ops, and
+    # sentinel-tripped docs. Rows with no batch this tick gather row 0's
+    # ticket values, so every reduce masks on map_counts > 0.
+    live = map_counts > 0
+    kstats = jnp.stack((
+        jnp.sum(jnp.where(live, n_seq, 0)),
+        jnp.sum(jnp.where(live, jnp.minimum(dups_for, map_counts), 0)),
+        jnp.sum(jnp.where(live, bad, False).astype(I32))))
+    return seq_state, map_state, n_seq, first, last, msn, bad, kstats
 
 
 _storm_tick = compile_cache.uncached(_storm_tick)
@@ -339,7 +352,8 @@ class StormController:
                  snapshot_interval_ticks: int | None = None,
                  admission=None,
                  max_pending_docs: int | None = None,
-                 busy_retry_s: float = 0.05) -> None:
+                 busy_retry_s: float = 0.05,
+                 logger=None) -> None:
         self.service = service
         self.seq_host = seq_host
         self.merge_host = merge_host
@@ -394,9 +408,10 @@ class StormController:
         self.durability = durability
         self._blob_log = None
         self._group_wal = None
-        # (tick_id, [(frame, ack payload)]) awaiting the durability
-        # watermark — drained in tick order on the serving thread.
-        self._unacked: list[tuple[int, list]] = []
+        # (tick_id, [(frame, ack payload)], harvest_ns, ledger record)
+        # awaiting the durability watermark — drained in tick order on
+        # the serving thread.
+        self._unacked: list[tuple[int, list, int, dict | None]] = []
         if spill_dir is not None:
             import pathlib
 
@@ -458,6 +473,27 @@ class StormController:
                       "degraded_rejects": 0}
         self.tick_seconds: list[float] = []  # submit→harvest per round
         self.harvest_intervals: list[float] = []  # completion cadence
+        # Observability plane (the round-10 tentpole): one fixed-shape
+        # stage record per tick into a ring buffer + per-stage Histograms
+        # in the shared registry (alfred get_metrics exports them; the
+        # monitor renders the attribution bar), and a per-op trace joiner
+        # for frames that carry a sampled trace id ("tc" header field).
+        from ..utils import NullLogger, StageLedger, TraceSpans
+        self.logger = logger if logger is not None else NullLogger()
+        self.ledger = StageLedger(registry=merge_host.metrics,
+                                  prefix="storm.stage")
+        self.tracer = TraceSpans(logger=self.logger)
+        self._trace_seq = 0  # per-submission tracer-key disambiguator
+        # Server-side sampling cap: the CLIENT picks which frames carry
+        # a trace id, but one connection stamping every frame must not
+        # commandeer the serving thread's tracer (hop observes, span
+        # ring) — past this many traced frames per tick round, extra
+        # trace ids are ignored (the frame still serves normally).
+        self.max_traces_per_tick = 64
+        self._traced_pending = 0
+        # ingress decode / admission ns spent on frames buffered toward
+        # the NEXT tick (consumed by its ledger record at flush).
+        self._staged_ns = {"ingress_decode": 0, "admission": 0}
         # Depth-N pipeline (SURVEY §7 hard part (c)): a tick's readbacks,
         # durable records and acks are harvested only after N later
         # ticks' device work is enqueued, so the host↔device round trip
@@ -477,7 +513,8 @@ class StormController:
     def submit_frame(self, push: Callable[[dict], None] | None,
                      header: dict, payload: memoryview,
                      tenant_id: str = "default",
-                     client_id: str | None = None) -> None:
+                     client_id: str | None = None,
+                     ingress_ns: int | None = None) -> None:
         """One decoded storm frame from a session; ack is pushed after the
         tick that sequences it. Malformed frames raise ValueError BEFORE
         anything is buffered — a bad frame must fail alone, never poison
@@ -487,7 +524,15 @@ class StormController:
         come from the SESSION (token-validated tenant, service-assigned
         client id) — never from the frame header, which the client
         controls (a self-stamped tenant would mint itself a fresh bucket
-        per frame)."""
+        per frame).
+
+        ``ingress_ns`` is the transport's receive timestamp
+        (``time.monotonic_ns``), stamped BEFORE the codec decode so the
+        ledger's ingress_decode split covers it (None = entry here); a
+        frame whose header carries a sampled trace id (``"tc"``) gets
+        its ingress/admit hops marked on the controller's tracer."""
+        if ingress_ns is None:
+            ingress_ns = time.monotonic_ns()
         entries = header.get("docs")
         if not isinstance(entries, list) or not entries:
             raise ValueError("storm frame without docs")
@@ -496,7 +541,11 @@ class StormController:
         for entry in entries:
             if not (isinstance(entry, (list, tuple)) and len(entry) == 5):
                 raise ValueError(f"bad storm doc entry: {entry!r}")
-            doc_id, client_id, cseq0, ref_seq, count = entry
+            # NB the entry's writer id must NOT rebind the ``client_id``
+            # parameter — admission below keys on the SESSION identity,
+            # and a shadowing loop variable would hand the throttle a
+            # client-chosen string (fresh token bucket per frame).
+            doc_id, doc_client, cseq0, ref_seq, count = entry
             count = int(count)
             if not 0 < count <= self.MAX_COUNT:
                 raise ValueError(f"bad storm count {count} for {doc_id!r}")
@@ -506,7 +555,7 @@ class StormController:
                 # drop the first batch while acking it as sequenced.
                 raise ValueError(f"doc {doc_id!r} repeats within one frame")
             seen.add(doc_id)
-            docs.append((str(doc_id), str(client_id), int(cseq0),
+            docs.append((str(doc_id), str(doc_client), int(cseq0),
                          int(ref_seq), count))
         # Columnar from here down: ONE payload view + per-doc count/meta
         # arrays — no per-doc np.frombuffer, no byte copy (the words view
@@ -526,13 +575,46 @@ class StormController:
         # Admission gates run AFTER validation (a malformed frame is the
         # sender's error, not overload) and only on live traffic — replay
         # (recovery / readmit) re-runs already-admitted history.
+        # The tracer key pairs the client's id with a PER-SUBMISSION
+        # counter: clients choose their trace ids independently (two
+        # connections sampling the same small integer must never
+        # interleave marks on one span), and a shed frame's orphaned
+        # marks can never be joined by a later frame reusing the id.
+        # The ack carries back the client's raw id (_stamp_trace_ack
+        # unpacks the tuple).
+        tc = None if self._replay else trace_context(header)
+        if not isinstance(tc, (int, str)):
+            tc = None  # the field is client-opaque JSON; the tracer
+            # keys a dict on it, so unhashable shapes are ignored — a
+            # valid frame must never be nacked over its trace id.
+        trace = None
+        staged = (0, 0)
+        t_validated = time.monotonic_ns()
         if not self._replay:
             retry = self._admit(push, header, docs, offset,
                                 tenant_id, client_id)
+            t_admitted = time.monotonic_ns()
             if retry is not None:
-                return
+                return  # shed: its decode/admit ns never reaches a tick
+            # Charged only once the frame is BUFFERED — shed frames'
+            # time must not inflate a surviving tick's attribution (a
+            # frame shed LATER, at quarantine, refunds via staged_ns).
+            # The trace slot likewise allocates only now: a traced-but-
+            # shed frame must not consume the per-tick cap (tracing
+            # would starve during exactly the overload it should
+            # diagnose).
+            staged = (t_validated - ingress_ns, t_admitted - t_validated)
+            self._staged_ns["ingress_decode"] += staged[0]
+            self._staged_ns["admission"] += staged[1]
+            if tc is not None \
+                    and self._traced_pending < self.max_traces_per_tick:
+                trace = (tc, self._trace_seq)
+                self._trace_seq += 1
+                self._traced_pending += 1
+                self.tracer.mark(trace, "ingress", ingress_ns)
+                self.tracer.mark(trace, "admit", t_admitted)
         self._frames.append(_Frame(push, header.get("rid"), docs, words,
-                                   counts, meta))
+                                   counts, meta, trace, staged))
         self._pending_docs += len(docs)
         self.stats["submitted_ops"] += offset
         if self._pending_docs >= self.flush_threshold_docs:
@@ -661,11 +743,36 @@ class StormController:
         thread, so session pushes stay single-threaded."""
         dw = self._group_wal.durable_len
         while self._unacked and self._unacked[0][0] < dw:
-            _tick, acks = self._unacked.pop(0)
+            _tick, acks, t_harvested, led = self._unacked.pop(0)
+            t_drain = time.monotonic_ns()
+            if led is not None:
+                # The tick's commit-wait: harvest done → fsync watermark
+                # passed (the acked-durable latency the ledger attributes).
+                self.ledger.amend(led, "wal_commit_wait",
+                                  t_drain - t_harvested)
             faults.crashpoint("storm.pre_ack")
             for frame, payload in acks:
                 payload["dw"] = dw
+                if frame.trace is not None:
+                    self.tracer.mark(frame.trace, "durable", t_drain)
+                    self._stamp_trace_ack(frame, payload)
                 frame.push(payload)
+
+    def _stamp_trace_ack(self, frame: _Frame, payload: dict) -> None:
+        """Finish a sampled frame's span at ack transmit: the joined hop
+        marks ride the ack header ("tc" + "hops", monotonic ns — clients
+        on the same host join their send/rx clocks in), the hop deltas
+        feed ``storm.hop.*`` histograms, and the span record goes out
+        through the telemetry logger."""
+        self.tracer.mark(frame.trace, "ack_tx")
+        span = self.tracer.finish(frame.trace)
+        if span is None:
+            return
+        payload[TRACE_KEY] = frame.trace[0]  # the client's raw id
+        payload["hops"] = span["hops"]
+        metrics = self.merge_host.metrics
+        for name, ms in span["deltas_ms"].items():
+            metrics.histogram(f"storm.hop.{name}").observe(ms / 1000.0)
 
     def _flush_round(self, require_full: bool = False) -> bool:
         """One fused tick over every buffered frame, deferring repeat
@@ -685,6 +792,7 @@ class StormController:
             # harvest path mid-outage.
             return False
         round_start = _time.perf_counter()
+        queue_depth = self._pending_docs
         frames, self._frames, self._pending_docs = self._frames, [], 0
         # Bus-path ops already admitted must sequence first (per-doc total
         # order is shared between the storm and per-op paths).
@@ -716,10 +824,28 @@ class StormController:
             self._frames = frames + self._frames
             self._pending_docs += sum(len(f.docs) for f in frames)
             return False
-        self._frames.extend(deferred)
+        # A deferred frame's staged decode/admit ns is consumed by THIS
+        # round's record (it was already pooled) — zero it on the frame
+        # so a later quarantine shed refunds exactly what is still
+        # staged, never double-subtracting.
+        self._frames.extend(f._replace(staged_ns=(0, 0))
+                            for f in deferred)
         self._pending_docs += sum(len(f.docs) for f in deferred)
         if not descs:
             return True
+        # Stage ledger: the tick that runs consumes the decode/admission
+        # ns staged by its frames' submit_frame calls (a frame DEFERRED
+        # to the next round charges the round it was decoded in —
+        # attribution, not exact accounting); scatter starts now. Replay
+        # rounds record nothing and must not steal ns staged by live
+        # frames (readmit replays interleave with serving).
+        if self._replay:
+            stage_ns = {}
+        else:
+            stage_ns = dict(self._staged_ns)
+            self._staged_ns = {"ingress_decode": 0, "admission": 0}
+            self._traced_pending = 0  # next round gets a fresh cap
+        t_scatter0 = _time.monotonic_ns()
 
         seq_host, merge_host = self.seq_host, self.merge_host
         # WAL replay re-runs the tick with its RECORDED timestamp so the
@@ -792,8 +918,9 @@ class StormController:
                     pos += 1
 
         seq_host._host_state = None  # device state is about to move
+        t_dispatch0 = _time.monotonic_ns()
         (seq_host._state, merge_host._xstate, n_seq, first, last,
-         msn, bad) = _storm_tick(
+         msn, bad, kstats) = _storm_tick(
             seq_host._state, merge_host._xstate,
             jnp.asarray(slot_full), jnp.asarray(cseq0_full),
             jnp.asarray(ref_full), jnp.asarray(ts_full),
@@ -810,11 +937,19 @@ class StormController:
             descs=descs, frame_words=frame_words, counts=counts_col,
             map_rows=map_rows, mrows=mrows,
             acks=acks, now=now, submitted=int(counts_col.sum()),
-            out=(n_seq, first, last, msn, bad), start=round_start)
+            out=(n_seq, first, last, msn, bad, kstats), start=round_start,
+            stage_ns=stage_ns, queue_depth=queue_depth)
         for out_arr in rec["out"]:
             copy_async = getattr(out_arr, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()
+        t_dispatched = _time.monotonic_ns()
+        stage_ns["scatter"] = t_dispatch0 - t_scatter0
+        stage_ns["device_dispatch"] = t_dispatched - t_dispatch0
+        if not self._replay:
+            for frame, _i0, _i1 in acks:
+                if frame.trace is not None:
+                    self.tracer.mark(frame.trace, "dispatch", t_dispatched)
         self._inflight.append(rec)
         while len(self._inflight) > self.pipeline_depth:
             self._harvest_one(self._inflight.pop(0))
@@ -827,7 +962,15 @@ class StormController:
     def _harvest_one(self, rec: dict) -> None:
         import time as _time
 
-        n_seq, first, last, msn, bad = (np.asarray(a) for a in rec["out"])
+        t_read0 = _time.monotonic_ns()
+        n_seq, first, last, msn, bad, kstats = (np.asarray(a)
+                                                for a in rec["out"])
+        # Device-side kernel counters (the i32[3] stats plane riding this
+        # readback): sequenced / dup-dropped / sentinel docs, device-true.
+        kstats = kstats.tolist()
+        t_readback = _time.monotonic_ns()
+        stage_ns = rec.get("stage_ns", {})
+        stage_ns["readback"] = t_readback - t_read0
         map_rows = rec["map_rows"]
         # ONE batched gather+pack builds the tick's per-doc ack matrix
         # (n_seq, first, last, msn) — the columnar twin of
@@ -844,6 +987,10 @@ class StormController:
         bad_rows = bad[map_rows]
         any_bad = bool(bad_rows.any())
         bad_l = bad_rows.tolist()
+        if not self._replay:
+            for frame, _i0, _i1 in rec["acks"]:
+                if frame.trace is not None:
+                    self.tracer.mark(frame.trace, "sequenced", t_readback)
         fanout = self.service.fanout
         now = rec["now"]
         mrows = rec["mrows"]
@@ -886,6 +1033,8 @@ class StormController:
                 # broadcaster: compact tick frame into the pub/sub hop.
                 if pubs is not None:
                     pubs.append((doc, b"\x00storm%d:%d:%d" % (fs, ls, m)))
+        t_assembled = _time.monotonic_ns()
+        stage_ns["ack_pack"] = t_assembled - t_readback
         if pubs:
             # O(batch) broadcast: the whole tick's room publishes go down
             # in ONE native call (fanout_publish_batch) — never one
@@ -896,6 +1045,8 @@ class StormController:
             else:  # duck-typed fanout without the batch surface
                 for room, body in pubs:
                     fanout.publish(room, body)
+        t_fanout = _time.monotonic_ns()
+        stage_ns["fanout_publish"] = t_fanout - t_assembled
         import json as _json
         import struct as _struct
 
@@ -919,10 +1070,16 @@ class StormController:
             idx = self._blob_log.append(blob_bytes)
             assert idx == tick_id, (idx, tick_id)
             if self.durability == "sync":
+                t_sync0 = _time.monotonic_ns()
                 self._blob_log.sync()
+                stage_ns["wal_commit_wait"] = (_time.monotonic_ns()
+                                               - t_sync0)
         else:
             self._tick_blobs[tick_id] = prefix + b"".join(
                 bytes(memoryview(p)) for p in word_parts)
+        t_wal = _time.monotonic_ns()
+        stage_ns["wal_append"] = (t_wal - t_fanout
+                                  - stage_ns.get("wal_commit_wait", 0))
         # Stats BEFORE acks: once an ack leaves the process, this host's
         # bookkeeping must already reflect the tick (clients/tests react
         # to acks immediately).
@@ -933,6 +1090,12 @@ class StormController:
         # host's routing stats so scalar_fraction spans BOTH ingest paths.
         self.merge_host.stats["device_ops"] += total_seq
         self.merge_host.metrics.counter("storm.sequenced_ops").inc(total_seq)
+        # Device-true counters from the kstats plane (vs the host-derived
+        # stats above — a drift between the two is itself a signal).
+        kmetrics = self.merge_host.metrics
+        kmetrics.counter("storm.device.sequenced_ops").inc(kstats[0])
+        kmetrics.counter("storm.device.dup_ops").inc(kstats[1])
+        kmetrics.counter("storm.device.sentinel_docs").inc(kstats[2])
         done = _time.perf_counter()
         self.tick_seconds.append(done - rec["start"])
         if self._last_harvest is not None:
@@ -942,6 +1105,7 @@ class StormController:
         # matrix — a StormAck that session push paths binary-encode
         # without ever building per-doc dicts.
         from ..protocol.codec import StormAck
+        t_ack0 = _time.monotonic_ns()
         acks = []
         for frame, i0, i1 in rec["acks"]:
             if frame.push is None:
@@ -956,17 +1120,30 @@ class StormController:
                     rec["descs"][i][0] for i in range(i0, i1) if bad_l[i]]
                 payload["retry_after_s"] = self.busy_retry_s
             acks.append((frame, payload))
+        t_harvest_done = _time.monotonic_ns()
+        stage_ns["ack_pack"] += t_harvest_done - t_ack0
+        # Commit the tick's ledger record (fixed shape; replay ticks are
+        # reconstruction, not serving — they don't pollute attribution).
+        # Group-mode commit-wait is unknown until the fsync watermark
+        # passes the tick; the drain backfills it on the record object.
+        led = None
+        if not self._replay:
+            led = self.ledger.record(tick_id, rec.get("queue_depth", 0),
+                                     len(rec["descs"]), rec["submitted"],
+                                     stage_ns)
         if self._group_wal is not None and not self._replay:
             # Withhold until fsynced — then deliver in tick order with the
             # durability watermark stamped on (clients resubmit anything
             # above the watermark after a reconnect).
-            self._unacked.append((tick_id, acks))
+            self._unacked.append((tick_id, acks, t_harvest_done, led))
             self._drain_durable_acks()
         else:
             dw = self.durable_watermark
             for frame, payload in acks:
                 faults.crashpoint("storm.pre_ack")
                 payload["dw"] = dw
+                if frame.trace is not None:
+                    self._stamp_trace_ack(frame, payload)
                 frame.push(payload)
 
     # -- snapshot / recovery ---------------------------------------------------
@@ -1154,6 +1331,13 @@ class StormController:
                 kept.append(frame)
                 continue
             self._pending_docs -= len(frame.docs)
+            # Refund the shed frame's staged ledger ns and trace slot:
+            # a tick that never served it must not inherit its
+            # attribution, and its sampling-cap slot frees for peers.
+            self._staged_ns["ingress_decode"] -= frame.staged_ns[0]
+            self._staged_ns["admission"] -= frame.staged_ns[1]
+            if frame.trace is not None:
+                self._traced_pending = max(0, self._traced_pending - 1)
             self._shed(frame.push, {"rid": frame.rid},
                        sum(n for *_, n in frame.docs), "quarantined",
                        self.busy_retry_s,

@@ -4,12 +4,16 @@ Reference parity: server/service-monitor (the routerlicious monitoring
 satellite) collapsed to its useful core: a poller that scrapes the
 assembly's metrics registry through the front door (``get_metrics`` — the
 alfred analog of a /metrics endpoint) and renders deltas, so an operator
-can watch sequencing/broadcast/merge-host rates live.
+can watch sequencing/broadcast/merge-host rates live. Round 10 adds the
+storm stage ledger: the per-stage histograms (``storm.stage.*``) render
+as a live attribution bar — which hop of the serving tick eats the
+budget — plus ``--json`` for the machine-readable line format.
 
 Usage::
 
-    python -m fluidframework_tpu.tools.monitor --port 7070            # watch
-    python -m fluidframework_tpu.tools.monitor --port 7070 --once     # scrape
+    python -m fluidframework_tpu.tools.monitor --port 7070          # watch
+    python -m fluidframework_tpu.tools.monitor --port 7070 --json   # lines
+    python -m fluidframework_tpu.tools.monitor --port 7070 --once   # scrape
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ import sys
 import time
 
 from ..protocol.codec import decode_body, encode_frame
+from ..utils.metrics import STORM_STAGES
 
 
 def scrape(host: str, port: int, timeout: float = 10.0) -> dict:
@@ -45,31 +50,142 @@ def _recv_exactly(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+def stage_shares(metrics: dict,
+                 prev: dict | None = None) -> dict[str, float]:
+    """Per-stage share of attributed tick time from a metrics snapshot
+    (the ``storm.stage.<name>.mean``/``.count`` histogram exports);
+    empty when the scrape carries no stage ledger. With ``prev`` the
+    shares cover only the time attributed SINCE that snapshot — the
+    live window a watcher wants (cumulative shares stop moving as
+    uptime grows); falls back to cumulative when the window saw no
+    ticks."""
+    def totals(snap):
+        return {stage: snap.get(f"storm.stage.{stage}.mean", 0.0)
+                * snap.get(f"storm.stage.{stage}.count", 0.0)
+                for stage in STORM_STAGES}
+
+    now_t = totals(metrics)
+    if prev is not None:
+        window = {s: now_t[s] - t for s, t in totals(prev).items()}
+        # Any negative per-stage window means the service restarted
+        # (registry reset) — the diff is meaningless, not just empty:
+        # mixed signs could pass a sum>0 check and render shares
+        # outside [0, 1]. Fall back to the fresh cumulative totals.
+        if sum(window.values()) > 0 \
+                and all(v >= 0 for v in window.values()):
+            now_t = window
+    grand = sum(now_t.values())
+    if grand <= 0:
+        return {}
+    return {s: t / grand for s, t in now_t.items()}
+
+
+def render_stage_bar(metrics: dict, width: int = 52,
+                     prev: dict | None = None) -> str:
+    """The live stage-attribution view: one proportional bar over the
+    stage shares (windowed vs ``prev`` when given) plus a per-stage
+    p50/p99 table (ms, cumulative histograms)."""
+    shares = stage_shares(metrics, prev)
+    if not shares:
+        return "stage ledger: (no storm ticks yet)"
+    glyphs = "#=+*o.:%~-"
+    bar = ""
+    legend = []
+    for i, stage in enumerate(STORM_STAGES):
+        share = shares.get(stage, 0.0)
+        cells = int(round(share * width))
+        g = glyphs[i % len(glyphs)]
+        bar += g * cells
+        p50 = metrics.get(f"storm.stage.{stage}.p50", 0.0) * 1e3
+        p99 = metrics.get(f"storm.stage.{stage}.p99", 0.0) * 1e3
+        legend.append(f"  {g} {stage:<16} {100 * share:5.1f}%"
+                      f"  p50 {p50:8.3f}ms  p99 {p99:8.3f}ms")
+    lines = [f"stage ledger  [{bar:<{width}}]"]
+    lines.extend(legend)
+    return "\n".join(lines)
+
+
+def render_human(now: dict, prev: dict, interval: float) -> str:
+    """Operator view of one poll: headline rates (per-second deltas of
+    the interesting counters), the stage bar, and the hop decomposition
+    when sampled tracing is live."""
+    lines = [f"-- {time.strftime('%H:%M:%S')} " + "-" * 40]
+    rates = []
+    per_s = max(interval, 1e-9)
+    for name in sorted(now):
+        value = now[name]
+        if name.rsplit(".", 1)[-1] in ("p50", "p99", "mean", "max"):
+            continue  # histogram exports are levels, not counters — a
+            # grown p99 is not a rate.
+        if name in prev and isinstance(value, (int, float)) \
+                and value > prev[name]:
+            rates.append((value - prev[name], name))
+    if rates:
+        # Busiest counters first — alphabetical order would crowd the
+        # display with whichever subsystem sorts earliest.
+        rates.sort(reverse=True)
+        lines.append("rates:")
+        lines.extend(f"  {name:<32} +{delta / per_s:,.1f}/s"
+                     for delta, name in rates[:16])
+    lines.append(render_stage_bar(now, prev=prev or None))
+    hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
+                       if k.startswith("storm.hop.")})
+    if hop_keys:
+        lines.append("sampled op hops (ack latency decomposition):")
+        for base in hop_keys:
+            p50 = now.get(f"{base}.p50", 0.0) * 1e3
+            p99 = now.get(f"{base}.p99", 0.0) * 1e3
+            n = int(now.get(f"{base}.count", 0))
+            lines.append(f"  {base.removeprefix('storm.hop.'):<28}"
+                         f" p50 {p50:8.3f}ms  p99 {p99:8.3f}ms  n={n}")
+    return "\n".join(lines)
+
+
 def watch(host: str, port: int, interval: float,
-          out=sys.stdout) -> None:
-    """Poll forever, printing each scrape (absolute values) plus the
-    per-interval increase of every metric that grew — the monotonic
-    counters' rates — under ``"+<name>"`` keys. Gauges and histogram
-    percentiles stay absolute (a snapshot cannot tell the kinds apart)."""
+          out=sys.stdout, as_json: bool = False,
+          max_polls: int | None = None) -> None:
+    """Poll forever (or ``max_polls`` times — the testable bound).
+
+    ``--json`` keeps the original machine format: each scrape as one
+    JSON line (absolute values) plus ``"+<name>"`` keys for the
+    per-interval increase of every metric that grew. The default human
+    mode renders rates + the stage-attribution bar. Either way a
+    restarting service must not kill the watcher: scrape failures
+    report and retry on the next interval (reconnect-on-restart)."""
     prev: dict = {}
-    while True:
+    prev_t: float | None = None
+    polls = 0
+    while max_polls is None or polls < max_polls:
+        polls += 1
         try:
             now = scrape(host, port)
         except (OSError, ConnectionError) as err:
             # A restarting service must not kill the watcher; report and
             # retry on the next interval.
-            print(json.dumps({"ts": round(time.time(), 1),
-                              "unreachable": repr(err)}),
-                  file=out, flush=True)
+            if as_json:
+                print(json.dumps({"ts": round(time.time(), 1),
+                                  "unreachable": repr(err)}),
+                      file=out, flush=True)
+            else:
+                print(f"-- unreachable ({err!r}); retrying in "
+                      f"{interval}s", file=out, flush=True)
             time.sleep(interval)
             continue
-        line: dict = {name: value for name, value in sorted(now.items())}
-        for name, value in now.items():
-            if name in prev and value > prev[name]:
-                line[f"+{name}"] = round(value - prev[name], 3)
-        print(json.dumps({"ts": round(time.time(), 1), **line}),
-              file=out, flush=True)
+        now_t = time.monotonic()
+        if as_json:
+            line: dict = {name: value for name, value in sorted(now.items())}
+            for name, value in now.items():
+                if name in prev and value > prev[name]:
+                    line[f"+{name}"] = round(value - prev[name], 3)
+            print(json.dumps({"ts": round(time.time(), 1), **line}),
+                  file=out, flush=True)
+        else:
+            # Rates divide by the MEASURED gap between scrapes — a slow
+            # scrape on a loaded service must not overstate them.
+            elapsed = now_t - prev_t if prev_t is not None else interval
+            print(render_human(now, prev, elapsed), file=out, flush=True)
         prev = now
+        prev_t = now_t
         time.sleep(interval)
 
 
@@ -80,12 +196,15 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--interval", type=float, default=5.0)
     parser.add_argument("--once", action="store_true",
                         help="print one scrape as JSON and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="watch in machine format: one JSON line per "
+                             "poll with +deltas for grown counters")
     args = parser.parse_args(argv)
     if args.once:
         print(json.dumps(scrape(args.host, args.port), indent=1,
                          sort_keys=True))
         return
-    watch(args.host, args.port, args.interval)
+    watch(args.host, args.port, args.interval, as_json=args.json)
 
 
 if __name__ == "__main__":

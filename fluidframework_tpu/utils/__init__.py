@@ -7,10 +7,12 @@ services-core/src/metricClient.ts, services-utils (nconf config).
 from .config import Config, default_config
 from .events import BatchManager, Deferred, Heap, TypedEventEmitter
 from .metrics import (
+    STORM_STAGES,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
+    StageLedger,
     default_registry,
 )
 from .telemetry import (
@@ -22,6 +24,7 @@ from .telemetry import (
     PerformanceEvent,
     PerfTrace,
     TelemetryLogger,
+    TraceSpans,
     timed,
 )
 
@@ -43,7 +46,10 @@ __all__ = [
     "NullLogger",
     "PerformanceEvent",
     "PerfTrace",
+    "StageLedger",
+    "STORM_STAGES",
     "TelemetryLogger",
     "timed",
+    "TraceSpans",
     "TypedEventEmitter",
 ]

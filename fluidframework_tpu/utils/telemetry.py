@@ -12,8 +12,10 @@ follow the reference: "generic" | "performance" | "error".
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
+import threading
 import time
 from typing import Any, Callable
 
@@ -206,6 +208,91 @@ class PerformanceEvent:
             self.cancel(exc)
         else:
             self.end()
+
+
+class TraceSpans:
+    """Per-op distributed-trace joiner (connectionTelemetry.ts op
+    round-trip spans, generalized to the storm path): sampled frames
+    carry a trace id; each hop that touches the frame calls
+    :meth:`mark` with a shared monotonic-ns clock, and :meth:`finish`
+    joins the marks into ONE span record — absolute ``hops`` (ns) plus
+    consecutive ``deltas_ms`` — emitted through the telemetry logger
+    (category "performance") and kept in a bounded ring for in-process
+    consumers (bench columns, tests).
+
+    Marks arrive from several threads (bridge pump, serving thread, WAL
+    drain); a single lock serializes the tiny dict ops. Unfinished
+    traces are evicted oldest-first past ``max_pending`` so a client
+    that dies mid-flight can never leak marks without bound.
+    """
+
+    def __init__(self, logger: TelemetryLogger | None = None,
+                 event_name: str = "OpTraceSpan",
+                 capacity: int = 4096, max_pending: int = 4096) -> None:
+        self._logger = logger or NullLogger()
+        self._event_name = event_name
+        self._marks: collections.OrderedDict = collections.OrderedDict()
+        self._max_pending = max(1, max_pending)
+        self.spans: collections.deque = collections.deque(
+            maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def now_ns() -> int:
+        return time.monotonic_ns()
+
+    def mark(self, trace_id: Any, hop: str, t_ns: int | None = None) -> None:
+        t = self.now_ns() if t_ns is None else int(t_ns)
+        with self._lock:
+            marks = self._marks.get(trace_id)
+            if marks is None:
+                while len(self._marks) >= self._max_pending:
+                    self._marks.popitem(last=False)
+                marks = self._marks[trace_id] = []
+            marks.append((hop, t))
+
+    def hops(self, trace_id: Any) -> dict:
+        """Current absolute marks of an UNFINISHED trace (hop → ns) —
+        what the server stamps onto a traced ack so the client can join
+        its own send/rx clocks in (same-host monotonic domain)."""
+        with self._lock:
+            return dict(self._marks.get(trace_id, ()))
+
+    def finish(self, trace_id: Any, **props: Any) -> dict | None:
+        """Join and emit one span; None (and no event) for an id that
+        never marked — double-finish is likewise a no-op."""
+        with self._lock:
+            marks = self._marks.pop(trace_id, None)
+        if not marks:
+            return None
+        t0 = marks[0][1]
+        deltas = {f"{a}_to_{b}": round((tb - ta) / 1e6, 4)
+                  for (a, ta), (b, tb) in zip(marks, marks[1:])}
+        span = {"trace_id": trace_id, "hops": dict(marks),
+                "deltas_ms": deltas,
+                "total_ms": round((marks[-1][1] - t0) / 1e6, 4), **props}
+        self.spans.append(span)
+        self._logger.send_performance(self._event_name, span["total_ms"],
+                                      trace_id=trace_id, **deltas)
+        return span
+
+    def hop_quantiles(self, qs=(0.5, 0.99)) -> dict:
+        """Per-hop-delta quantiles over the finished-span ring — the
+        sampled decomposition of end-to-end latency the round's bench
+        rows record: {delta_name: {"p50_ms", "p99_ms", "count"}}."""
+        by_hop: dict[str, list[float]] = {}
+        for span in list(self.spans):
+            for name, ms in span["deltas_ms"].items():
+                by_hop.setdefault(name, []).append(ms)
+        from .metrics import percentile
+        out: dict = {}
+        for name, vals in by_hop.items():
+            vals.sort()
+            row = {"count": len(vals)}
+            for q in qs:
+                row[f"p{int(q * 100)}_ms"] = round(percentile(vals, q), 4)
+            out[name] = row
+        return out
 
 
 def timed(logger: TelemetryLogger, event_name: str,

@@ -101,6 +101,97 @@ class TestServiceMonitor:
             proc.terminate()
             proc.wait(timeout=10)
 
+    def test_watch_json_mode_emits_lines_with_deltas(self, monkeypatch):
+        import io
+        import json
+
+        from fluidframework_tpu.tools import monitor
+        scrapes = iter([{"deli.sequenced_ops": 10.0},
+                        {"deli.sequenced_ops": 25.0}])
+        monkeypatch.setattr(monitor, "scrape",
+                            lambda *a, **k: next(scrapes))
+        out = io.StringIO()
+        monitor.watch("h", 1, interval=0.0, out=out, as_json=True,
+                      max_polls=2)
+        lines = [json.loads(line) for line in
+                 out.getvalue().strip().splitlines()]
+        assert lines[0]["deli.sequenced_ops"] == 10.0
+        assert "+deli.sequenced_ops" not in lines[0]
+        assert lines[1]["+deli.sequenced_ops"] == 15.0
+
+    def test_watch_reconnects_after_restart(self, monkeypatch):
+        """A restarting service must not kill the watcher: the failed
+        scrape reports and the next interval picks the service back up —
+        in BOTH output modes."""
+        import io
+        import json
+
+        from fluidframework_tpu.tools import monitor
+        for as_json in (True, False):
+            calls = {"n": 0}
+
+            def scrape(*a, **k):
+                calls["n"] += 1
+                if calls["n"] == 2:  # the restart window
+                    raise ConnectionError("refused")
+                return {"alfred.connects": float(calls["n"])}
+
+            monkeypatch.setattr(monitor, "scrape", scrape)
+            out = io.StringIO()
+            monitor.watch("h", 1, interval=0.0, out=out, as_json=as_json,
+                          max_polls=3)
+            text = out.getvalue()
+            assert calls["n"] == 3  # kept polling through the outage
+            if as_json:
+                lines = [json.loads(line)
+                         for line in text.strip().splitlines()]
+                assert "unreachable" in lines[1]
+                assert lines[2]["alfred.connects"] == 3.0
+            else:
+                assert "unreachable" in text
+                assert "alfred.connects" in text
+
+    def test_stage_bar_renders_attribution(self):
+        from fluidframework_tpu.tools.monitor import (
+            render_stage_bar, stage_shares)
+        metrics = {}
+        for stage, mean in (("device_dispatch", 0.006),
+                            ("readback", 0.003),
+                            ("wal_commit_wait", 0.001)):
+            metrics[f"storm.stage.{stage}.mean"] = mean
+            metrics[f"storm.stage.{stage}.count"] = 100.0
+            metrics[f"storm.stage.{stage}.p50"] = mean
+            metrics[f"storm.stage.{stage}.p99"] = mean * 2
+        shares = stage_shares(metrics)
+        assert abs(shares["device_dispatch"] - 0.6) < 1e-9
+        assert abs(sum(shares.values()) - 1.0) < 1e-9
+        text = render_stage_bar(metrics)
+        assert "device_dispatch" in text and "60.0%" in text
+        assert "p99" in text
+        # No ticks yet: the bar degrades, never divides by zero.
+        assert "no storm ticks" in render_stage_bar({})
+        # Windowed shares: vs a prev snapshot, only the NEW attributed
+        # time counts — a behavior shift shows immediately however long
+        # the cumulative history is.
+        later = dict(metrics)
+        later["storm.stage.wal_commit_wait.mean"] = 0.1
+        later["storm.stage.wal_commit_wait.count"] = 101.0
+        windowed = stage_shares(later, prev=metrics)
+        assert windowed["wal_commit_wait"] > 0.9  # the stall dominates
+        assert stage_shares(later)["wal_commit_wait"] < 0.92  # cumulative
+        # An idle window (no new ticks) falls back to cumulative.
+        assert stage_shares(metrics, prev=metrics) == stage_shares(metrics)
+        # A service RESTART resets the registry: mixed-sign windows must
+        # fall back to the new cumulative totals, never render shares
+        # outside [0, 1].
+        post = {"storm.stage.device_dispatch.mean": 0.001,
+                "storm.stage.device_dispatch.count": 10.0,
+                "storm.stage.wal_commit_wait.mean": 0.1,
+                "storm.stage.wal_commit_wait.count": 20.0}
+        shares = stage_shares(post, prev=metrics)  # prev from old process
+        assert shares == stage_shares(post)
+        assert all(0.0 <= v <= 1.0 for v in shares.values())
+
     def test_monitor_cli_once(self):
         proc = subprocess.Popen(
             [sys.executable, "-m", "fluidframework_tpu.server.alfred",

@@ -10,6 +10,7 @@ import pytest
 
 from fluidframework_tpu.protocol.codec import (
     MAX_FRAME,
+    TRACE_KEY,
     BroadcastBatch,
     RawBody,
     StormAck,
@@ -21,6 +22,8 @@ from fluidframework_tpu.protocol.codec import (
     encode_storm_frame,
     is_storm_body,
     ops_event_encode_count,
+    stamp_trace,
+    trace_context,
 )
 
 
@@ -140,6 +143,75 @@ class TestStormAckCodec:
         body = encode_storm_body({"op": "storm_ack"}, b"\0" * 10)
         with pytest.raises(ValueError, match="i32"):
             decode_storm_push(body)
+
+
+class TestTraceContext:
+    def test_stamp_and_extract(self):
+        header = {"op": "storm", "docs": []}
+        assert trace_context(header) is None
+        assert stamp_trace(header, 1234) is header
+        assert header[TRACE_KEY] == 1234
+        assert trace_context(header) == 1234
+
+    def test_roundtrip_property_with_and_without_trace(self):
+        """Property: any header x payload round-trips byte-identically
+        whether or not a trace context rides along, and the trace id
+        survives arbitrary JSON-able types (the field is opaque)."""
+        rng = np.random.default_rng(7)
+        ids = [0, 1, 2**31 - 1, -5, "hex-abc", [3, "x"], None, 1.5]
+        for trial in range(25):
+            n = int(rng.integers(0, 256))
+            payload = rng.integers(0, 1 << 31, n,
+                                   dtype=np.int64).astype(np.uint32).tobytes()
+            header = {"op": "storm", "rid": trial,
+                      "docs": [["d", "c", 1, 1, n]]}
+            tc = ids[trial % len(ids)]
+            traced = stamp_trace(dict(header), tc)
+            got, got_payload = decode_storm_body(
+                encode_storm_body(traced, payload))
+            assert got == traced and trace_context(got) == tc
+            assert bytes(got_payload) == payload
+            # The untraced twin decodes to a header WITHOUT the field —
+            # tracing adds bytes only to sampled frames.
+            got_plain, _ = decode_storm_body(
+                encode_storm_body(header, payload))
+            assert TRACE_KEY not in got_plain
+
+    def test_old_decoder_ignores_the_new_field(self):
+        """Version tolerance: the storm binary layout is UNCHANGED (the
+        trace context is a JSON header key), so a consumer that predates
+        the field — it only reads magic/version/docs — parses a traced
+        frame identically. Simulated by the pre-round-10 read sequence
+        over the raw bytes."""
+        import json as _json
+
+        payload = np.arange(16, dtype=np.uint32).tobytes()
+        header = stamp_trace({"op": "storm", "rid": 3,
+                              "docs": [["d", "c", 1, 1, 16]]}, 99)
+        body = encode_storm_body(header, payload)
+        # The round-9 decoder logic, verbatim: magic, version, hlen, JSON.
+        assert body[0] == 0 and body[1] == 1
+        hlen = struct.unpack_from("<I", body, 2)[0]
+        old_header = _json.loads(bytes(body[6:6 + hlen]).decode())
+        assert old_header["docs"] == [["d", "c", 1, 1, 16]]
+        assert old_header["rid"] == 3
+        assert bytes(body[6 + hlen:]) == payload
+
+    def test_traced_ack_hops_ride_the_header(self):
+        """The server's joined hop marks come back on the columnar ack
+        exactly like the quarantine fields — header keys, not payload —
+        so untraced consumers never see them."""
+        ack = StormAck(5, np.array([[8, 1, 8, 1]], np.int32))
+        ack["tc"] = 99
+        ack["hops"] = {"ingress": 10, "admit": 20, "sequenced": 30,
+                       "ack_tx": 40}
+        out = decode_storm_push(encode_push(ack))
+        assert out["tc"] == 99
+        assert out["hops"] == {"ingress": 10, "admit": 20,
+                               "sequenced": 30, "ack_tx": 40}
+        assert list(out["hops"]) == ["ingress", "admit", "sequenced",
+                                     "ack_tx"]  # JSON keeps hop order
+        assert out["acks"] == [[8, 1, 8, 1]]
 
 
 class TestBroadcastEncodeOnce:
