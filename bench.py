@@ -1705,6 +1705,252 @@ def emit_round10(path: str = "BENCH_r10.json") -> dict:
     return out
 
 
+def _gen_head_stream(rng: random.Random, n_ops: int,
+                     n_writers: int = 8) -> list[dict]:
+    """The ADVERSARIAL head-concentrated stream (the BENCH_r06 known-loss
+    shape): every insert lands at the document head and removes hit the
+    head range, so all structural work concentrates in block 0 and the
+    rebalance trigger fires at the maximum rate the geometry allows."""
+    from fluidframework_tpu.ops import mergetree_kernel as mtk
+
+    ops, length, pool = [], 0, 0
+    for seq in range(1, n_ops + 1):
+        client = rng.randrange(n_writers)
+        if length > 16 and rng.random() < 0.25:
+            end = rng.randint(1, 6)
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=0, end=end, seq=seq,
+                            ref_seq=seq - 1, client=client))
+            length -= end
+        else:
+            tlen = rng.randint(1, 8)
+            ops.append(dict(kind=mtk.MT_INSERT, pos=0, seq=seq,
+                            ref_seq=seq - 1, client=client,
+                            pool_start=pool, text_len=tlen))
+            pool += tlen
+            length += tlen
+    return ops
+
+
+def bench_rebalance_r11(num_docs: int = 64, k: int = 32, ticks: int = 6,
+                        sizes: tuple = (512, 2048, 8192)) -> dict:
+    """Round-11 rebalance rows: the serving path (block apply + the
+    conditional rebalance exactly as storm._mixed_tick fuses it) against
+    the flat kernel across table sizes and op-locality shapes, with the
+    OLD from-scratch rebalance as the in-round baseline, per-rebalance
+    microbench (incremental spill vs full rebuild on the same danger
+    state), and the device fire-rate/blocks-touched columns the kstats
+    plane now exports. Same 64-doc XLA-CPU sweep shape as the BENCH_r06
+    section this round answers (its S=8192 serving row was 0.65x)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops import mergetree_blocks as mtb
+    from fluidframework_tpu.ops import mergetree_kernel as mtk
+    from fluidframework_tpu.ops import mergetree_pallas as mtp
+
+    @functools.partial(jax.jit, static_argnames=("tick_k",))
+    def maybe_full(state, min_seq, tick_k):
+        """The round-6 conditional rebalance: from-scratch on danger."""
+        bk = state.length.shape[2]
+        danger = jnp.any(jnp.max(state.blk_count, axis=1)
+                         + 2 * tick_k + 2 > bk)
+        return jax.lax.cond(danger,
+                            lambda s: mtb._rebalance_impl(s, min_seq),
+                            lambda s: s, state)
+
+    def measure(apply_fn, state0, batches, passes=2, reps=2):
+        st = apply_fn(state0, batches[0])  # compile + warm
+        jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+        best = 0.0
+        for _ in range(reps):
+            st = state0
+            start = time.perf_counter()
+            for _ in range(passes):
+                for batch in batches:
+                    st = apply_fn(st, batch)
+            jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+            best = max(best, num_docs * k * len(batches) * passes
+                       / (time.perf_counter() - start))
+        return best
+
+    zero_ms = jnp.zeros((num_docs,), jnp.int32)
+    out: dict = {
+        "shape": f"{num_docs} docs, k={k}, {ticks} ticks, XLA "
+                 f"{jax.default_backend()}",
+        "streams": {}}
+    for stream_name, gen in (("head_concentrated", _gen_head_stream),
+                             ("spread", _gen_merge_stream)):
+        stream = gen(random.Random(0), k * ticks)
+        batches = []
+        for t in range(ticks):
+            one = mtk.make_merge_op_batch([stream[t * k:(t + 1) * k]],
+                                          1, k)
+            batches.append(mtk.MergeOpBatch(
+                *[jnp.asarray(_tile(np.asarray(f), num_docs))
+                  for f in one]))
+        rows: dict = {}
+        for s in sizes:
+            row: dict = {}
+            flat = measure(mtp.apply_tick_best,
+                           mtk.init_state(num_docs, s), batches)
+            row["flat"] = round(flat, 1)
+            configs = [("base", *mtb.choose_block_geometry(s, k), "incr")]
+            if stream_name == "head_concentrated":
+                nb_t, bk_t = mtb.choose_block_geometry(s, k, 1.0)
+                if (nb_t, bk_t) != configs[0][1:3]:
+                    # The geometry the serving host retunes to once the
+                    # fire rate reveals the head concentration — the
+                    # round-11 serving configuration for this stream.
+                    configs.append(("autotuned", nb_t, bk_t, "incr"))
+                    configs.append(("autotuned_full_rebalance", nb_t,
+                                    bk_t, "full"))
+                # r06-sweep comparability: the S-exact lane-width grid
+                # its apply-only table used — isolates the incremental
+                # lever from the geometry lever.
+                configs.append((f"r06_grid_{s // 128}x128", s // 128,
+                                128, "incr"))
+                configs.append((f"r06_grid_{s // 128}x128_full_rebalance",
+                                s // 128, 128, "full"))
+            for label, nb, bk, reb in configs:
+                def apply_blocks(state, batch, reb=reb):
+                    state, _ovf = mtb.apply_tick_blocks(state, batch)
+                    if reb == "full":
+                        return maybe_full(state, zero_ms, k)
+                    return mtb.maybe_rebalance(state, zero_ms, k)
+                rate = measure(apply_blocks,
+                               mtb.init_state(num_docs, nb, bk), batches)
+                row[f"blocks_{label}"] = {
+                    "geometry": f"{nb}x{bk}",
+                    "ops_per_sec": round(rate, 1),
+                    "block_vs_flat": round(rate / flat, 3)}
+            # Fire-rate / blocks-touched columns (device rstats, one
+            # instrumented double pass — the kstats the serving hosts
+            # export as storm.device.*).
+            for label, nb, bk, _reb in configs:
+                if "full" in label:
+                    continue
+                st = mtb.init_state(num_docs, nb, bk)
+                fired = touched = 0
+                for batch in batches * 2:
+                    st, _ovf = mtb.apply_tick_blocks(st, batch)
+                    st, rs = mtb.maybe_rebalance_stats(st, zero_ms, k)
+                    rs = np.asarray(rs)
+                    fired += int(rs[0])
+                    touched += int(rs[1])
+                row[f"blocks_{label}"]["rebalance_fired_per_tick"] = \
+                    round(fired / (2 * ticks), 3)
+                row[f"blocks_{label}"]["blocks_touched_per_fire"] = \
+                    round(touched / max(1, fired), 1)
+            rows[f"S={s}"] = row
+        out["streams"][stream_name] = rows
+
+    # Per-rebalance microbench: drive the head stream WITH the fused
+    # maintenance to a steady state, stop at a tick where the danger
+    # trigger is armed and the local spill is feasible, then time the
+    # incremental spill vs the full rebuild FROM THE SAME STATE.
+    stream = _gen_head_stream(random.Random(0), k * ticks)
+    batches = []
+    for t in range(ticks):
+        one = mtk.make_merge_op_batch([stream[t * k:(t + 1) * k]], 1, k)
+        batches.append(mtk.MergeOpBatch(
+            *[jnp.asarray(_tile(np.asarray(f), num_docs)) for f in one]))
+    micro: dict = {}
+    for s in sizes:
+        nb, bk = s // 128, 128
+        cap = bk - (2 * k + 2)
+        st = mtb.init_state(num_docs, nb, bk)
+        danger_state = None
+        for batch in batches * 2:
+            st, _ovf = mtb.apply_tick_blocks(st, batch)
+            # The kernel's OWN conveyor plan decides feasibility (no
+            # drifting host replica), and the tomb-pressure predicate
+            # must be false too — otherwise maybe_rebalance takes the
+            # full branch and the "incremental" column would silently
+            # time the rebuild.
+            c = st.blk_count
+            nb_i = jax.lax.broadcasted_iota(jnp.int32, c.shape, 1)
+            c1, _e, h = mtb._spill_counts(c, jnp.int32(cap), nb_i)
+            c2 = c1 - h + jnp.roll(h, -1, axis=-1)
+            feasible = bool(jnp.all(c2 <= cap))
+            tomb_light = bool(jnp.all(
+                st.blk_tomb.sum(axis=1) * mtb.TOMB_PRESSURE_DEN
+                < nb * bk))
+            if int(jnp.max(c)) > cap and feasible and tomb_light:
+                danger_state = st  # armed, feasible, drops deferred
+            st = mtb.maybe_rebalance(st, zero_ms, k)
+        if danger_state is None:
+            micro[f"S={s}"] = {"skipped": "no armed feasible state"}
+            continue
+
+        def t_ms(fn):
+            fn()  # compile/warm
+            best = float("inf")
+            for _ in range(5):
+                start = time.perf_counter()
+                out_state = fn()
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(out_state)[0])
+                best = min(best, (time.perf_counter() - start) * 1e3)
+            return best
+
+        full_ms = t_ms(lambda: mtb.rebalance(danger_state, zero_ms))
+        incr_ms = t_ms(lambda: mtb.maybe_rebalance_stats(
+            danger_state, zero_ms, k)[0])
+        micro[f"S={s}"] = {
+            "geometry": f"{nb}x128",
+            "ms_per_full_rebalance": round(full_ms, 2),
+            "ms_per_incremental_spill": round(incr_ms, 2),
+            "incremental_speedup": round(full_ms / max(incr_ms, 1e-9),
+                                         2)}
+    out["rebalance_microbench"] = micro
+    return out
+
+
+def emit_round11(path: str = "BENCH_r11.json") -> dict:
+    """ISSUE 8 acceptance bars: serving-path block_vs_flat at S=8192 on
+    the adversarial head-concentrated stream (was 0.65 in BENCH_r06),
+    the incremental-vs-full rebalance microbench, and the device
+    fire-rate columns. Fail-soft writer."""
+    import jax
+
+    from fluidframework_tpu.utils import compile_cache
+
+    compile_cache.enable()
+    backend = jax.default_backend()
+    out: dict = {"round": 11, "environment": {"backend": backend}}
+    try:
+        out["rebalance_r11"] = bench_rebalance_r11()
+    except Exception as err:  # fail-soft: record, don't crash the writer
+        out["rebalance_r11"] = {"skipped": repr(err)}
+    out["environment"]["note"] = (
+        "Backend %s. Round-11 tentpole: the block table's conditional "
+        "rebalance became INCREMENTAL (overfull blocks spill into "
+        "neighbors with per-block circular log-shifts; tombstone drops "
+        "defer behind the blk_tomb pressure threshold; summaries "
+        "refresh only for touched blocks) and the geometry autotunes "
+        "from observed op locality (head-concentration fraction = the "
+        "rebalance fire rate off the device kstats plane; "
+        "choose_block_geometry head_fraction scales Bk so the hot "
+        "block absorbs 1-4 ticks per spill). blocks_autotuned is THE "
+        "serving configuration for a head-concentrated doc after "
+        "retune (parallel/serving.retune_text_geometry / "
+        "KernelMergeHost.autotune_block_geometry); blocks_base is the "
+        "pre-retune geometry; the r06_grid rows reproduce the "
+        "BENCH_r06 sweep's S-exact 64x128-style grid to isolate the "
+        "incremental lever (its serving row measured 0.65x at S=8192 "
+        "with the from-scratch rebalance). The <=25 ms pipelined-p99 "
+        "ledger rows (merge 36.3 / sequencer 35.8 / tree 52.1 / mixed "
+        "78.6) are tunneled-TPU quantities and need a TPU hour to "
+        "re-measure; the expected mover is the mixed/merge ticks' "
+        "rebalance share, which the fire-rate columns here bound."
+        % backend)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
 def main() -> None:
     from fluidframework_tpu.utils import compile_cache
 
@@ -1821,7 +2067,25 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--e2e-r10" in sys.argv:
+    if "--rebalance-r11" in sys.argv:
+        res = emit_round11()
+        r11 = res.get("rebalance_r11", {})
+        head = r11.get("streams", {}).get("head_concentrated", {})
+        row = head.get("S=8192", {})
+        serving = row.get("blocks_autotuned", row.get("blocks_base", {}))
+        print(json.dumps({
+            "metric": "serving-path block-table ops/sec at S=8192, "
+                      "head-concentrated stream, incremental rebalance "
+                      "+ autotuned geometry (BENCH_r11)",
+            "value": serving.get("ops_per_sec", 0.0),
+            "unit": "ops/s",
+            "block_vs_flat": serving.get("block_vs_flat"),
+            "rebalance_fired_per_tick": serving.get(
+                "rebalance_fired_per_tick"),
+            "microbench": r11.get("rebalance_microbench", {}).get(
+                "S=8192"),
+        }))
+    elif "--e2e-r10" in sys.argv:
         res = emit_round10()
         row = res["e2e_storm_10k_docs"]
         att = row.get("stage_attribution", {})

@@ -57,6 +57,7 @@ steady state.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -641,22 +642,286 @@ def rebalance(state: BlockMergeState, min_seq: jax.Array,
     return _rebalance_impl(state, min_seq, coalesce)
 
 
+# -- incremental rebalance (round 11) ------------------------------------------
+#
+# The from-scratch ``_rebalance_impl`` (compact → from_flat → summary
+# rebuild) is exact but pays two full log2(S) shift cascades over every
+# plane — and on head-concentrated streams the danger trigger fires
+# nearly every tick (BENCH_r06: the serving path LOSES to the flat
+# kernel at S=8192, 0.65×). The incremental form below restores the
+# per-block-headroom invariant (ADVICE item 4) by spilling ONLY overfull
+# blocks into their neighbors with LOCAL log-shift spreads (per-block
+# circular rolls — log2(Bk) stages instead of log2(S), one direction in
+# the common case), defers the tombstone zamboni off the hot tick behind
+# a ``blk_tomb`` pressure threshold, and updates summaries only for the
+# blocks the spill touched; cold blocks keep their planes BIT-identical
+# (the ADVICE item 3 exactness proof never re-derives). The decision and
+# the spill are functions of the state alone (plus the static tick
+# width), so a durable-log replay re-decides and re-lays-out
+# byte-identically.
+#
+# One conveyor step moves each overfull block's excess one block over —
+# SIMULTANEOUSLY across all blocks, so a chain of at-cap blocks shifts
+# like a belt in a single step. The occupied slots' document order is
+# preserved exactly (right-step: a block's TAIL ranks prepend to its
+# right neighbor; left-step: a block's HEAD ranks append to its left
+# neighbor), so the flat_view sequence of occupied slots — the semantic
+# state — is untouched: the spill is a pure re-layout.
+
+#: blk_tomb pressure denominator: the deferred zamboni (full rebalance,
+#: which drops acked tombstones) fires once tombstones occupy >= 1/4 of
+#: a document's total block capacity. Below that, the fused tick only
+#: re-layouts (tombstone drops stay off the hot tick).
+TOMB_PRESSURE_DEN = 4
+
+
+def _bcast(cond: jax.Array, x: jax.Array) -> jax.Array:
+    while cond.ndim < x.ndim:
+        cond = cond[None]
+    return cond
+
+
+def _blk_circ_shift(x: jax.Array, amount: jax.Array,
+                    left: bool) -> jax.Array:
+    """Circular per-block shift of the trailing [NB, Bk] axes by a
+    per-block ``amount`` [NB, 1] — log2(Bk) masked rolls. Each stage is
+    a pure per-row permutation (roll-or-not per block), so the composed
+    result is an exact circular shift: no collision analysis needed,
+    unlike the monotone threshold cascades of the full pack/spread."""
+    bk = x.shape[-1]
+    step = 1
+    while step < bk:
+        m = (amount & step) != 0
+        x = jnp.where(_bcast(m, x),
+                      jnp.roll(x, -step if left else step, axis=-1), x)
+        step *= 2
+    return x
+
+
+def _spill_counts(c: jax.Array, cap, nb_i: jax.Array
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Counts-only conveyor plan (right step then left step) along the
+    block axis (last axis of ``c``). Returns (counts after right,
+    right excess e, left excess h) — the movement replays exactly these
+    amounts, and maybe_rebalance simulates them for feasibility."""
+    nb = c.shape[-1]
+    e = jnp.where(nb_i == nb - 1, 0, jnp.maximum(c - cap, 0))
+    c1 = c - e + jnp.roll(e, 1, axis=-1)
+    h = jnp.where(nb_i == 0, 0, jnp.maximum(c1 - cap, 0))
+    return c1, e, h
+
+
+def _doc_spill_right(p, prop, overlap, summ, cap):
+    """One right conveyor step on one document: every block's excess
+    over ``cap`` (its tail — the largest ranks) prepends to its right
+    neighbor, whose own stayers shift right to make room. Per-doc
+    shapes as in the tick body (planes [NB, Bk], summaries [NB, 1])."""
+    c = summ["blk_count"]
+    nb = c.shape[0]
+    nb_i = _iota2(c.shape, 0)
+    e = jnp.where(nb_i == nb - 1, 0, jnp.maximum(c - cap, 0))
+    keep = c - e
+    a = jnp.roll(e, 1, axis=0)           # arrivals (row 0 gets e[-1]=0)
+    keep_prev = jnp.roll(keep, 1, axis=0)
+    touched = (e > 0) | (a > 0)          # [NB, 1]
+
+    def move(x, fill):
+        # Arrivals: left neighbor's occupied tail [keep_prev, keep_prev
+        # + a) lands at offsets [0, a); stayers shift right by a.
+        prev = _blk_circ_shift(jnp.roll(x, 1, axis=-2), keep_prev,
+                               left=True)
+        mine = _blk_circ_shift(x, a, left=False)
+        off = lax.broadcasted_iota(I32, x.shape[-2:], 1)
+        out = jnp.where(_bcast(off < a, x), prev,
+                        jnp.where(_bcast((off >= a) & (off < a + keep), x),
+                                  mine, fill))
+        return jnp.where(_bcast(touched, x), out, x)
+
+    p = {name: move(arr, _FILL[name]) for name, arr in p.items()}
+    prop = move(prop, 0)
+    overlap = move(overlap, 0)
+    summ = dict(summ)
+    summ["blk_count"] = keep + a
+    return p, prop, overlap, summ, touched
+
+
+def _doc_spill_left(p, prop, overlap, summ, cap):
+    """The mirror step: every block's excess HEAD (its smallest ranks)
+    appends to its left neighbor's tail — the tail-hot shape (block 0
+    cannot take this path; the feasibility gate falls back to the full
+    rebalance when neither direction restores the cap)."""
+    c = summ["blk_count"]
+    nb = c.shape[0]
+    nb_i = _iota2(c.shape, 0)
+    h = jnp.where(nb_i == 0, 0, jnp.maximum(c - cap, 0))
+    keep = c - h
+    a = jnp.roll(h, -1, axis=0)          # arrivals (last row gets h[0]=0)
+    touched = (h > 0) | (a > 0)
+
+    def move(x, fill):
+        # Stayers shift left by h; arrivals are the right neighbor's
+        # head [0, a), landing at offsets [keep, keep + a).
+        nxt = _blk_circ_shift(jnp.roll(x, -1, axis=-2), keep, left=False)
+        mine = _blk_circ_shift(x, h, left=True)
+        off = lax.broadcasted_iota(I32, x.shape[-2:], 1)
+        out = jnp.where(_bcast(off < keep, x), mine,
+                        jnp.where(_bcast(off < keep + a, x), nxt, fill))
+        return jnp.where(_bcast(touched, x), out, x)
+
+    p = {name: move(arr, _FILL[name]) for name, arr in p.items()}
+    prop = move(prop, 0)
+    overlap = move(overlap, 0)
+    summ = dict(summ)
+    summ["blk_count"] = keep + a
+    return p, prop, overlap, summ, touched
+
+
+def _doc_refresh_summaries(p, summ, touched):
+    """Exact summaries for the spill-touched blocks only; cold blocks
+    keep their carried values bit-identically (they are already exact —
+    the selection documents and enforces the touched-only contract)."""
+    occ = _iota2(p["length"].shape, 1) < summ["blk_count"]
+    removed = occ & (p["rem_seq"] != NONE_SEQ)
+    live = occ & ~removed
+    mut = jnp.where(occ, jnp.maximum(p["ins_seq"],
+                                     jnp.where(removed, p["rem_seq"], 0)),
+                    0)
+    summ = dict(summ)
+    summ["blk_live_len"] = jnp.where(
+        touched, jnp.sum(jnp.where(live, p["length"], 0), axis=1,
+                         keepdims=True), summ["blk_live_len"])
+    summ["blk_max_seq"] = jnp.where(
+        touched, jnp.max(mut, axis=1, keepdims=True), summ["blk_max_seq"])
+    summ["blk_tomb"] = jnp.where(
+        touched, jnp.sum(removed.astype(I32), axis=1, keepdims=True),
+        summ["blk_tomb"])
+    return summ
+
+
+def _incremental_spill_impl(state: BlockMergeState, tick_k: int
+                            ) -> tuple[BlockMergeState, jax.Array]:
+    """Batch incremental re-layout: right conveyor step always, left
+    step only when the batch still has over-cap blocks (one lax.cond —
+    the head-hot common case pays a single one-directional spill).
+    Returns (state', blocks_touched i32 scalar). Occupied-slot document
+    order is preserved exactly; nothing is dropped."""
+    b, nb, bk = state.length.shape
+    cap = I32(bk - (2 * tick_k + 2))
+
+    p = {name: getattr(state, name) for name in _SLOT_PLANES}
+    prop = jnp.transpose(state.prop_val, (0, 3, 1, 2))
+    overlap = jnp.transpose(state.rem_overlap, (0, 3, 1, 2))
+    summ = {name: getattr(state, name)[:, :, None] for name in _SUMM}
+
+    def vspill(step, args):
+        return jax.vmap(lambda p, pr, ov, sm: step(p, pr, ov, sm, cap)
+                        )(*args)
+
+    p, prop, overlap, summ, t_r = vspill(_doc_spill_right,
+                                         (p, prop, overlap, summ))
+
+    def left(args):
+        return vspill(_doc_spill_left, args)
+
+    def skip(args):
+        p, prop, overlap, summ = args
+        return p, prop, overlap, summ, jnp.zeros_like(t_r)
+
+    # The left mirror runs only when the right pass alone did not
+    # restore the cap somewhere in the BATCH (a real cond, outside the
+    # vmap) — the head-hot common case pays one one-directional spill.
+    need_left = jnp.any(summ["blk_count"] > cap)
+    p, prop, overlap, summ, t_l = lax.cond(need_left, left, skip,
+                                           (p, prop, overlap, summ))
+    touched = t_r | t_l
+    summ = jax.vmap(_doc_refresh_summaries)(p, summ, touched)
+    new = state._replace(
+        **{name: p[name] for name in _SLOT_PLANES},
+        prop_val=jnp.transpose(prop, (0, 2, 3, 1)),
+        rem_overlap=jnp.transpose(overlap, (0, 2, 3, 1)),
+        **{name: summ[name][:, :, 0] for name in _SUMM})
+    return new, jnp.sum(touched.astype(I32))
+
+
+def _maybe_rebalance_impl(state: BlockMergeState, min_seq: jax.Array,
+                          tick_k: int
+                          ) -> tuple[BlockMergeState, jax.Array]:
+    """Shared body of maybe_rebalance/maybe_rebalance_stats (inlined by
+    storm._mixed_tick). Decision, spill and zamboni are all functions of
+    the state + the static tick width, so replay re-decides identically:
+
+      * no block above cap = Bk - (2*tick_k + 2)  → no-op,
+      * over-cap blocks, conveyor plan feasible, tombstones light
+                                                  → incremental spill,
+      * conveyor infeasible (table genuinely near capacity, or the hot
+        edge blocked) OR blk_tomb pressure ≥ capacity/TOMB_PRESSURE_DEN
+                                                  → full rebalance (the
+        deferred zamboni: drop acked tombstones, uniform redistribution,
+        from-scratch summaries).
+
+    Returns (state', rstats i32[2] = [rebalance_fired, blocks_touched])
+    — the device counters the serving kstats plane exports."""
+    b, nb, bk = state.length.shape
+    headroom = 2 * tick_k + 2
+    cap = I32(bk - headroom)
+    c = state.blk_count
+    nb_i = lax.broadcasted_iota(I32, c.shape, 1)
+    danger = jnp.any(jnp.max(c, axis=1) + headroom > bk)
+    c1, e, h = _spill_counts(c, cap, nb_i)
+    c2 = c1 - h + jnp.roll(h, -1, axis=-1)
+    local_ok = jnp.all(c2 <= cap)
+    tomb_heavy = jnp.any(state.blk_tomb.sum(axis=1) * TOMB_PRESSURE_DEN
+                         >= nb * bk)
+    branch = jnp.where(danger,
+                       jnp.where(local_ok & ~tomb_heavy, 1, 2), 0)
+
+    def none_fn(s, _ms):
+        return s, I32(0)
+
+    def incr_fn(s, _ms):
+        return _incremental_spill_impl(s, tick_k)
+
+    def full_fn(s, ms):
+        return _rebalance_impl(s, ms), I32(b * nb)
+
+    state, touched = lax.switch(branch, (none_fn, incr_fn, full_fn),
+                                state, min_seq)
+    rstats = jnp.stack(((branch > 0).astype(I32), touched))
+    return state, rstats
+
+
+@functools.partial(jax.jit, static_argnames=("tick_k",))
+def maybe_rebalance_stats(state: BlockMergeState, min_seq: jax.Array,
+                          tick_k: int
+                          ) -> tuple[BlockMergeState, jax.Array]:
+    """maybe_rebalance + the device rstats pair ([fired, blocks_touched]
+    i32[2]) that rides the serving tick's kstats readback."""
+    return _maybe_rebalance_impl(state, min_seq, tick_k)
+
+
 @functools.partial(jax.jit, static_argnames=("tick_k",))
 def maybe_rebalance(state: BlockMergeState, min_seq: jax.Array,
                     tick_k: int) -> BlockMergeState:
-    """The FUSED per-tick form (storm._mixed_tick): rebalance only when
-    some document's fullest block could no longer absorb a worst-case
-    next tick (2 slots/op, all ``tick_k`` ops in one block). The cond
-    keeps the no-overflow guarantee of choose_block_geometry while the
-    steady state — edits spread across blocks — pays one [B, NB] max
-    per tick instead of the full pack cascade. Deterministic in the
-    state, so durable-log replays re-decide identically."""
-    bk = state.length.shape[2]
-    danger = jnp.any(jnp.max(state.blk_count, axis=1)
-                     + 2 * tick_k + 2 > bk)
-    return lax.cond(danger,
-                    lambda s: _rebalance_impl(s, min_seq),
-                    lambda s: s, state)
+    """The FUSED per-tick form (storm._mixed_tick): act only when some
+    document's fullest block could no longer absorb a worst-case next
+    tick (2 slots/op, all ``tick_k`` ops in one block) — and then prefer
+    the INCREMENTAL neighbor spill over the from-scratch rebuild (see
+    :func:`_maybe_rebalance_impl` for the decision ladder). Keeps the
+    no-overflow guarantee of choose_block_geometry; the steady state —
+    edits spread across blocks — pays one [B, NB] max per tick.
+    Deterministic in the state, so durable-log replays re-decide
+    identically."""
+    return _maybe_rebalance_impl(state, min_seq, tick_k)[0]
+
+
+#: Debug gate for to_flat's truncation guard: the guard reads
+#: max(count) back to the host, which SYNCS the device stream — on the
+#: overflow-replay / conversion hot paths that turns an async re-block
+#: into a blocking round trip. Callers there guarantee slots >= live
+#: count structurally (they size ``slots`` FROM the count), so the
+#: guard is a debug assertion, armed by FFTPU_DEBUG_TO_FLAT=1 (tests
+#: arm it) or by flipping this module flag.
+DEBUG_TO_FLAT = os.environ.get("FFTPU_DEBUG_TO_FLAT", "") not in ("", "0")
 
 
 def to_flat(state: BlockMergeState, slots: int | None = None
@@ -664,12 +929,13 @@ def to_flat(state: BlockMergeState, slots: int | None = None
     """PACKED flat state (gaps squeezed out) — the layout the
     sequence-parallel sharded path (ops/mergetree_sharded.py) and the
     host overflow replay consume. ``slots`` pads/truncates the slot axis
-    (must hold every occupied slot)."""
+    (must hold every occupied slot — debug-checked only, see
+    :data:`DEBUG_TO_FLAT`; the check forces a host sync)."""
     packed = mtk.compact(flat_view(state),
                          jnp.full((state.count.shape[0],), -1, I32))
     if slots is not None and slots != packed.valid.shape[1]:
         b, s = packed.valid.shape
-        assert slots >= s or bool(
+        assert slots >= s or not DEBUG_TO_FLAT or bool(
             np.asarray(jnp.max(packed.count)) <= slots), "truncating live slots"
         def fit(x, fill):
             if slots >= x.shape[1]:
@@ -695,18 +961,46 @@ def to_flat(state: BlockMergeState, slots: int | None = None
 # -- host helpers --------------------------------------------------------------
 
 
-def choose_block_geometry(min_slots: int, tick_k: int = 0
-                          ) -> tuple[int, int]:
+def bk_for_locality(tick_k: int, head_fraction: float = 0.0) -> int:
+    """Lane-multiple (128) block width for a serving table: first grown
+    until a WORST-CASE tick (2 slots/op, all ``tick_k`` ops in one
+    block) fits — the capacity floor, never capped — then grown further
+    so the hot block absorbs 1..4 ticks per spill at the observed
+    head-concentration fraction (the autotune lever, capped at 4096
+    lanes so pathological concentration cannot explode one block). The
+    single source of the Bk-scaling rule: choose_block_geometry and
+    KernelMergeHost.autotune_block_geometry must agree on it or the
+    per-op and serving paths would autotune the same locality to
+    different geometries."""
+    worst = 2 * tick_k + 8
+    bk = 128
+    while bk < worst + 8:
+        bk *= 2
+    absorb = 1 + int(round(3 * min(1.0, max(0.0, head_fraction))))
+    while bk < worst + 8 + 2 * tick_k * (absorb - 1) and bk < 4096:
+        bk *= 2
+    return bk
+
+
+def choose_block_geometry(min_slots: int, tick_k: int = 0,
+                          head_fraction: float = 0.0) -> tuple[int, int]:
     """(NB, Bk) for a serving text table admitting ``min_slots`` total
     slots with up to ``tick_k`` ops per tick. Bk is a lane multiple
     (128) with room for a WORST-CASE tick — every op (2 slots each)
     landing in one block — on top of the uniform fill the per-tick
     rebalance restores, so a capacity-checked serving tick can never hit
-    the overflow path."""
+    the overflow path.
+
+    ``head_fraction`` is the OBSERVED op locality (the fraction of ticks
+    whose rebalance trigger fired — the serving hosts estimate it from
+    the ``rebalance_fired`` device kstat). Head-concentrated streams
+    refill ONE block every tick, so the trigger fires at every tick at
+    the base geometry; scaling Bk up gives the hot block R = 1..4 ticks
+    of absorption per spill, amortizing the rebalance R× while the
+    per-op apply cost only grows by the O(Bk) structural phase. At
+    head_fraction=0.0 the geometry is exactly the historical one."""
     worst = 2 * tick_k + 8
-    bk = 128
-    while bk < worst + 8:
-        bk *= 2
+    bk = bk_for_locality(tick_k, head_fraction)
     usable = bk - worst
     nb = max(1, -(-min_slots // usable))
     return nb, bk

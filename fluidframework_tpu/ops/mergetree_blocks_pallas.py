@@ -192,3 +192,21 @@ def apply_tick_blocks_best(state: BlockMergeState, ops: mtk.MergeOpBatch
     if default_interpret():
         return apply_tick_blocks(state, ops)
     return apply_tick_blocks_pallas(state, ops)
+
+
+def serve_tick_blocks_best(state: BlockMergeState, ops: mtk.MergeOpBatch,
+                           min_seq: jax.Array, tick_k: int
+                           ) -> tuple[BlockMergeState, jax.Array,
+                                      jax.Array]:
+    """One SERVING-path step: the best apply for this backend followed
+    by the conditional maintenance ladder (incremental neighbor spill /
+    deferred zamboni — mergetree_blocks.maybe_rebalance_stats), exactly
+    the per-tick composition storm._mixed_tick fuses. The maintenance
+    leg is shared XLA on every backend — its per-block circular shifts
+    and summary selects sit OUTSIDE the VMEM grid program, so the twin
+    stays bit-pinned to the XLA path through rebalances by
+    construction. Returns (state', overflow[B], rstats i32[2])."""
+    from .mergetree_blocks import maybe_rebalance_stats
+    state, ovf = apply_tick_blocks_best(state, ops)
+    state, rstats = maybe_rebalance_stats(state, min_seq, tick_k)
+    return state, ovf, rstats

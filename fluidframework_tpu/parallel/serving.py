@@ -49,6 +49,7 @@ from ..ops import mergetree_kernel as mtk
 from ..ops import sequencer as seqk
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType
+from ..utils import faults
 from . import multihost
 from .mesh import aggregate_metrics
 
@@ -152,6 +153,7 @@ class ShardedServing:
                  map_slots: int = 32,
                  durable_retention_ticks: int = 1024,
                  text_slots: int = 0, text_k: int = 0, text_props: int = 4,
+                 text_locality: float = 0.0,
                  matrix_vec_slots: int = 0, matrix_cell_slots: int = 0,
                  matrix_k: int = 0,
                  tree_slots: int = 0, tree_k: int = 0,
@@ -188,10 +190,22 @@ class ShardedServing:
         # Text rows live in the block-structured table (the serving
         # path, ops/mergetree_blocks.py); geometry guarantees a
         # capacity-checked tick can never overflow a block given the
-        # per-tick fused rebalance inside _mixed_tick.
+        # per-tick fused rebalance inside _mixed_tick. ``text_locality``
+        # is the expected head-concentration fraction (0 = the
+        # historical geometry); retune_text_geometry() re-derives it
+        # later from the OBSERVED rebalance fire rate (the device
+        # kstats plane) and re-blocks in place.
+        self.text_props = text_props
+        self.text_geometry = (mtb.choose_block_geometry(
+            text_slots, self.text_k, text_locality)
+            if text_slots else None)
         self.merge_state = lift(mtb.init_state(
-            b_local, *mtb.choose_block_geometry(text_slots, self.text_k),
+            b_local, *self.text_geometry,
             text_props, overlap_words)) if text_slots else None
+        #: Cumulative mixed-tick rebalance attribution (device-true,
+        #: from the kstats plane): the observed-locality input.
+        self.rebalance_stats = {"ticks": 0, "fired": 0,
+                                "blocks_touched": 0}
         self.matrix_vec_slots = matrix_vec_slots
         self.matrix_cell_slots = matrix_cell_slots
         self.matrix_k = matrix_k or (k if matrix_vec_slots else 0)
@@ -513,6 +527,7 @@ class ShardedServing:
         put = lambda a: multihost.feed(self.mesh, a, global_batch=b)
         tree_overflow = None
         text_overflow = None
+        kstats = None
         if not self._mixed:
             gather = np.arange(lo, hi, dtype=np.int32)
             (self.seq_state, self.map_state, n_seq, first, last,
@@ -527,7 +542,7 @@ class ShardedServing:
                  seq_counts, map_counts], axis=1)
             (self.seq_state, self.map_state, self.merge_state,
              self.matrix_state, self.tree_state, n_seq, first, last,
-             _msn, tree_overflow, text_overflow) = _mixed_tick(
+             _msn, tree_overflow, text_overflow, kstats) = _mixed_tick(
                 self.seq_state, self.map_state, self.merge_state,
                 self.matrix_state, self.tree_state,
                 put(scalars), put(map_words),
@@ -543,9 +558,10 @@ class ShardedServing:
         # in flight behind it (depth 0 = synchronous, the default).
         rec = dict(submitted=submitted, records=records,
                    out=(n_seq, first, last), tree_overflow=tree_overflow,
-                   text_overflow=text_overflow)
+                   text_overflow=text_overflow, kstats=kstats)
         probes = rec["out"] + tuple(
-            a for a in (tree_overflow, text_overflow) if a is not None)
+            a for a in (tree_overflow, text_overflow, kstats)
+            if a is not None)
         for arr in probes:
             copy_async = getattr(arr, "copy_to_host_async", None)
             if copy_async is not None:
@@ -602,6 +618,18 @@ class ShardedServing:
                     f"tree rank overflow on rows "
                     f"{sorted(self.last_tree_overflow)}; host re-rank "
                     "required (size tree ranks for the tick width)")
+        if rec.get("kstats") is not None:
+            # Rebalance attribution off the existing readback (the
+            # kstats cells are replicated scalars — every process reads
+            # its own copy): the observed-locality input of
+            # retune_text_geometry.
+            from ..server import storm as storm_mod
+            ks = np.asarray(rec["kstats"])
+            self.rebalance_stats["ticks"] += 1
+            self.rebalance_stats["fired"] += int(
+                ks[storm_mod.KSTAT_REBALANCE_FIRED])
+            self.rebalance_stats["blocks_touched"] += int(
+                ks[storm_mod.KSTAT_BLOCKS_TOUCHED])
         if rec.get("text_overflow") is not None:
             # choose_block_geometry + the fused per-tick rebalance make
             # this unreachable for capacity-checked admissions; a hit
@@ -617,6 +645,47 @@ class ShardedServing:
         return harvest
 
     # -- capacity maintenance --------------------------------------------------
+
+    def observed_head_fraction(self) -> float:
+        """Fraction of mixed ticks whose block-table rebalance fired —
+        the device-true op-locality estimate (head-concentrated streams
+        refill one block and fire near 1.0; spread streams near 0.0).
+        The input of :meth:`retune_text_geometry`."""
+        ticks = self.rebalance_stats["ticks"]
+        if ticks == 0:
+            return 0.0
+        return self.rebalance_stats["fired"] / ticks
+
+    def retune_text_geometry(self, head_fraction: float | None = None
+                             ) -> tuple[int, int]:
+        """Re-derive the text block geometry from observed op locality
+        and re-block the live table in place (between ticks). The
+        re-block is a pure re-layout through the packed flat form —
+        occupied-slot document order, text pools and admission marks are
+        untouched, so serving continues identically; only the rebalance
+        fire RATE changes (resize geometry, not replay frequency —
+        ADVICE item 4). Deterministic in (state, head_fraction): a
+        restore + replay that re-runs the same retune call re-blocks
+        byte-identically. Returns the (possibly unchanged) geometry."""
+        if self.merge_state is None:
+            raise ValueError("assembly built without text_slots")
+        if head_fraction is None:
+            head_fraction = self.observed_head_fraction()
+        nb, bk = mtb.choose_block_geometry(self.text_slots, self.text_k,
+                                           head_fraction)
+        if (nb, bk) == self.text_geometry:
+            return self.text_geometry
+        # Chaos kill class "mid-retune": the layout is about to move
+        # wholesale; a crash here loses only volatile device state (the
+        # durable records + checkpoint replay rebuild the rows, and the
+        # replayed retune re-decides the same geometry).
+        faults.crashpoint("pool.mid_retune")
+        packed = mtb.to_flat(self.merge_state, slots=nb * bk)
+        self.merge_state = mtb.from_flat(packed, nb)
+        self.text_geometry = (nb, bk)
+        self.rebalance_stats = {"ticks": 0, "fired": 0,
+                                "blocks_touched": 0}
+        return self.text_geometry
 
     def compact_text(self) -> None:
         """Zamboni over every text row (mtk.compact at each doc's device

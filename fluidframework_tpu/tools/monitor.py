@@ -105,6 +105,35 @@ def render_stage_bar(metrics: dict, width: int = 52,
     return "\n".join(lines)
 
 
+def render_rebalance(metrics: dict, prev: dict | None = None) -> str:
+    """Block-table maintenance line from the device kstats counters
+    (``storm.device.rebalance_fired`` / ``blocks_touched`` — the
+    round-11 rebalance-attribution plane) plus the merge-host pre-tick
+    fires/retunes; empty when nothing has ever fired. The fire rate is
+    fires per harvested tick over the window — the head-concentration
+    signal geometry autotuning keys on."""
+    fired = metrics.get("storm.device.rebalance_fired", 0)
+    touched = metrics.get("storm.device.blocks_touched", 0)
+    # Tick denominator: the stage ledger records one scatter split per
+    # harvested tick, so its histogram count IS the tick count.
+    ticks = metrics.get("storm.stage.scatter.count", 0)
+    host_fires = metrics.get("merge.rebalance_fires", 0)
+    retunes = metrics.get("merge.geometry_retunes", 0)
+    if not (fired or host_fires or retunes):
+        return ""
+    if prev is not None:
+        w_fired = fired - prev.get("storm.device.rebalance_fired", 0)
+        w_ticks = ticks - prev.get("storm.stage.scatter.count", 0)
+        w_touched = touched - prev.get("storm.device.blocks_touched", 0)
+        if w_fired >= 0 and w_ticks > 0:
+            fired, ticks = w_fired, w_ticks
+            if w_touched >= 0:  # windowed WITH the rate, same interval
+                touched = w_touched
+    rate = (f"{fired / ticks:.2f}/tick" if ticks else f"{fired:g} fires")
+    return (f"block rebalance: {rate}  blocks_touched {touched:g}  "
+            f"host pre-tick fires {host_fires:g}  retunes {retunes:g}")
+
+
 def render_human(now: dict, prev: dict, interval: float) -> str:
     """Operator view of one poll: headline rates (per-second deltas of
     the interesting counters), the stage bar, and the hop decomposition
@@ -128,6 +157,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
         lines.extend(f"  {name:<32} +{delta / per_s:,.1f}/s"
                      for delta, name in rates[:16])
     lines.append(render_stage_bar(now, prev=prev or None))
+    rebal = render_rebalance(now, prev or None)
+    if rebal:
+        lines.append(rebal)
     hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
                        if k.startswith("storm.hop.")})
     if hop_keys:

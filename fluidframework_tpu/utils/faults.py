@@ -19,6 +19,9 @@ Registered points (grep for ``crashpoint(`` to audit):
                             record NOT yet enqueued (volatile-state window)
 ``storm.pre_ack``           durable record fsynced, ack NOT yet pushed
 ``pool.mid_rebalance``      block merge pool mid-rebalance (layout moving)
+``pool.mid_retune``         block geometry retune mid-move (whole-pool
+                            re-block; the replayed retune must re-decide
+                            the same geometry)
 ``snapshot.mid_upload``     snapshot chunks partially written
 ``snapshot.pre_publish``    snapshot uploaded, head ref NOT yet flipped
 ==========================  ==================================================

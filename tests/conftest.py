@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Arm the debug-tier truncation guard in ops/mergetree_blocks.to_flat
+# (a host-syncing max(count) readback, off on the serving hot path) —
+# the suite keeps the tripwire while production stays async. Must be
+# set before fluidframework_tpu.ops.mergetree_blocks is imported.
+os.environ.setdefault("FFTPU_DEBUG_TO_FLAT", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

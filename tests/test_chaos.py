@@ -176,6 +176,92 @@ print("SURVIVED", flush=True)  # the kill plan never fired
 """
 
 
+# Same workload, but the kill plan arms pool.mid_retune: after the
+# head-insert storm the host autotunes its block geometry (the explicit
+# head_fraction pins the decision so the kill plan deterministically
+# reaches a real re-block), and the process dies while the pool layout
+# is moving wholesale.
+_RETUNE_CHILD = _REBALANCE_CHILD.replace(
+    'print("SURVIVED", flush=True)  # the kill plan never fired',
+    'host.autotune_block_geometry(min_observations=1, '
+    'fire_threshold=0.0, head_fraction=1.0)\n'
+    'print("SURVIVED", flush=True)  # the kill plan never fired')
+
+
+def _recover_host(tmp_path):
+    """Merger-lambda replay of the scriptorium durable log into a FRESH
+    host (the pool.mid_* recovery path)."""
+    from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+    from fluidframework_tpu.runtime.container import Container
+    from fluidframework_tpu.server.durable_store import (
+        DurableMessageBus, FileStateStore)
+    from fluidframework_tpu.server.merge_host import KernelMergeHost
+    from fluidframework_tpu.server.routerlicious import RouterliciousService
+
+    host = KernelMergeHost(flush_threshold=8)
+    service = RouterliciousService(
+        bus=DurableMessageBus(str(tmp_path / "bus")),
+        store=FileStateStore(str(tmp_path / "state")),
+        merge_host=host)
+    service.connect("doc", lambda msgs: None)
+    c = Container.load(LocalDocumentService(service, "doc"))
+    text = c.runtime.get_datastore("default") \
+        .get_channel("text").get_text()
+    return host, c, text
+
+
+def test_kill_mid_retune_replay_redecides_identically(tmp_path):
+    """The pool.mid_retune kill class (round 11): the process dies while
+    a geometry retune is moving the whole pool layout. Device state is
+    volatile, so recovery = durable-log replay into a fresh host — and
+    because the retune is a pure function of (state, block_slots), two
+    independent replays that re-run the same retune must agree
+    byte-for-byte on every pool plane (replay re-decides identically)."""
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    env = dict(__import__("os").environ)
+    env["FFTPU_CRASHPOINT"] = "pool.mid_retune:1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [_sys.executable, "-c", _RETUNE_CHILD, str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == faults.KILL_EXIT_CODE, (proc.returncode,
+                                                      proc.stdout,
+                                                      proc.stderr)
+
+    host1, c1, text1 = _recover_host(tmp_path)
+    host2, _c2, text2 = _recover_host(tmp_path)
+    assert text1  # edits before the kill were durably sequenced
+    assert text1 == text2
+    assert host1.text("doc", "default", "text") == text1
+    # Re-run the same retune on both replicas: the decision ladder and
+    # the re-block are deterministic in the replayed state, so every
+    # pool plane must stay byte-identical between the two recoveries.
+    ret1 = host1.autotune_block_geometry(min_observations=1,
+                                         fire_threshold=0.0,
+                                         head_fraction=1.0)
+    ret2 = host2.autotune_block_geometry(min_observations=1,
+                                         fire_threshold=0.0,
+                                         head_fraction=1.0)
+    assert ret1 == ret2
+    assert sorted(host1._merge_pools) == sorted(host2._merge_pools)
+    for slots, p1 in host1._merge_pools.items():
+        p2 = host2._merge_pools[slots]
+        if hasattr(p1, "nb"):
+            assert (p1.nb, p1.bk) == (p2.nb, p2.bk), slots
+        for f in type(p1.state)._fields:
+            assert np.array_equal(np.asarray(getattr(p1.state, f)),
+                                  np.asarray(getattr(p2.state, f))), \
+                (slots, f)
+    # And the recovered, retuned host keeps sequencing.
+    c1.runtime.get_datastore("default").get_channel("text") \
+        .insert_text(0, "recovered ")
+    assert host1.text("doc", "default", "text").startswith("recovered ")
+
+
 def test_kill_mid_rebalance_recovers_from_durable_log(tmp_path):
     """The pool.mid_rebalance kill class (per-op merge path): the block
     pool's layout is mid-move when the process dies. The device state is

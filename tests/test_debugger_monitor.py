@@ -211,3 +211,34 @@ class TestServiceMonitor:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+
+def test_rebalance_line_renders_fire_rate():
+    """Round-11 rebalance attribution line: silent until anything fires,
+    fires-per-tick over the poll window (stage-ledger scatter count is
+    the tick denominator), cumulative fallback across restarts."""
+    from fluidframework_tpu.tools.monitor import render_rebalance
+
+    assert render_rebalance({}) == ""  # nothing ever fired → no line
+    m = {"storm.device.rebalance_fired": 4.0,
+         "storm.device.blocks_touched": 36.0,
+         "storm.stage.scatter.count": 16.0,
+         "merge.rebalance_fires": 2.0,
+         "merge.geometry_retunes": 1.0}
+    text = render_rebalance(m)
+    assert "0.25/tick" in text
+    assert "blocks_touched 36" in text
+    assert "retunes 1" in text
+    # Windowed: only the poll window's fires/ticks/touched count —
+    # (4-2)/(16-8) fires per tick, 36-30 blocks touched.
+    prev = {"storm.device.rebalance_fired": 2.0,
+            "storm.stage.scatter.count": 8.0,
+            "storm.device.blocks_touched": 30.0}
+    windowed = render_rebalance(m, prev)
+    assert "0.25/tick" in windowed
+    assert "blocks_touched 6" in windowed
+    # A service restart resets the registry (negative window): fall back
+    # to the new cumulative totals rather than rendering garbage.
+    prev_big = {"storm.device.rebalance_fired": 10.0,
+                "storm.stage.scatter.count": 100.0}
+    assert "0.25/tick" in render_rebalance(m, prev_big)

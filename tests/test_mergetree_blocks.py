@@ -251,6 +251,236 @@ def test_rebalance_preserves_future_resolution(seed):
     assert live == live_flat, seed
 
 
+def gen_head_stream(rng, n_ops):
+    """Adversarial head-concentrated stream: every structural op lands
+    at the document head (the BENCH_r06 known-loss shape — the
+    incremental-rebalance trigger fires at the maximum rate)."""
+    ops, length, pool = [], 0, 0
+    for seq in range(1, n_ops + 1):
+        if length > 8 and rng.random() < 0.25:
+            end = rng.randint(1, 4)
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=0, end=end, seq=seq,
+                            ref_seq=seq - 1, client=rng.randrange(4)))
+            length -= end
+        else:
+            tlen = rng.randint(1, 4)
+            ops.append(dict(kind=mtk.MT_INSERT, pos=0, seq=seq,
+                            ref_seq=seq - 1, client=rng.randrange(4),
+                            pool_start=pool, text_len=tlen))
+            pool += tlen
+            length += tlen
+    return ops
+
+
+def gen_tomb_stream(rng, n_ops):
+    """Tombstone-heavy: half the ops remove — blk_tomb pressure builds
+    toward the deferred-zamboni threshold."""
+    ops, length, pool = [], 0, 0
+    for seq in range(1, n_ops + 1):
+        if length > 6 and rng.random() < 0.5:
+            start = rng.randrange(length - 4)
+            end = start + rng.randint(1, 4)
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=start, end=end,
+                            seq=seq, ref_seq=seq - 1,
+                            client=rng.randrange(4)))
+            length -= end - start
+        else:
+            tlen = rng.randint(1, 3)
+            ops.append(dict(kind=mtk.MT_INSERT,
+                            pos=rng.randint(0, length), seq=seq,
+                            ref_seq=seq - 1, client=rng.randrange(4),
+                            pool_start=pool, text_len=tlen))
+            pool += tlen
+            length += tlen
+    return ops
+
+
+def _decide(block, k):
+    """Host replica of the maybe_rebalance decision ladder (the
+    determinism pin: the device must agree with this pure function of
+    the state)."""
+    nb, bk = block.blk_count.shape[1], block.length.shape[2]
+    cap = bk - (2 * k + 2)
+    c = np.asarray(block.blk_count)
+    danger = bool((c.max(axis=1) + 2 * k + 2 > bk).any())
+    e = np.maximum(c - cap, 0)
+    e[:, -1] = 0
+    c1 = c - e + np.roll(e, 1, axis=-1)
+    h = np.maximum(c1 - cap, 0)
+    h[:, 0] = 0
+    c2 = c1 - h + np.roll(h, -1, axis=-1)
+    local_ok = bool((c2 <= cap).all())
+    tomb_heavy = bool((np.asarray(block.blk_tomb).sum(axis=1)
+                       * mtb.TOMB_PRESSURE_DEN >= nb * bk).any())
+    if not danger:
+        return 0
+    return 1 if (local_ok and not tomb_heavy) else 2
+
+
+@pytest.mark.parametrize("shape", ["head", "spread", "tomb"])
+@pytest.mark.parametrize("seed", range(2))
+def test_incremental_rebalance_bit_identical(shape, seed):
+    """The round-11 differential fuzz: across head-concentrated, spread
+    and tombstone-heavy streams, the incremental spill is a PURE
+    re-layout — every occupied slot (tombstones, overlap words, props
+    included) stays bit-identical to the flat kernel in document order —
+    its summaries never drift from the from-scratch rebuild, the
+    per-block headroom truth is restored whenever the table has
+    capacity, and the full-rebuild branch is bit-identical to
+    ``rebalance`` (≡ flat ``compact`` + ``from_flat``, the pinned
+    round-6 contract)."""
+    rng = random.Random(7500 + seed)
+    gen = {"head": gen_head_stream, "spread": gen_stream,
+           "tomb": gen_tomb_stream}[shape]
+    stream = gen(rng, 120)
+    k, nb, bk = 8, 8, 64
+    cap = bk - (2 * k + 2)
+    flat = mtk.init_state(1, 1024, num_props=2)
+    block = mtb.init_state(1, num_blocks=nb, block_slots=bk, num_props=2)
+    zero = jnp.zeros((1,), jnp.int32)
+    branches = set()
+    for start in range(0, 120, k):
+        batch = mtk.make_merge_op_batch([stream[start:start + k]], 1, k)
+        flat = mtk.apply_tick(flat, batch)
+        block, ovf = mtb.apply_tick_blocks(block, batch)
+        assert int(np.asarray(ovf)[0]) == int(mtb.OVF_NONE), (shape, start)
+        branch = _decide(block, k)
+        branches.add(branch)
+        ref_full = mtb.rebalance(block, zero)
+        block2, rs = mtb.maybe_rebalance_stats(block, zero, k)
+        rs = np.asarray(rs)
+        assert (rs[0] == 1) == (branch > 0), (shape, start, branch, rs)
+        if branch == 2:
+            # Full branch ≡ rebalance() ≡ compact+from_flat, bit-exact.
+            for f in mtb.BlockMergeState._fields:
+                assert np.array_equal(np.asarray(getattr(block2, f)),
+                                      np.asarray(getattr(ref_full, f))), \
+                    (shape, start, f)
+        if branch == 0:
+            for f in mtb.BlockMergeState._fields:
+                assert np.array_equal(np.asarray(getattr(block2, f)),
+                                      np.asarray(getattr(block, f))), \
+                    (shape, start, f)
+        # Replay determinism: re-deciding from the same state re-lays
+        # out byte-identically (the durable-log replay contract).
+        block3, rs3 = mtb.maybe_rebalance_stats(block, zero, k)
+        assert np.array_equal(rs, np.asarray(rs3))
+        for f in mtb.BlockMergeState._fields:
+            assert np.array_equal(np.asarray(getattr(block2, f)),
+                                  np.asarray(getattr(block3, f))), \
+                (shape, start, f)
+        block = block2
+        # Summaries never drift through the incremental path.
+        rebuilt = mtb.recompute_summaries(block)
+        for f in ("blk_live_len", "blk_max_seq", "blk_tomb", "count"):
+            assert np.array_equal(np.asarray(getattr(block, f)),
+                                  np.asarray(getattr(rebuilt, f))), \
+                (shape, start, f)
+        # Capacity truth (ADVICE item 4): whenever the table CAN satisfy
+        # per-block headroom, the maintenance pass restored it.
+        counts = np.asarray(block.blk_count)
+        feasible = np.asarray(block.count) <= nb * cap
+        assert np.all((counts.max(axis=1) <= cap) | ~feasible), \
+            (shape, start, counts)
+        # min_seq 0 drops nothing on either path: occupied slots (incl.
+        # tombstones) must match the flat kernel slot-for-slot.
+        assert occupied_rows(mtb.flat_view(block), 0) == \
+            occupied_rows(flat, 0), (shape, start)
+    assert 1 in branches, (shape, "incremental branch never exercised")
+
+
+def test_deferred_zamboni_fires_on_tomb_pressure():
+    """Tombstone drops stay OFF the hot tick until blk_tomb pressure
+    crosses the threshold — then the full branch fires at the window
+    and actually drops (count shrinks), matching rebalance() bit-exactly
+    (exercised with an advancing MSN, unlike the fuzz's zero window)."""
+    # Alternating head-insert / head-remove waves. The LIGHT remove
+    # waves (below the pressure threshold of nb*bk/TOMB_PRESSURE_DEN =
+    # 64 tombstones) leave tombstones aboard when the next insert wave
+    # arms the danger trigger — the spill must ride them through
+    # untouched (deferred). The final HEAVY wave (70 > 64) crosses the
+    # pressure threshold while no danger fires, so the next insert
+    # wave's first fire takes the full branch and the zamboni drops.
+    ops = []
+    seq = 0
+
+    def insert_wave(n):
+        nonlocal seq
+        for _ in range(n):
+            seq += 1
+            ops.append(dict(kind=mtk.MT_INSERT, pos=0, seq=seq,
+                            ref_seq=seq - 1, client=0,
+                            pool_start=seq, text_len=1))
+
+    def remove_wave(n):
+        nonlocal seq
+        for _ in range(n):
+            seq += 1
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=0, end=1, seq=seq,
+                            ref_seq=seq - 1, client=0))
+
+    insert_wave(80)
+    remove_wave(40)
+    insert_wave(80)
+    remove_wave(50)
+    insert_wave(60)
+    remove_wave(70)
+    insert_wave(40)
+    block = mtb.init_state(1, num_blocks=4, block_slots=64)
+    k = 10
+    saw_pressure_drop = False
+    saw_deferred = False
+    for start in range(0, len(ops), k):
+        batch = mtk.make_merge_op_batch([ops[start:start + k]], 1, k)
+        block, ovf = mtb.apply_tick_blocks(block, batch)
+        assert int(np.asarray(ovf)[0]) == int(mtb.OVF_NONE), start
+        ms = jnp.asarray([start], jnp.int32)  # advancing collab window
+        branch = _decide(block, k)
+        tomb_heavy = (int(np.asarray(block.blk_tomb).sum())
+                      * mtb.TOMB_PRESSURE_DEN >= 4 * 64)
+        if branch == 1 and int(np.asarray(block.blk_tomb).sum()) > 0:
+            saw_deferred = True  # drops stayed off this hot tick
+        if branch == 2:
+            ref = mtb.rebalance(block, ms)
+            nxt, _rs = mtb.maybe_rebalance_stats(block, ms, k)
+            for f in mtb.BlockMergeState._fields:
+                assert np.array_equal(np.asarray(getattr(nxt, f)),
+                                      np.asarray(getattr(ref, f))), f
+            if tomb_heavy and (int(np.asarray(nxt.count)[0])
+                               < int(np.asarray(block.count)[0])):
+                saw_pressure_drop = True
+            block = nxt
+        else:
+            before = int(np.asarray(block.count)[0])
+            block, _rs = mtb.maybe_rebalance_stats(block, ms, k)
+            # The incremental/no-op branches NEVER drop.
+            assert int(np.asarray(block.count)[0]) == before, start
+    assert saw_deferred, "tombstones never rode through a hot-tick spill"
+    assert saw_pressure_drop, "pressure-triggered zamboni never dropped"
+
+
+def test_choose_block_geometry_head_fraction():
+    """head_fraction=0 is the historical geometry bit-for-bit; higher
+    observed concentration grows Bk monotonically (lane multiple, total
+    capacity still admits min_slots) so the hot block absorbs more
+    ticks per spill."""
+    for slots, k in ((512, 32), (2048, 32), (8192, 32), (8192, 128)):
+        base = mtb.choose_block_geometry(slots, k)
+        assert base == mtb.choose_block_geometry(slots, k, 0.0)
+        prev_bk = 0
+        for hf in (0.0, 0.3, 0.6, 1.0):
+            nb, bk = mtb.choose_block_geometry(slots, k, hf)
+            assert bk % 128 == 0 and bk >= prev_bk
+            prev_bk = bk
+            worst = 2 * k + 8
+            # Usable capacity (below the per-block worst-case reserve)
+            # admits min_slots at every head_fraction.
+            assert nb * (bk - worst) >= slots, (slots, k, hf, nb, bk)
+        nb1, bk1 = mtb.choose_block_geometry(slots, k, 1.0)
+        if slots >= 2048:
+            assert bk1 > base[1], (slots, k)
+
+
 def test_overflow_is_atomic_and_replayable():
     """Force a block overflow (tiny Bk, one-position insert storm): the
     kernel reports the first failed op index, the table is frozen at the
@@ -329,3 +559,28 @@ def test_converters_roundtrip():
         assert np.array_equal(host[f], np.asarray(getattr(block, f)[0])), f
     for f in ("length", "ins_seq", "rem_seq", "pool_start"):
         assert np.array_equal(host[f], np.asarray(getattr(block, f)[0])), f
+
+
+def test_serve_tick_blocks_best_composes_maintenance():
+    """The serving-path composition the Pallas module exports (best
+    apply + the conditional maintenance ladder) is bit-identical to
+    calling the two legs explicitly — the fused shape storm._mixed_tick
+    uses, kept honest on every backend."""
+    from fluidframework_tpu.ops import mergetree_blocks_pallas as mtbp
+
+    rng = random.Random(99)
+    stream = gen_head_stream(rng, 48)
+    k = 8
+    a = mtb.init_state(1, num_blocks=8, block_slots=64, num_props=2)
+    b = mtb.init_state(1, num_blocks=8, block_slots=64, num_props=2)
+    zero = jnp.zeros((1,), jnp.int32)
+    for start in range(0, 48, k):
+        batch = mtk.make_merge_op_batch([stream[start:start + k]], 1, k)
+        a, ovf_a, rs_a = mtbp.serve_tick_blocks_best(a, batch, zero, k)
+        b, ovf_b = mtbp.apply_tick_blocks_best(b, batch)
+        b, rs_b = mtb.maybe_rebalance_stats(b, zero, k)
+        assert np.array_equal(np.asarray(ovf_a), np.asarray(ovf_b))
+        assert np.array_equal(np.asarray(rs_a), np.asarray(rs_b))
+        for f in mtb.BlockMergeState._fields:
+            assert np.array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f))), (start, f)

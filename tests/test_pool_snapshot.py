@@ -150,6 +150,97 @@ def test_import_requires_fresh_host(tmp_path):
         host.import_state(snap)
 
 
+def _assert_pools_identical(a, b):
+    """Every merge pool byte-identical between two hosts: geometry AND
+    all device planes (the replay-determinism comparison surface)."""
+    import numpy as np
+    assert sorted(a._merge_pools) == sorted(b._merge_pools)
+    for slots, pa in a._merge_pools.items():
+        pb = b._merge_pools[slots]
+        assert type(pa) is type(pb), slots
+        if hasattr(pa, "nb"):
+            assert (pa.nb, pa.bk) == (pb.nb, pb.bk), slots
+        for f in type(pa.state)._fields:
+            assert np.array_equal(np.asarray(getattr(pa.state, f)),
+                                  np.asarray(getattr(pb.state, f))), \
+                (slots, f)
+
+
+def test_geometry_retune_snapshot_replay_determinism(tmp_path):
+    """The round-11 replay/restore determinism bar: a geometry retune +
+    incremental-rebalance sequence survives export_state/import_state
+    byte-identically, a restore of the PRE-retune snapshot that re-runs
+    the same retune re-decides the same layout byte-for-byte, and the
+    same sequenced tail (the WAL-tail replay analog) converges all
+    replicas identically on the retuned geometry."""
+    import random as _random
+
+    from fluidframework_tpu.server.local_server import LocalCollabServer
+
+    host = KernelMergeHost(flush_threshold=8)
+    server = LocalCollabServer(merge_host=host)
+    c = make_doc(server, "doc0")
+    # A second writer that never submits pins the MSN, so the zamboni
+    # cannot coalesce the head-insert run — the table genuinely grows
+    # and the head-concentrated stream arms the rebalance trigger.
+    Container.load(LocalDocumentService(server, "doc0"))
+    text, _root = get_parts(c)
+    for i in range(300):
+        text.insert_text(0, f"edit{i} ")
+    host.flush()
+    assert host.stats["rebalances"] > 0  # incremental ladder exercised
+    git = GitSnapshotStore(tmp_path / "git")
+    pre = git.upload("__pools__", host.export_state())
+    # The stream IS head-concentrated (every insert at pos 0); pin the
+    # concentration estimate so every block pool — including the one the
+    # doc migrated into — re-blocks, and the decision is deterministic
+    # for the pre-retune-restore replica below.
+    retuned = host.autotune_block_geometry(min_observations=1,
+                                           fire_threshold=0.0,
+                                           head_fraction=1.0)
+    assert retuned, "head-concentrated stream never tripped the autotune"
+    assert host.stats["geometry_retunes"] >= 1
+
+    # (a) Replay re-decides identically: restore the PRE-retune snapshot
+    # and apply the same retune decisions — byte-identical pools
+    # (pool.retune is a pure function of (state, block_slots)).
+    host2 = KernelMergeHost(flush_threshold=8)
+    host2.import_state(git.get("__pools__", pre))
+    for slots, (_nb, bk) in retuned.items():
+        host2._merge_pools[slots].retune(bk)
+    _assert_pools_identical(host, host2)
+
+    # (b) The retuned geometry itself survives the snapshot seam (the
+    # "block_geometry" stamp re-blocks the fresh pool before planes
+    # load).
+    post = git.upload("__pools__", host.export_state())
+    host3 = KernelMergeHost(flush_threshold=8)
+    host3.import_state(git.get("__pools__", post))
+    for slots, (nb, bk) in retuned.items():
+        p = host3._merge_pools[slots]
+        assert (p.nb, p.bk) == (nb, bk), slots
+    _assert_pools_identical(host, host3)
+
+    # (c) The same sequenced tail through original and both restores
+    # converges byte-identically — the tail keeps hammering the head so
+    # the incremental rebalance re-fires on the retuned geometry.
+    base = host.summarize("doc0")["sequence_number"]
+    rng = _random.Random(11)
+    tail = [seq_msg(base + 1 + i, "text",
+                    {"type": "insert", "pos": 0,
+                     "text": f"t{rng.randrange(100)} "})
+            for i in range(24)]
+    for h in (host, host2, host3):
+        for m in tail:
+            h.ingest("doc0", m)
+        h.flush()
+    assert (host2.text("doc0", "default", "text")
+            == host3.text("doc0", "default", "text")
+            == host.text("doc0", "default", "text"))
+    _assert_pools_identical(host, host2)
+    _assert_pools_identical(host, host3)
+
+
 def test_tree_channels_are_flagged_for_log_replay(tmp_path):
     """Tree channels are not snapshotted (they rebuild from the durable
     op-log replay); export records their keys so callers know."""
