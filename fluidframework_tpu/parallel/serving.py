@@ -932,4 +932,263 @@ class ShardedServing:
         return mtb.materialize(state1, pool, 0)
 
 
-__all__ = ["ShardedServing", "HostPort"]
+class ShardResidency:
+    """Per-shard tiered doc residency over one :class:`ShardedServing`
+    assembly — the multi-host face of ``server/residency.py``: each host
+    range is a fixed pool of device rows, and the REGISTERED document
+    population (doc ids) can be arbitrarily larger. A resident doc owns
+    one row inside its owning host's range; a cold doc is one host-side
+    record (its row's planes across every family + text pool + durable
+    log tail) and zero device rows.
+
+    :meth:`resolve` is the front door: it returns the doc's row,
+    hydrating on miss — restore the cold record into a recycled row, or
+    CLIENT_JOIN the configured lanes through the real sequencer kernel
+    for a first-touch doc (never state surgery: a recycled row's blanked
+    clientSeq table MUST re-join, or the new doc's cseq dedup would
+    inherit the old doc's counters). When the host range is full the LRU
+    resident evicts first; a doc with a pending (unticked) submission
+    refuses eviction.
+
+    Determinism: recency is dict insertion order, not wall time —
+    identical resolve/submit sequences make identical placement
+    decisions on every host (the same property the placement tests in
+    the single-controller tier rely on).
+
+    Single-process scope: export/blank address device shards, so each
+    process manages ONLY rows inside its ``multihost.local_docs`` slice
+    (exactly the rows it can checkpoint). Re-tuning text geometry
+    invalidates cold text planes — re-hydrate everything first (the
+    retune path already requires a settled assembly)."""
+
+    def __init__(self, serving: ShardedServing,
+                 join_slots: tuple[int, ...] = (0,)) -> None:
+        self.serving = serving
+        self._join_slots = tuple(join_slots)
+        # Free rows per host = the intersection of the host's range and
+        # this process's addressable slice (reversed so pops hand out
+        # low rows first).
+        self._free = {
+            p.host_id: list(range(
+                max(p.start, serving.local_lo),
+                min(p.stop, serving.local_hi)))[::-1]
+            for p in serving.hosts}
+        self.row_of: dict[str, int] = {}
+        self._doc_of: dict[int, str] = {}
+        # Insertion-ordered dict as the LRU spine: touch re-inserts, so
+        # iteration order alone IS the recency order (values unused).
+        self._lru: dict[str, None] = {}
+        #: doc_id -> cold record (the demoted row's full state).
+        self.cold: dict[str, dict] = {}
+        self.stats = {"hydrations": 0, "cold_hydrations": 0,
+                      "evictions": 0}
+        self._blank1: tuple[Any, dict] | None = None  # (geometry, states)
+
+    # -- directory -------------------------------------------------------------
+
+    def host_for(self, doc_id: str) -> int:
+        """Stable doc->host assignment (the bus-partition analog); any
+        process computes the same owner."""
+        import zlib
+        return zlib.crc32(doc_id.encode()) % len(self.serving.hosts)
+
+    def is_resident(self, doc_id: str) -> bool:
+        return doc_id in self.row_of
+
+    def resident_count(self, host_id: int | None = None) -> int:
+        if host_id is None:
+            return len(self.row_of)
+        port = self.serving.hosts[host_id]
+        return sum(1 for row in self._doc_of if port.owns(row))
+
+    def _touch(self, doc_id: str) -> None:
+        self._lru.pop(doc_id, None)
+        self._lru[doc_id] = None
+
+    # -- hydration -------------------------------------------------------------
+
+    def resolve(self, doc_id: str, host_id: int | None = None) -> int:
+        """The doc's device row, hydrating it on miss (possibly evicting
+        the owning host's LRU resident to free a row)."""
+        row = self.row_of.get(doc_id)
+        if row is not None:
+            self._touch(doc_id)
+            return row
+        if host_id is None:
+            host_id = self.host_for(doc_id)
+        port = self.serving.hosts[host_id]
+        free = self._free[host_id]
+        if not free:
+            pending = self.serving._pending[host_id]
+            victim = next(
+                (d for d in self._lru
+                 if port.owns(self.row_of[d])
+                 and self.row_of[d] not in pending), None)
+            if victim is None:
+                raise RuntimeError(
+                    f"host {host_id} has no free or evictable row for "
+                    f"{doc_id!r} (every resident has a pending "
+                    "submission — tick first)")
+            self.evict(victim)
+        row = free.pop()
+        cold = self.cold.pop(doc_id, None)
+        if cold is not None:
+            self._restore(row, cold)
+            self.stats["cold_hydrations"] += 1
+        else:
+            self._join_fresh(row)
+        self.row_of[doc_id] = row
+        self._doc_of[row] = doc_id
+        self._touch(doc_id)
+        self.stats["hydrations"] += 1
+        return row
+
+    def _join_fresh(self, row: int) -> None:
+        """Activate a first-touch doc's client lanes through the real
+        sequencer kernel (one row's JOIN batch; the other rows carry
+        zero valid ops)."""
+        s = self.serving
+        if not self._join_slots:
+            return
+        b_local = s.local_hi - s.local_lo
+        per_row: list[list[dict]] = [[] for _ in range(b_local)]
+        per_row[row - s.local_lo] = [
+            dict(kind=int(MessageType.CLIENT_JOIN), slot=-1, target=lane,
+                 timestamp=1) for lane in self._join_slots]
+        ops = seqk.make_op_batch(per_row, b_local, len(self._join_slots))
+        ops = multihost.feed(s.mesh, jax.tree.map(np.asarray, ops),
+                             global_batch=s.num_docs)
+        s.seq_state, out = seqk.process_batch(s.seq_state, ops)
+        jax.block_until_ready(out.kind)
+
+    def _restore(self, row: int, rec: dict) -> None:
+        s = self.serving
+
+        def write(state, rows):
+            return jax.tree.map(lambda a, r: a.at[row].set(r[0]),
+                                state, rows)
+
+        for name, planes in rec["states"].items():
+            if name == "seq":
+                s.seq_state = write(s.seq_state, planes)
+            elif name == "map":
+                s.map_state = write(s.map_state, planes)
+            elif name == "text":
+                s.merge_state = write(s.merge_state, planes)
+            elif name == "matrix":
+                s.matrix_state = write(s.matrix_state, planes)
+            elif name == "tree":
+                s.tree_state = write(s.tree_state, planes)
+            else:
+                raise ValueError(f"unknown family {name!r}")
+        if "text_pool" in rec and row in s.text_pool:
+            s.text_pool[row] = rec["text_pool"]
+            s._text_high[row] = rec["text_high"]
+        if "mx_high" in rec and row in s._mx_high:
+            s._mx_high[row] = list(rec["mx_high"])
+            s._mx_handles[row] = rec["mx_handles"]
+        if rec["durable"]:
+            s.durable[row] = rec["durable"]
+        if rec["durable_base"]:
+            s._durable_base[row] = rec["durable_base"]
+
+    # -- eviction --------------------------------------------------------------
+
+    def evict(self, doc_id: str) -> None:
+        """Demote one resident doc: export its row's planes (every
+        family) + host bookkeeping into a cold record, blank the row to
+        init fills and recycle it. The row's durable log travels with
+        the doc (records are row-relative only through placement, so
+        they replay into whatever row the doc hydrates into next)."""
+        s = self.serving
+        row = self.row_of[doc_id]
+        port = s.route(row)
+        if row in s._pending[port.host_id]:
+            raise ValueError(
+                f"{doc_id!r} (row {row}) has a pending submission — "
+                "tick before evicting")
+        if s._inflight:
+            s.flush()  # the durable log must cover in-flight ticks
+        port1 = HostPort(-1, row, row + 1)
+        rec: dict[str, Any] = {
+            "states": {
+                name: jax.tree.map(lambda a: _plane_rows(a, port1), st)
+                for name, st in s._family_states().items()},
+            "durable": s.durable.pop(row, []),
+            "durable_base": s._durable_base.pop(row, 0),
+        }
+        if row in s.text_pool:
+            rec["text_pool"] = s.text_pool[row]
+            rec["text_high"] = s._text_high[row]
+        if row in s._mx_high:
+            rec["mx_high"] = list(s._mx_high[row])
+            rec["mx_handles"] = s._mx_handles[row]
+        self.cold[doc_id] = rec
+        self._blank(row)
+        del self.row_of[doc_id]
+        del self._doc_of[row]
+        self._lru.pop(doc_id, None)
+        self._free[port.host_id].append(row)
+        self.stats["evictions"] += 1
+
+    def _blank(self, row: int) -> None:
+        s = self.serving
+        if self._blank1 is None or self._blank1[0] != s.text_geometry:
+            overlap = mtk.overlap_words_for(s.num_clients)
+            states: dict[str, Any] = {
+                "seq": seqk.init_state(1, s.num_clients + 1),
+                "map": mk.init_state(1, s.map_slots)}
+            if s.merge_state is not None:
+                states["text"] = mtb.init_state(
+                    1, *s.text_geometry, s.text_props, overlap)
+            if s.matrix_state is not None:
+                states["matrix"] = mxk.init_state(
+                    1, s.matrix_vec_slots, s.matrix_cell_slots, overlap)
+            if s.tree_state is not None:
+                states["tree"] = tk.init_state(1, s.tree_slots)
+            self._blank1 = (s.text_geometry,
+                            jax.tree.map(np.asarray, states))
+        blanks = self._blank1[1]
+
+        def write(state, rows):
+            return jax.tree.map(lambda a, r: a.at[row].set(r[0]),
+                                state, rows)
+
+        s.seq_state = write(s.seq_state, blanks["seq"])
+        s.map_state = write(s.map_state, blanks["map"])
+        if s.merge_state is not None:
+            s.merge_state = write(s.merge_state, blanks["text"])
+        if s.matrix_state is not None:
+            s.matrix_state = write(s.matrix_state, blanks["matrix"])
+        if s.tree_state is not None:
+            s.tree_state = write(s.tree_state, blanks["tree"])
+        if row in s.text_pool:
+            s.text_pool[row] = ""
+            s._text_high[row] = 0
+        if row in s._mx_high:
+            s._mx_high[row] = [0, 0, 0]
+            s._mx_handles[row] = 0
+
+    def evict_idle(self, keep_per_host: int) -> list[str]:
+        """Shrink every host's resident set to ``keep_per_host`` by
+        evicting LRU residents (pending-submission docs are skipped —
+        they are by definition not idle)."""
+        evicted: list[str] = []
+        for port in self.serving.hosts:
+            excess = self.resident_count(port.host_id) - keep_per_host
+            if excess <= 0:
+                continue
+            for doc in [d for d in self._lru
+                        if port.owns(self.row_of[d])]:
+                if excess <= 0:
+                    break
+                row = self.row_of[doc]
+                if row in self.serving._pending[port.host_id]:
+                    continue
+                self.evict(doc)
+                evicted.append(doc)
+                excess -= 1
+        return evicted
+
+
+__all__ = ["ShardedServing", "ShardResidency", "HostPort"]

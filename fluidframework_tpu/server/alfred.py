@@ -157,6 +157,17 @@ class RequestSession:
                 if retry is not None:
                     return {"rid": rid, "error": "throttled",
                             "retry_after_s": retry}
+            residency = getattr(getattr(service, "storm", None),
+                                "residency", None)
+            if residency is not None:
+                # Cold-doc connect hydrates through the admission-gated
+                # path: a hydration stampede busy-nacks with the
+                # bucket's laddered retry hint instead of serializing
+                # every cold connect behind snapshot restores.
+                retry = residency.ensure_resident(self.doc_id)
+                if retry is not None:
+                    return {"rid": rid, "error": "hydrating",
+                            "retryable": True, "retry_after_s": retry}
             self.connection = service.connect(
                 self.doc_id,
                 self.push_ops,

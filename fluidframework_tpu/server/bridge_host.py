@@ -101,6 +101,22 @@ class BridgeFrontDoor:
                         storm.flush()
                     except Exception as err:
                         self.logger.send_error("BridgeStormFlushFailed", err)
+                # Idle residency sweep on the serving thread: docs idle
+                # past the timeout demote to the cold tier here (the
+                # bridge deployment never pumps the service's own idle
+                # pass — this IS its idle cadence), freeing pool slots
+                # for the next cold-doc hydration.
+                residency = getattr(getattr(self.service, "storm", None),
+                                    "residency", None)
+                if residency is not None:
+                    try:
+                        # Bounded per pass (each eviction is a flush +
+                        # fsync + upload on this serving thread); the
+                        # next idle poll continues the drain.
+                        residency.evict_idle(max_evictions=32)
+                    except Exception as err:
+                        self.logger.send_error("BridgeEvictIdleFailed",
+                                               err)
                 continue
             try:
                 self._dispatch(*event)

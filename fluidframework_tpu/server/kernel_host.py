@@ -103,6 +103,12 @@ class KernelSequencerHost:
         self._capacity = max(1, initial_capacity)
         self._state = seqk.init_state(self._capacity, self._alloc_slots + 1)
         self._rows: dict[str, int] = {}
+        # Row recycling (the doc-residency seam): released rows return to
+        # this free list and are reissued before the high-water counter
+        # advances, so device capacity is bounded by the PEAK RESIDENT doc
+        # count — never the total number of documents ever served.
+        self._free_rows: list[int] = []
+        self._row_count = 0  # high-water mark of allocated rows
         self._slots: list[dict[str, int]] = [{} for _ in range(self._capacity)]
         # Bumped on every client->slot membership change; callers caching
         # resolved (row, slot) cohorts key on it (server/storm.py).
@@ -133,11 +139,43 @@ class KernelSequencerHost:
     def _row(self, doc_id: str) -> int:
         row = self._rows.get(doc_id)
         if row is None:
-            row = len(self._rows)
-            if row >= self._capacity:
-                self._grow_rows()
+            if self._free_rows:
+                row = self._free_rows.pop()
+            else:
+                row = self._row_count
+                if row >= self._capacity:
+                    self._grow_rows()
+                self._row_count += 1
             self._rows[doc_id] = row
         return row
+
+    def release_doc(self, doc_id: str) -> int:
+        """Free a document's device row (the eviction half of tiered doc
+        residency): blank the row back to init defaults on device and
+        recycle its index. The caller owns durability — a released row's
+        state is GONE from this host, so evict only after its checkpoint
+        (sequencer checkpoint + WAL watermark) is durable. Returns the
+        freed row index."""
+        row = self._rows.pop(doc_id)
+        assert not self._pending[row], (
+            f"release_doc({doc_id!r}) with pending ops — flush first")
+        self._ready.pop(doc_id, None)
+        self._slots[row] = {}
+        self._timeout_ms[row] = self.DEFAULT_TIMEOUT_MS
+        # Cohort caches key on the membership generation; a recycled row
+        # must never be served through a stale (row, slot) resolution.
+        self.membership_gen += 1
+        blank = seqk.init_state(1, self._alloc_slots + 1)
+        self._state = seqk.SequencerState(
+            **{f: getattr(self._state, f).at[row].set(
+                getattr(blank, f)[0]) for f in self._state._fields})
+        self._host_state = None
+        self._free_rows.append(row)
+        return row
+
+    @property
+    def resident_docs(self) -> int:
+        return len(self._rows)
 
     def _grow_rows(self) -> None:
         old = self._capacity

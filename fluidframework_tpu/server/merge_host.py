@@ -623,6 +623,10 @@ class KernelMergeHost:
 
         self._merge_rows: dict[ChannelKey, _MergeRow] = {}
         self._map_rows: dict[ChannelKey, _MapRow] = {}
+        # Map-row recycling (doc residency): released rows reissue before
+        # the high-water counter grows the state — see release_map_row.
+        self._free_map_rows: list[int] = []
+        self._map_row_count = 0
         # Shared value interning (map values + annotate values). Id 0 is
         # reserved for "absent"/None; ids index _val_rev.
         self._vals: dict[str, int] = {}
@@ -714,12 +718,32 @@ class KernelMergeHost:
     def _map_row(self, key: ChannelKey) -> _MapRow:
         state = self._map_rows.get(key)
         if state is None:
-            row = len(self._map_rows)
-            if row >= self._map_capacity:
-                self._grow_map_rows()
+            if self._free_map_rows:
+                row = self._free_map_rows.pop()
+            else:
+                row = self._map_row_count
+                if row >= self._map_capacity:
+                    self._grow_map_rows()
+                self._map_row_count += 1
             state = _MapRow(row)
             self._map_rows[key] = state
         return state
+
+    def release_map_row(self, key: ChannelKey) -> int:
+        """Free a map channel's device row (the eviction half of tiered
+        doc residency): blank the planes back to init fills and recycle
+        the index, so map capacity is bounded by the PEAK RESIDENT
+        channel count. The caller owns durability — evict only after the
+        row's snapshot is durable. Returns the freed row index."""
+        state = self._map_rows.pop(key)
+        assert not state.pending, (
+            f"release_map_row({key}) with pending ops — flush first")
+        row = state.row
+        self._xstate = mk.MapState(
+            **{f: getattr(self._xstate, f).at[row].set(_MAP_FILL[f])
+               for f in mk.MapState._fields})
+        self._free_map_rows.append(row)
+        return row
 
     def _grow_map_rows(self) -> None:
         old = self._map_capacity
@@ -2467,6 +2491,12 @@ class KernelMergeHost:
             row.last_seq = rec["last_seq"]
             row.literal_values = rec["literal"]
             self._map_rows[ChannelKey(*rec["key"])] = row
+        # Row allocator resumes past the restored rows; gaps left by
+        # pre-snapshot evictions are reissued exactly like live frees.
+        used = {r.row for r in self._map_rows.values()}
+        self._map_row_count = max(used, default=-1) + 1
+        self._free_map_rows = [r for r in range(self._map_row_count)
+                               if r not in used]
 
         mx = snap.get("matrix")
         if mx is not None:

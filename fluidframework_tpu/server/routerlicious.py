@@ -781,6 +781,17 @@ class RouterliciousService:
             ))
         if ejected:
             self._maybe_pump()
+        # Doc-granularity idle ejection rides the same cadence: resident
+        # docs idle past the residency timeout demote to the cold tier
+        # (snapshot + WAL tail), freeing their device pool slots for the
+        # next hydration. Refusals (quarantined, degraded WAL) skip.
+        # Bounded per pass: each eviction pays a flush + fsync barrier +
+        # snapshot upload on the serving thread, so a lull that idles
+        # thousands of docs at once must drain over several passes, not
+        # stall serving for one giant sweep.
+        residency = getattr(self.storm, "residency", None)
+        if residency is not None:
+            residency.evict_idle(max_evictions=32)
         return ejected
 
     def _drain_fanout(self) -> int:
@@ -833,6 +844,14 @@ class RouterliciousService:
         mode: str = "write",
         scopes: tuple[str, ...] = ScopeType.ALL,
     ) -> _LiveConnection:
+        residency = getattr(self.storm, "residency", None)
+        if residency is not None:
+            # Tiered residency: the first connect against a cold doc
+            # hydrates it (PAPER §2.6: routerlicious loads the document
+            # on connect). In-process connects bypass the hydration
+            # bucket — the front doors (alfred/bridge) gate BEFORE
+            # calling here and nack with retry_after_s.
+            residency.ensure_resident(doc_id, gate=False)
         client_number = next(self._client_counter)
         self.store.put("client_counter", client_number)
         client_id = f"client-{client_number}"
@@ -863,6 +882,14 @@ class RouterliciousService:
         announce_connect(self._connections_for(doc_id), connection)
 
     def disconnect(self, doc_id: str, client_id: str) -> None:
+        residency = getattr(self.storm, "residency", None)
+        if residency is not None:
+            # The CLIENT_LEAVE below sequences through the deli row — a
+            # cold doc must hydrate into a TRACKED pool slot first, or
+            # the leave would lazily allocate a row residency never sees
+            # (an untracked slot leak past max_resident). The doc goes
+            # idle (no clients) and re-evicts on the next sweep.
+            residency.ensure_resident(doc_id, gate=False)
         if self.fanout is not None:
             sub = self._fanout_subs.pop((doc_id, client_id), None)
             if sub is not None:
@@ -897,6 +924,16 @@ class RouterliciousService:
 
     def submit(self, doc_id: str, client_id: str,
                messages: list[DocumentMessage]) -> None:
+        residency = getattr(self.storm, "residency", None)
+        if residency is not None:
+            # Per-op traffic must refresh the doc's idle clock (or an
+            # ACTIVE doc could idle-evict mid-session) and a cold doc
+            # must hydrate into a TRACKED row before the orderer's deli
+            # submit lazily allocates one residency never sees — the
+            # same contract as connect()/disconnect(). Resident docs pay
+            # one dict re-insert (touch); only genuinely cold docs pay a
+            # restore.
+            residency.ensure_resident(doc_id, gate=False)
         self.metrics.counter("alfred.submitted_ops").inc(len(messages))
         self.orderer.connect(doc_id, client_id).order([
             RawOperation(

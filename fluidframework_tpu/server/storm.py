@@ -387,6 +387,7 @@ class StormController:
                  admission=None,
                  max_pending_docs: int | None = None,
                  busy_retry_s: float = 0.05,
+                 doc_index_retention_ticks: int | None = None,
                  logger=None) -> None:
         self.service = service
         self.seq_host = seq_host
@@ -402,9 +403,15 @@ class StormController:
             merge_host._grow_map_slots(self.max_key_slots)
         self._frames: list[_Frame] = []
         self._pending_docs = 0
-        # One-entry cohort cache: (membership_gen, ((doc, client), ...))
-        # -> resolved (seq_rows, slots, map_rows) arrays.
-        self._cohort_cache: dict = {}
+        # Bounded cohort LRU: (membership_gen, ((doc, client), ...)) ->
+        # resolved (seq_rows, slots, map_rows) arrays. Residency churn
+        # guarantees ALTERNATING cohorts (hydrations rotate the doc set),
+        # so the old single-entry cache thrashed every tick — a small LRU
+        # keeps each live cohort's resolution warm, and the hit/miss
+        # counters (storm.cohort_cache.*) make a thrash observable.
+        from ..utils import CountedLRU
+        self._cohort_cache = CountedLRU(
+            8, registry=merge_host.metrics, prefix="storm.cohort_cache")
         self._tick_counter = 0  # tick blob index
         # Tick words blobs: the bulk of the scriptorium payload. With a
         # spill dir they ride the disk WAL (the Mongo-storage analog —
@@ -497,6 +504,21 @@ class StormController:
         # is frozen out of cohorts (submits nack retryable) and serves
         # reads through the scalar record fold until readmit_doc().
         self.quarantined: dict[str, dict] = {}
+        # Tiered hot/cold residency (server/residency.py attaches
+        # itself): when set, _admit hydrates cold docs (or busy-nacks a
+        # stampede), WAL replay hydrates on first touch, and eviction
+        # trims the per-doc bookkeeping below.
+        self.residency = None
+        self._in_round = False  # mid-_flush_round (evictions refuse)
+        # Opt-in retention for the per-doc (first, last, tick) index:
+        # entries whose tick falls below ``tick_counter - retention``
+        # drop at harvest. Mirrors parallel/serving.py's
+        # durable_retention_ticks contract — catch-up reads older than
+        # the horizon become impossible (clients that far behind reload
+        # from a snapshot), and in exchange a long-lived host's index
+        # RAM is bounded by the retention window, not total history.
+        # None (default) keeps the full index.
+        self.doc_index_retention_ticks = doc_index_retention_ticks
         #: Ticks each doc participated in (telemetry: the zero-lost-ticks
         #: invariant for a quarantined doc's batch peers asserts on this).
         self.doc_tick_counts: dict[str, int] = {}
@@ -694,6 +716,31 @@ class StormController:
                                                weight=n_ops)
             if retry is not None:
                 return self._shed(push, header, n_ops, "throttled", retry)
+        if self.residency is not None:
+            cap = self.residency.max_resident
+            if cap is not None and len(docs) > cap:
+                # TERMINAL: a single frame naming more distinct docs
+                # than the pool holds can never be admitted — no amount
+                # of eviction makes room while the frame itself excludes
+                # every named doc. Say so instead of promising a retry
+                # that cannot succeed (the wal-failed precedent).
+                return self._shed(push, header, n_ops, "frame-too-wide",
+                                  self.busy_retry_s,
+                                  docs=[d for d, *_ in docs],
+                                  retryable=False)
+            # Tiered residency LAST — hydration is the one expensive
+            # gate (snapshot read + row restore, and a full pool pays an
+            # eviction's durability barrier), so frames the O(1)
+            # queue/throttle checks would shed anyway must never reach
+            # it. A hydration stampede or a full pool busy-nacks the
+            # WHOLE frame with the bucket's laddered retry hint —
+            # cold-doc storms degrade to queued hydrations, never to
+            # pool growth or OOM.
+            retry, code = self.residency.admit_docs(
+                [d for d, *_ in docs])
+            if retry is not None:
+                return self._shed(push, header, n_ops, code, retry,
+                                  docs=[d for d, *_ in docs])
         return None
 
     def _shed(self, push, header: dict, n_ops: int, code: str,
@@ -829,9 +876,16 @@ class StormController:
         queue_depth = self._pending_docs
         frames, self._frames, self._pending_docs = self._frames, [], 0
         # Bus-path ops already admitted must sequence first (per-doc total
-        # order is shared between the storm and per-op paths).
-        self.service.pump()
-        self.seq_host._flush_pending()
+        # order is shared between the storm and per-op paths). The
+        # in-round flag keeps the pump's idle pass from evicting docs out
+        # from under the cohort being assembled (residency.evict refuses
+        # while it is set).
+        self._in_round = True
+        try:
+            self.service.pump()
+            self.seq_host._flush_pending()
+        finally:
+            self._in_round = False
 
         taken: set[str] = set()
         descs: list[tuple[str, str, int, int, int]] = []
@@ -913,8 +967,8 @@ class StormController:
                 mrow = self._storm_mrow(doc)
                 map_rows[i] = mrow.row
                 mrows.append(mrow)
-            self._cohort_cache = {
-                cohort_key: (seq_rows, slots, map_rows, mrows)}
+            self._cohort_cache.put(cohort_key,
+                                   (seq_rows, slots, map_rows, mrows))
 
         b_seq = seq_host._capacity
         b_map = merge_host._map_capacity
@@ -1057,8 +1111,19 @@ class StormController:
                                 ns, fs, ls, m, offsets[i]])
             if not replaying:
                 if ns > 0:
-                    self._doc_ticks.setdefault(doc, []).append(
-                        (fs, ls, tick_id))
+                    dt = self._doc_ticks.setdefault(doc, [])
+                    dt.append((fs, ls, tick_id))
+                    retention = self.doc_index_retention_ticks
+                    if retention is not None and dt[0][2] < (
+                            tick_id - retention):
+                        # Opt-in index retention (see __init__): drop
+                        # entries below the horizon; ticks are appended
+                        # in order, so the trim is a prefix cut.
+                        horizon = tick_id - retention
+                        keep = 0
+                        while keep < len(dt) and dt[keep][2] < horizon:
+                            keep += 1
+                        del dt[:keep]
                 # Telemetry for the quarantine blast-radius invariant:
                 # batch peers of a quarantined doc lose zero ticks.
                 doc_tick_counts[doc] = doc_tick_counts.get(doc, 0) + 1
@@ -1284,6 +1349,11 @@ class StormController:
                 self.merge_host.import_state(snap["merge_host"])
                 start = snap["tick_watermark"]
                 restored_from = head
+                if self.residency is not None:
+                    # Docs the global snapshot restored are resident;
+                    # the WAL-tail replay below hydrates cold docs on
+                    # first touch (prepare_replay).
+                    self.residency.adopt_resident()
             elif self._blob_log is not None and len(self._blob_log) > 0:
                 # The WAL holds durable ticks but no snapshot is
                 # readable (corrupt head/chunks, or a crash before the
@@ -1322,6 +1392,10 @@ class StormController:
         if restored_from is not None and start < durable:
             replayed = self._replay_wal(start, durable)
         self._last_checkpoint_tick = self._tick_counter
+        if self.residency is not None:
+            # Trim the blob-scan index back to the hot set: cold docs'
+            # indexes live in their cold snapshots (restored on hydrate).
+            self.residency.after_recover()
         return {"restored_from": restored_from, "replayed_ticks": replayed}
 
     def _replay_wal(self, start: int, end: int) -> int:
@@ -1336,8 +1410,32 @@ class StormController:
                 self._tick_counter = tick
                 self._replay_ts = header["ts"]
                 entries = [e[:5] for e in header["docs"]]
+                payload = memoryview(blob)[off:]
+                if self.residency is not None:
+                    # Hydrate cold docs on first touch; drop the entries
+                    # a doc's cold snapshot already reflects (ticks
+                    # below its watermark) — watermark-exact, per-doc
+                    # independent, so peers replay unchanged.
+                    kept = self.residency.prepare_replay(entries, tick)
+                    if not kept:
+                        # Whole tick inside cold snapshots: account for
+                        # it without a device tick (ids must stay 1:1
+                        # with WAL record indices).
+                        self._tick_counter = tick + 1
+                        continue
+                    if len(kept) != len(entries):
+                        # The payload is positional (words located by
+                        # cumulative counts), so dropped entries splice
+                        # their word slices out too — each header entry
+                        # records its byte offset (index 9).
+                        w_off = {e[0]: e[9] for e in header["docs"]}
+                        payload = memoryview(b"".join(
+                            bytes(payload[w_off[doc]:
+                                          w_off[doc] + count * 4])
+                            for doc, _c, _c0, _r, count in kept))
+                    entries = kept
                 self.submit_frame(None, {"docs": entries, "rid": None},
-                                  memoryview(blob)[off:])
+                                  payload)
                 self.flush()
         finally:
             self._replay = False
@@ -1542,7 +1640,14 @@ class StormController:
         the compact in-RAM (first, last, tick) index. The shape matches
         what :func:`materialize_storm_records` consumes."""
         out = []
-        for fs, ls, tick in self._doc_ticks.get(doc_id, ()):
+        ticks = self._doc_ticks.get(doc_id)
+        if ticks is None and self.residency is not None \
+                and not self.residency.is_resident(doc_id):
+            # Cold doc: its catch-up index rode the eviction snapshot.
+            # A gap fetch is a READ — serve it from the cold head
+            # without hydrating (readers must not churn the pool).
+            ticks = self.residency.cold_doc_ticks(doc_id)
+        for fs, ls, tick in ticks or ():
             if ls <= from_seq or (to_seq is not None and fs > to_seq):
                 continue
             header, _off = self._parse_header(self._read_blob(tick))

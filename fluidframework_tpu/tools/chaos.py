@@ -75,6 +75,14 @@ KILL_POINTS = (
 #: torn group commit, torn checkpoint).
 SMOKE_POINTS = ("storm.mid_tick", "wal.pre_fsync", "snapshot.pre_publish")
 
+#: Residency kill classes (ISSUE 9): the child runs with a device pool
+#: capped BELOW the doc count (``residency=`` in run_chaos), so every
+#: round demotes the LRU doc and hydrates the cold one — each point
+#: fires mid-transition. Recovery must reconverge byte-identically with
+#: no acked-durable op lost, whether the doc died hot, cold, or halfway.
+RESIDENCY_KILL_POINTS = ("residency.mid_hydrate", "residency.mid_evict",
+                         "residency.post_evict")
+
 
 # -- child process (the serving host under test) ------------------------------
 
@@ -117,13 +125,19 @@ def _tick_words(seed: int, round_no: int, doc_i: int, k: int,
     return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
 
 
-def _digest(service, storm, seq_host, merge_host, docs: list[str]) -> dict:
+def _digest(service, storm, seq_host, merge_host, docs: list[str],
+            residency=None) -> dict:
     """Canonical serialization of every compared plane (see module doc
-    for the two excluded arrival-clock planes)."""
+    for the two excluded arrival-clock planes). With a residency tier
+    attached, each doc hydrates just before its planes are read — a doc
+    that finished the run cold must digest identically to one that
+    stayed hot."""
     from ..protocol.codec import to_wire
 
     out: dict = {"docs": {}}
     for doc in docs:
+        if residency is not None:
+            residency.ensure_resident(doc, gate=False)
         history = []
         for m in service.get_deltas(doc, 0):
             history.append([
@@ -156,6 +170,18 @@ def child_main(args) -> None:
     docs = [f"chaos-doc-{i}" for i in range(args.docs)]
     service, storm, seq_host, merge_host = _build_stack(args.dir, args.docs)
 
+    residency = None
+    if args.residency:
+        # Device pool capped below the doc count: every round's frame
+        # against the round-robin cold doc forces an LRU eviction + a
+        # hydration — the residency crashpoints fire mid-transition.
+        # Deterministic tiering: idle eviction parked (capacity is the
+        # only eviction trigger), hydration bucket effectively unmetered.
+        from ..server.residency import ResidencyManager
+        residency = ResidencyManager(storm, max_resident=args.residency,
+                                     idle_evict_s=1e9,
+                                     hydration_rate_per_s=1e9)
+
     if args.resume_from is None:
         # Fresh life: joins + the genesis checkpoint (so every recovery
         # has a snapshot to restore — the harness arms kills only after).
@@ -178,19 +204,37 @@ def child_main(args) -> None:
     k = args.k
     for r in range(start, args.ticks):
         acks: list = []
-        entries = [[d, clients[d], 1 + r * k, 1, k] for d in docs]
-        payload = b"".join(
-            _tick_words(args.seed, r, i, k).tobytes()
-            for i in range(len(docs)))
-        storm.submit_frame(acks.append, {"rid": r, "docs": entries},
-                           memoryview(payload))
-        storm.flush()
-        if acks:
-            print(f"ACKED {r}", flush=True)
+        if residency is not None:
+            # Per-doc frames so the residency gate sees each doc alone
+            # (a whole-cohort frame could never fit the capped pool);
+            # the round is ACKED only when EVERY doc's frame acked.
+            for i, d in enumerate(docs):
+                payload = _tick_words(args.seed, r, i, k).tobytes()
+                storm.submit_frame(
+                    acks.append,
+                    {"rid": r * len(docs) + i,
+                     "docs": [[d, clients[d], 1 + r * k, 1, k]]},
+                    memoryview(payload))
+                storm.flush()
+            ok = [a for a in acks
+                  if not (isinstance(a, dict) and a.get("error"))]
+            if len(ok) == len(docs):
+                print(f"ACKED {r}", flush=True)
+        else:
+            entries = [[d, clients[d], 1 + r * k, 1, k] for d in docs]
+            payload = b"".join(
+                _tick_words(args.seed, r, i, k).tobytes()
+                for i in range(len(docs)))
+            storm.submit_frame(acks.append, {"rid": r, "docs": entries},
+                               memoryview(payload))
+            storm.flush()
+            if acks:
+                print(f"ACKED {r}", flush=True)
         if (r + 1) % args.cp_every == 0:
             storm.checkpoint()
     faults.disarm()
-    digest = _digest(service, storm, seq_host, merge_host, docs)
+    digest = _digest(service, storm, seq_host, merge_host, docs,
+                     residency=residency)
     print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
 
 
@@ -199,11 +243,14 @@ def child_main(args) -> None:
 
 def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 cp_every: int, resume_from: int | None,
-                kill_env: str | None, timeout: float) -> dict:
+                kill_env: str | None, timeout: float,
+                residency: int | None = None) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
            "--cp-every", str(cp_every)]
+    if residency is not None:
+        cmd += ["--residency", str(residency)]
     if resume_from is not None:
         cmd += ["--resume-from", str(resume_from)]
     env = dict(os.environ)
@@ -226,14 +273,18 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
 def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               seed: int = 0, docs: int = 2, k: int = 8, ticks: int = 5,
               cp_every: int = 2, timeout: float = 300.0,
-              twin_digest: dict | None = None) -> dict:
+              twin_digest: dict | None = None,
+              residency: int | None = None) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
-    twin across scenarios of the same configuration."""
+    twin across scenarios of the same configuration. ``residency`` caps
+    the child's device pool BELOW ``docs`` so every round crosses the
+    hot/cold boundary (the RESIDENCY_KILL_POINTS scenarios)."""
     from ..utils import faults
 
-    cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every)
+    cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
+               residency=residency)
     if twin_digest is None:
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
                            kill_env=None, timeout=timeout, **cfg)
@@ -798,6 +849,9 @@ def main(argv=None) -> None:
     parser.add_argument("--k", type=int, default=8)
     parser.add_argument("--ticks", type=int, default=5)
     parser.add_argument("--cp-every", type=int, default=2)
+    parser.add_argument("--residency", type=int, default=None,
+                        help="cap the device pool at N resident docs "
+                             "(tiered hot/cold residency under test)")
     parser.add_argument("--resume-from", type=int, default=None)
     parser.add_argument("--kill-point", default=None)
     parser.add_argument("--kill-hits", type=int, default=1)

@@ -134,6 +134,34 @@ def render_rebalance(metrics: dict, prev: dict | None = None) -> str:
             f"host pre-tick fires {host_fires:g}  retunes {retunes:g}")
 
 
+def render_residency(metrics: dict, prev: dict | None = None,
+                     interval: float = 1.0) -> str:
+    """Doc-residency line (the round-12 tiering plane): hot / known-cold
+    / hydrating gauge levels, hydration + eviction rates over the poll
+    window (cumulative counters when no window), hydration p99, and the
+    process RSS the tiering exists to bound. Empty when no residency
+    manager is attached (the gauges never appear)."""
+    if "residency.hot_docs" not in metrics:
+        return ""
+    hot = metrics.get("residency.hot_docs", 0)
+    cold = metrics.get("residency.known_cold_docs", 0)
+    hydrating = metrics.get("residency.hydrating_docs", 0)
+    hyd = metrics.get("residency.hydrations", 0)
+    evi = metrics.get("residency.evictions", 0)
+    per_s = max(interval, 1e-9)
+    if prev:
+        w_h = hyd - prev.get("residency.hydrations", 0)
+        w_e = evi - prev.get("residency.evictions", 0)
+        if w_h >= 0 and w_e >= 0:  # negative = service restarted
+            hyd, evi = w_h / per_s, w_e / per_s
+    p99 = metrics.get("residency.hydrate_s.p99", 0.0) * 1e3
+    rss = metrics.get("residency.rss_mb", 0.0)
+    return (f"residency: hot {hot:g}  cold {cold:g}  "
+            f"hydrating {hydrating:g}  hydrations {hyd:,.1f}/s "
+            f"p99 {p99:.3f}ms  evictions {evi:,.1f}/s  "
+            f"rss {rss:,.0f}MB")
+
+
 def render_human(now: dict, prev: dict, interval: float) -> str:
     """Operator view of one poll: headline rates (per-second deltas of
     the interesting counters), the stage bar, and the hop decomposition
@@ -160,6 +188,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     rebal = render_rebalance(now, prev or None)
     if rebal:
         lines.append(rebal)
+    residency = render_residency(now, prev or None, interval)
+    if residency:
+        lines.append(residency)
     hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
                        if k.startswith("storm.hop.")})
     if hop_keys:

@@ -8,6 +8,7 @@ from .config import Config, default_config
 from .events import BatchManager, Deferred, Heap, TypedEventEmitter
 from .metrics import (
     STORM_STAGES,
+    CountedLRU,
     Counter,
     Gauge,
     Histogram,
@@ -33,6 +34,7 @@ __all__ = [
     "ChildLogger",
     "CollectingLogger",
     "Config",
+    "CountedLRU",
     "Counter",
     "DebugLogger",
     "Deferred",

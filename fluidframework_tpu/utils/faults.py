@@ -24,6 +24,12 @@ Registered points (grep for ``crashpoint(`` to audit):
                             the same geometry)
 ``snapshot.mid_upload``     snapshot chunks partially written
 ``snapshot.pre_publish``    snapshot uploaded, head ref NOT yet flipped
+``residency.mid_hydrate``   cold-doc hydration mid-restore (sequencer row
+                            installed, map row NOT yet) — volatile only
+``residency.mid_evict``     cold snapshot uploaded, head ref NOT yet
+                            flipped, device rows still live
+``residency.post_evict``    cold head flipped, device rows NOT yet
+                            released (doc durable both ways)
 ==========================  ==================================================
 
 A plan is inert until :func:`arm` — the harness arms only after its

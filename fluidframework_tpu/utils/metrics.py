@@ -245,6 +245,69 @@ class StageLedger:
         return out
 
 
+class CountedLRU:
+    """Small bounded LRU map with hit/miss counters wired into a shared
+    registry — the cache shape the serving hot path needs (storm cohort
+    resolution, residency cold-handle lookups): O(1) get/put, strict
+    entry bound, and an observable hit rate so a thrashing cache shows
+    up in a metrics scrape instead of as unexplained tick time.
+
+    NOT thread-safe by itself — callers on the serving thread use it
+    bare; cross-thread users wrap it."""
+
+    __slots__ = ("capacity", "_data", "_hits", "_misses")
+
+    def __init__(self, capacity: int,
+                 registry: "MetricsRegistry | None" = None,
+                 prefix: str = "lru") -> None:
+        from collections import OrderedDict
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._data: Any = OrderedDict()
+        reg = registry if registry is not None else MetricsRegistry()
+        self._hits = reg.counter(f"{prefix}.hits")
+        self._misses = reg.counter(f"{prefix}.misses")
+
+    def get(self, key, default=None):
+        try:
+            value = self._data[key]
+        except KeyError:
+            self._misses.inc()
+            return default
+        self._data.move_to_end(key)
+        self._hits.inc()
+        return value
+
+    def put(self, key, value) -> None:
+        data = self._data
+        if key in data:
+            data.move_to_end(key)
+        data[key] = value
+        if len(data) > self.capacity:
+            data.popitem(last=False)  # evict least-recently-used
+
+    def pop(self, key, default=None):
+        return self._data.pop(key, default)
+
+    def clear(self) -> None:
+        self._data.clear()
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    @property
+    def hits(self) -> int:
+        return int(self._hits.value)
+
+    @property
+    def misses(self) -> int:
+        return int(self._misses.value)
+
+
 class MetricsRegistry:
     """Named metric bag. ``snapshot()`` flattens to {name: float}; counters
     and gauges sum across shards, histograms export count/mean/p50/p99/max.

@@ -86,6 +86,57 @@ def test_kill_exit_code_is_distinct():
     assert faults.KILL_EXIT_CODE == 137
 
 
+# -- residency kill classes (ISSUE 9): tier-1 smoke + slow matrix --------------
+
+#: Pool capped at 2 of 3 docs: every round's frame against the
+#: round-robin cold doc forces an LRU eviction + a hydration, so the
+#: residency crashpoints genuinely fire mid-transition.
+_RES_CFG = dict(seed=0, docs=3, k=8, ticks=5, cp_every=2, residency=2)
+
+_RES_SMOKE = [("residency.mid_hydrate", 2), ("residency.mid_evict", 1)]
+
+
+@pytest.fixture(scope="session")
+def residency_twin_digest(tmp_path_factory):
+    """Uninterrupted twin of the capped-pool workload (shared)."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("res_twin")), resume_from=None,
+        kill_env=None, timeout=300, **_RES_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _RES_SMOKE,
+                         ids=[p for p, _ in _RES_SMOKE])
+def test_residency_chaos_smoke_recovers_byte_identical(
+        point, hits, tmp_path, residency_twin_digest):
+    """Kill mid-hydrate / mid-evict: recovery must reconverge
+    byte-identically with the uninterrupted twin and lose zero
+    acked-durable ops — whether each doc died hot, cold, or halfway
+    through the transition (the acceptance bar of ISSUE 9)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=residency_twin_digest,
+                             **_RES_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_RES_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_residency_chaos_full_matrix(seed, tmp_path):
+    """Every residency kill point × two hit positions, per seed."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.RESIDENCY_KILL_POINTS, seeds=(seed,),
+        hit_positions=(1, 2), docs=3, k=8, ticks=6, cp_every=2,
+        residency=2)
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
+
+
 # -- overload fault classes (ISSUE 5): tier-1 smoke + slow matrix --------------
 
 

@@ -242,3 +242,54 @@ def test_rebalance_line_renders_fire_rate():
     prev_big = {"storm.device.rebalance_fired": 10.0,
                 "storm.stage.scatter.count": 100.0}
     assert "0.25/tick" in render_rebalance(m, prev_big)
+
+
+def test_residency_line_renders_tiering_state():
+    """Round-12 residency line: silent without a residency manager,
+    gauge levels + windowed hydration/eviction rates + hydration p99 +
+    RSS, cumulative fallback across restarts — and the same metrics
+    flow through --json watch mode untouched."""
+    import io
+    import json
+
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_residency
+
+    assert render_residency({}) == ""  # no manager attached → no line
+    m = {"residency.hot_docs": 100.0,
+         "residency.known_cold_docs": 9900.0,
+         "residency.hydrating_docs": 3.0,
+         "residency.hydrations": 50.0,
+         "residency.evictions": 40.0,
+         "residency.hydrate_s.p99": 0.0042,
+         "residency.rss_mb": 512.0}
+    text = render_residency(m)
+    assert "hot 100" in text and "cold 9900" in text
+    assert "hydrating 3" in text
+    assert "4.200ms" in text
+    assert "rss 512MB" in text
+    # Windowed rates over a 2s poll: (50-40)/2 and (40-38)/2.
+    prev = {"residency.hydrations": 40.0, "residency.evictions": 38.0}
+    windowed = render_residency(m, prev, interval=2.0)
+    assert "hydrations 5.0/s" in windowed
+    assert "evictions 1.0/s" in windowed
+    # Restart (negative window): fall back to cumulative counts.
+    prev_big = {"residency.hydrations": 999.0, "residency.evictions": 0.0}
+    assert "hydrations 50.0/s" in render_residency(m, prev_big,
+                                                   interval=1.0)
+    # Human watch mode carries the line; --json carries the raw metrics.
+    human = monitor.render_human(m, prev, interval=2.0)
+    assert "residency: hot 100" in human
+
+    scrapes = iter([dict(m)])
+    real_scrape = monitor.scrape
+    monitor.scrape = lambda *a, **k: next(scrapes)
+    try:
+        out = io.StringIO()
+        monitor.watch("h", 1, interval=0.0, out=out, as_json=True,
+                      max_polls=1)
+    finally:
+        monitor.scrape = real_scrape
+    line = json.loads(out.getvalue().strip())
+    assert line["residency.hot_docs"] == 100.0
+    assert line["residency.rss_mb"] == 512.0

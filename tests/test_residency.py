@@ -1,0 +1,523 @@
+"""Tiered hot/cold doc residency (server/residency.py): hydrate on
+cold connect/first-op, idle + capacity eviction through the durable
+snapshot tier, byte-identical re-hydration, admission-gated hydration
+storms, refusal invariants (quarantine pins, degraded WAL), bounded
+per-doc bookkeeping under churn, and the bounded cohort LRU."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server.durable_store import (
+    DurableMessageBus,
+    FileStateStore,
+    GitSnapshotStore,
+)
+from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+from fluidframework_tpu.server.merge_host import ChannelKey, KernelMergeHost
+from fluidframework_tpu.server.residency import (
+    COLD_KEY_PREFIX,
+    EvictionRefused,
+    ResidencyManager,
+)
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+from fluidframework_tpu.server.storm import StormController
+from fluidframework_tpu.tools import chaos
+from fluidframework_tpu.utils import CountedLRU
+from fluidframework_tpu.utils.metrics import MetricsRegistry
+
+K = 8
+
+
+def build_stack(tmp_path, num_docs=4, residency=True, clock=None,
+                storm_kw=None, **res_kw):
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=num_docs)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    # Durable bus + store (the deli/scriptorium pair): the recovery test
+    # rebuilds a stack over the same directories, and client joins must
+    # survive the restart exactly as in the chaos harness stack.
+    service = RouterliciousService(
+        bus=DurableMessageBus(str(tmp_path / "bus")),
+        store=FileStateStore(str(tmp_path / "state")),
+        merge_host=merge_host, batched_deli_host=seq_host,
+        auto_pump=False, idle_check_interval=10**9)
+    storm = StormController(
+        service, seq_host, merge_host, flush_threshold_docs=10**9,
+        spill_dir=str(tmp_path / "spill"), durability="group",
+        snapshots=GitSnapshotStore(tmp_path / "git"),
+        **(storm_kw or {}))
+    res = None
+    if residency:
+        kw = dict(idle_evict_s=1e9, hydration_rate_per_s=1e9)
+        kw.update(res_kw)
+        if clock is not None:
+            kw["clock"] = clock
+        res = ResidencyManager(storm, **kw)
+    return service, storm, seq_host, merge_host, res
+
+
+def tick_words(seed, k=K):
+    rng = np.random.default_rng(seed)
+    kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
+    slots = rng.integers(0, 16, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def set_words(r, k=K):
+    """Deterministic SET-only words: slot i <- value r*K+i+1 (no clears,
+    so the converged planes are guaranteed non-trivial)."""
+    slots = np.arange(k, dtype=np.uint32)
+    vals = np.arange(1 + r * k, 1 + (r + 1) * k, dtype=np.uint32)
+    return (slots << np.uint32(2)) | (vals << np.uint32(12))
+
+
+def drive(storm, doc, client, r, k=K, push=None, rid=None, words=None):
+    """One per-doc frame + settle (the per-doc shape residency gates)."""
+    import zlib
+    payload = (words if words is not None
+               else tick_words((zlib.crc32(doc.encode()) & 0xFFFF, r),
+                               k)).tobytes()
+    storm.submit_frame(push,
+                       {"rid": r if rid is None else rid,
+                        "docs": [[doc, client, 1 + r * k, 1, k]]},
+                       memoryview(payload))
+    storm.flush()
+
+
+def connect_docs(service, docs):
+    clients = {d: service.connect(d, lambda m: None).client_id
+               for d in docs}
+    service.pump()
+    return clients
+
+
+class TestLifecycle:
+    def test_evict_then_cold_first_op_hydrates(self, tmp_path):
+        service, storm, seq_host, merge_host, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["a", "b"])
+        for r in range(2):
+            for d in ("a", "b"):
+                drive(storm, d, clients[d], r, words=set_words(r))
+        ckey = ChannelKey("a", storm.datastore, storm.channel)
+
+        def planes_of(doc):
+            row = merge_host._map_rows[ckey].row
+            xs = merge_host._xstate
+            return {f: np.asarray(getattr(xs, f)[row])
+                    for f in ("present", "value", "vseq")}
+
+        before_planes = planes_of("a")
+        before_cp = dataclasses.asdict(seq_host.checkpoint("a"))
+        assert np.asarray(before_planes["vseq"]).max() > 0  # served state
+
+        handle = res.evict("a")
+        assert handle
+        assert not res.is_resident("a")
+        assert "a" not in seq_host._rows  # device row released
+        assert ckey not in merge_host._map_rows
+        assert "a" not in storm._doc_ticks  # bookkeeping trimmed
+        assert "a" not in storm.doc_tick_counts
+        assert res.cold_handle("a") == handle
+        assert storm.snapshots.head(COLD_KEY_PREFIX + "a") == handle
+
+        # Hydration restores the planes byte-identically (no ops between
+        # the evict and the hydrate).
+        res.ensure_resident("a", gate=False)
+        assert res.is_resident("a")
+        assert res.stats["cold_hydrations"] == 1
+        after_planes = planes_of("a")
+        for f, want in before_planes.items():
+            assert np.array_equal(after_planes[f], want), f
+        assert dataclasses.asdict(seq_host.checkpoint("a")) == before_cp
+
+        # And the hydrated doc keeps serving: first op acks cleanly.
+        acks = []
+        drive(storm, "a", clients["a"], 2, push=acks.append)
+        assert acks and not acks[0].get("error")
+
+    def test_cold_connect_hydrates(self, tmp_path):
+        service, storm, seq_host, _mh, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["a"])
+        drive(storm, "a", clients["a"], 0)
+        res.evict("a")
+        assert not res.is_resident("a")
+        # A NEW connect against the cold doc hydrates (PAPER §2.6: the
+        # document loads on connect).
+        service.connect("a", lambda m: None)
+        assert res.is_resident("a")
+        assert res.stats["cold_hydrations"] == 1
+
+    def test_cold_doc_catchup_read_without_hydration(self, tmp_path):
+        """A gap fetch against a COLD doc must return the full history
+        (served from the cold snapshot's tick index) WITHOUT hydrating —
+        readers must not churn the pool."""
+        service, storm, *_, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["a"])
+        for r in range(3):
+            drive(storm, "a", clients["a"], r)
+        want = [(m.sequence_number, m.client_sequence_number)
+                for m in service.get_deltas("a", 0)]
+        assert len(want) >= 3 * K
+        res.evict("a")
+        got = [(m.sequence_number, m.client_sequence_number)
+               for m in service.get_deltas("a", 0)]
+        assert got == want
+        assert not res.is_resident("a")  # the read did NOT hydrate
+
+    def test_disconnect_cold_doc_does_not_leak_untracked_row(
+            self, tmp_path):
+        """A CLIENT_LEAVE against a cold doc sequences through the deli
+        row — it must hydrate into a TRACKED slot first, or the leave
+        would lazily allocate a row residency never sees (an untracked
+        pool leak past max_resident)."""
+        service, storm, seq_host, _mh, res = build_stack(tmp_path)
+        conn = service.connect("a", lambda m: None)
+        service.pump()
+        drive(storm, "a", conn.client_id, 0)
+        res.evict("a")
+        assert "a" not in seq_host._rows
+        service.disconnect("a", conn.client_id)
+        service.pump()
+        # Every live device row is accounted to the residency directory.
+        assert set(seq_host._rows) <= set(res.resident)
+        assert res.is_resident("a")
+        res.evict("a")  # and the now-idle doc evicts cleanly again
+        assert "a" not in seq_host._rows
+
+    def test_per_op_submit_touches_and_hydrates(self, tmp_path):
+        """The per-op path must refresh the idle clock (an ACTIVE doc
+        must never idle-evict mid-session) and hydrate a cold doc into a
+        TRACKED row — otherwise the orderer's deli submit would lazily
+        allocate a blank row, regressing sequence numbers and corrupting
+        the next cold head."""
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage, MessageType)
+        clk = [0.0]
+        service, storm, seq_host, _mh, res = build_stack(
+            tmp_path, clock=lambda: clk[0], idle_evict_s=10.0)
+        conn = service.connect("a", lambda m: None)
+        service.pump()
+
+        def per_op(i):
+            service.submit("a", conn.client_id, [DocumentMessage(
+                type=MessageType.OPERATION, contents={"op": i},
+                client_sequence_number=i, reference_sequence_number=1)])
+            service.pump()
+
+        per_op(1)
+        seq_before = seq_host.checkpoint("a").sequence_number
+        # Active per-op traffic past the idle timeout: the touch keeps
+        # the doc hot (evict_idle must find nothing).
+        clk[0] = 12.0
+        per_op(2)
+        assert res.evict_idle() == []
+        # Cold doc + per-op submit: hydrates tracked, sequence numbers
+        # CONTINUE (no blank-row regression).
+        res.evict("a")
+        assert "a" not in seq_host._rows
+        per_op(3)
+        assert res.is_resident("a")
+        assert set(seq_host._rows) <= set(res.resident)
+        assert seq_host.checkpoint("a").sequence_number > seq_before
+
+    def test_frame_wider_than_pool_nacks_terminal(self, tmp_path):
+        """A frame naming more distinct docs than the pool holds can
+        NEVER be admitted — the nack must be non-retryable (the
+        wal-failed precedent), not a retry loop that cannot succeed."""
+        service, storm, *_ , res = build_stack(tmp_path, max_resident=2)
+        nacks = []
+        entries = [[f"w{i}", f"client-{i}", 1, 1, K] for i in range(3)]
+        payload = b"".join(set_words(0).tobytes() for _ in range(3))
+        storm.submit_frame(nacks.append, {"rid": 1, "docs": entries},
+                           memoryview(payload))
+        assert nacks and nacks[0]["error"] == "frame-too-wide"
+        assert nacks[0]["retryable"] is False
+
+    def test_idle_evict_at_timeout(self, tmp_path):
+        clk = [0.0]
+        service, storm, *_ , res = build_stack(
+            tmp_path, clock=lambda: clk[0], idle_evict_s=10.0)
+        clients = connect_docs(service, ["a", "b"])
+        drive(storm, "a", clients["a"], 0)
+        clk[0] = 5.0
+        drive(storm, "b", clients["b"], 0)
+        clk[0] = 12.0  # a idle 12s, b idle 7s
+        evicted = res.evict_idle()
+        assert evicted == ["a"]
+        assert not res.is_resident("a") and res.is_resident("b")
+        clk[0] = 16.0
+        assert res.evict_idle() == ["b"]
+        assert res.resident == {}
+
+    def test_rehydrate_byte_identical_vs_never_evicted_twin(self, tmp_path):
+        """Snapshot + WAL-tail replay ≡ never-evicted twin: a stack whose
+        pool holds ONE doc (every frame evicts the LRU and hydrates the
+        target) must end byte-identical to a twin that never tiers."""
+        docs = ["a", "b", "c"]
+        churn = build_stack(tmp_path / "churn", max_resident=1)
+        twin = build_stack(tmp_path / "twin", residency=False)
+        digests = []
+        for service, storm, seq_host, merge_host, res in (churn, twin):
+            clients = connect_docs(service, docs)
+            for r in range(4):
+                for d in docs:
+                    drive(storm, d, clients[d], r)
+            if res is not None:
+                assert res.stats["evictions"] >= 8  # genuinely churned
+                assert res.stats["cold_hydrations"] >= 8
+            digests.append(chaos._digest(service, storm, seq_host,
+                                         merge_host, docs, residency=res))
+        assert digests[0] == digests[1]
+
+    def test_recover_trims_cold_docs_and_rehydrates(self, tmp_path):
+        service, storm, seq_host, merge_host, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["a", "b"])
+        for r in range(2):
+            for d in ("a", "b"):
+                drive(storm, d, clients[d], r)
+        res.evict("a")
+        storm.checkpoint()
+        want = chaos._digest(service, storm, seq_host, merge_host,
+                             ["a", "b"], residency=res)
+
+        # Process death: a fresh stack over the same durable directories.
+        service2, storm2, seq2, merge2, res2 = build_stack(tmp_path)
+        info = storm2.recover()
+        assert info["restored_from"] is not None
+        assert res2.is_resident("b")
+        assert not res2.is_resident("a")  # stayed cold through recovery
+        assert "a" not in storm2._doc_ticks  # RAM stays O(hot)
+        got = chaos._digest(service2, storm2, seq2, merge2, ["a", "b"],
+                            residency=res2)
+        assert got == want
+        assert res2.stats["cold_hydrations"] >= 1  # the digest hydrated a
+
+
+class TestRefusals:
+    def test_quarantined_doc_pinned_resident(self, tmp_path):
+        clk = [0.0]
+        service, storm, *_, res = build_stack(
+            tmp_path, clock=lambda: clk[0], idle_evict_s=10.0)
+        clients = connect_docs(service, ["a", "b"])
+        for d in ("a", "b"):
+            drive(storm, d, clients[d], 0)
+        storm.quarantined["a"] = {"reason": "test", "tick": 0}
+        with pytest.raises(EvictionRefused):
+            res.evict("a")
+        clk[0] = 20.0
+        assert res.evict_idle() == ["b"]  # a skipped: pinned resident
+        assert res.is_resident("a")
+        assert res.stats["evict_refusals"] >= 1
+
+    def test_degraded_wal_refuses_eviction(self, tmp_path):
+        service, storm, *_, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["a"])
+        drive(storm, "a", clients["a"], 0)
+        storm._group_wal.breaker.record_failure()
+        assert storm.wal_degraded
+        with pytest.raises(EvictionRefused):
+            res.evict("a")
+        assert res.is_resident("a")
+        storm._group_wal.breaker.record_success()
+        res.evict("a")
+        assert not res.is_resident("a")
+
+    def test_full_pool_of_pinned_docs_busy_nacks(self, tmp_path):
+        service, storm, *_, res = build_stack(tmp_path, max_resident=1)
+        clients = connect_docs(service, ["a"])
+        drive(storm, "a", clients["a"], 0)
+        storm.quarantined["a"] = {"reason": "test", "tick": 0}
+        nacks = []
+        drive(storm, "b", "client-99", 0, push=nacks.append, rid=77)
+        assert nacks and nacks[0]["error"] == "busy"
+        assert nacks[0]["retry_after_s"] > 0
+        assert not res.is_resident("b")
+
+
+class TestCapacityAndAdmission:
+    def test_lru_capacity_eviction(self, tmp_path):
+        service, storm, seq_host, _mh, res = build_stack(
+            tmp_path, max_resident=2)
+        clients = connect_docs(service, ["a", "b"])
+        drive(storm, "a", clients["a"], 0)
+        drive(storm, "b", clients["b"], 0)
+        # A third doc's frame must evict the LRU (a), not grow the pool.
+        drive(storm, "c", "client-42", 0)
+        assert res.is_resident("c") and res.is_resident("b")
+        assert not res.is_resident("a")
+        assert len(res.resident) == 2
+        # Device rows recycled, not grown: the high-water mark is bounded
+        # by the PEAK RESIDENT count, never the registered population.
+        assert seq_host._row_count <= 2 + 1  # +1: c joined before a evicted
+
+    def test_hydration_storm_is_admission_gated(self, tmp_path):
+        clk = [0.0]
+        service, storm, *_, res = build_stack(
+            tmp_path, clock=lambda: clk[0],
+            hydration_rate_per_s=1.0, hydration_burst=1.0)
+        clients = connect_docs(service, ["a"])
+        drive(storm, "a", clients["a"], 0)
+        res.evict("a")
+        res.evict_idle()  # no-op, just exercises the sweep guard
+
+        # Burst=1: the first cold-doc frame hydrates, the second nacks
+        # with the bucket's laddered retry hint.
+        drive(storm, "a", clients["a"], 1)
+        assert res.is_resident("a")
+        nacks = []
+        drive(storm, "b", "client-9", 0, push=nacks.append, rid=5)
+        assert nacks and nacks[0]["error"] == "hydrating"
+        retry = nacks[0]["retry_after_s"]
+        assert retry > 0
+        assert res.stats["hydration_nacks"] == 1
+
+        # The refusal reserved a CLAIMABLE slot: returning at the hint
+        # succeeds without re-debiting (no compounding retry debt).
+        clk[0] += retry
+        acks = []
+        drive(storm, "b", "client-9", 0, push=acks.append, rid=6)
+        assert acks and not acks[0].get("error")
+        assert res.is_resident("b")
+
+    def test_early_return_keeps_same_reservation(self, tmp_path):
+        clk = [0.0]
+        service, storm, *_, res = build_stack(
+            tmp_path, clock=lambda: clk[0],
+            hydration_rate_per_s=1.0, hydration_burst=1.0)
+        retry0 = res.ensure_resident("x")
+        assert retry0 is None  # burst token
+        retry1 = res.ensure_resident("y")
+        assert retry1 is not None
+        # Coming back EARLY returns the remaining wait on the SAME slot
+        # (no second debit against the bucket).
+        clk[0] += retry1 / 2
+        retry2 = res.ensure_resident("y")
+        assert retry2 == pytest.approx(retry1 - retry1 / 2, abs=1e-6)
+        clk[0] += retry2
+        assert res.ensure_resident("y") is None
+
+
+class TestBoundedBookkeeping:
+    def test_doc_bookkeeping_stays_o_hot_under_churn(self, tmp_path):
+        """Satellite: _doc_ticks / doc_tick_counts trim on eviction, so
+        a churned many-doc run keeps them O(hot set) — never one entry
+        per doc ever served."""
+        hot = 4
+        service, storm, seq_host, _mh, res = build_stack(
+            tmp_path, num_docs=hot, max_resident=hot)
+        n_docs = 48
+        clients = {}
+        for i in range(n_docs):
+            doc = f"doc-{i}"
+            clients[doc] = service.connect(doc, lambda m: None).client_id
+            service.pump()
+            drive(storm, doc, clients[doc], 0)
+        assert res.stats["evictions"] >= n_docs - hot
+        assert len(res.resident) == hot
+        assert len(storm._doc_ticks) <= hot
+        assert len(storm.doc_tick_counts) <= hot
+        assert seq_host._row_count <= hot
+        # The trimmed bookkeeping travels with the doc: re-hydrating an
+        # early victim restores its tick index and telemetry count.
+        drive(storm, "doc-0", clients["doc-0"], 1)
+        assert storm.doc_tick_counts["doc-0"] == 2
+        assert len(storm._doc_ticks["doc-0"]) == 2
+
+    def test_doc_index_retention_horizon(self, tmp_path):
+        service, storm, *_ , res = build_stack(
+            tmp_path, storm_kw=dict(doc_index_retention_ticks=3))
+        clients = connect_docs(service, ["a"])
+        for r in range(8):
+            drive(storm, "a", clients["a"], r)
+        ticks = storm._doc_ticks["a"]
+        assert len(ticks) <= 4  # horizon + the tick that triggered it
+        assert ticks[-1][2] == storm._tick_counter - 1
+        assert all(t[2] >= storm._tick_counter - 1 - 3 for t in ticks)
+
+
+class TestCohortCache:
+    def test_cohort_cache_is_bounded_lru_with_counters(self, tmp_path):
+        """Satellite: residency churn alternates cohorts; the old
+        single-entry cache thrashed every tick. The bounded LRU keeps
+        each live cohort warm and exports hit/miss counters."""
+        service, storm, _sh, merge_host, _res = build_stack(
+            tmp_path, residency=False)
+        clients = connect_docs(service, ["a", "b"])
+        # Alternate two single-doc cohorts — the single-entry cache
+        # would miss every round.
+        for r in range(4):
+            for d in ("a", "b"):
+                drive(storm, d, clients[d], r)
+        snap = merge_host.metrics.snapshot()
+        assert snap["storm.cohort_cache.misses"] == 2  # one per cohort
+        assert snap["storm.cohort_cache.hits"] >= 6
+        assert len(storm._cohort_cache) <= storm._cohort_cache.capacity
+
+
+class TestCountedLRU:
+    def test_bound_and_recency(self):
+        lru = CountedLRU(2)
+        lru.put("a", 1)
+        lru.put("b", 2)
+        assert lru.get("a") == 1  # a now most-recent
+        lru.put("c", 3)  # evicts b (LRU)
+        assert "b" not in lru
+        assert lru.get("b") is None
+        assert lru.get("a") == 1 and lru.get("c") == 3
+        assert len(lru) == 2
+
+    def test_counters_reach_registry(self):
+        reg = MetricsRegistry()
+        lru = CountedLRU(4, registry=reg, prefix="t.lru")
+        lru.put("k", "v")
+        lru.get("k")
+        lru.get("missing")
+        snap = reg.snapshot()
+        assert snap["t.lru.hits"] == 1 and snap["t.lru.misses"] == 1
+        assert lru.hits == 1 and lru.misses == 1
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            CountedLRU(0)
+
+
+class TestRowRecycling:
+    def test_sequencer_rows_recycle(self, tmp_path):
+        seq = KernelSequencerHost(num_slots=2, initial_capacity=2)
+        service = RouterliciousService(batched_deli_host=seq,
+                                       auto_pump=False)
+        for d in ("a", "b"):
+            service.connect(d, lambda m: None)
+        service.pump()
+        assert seq._row_count == 2
+        gen = seq.membership_gen
+        row_a = seq._rows["a"]
+        cp = seq.checkpoint("b")
+        seq.release_doc("a")
+        assert seq.membership_gen > gen  # stale cohorts invalidated
+        assert seq._free_rows == [row_a]
+        # The freed row reissues before the high-water mark grows.
+        service.connect("c", lambda m: None)
+        service.pump()
+        assert seq._rows["c"] == row_a
+        assert seq._row_count == 2
+        # The surviving doc's planes are untouched.
+        assert dataclasses.asdict(seq.checkpoint("b")) == \
+            dataclasses.asdict(cp)
+
+    def test_released_row_is_blank(self, tmp_path):
+        service, storm, seq_host, merge_host, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["a"])
+        drive(storm, "a", clients["a"], 0)
+        row = seq_host._rows["a"]
+        res.evict("a")
+        # Device planes at the recycled index equal init defaults — a
+        # stale clientSeq table would poison the next tenant's dedup.
+        import fluidframework_tpu.ops.sequencer as seqk
+        blank = seqk.init_state(1, seq_host._alloc_slots + 1)
+        for f in type(seq_host._state)._fields:
+            got = np.asarray(getattr(seq_host._state, f))[row]
+            want = np.asarray(getattr(blank, f))[0]
+            assert np.array_equal(got, want), f

@@ -220,3 +220,75 @@ def test_durable_retention_bounds_log_without_checkpoints(mesh):
     assert len(serving.durable[0]) == 5
     assert serving.durable_offset(0) == 12
     assert serving._durable_base[0] == 7
+
+
+def test_shard_residency_oversubscribed_churn(mesh):
+    """ShardResidency (ISSUE 9): a registered doc population 5x the
+    device row pool serves through hydrate/evict churn with every doc's
+    converged value preserved, resident count bounded by the pool, and
+    idle shrink freeing rows."""
+    from fluidframework_tpu.parallel.serving import ShardResidency
+
+    num_rows = 4
+    serving = ShardedServing(make_mesh(jax.devices()[:1]),
+                             num_docs=num_rows, k=4, num_hosts=2,
+                             num_clients=2, map_slots=8)
+    res = ShardResidency(serving, join_slots=(0,))
+    docs = [f"doc-{i}" for i in range(5 * num_rows)]
+    want = {}
+    for rnd in range(2):
+        for i, doc in enumerate(docs):
+            row = res.resolve(doc)
+            assert serving.hosts[res.host_for(doc)].owns(row)
+            value = (rnd * 37 + i) % 97 + 1
+            words = np.array(
+                [np.uint32(value) << 12 | np.uint32(1) << 2], np.uint32)
+            serving.submit(row, words, first_cseq=rnd + 1)
+            serving.tick()
+            want[doc] = value
+    assert res.resident_count() <= num_rows
+    assert res.stats["evictions"] > 0
+    assert res.stats["cold_hydrations"] > 0
+    # Every doc's value survived its evict/re-hydrate round trips.
+    for doc in docs:
+        row = res.resolve(doc)
+        got = int(np.asarray(serving.map_state.value)[row, 1])
+        assert got == want[doc], doc
+    # Idle shrink: one resident per host, rows recycled to the free list.
+    res.evict_idle(keep_per_host=1)
+    assert res.resident_count() <= 2
+    assert sum(len(f) for f in res._free.values()) >= num_rows - 2
+
+
+def test_shard_residency_refuses_pending_evict(mesh):
+    from fluidframework_tpu.parallel.serving import ShardResidency
+
+    serving = ShardedServing(make_mesh(jax.devices()[:1]), num_docs=2,
+                             k=4, num_hosts=1, map_slots=8)
+    res = ShardResidency(serving)
+    row = res.resolve("doc-a")
+    serving.submit(row, np.array([(5 << 12) | (1 << 2)], np.uint32),
+                   first_cseq=1)
+    with pytest.raises(ValueError):
+        res.evict("doc-a")
+    serving.tick()
+    res.evict("doc-a")  # settles after the tick
+    assert not res.is_resident("doc-a")
+
+
+def test_shard_residency_resolve_skips_pending_victims(mesh):
+    """A full host range with a pending-submission LRU resident must
+    evict the next evictable doc, not crash on the pinned one."""
+    from fluidframework_tpu.parallel.serving import ShardResidency
+
+    serving = ShardedServing(make_mesh(jax.devices()[:1]), num_docs=2,
+                             k=4, num_hosts=1, map_slots=8)
+    res = ShardResidency(serving)
+    row_a = res.resolve("doc-a")  # LRU after doc-b resolves
+    res.resolve("doc-b")
+    serving.submit(row_a, np.array([(5 << 12) | (1 << 2)], np.uint32),
+                   first_cseq=1)
+    row_c = res.resolve("doc-c")  # must evict doc-b, not doc-a
+    assert res.is_resident("doc-a") and res.is_resident("doc-c")
+    assert not res.is_resident("doc-b")
+    assert row_c != row_a
