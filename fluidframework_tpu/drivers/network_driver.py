@@ -483,3 +483,116 @@ class StormStream:
             self.tracer.finish(tc, rid=payload.get("rid"))
         if self._on_ack is not None:
             self._on_ack(payload)
+
+
+class ViewerStream:
+    """Read-only broadcast viewer (the client half of the viewer plane,
+    server/broadcaster.py): connects ``mode="viewer"`` — no CLIENT_JOIN,
+    no quorum, no admission debit server-side — and consumes the
+    document's broadcast stream:
+
+    * binary ``storm_tick`` frames (the storm path's once-per-doc-per-
+      tick broadcast: sequenced window + raw op words),
+    * ``ops`` events (the per-op JSON path),
+    * ``viewer_presence`` roster samples + counts,
+    * ``viewer_resync`` lag-drop directives — on one, the stream marks
+      itself lagged; :meth:`resync` catches up out-of-band (latest
+      snapshot + ``get_deltas`` from the last seq seen, which serves
+      even cold docs from their cold-head tick index) and re-enters the
+      live stream via the gated ``viewer_resume`` op, honoring
+      ``retry_after_s`` like every admission-aware client.
+    """
+
+    def __init__(self, service: NetworkDocumentService,
+                 on_tick: Callable[[dict], None] | None = None,
+                 on_ops: Callable[[list], None] | None = None) -> None:
+        self._service = service
+        self._on_tick = on_tick
+        self._on_ops = on_ops
+        self.viewer_id: str | None = None
+        self.last_seq = 0
+        self.audience_total = 0
+        self.lagged = False
+        self.stats = {"ticks": 0, "ops": 0, "resyncs": 0,
+                      "presence_updates": 0}
+        service._handlers["storm_tick"] = self._handle_tick
+        service._handlers["ops"] = self._handle_ops
+        service._handlers["viewer_presence"] = self._handle_presence
+        service._handlers["viewer_resync"] = self._handle_resync
+
+    def connect(self) -> dict:
+        req: dict = {"op": "connect", "mode": "viewer",
+                     "client_key": self._service._client_key}
+        if self._service._token is not None:
+            req["token"] = self._service._token
+        hello = self._service._request(req)
+        self.viewer_id = hello["client_id"]
+        self.last_seq = max(self.last_seq, hello.get("seq", 0))
+        self.audience_total = hello.get("viewers", 0)
+        return hello
+
+    def _handle_tick(self, payload: dict) -> None:
+        self.stats["ticks"] += 1
+        self.last_seq = max(self.last_seq, payload.get("last", 0))
+        if self._on_tick is not None:
+            self._on_tick(payload)
+
+    def _handle_ops(self, payload: dict) -> None:
+        messages = payload.get("messages", [])
+        self.stats["ops"] += len(messages)
+        for m in messages:
+            seq = getattr(m, "sequence_number", 0)
+            if seq > self.last_seq:
+                self.last_seq = seq
+        if self._on_ops is not None:
+            self._on_ops(messages)
+
+    def _handle_presence(self, payload: dict) -> None:
+        self.stats["presence_updates"] += 1
+        self.audience_total = payload.get("total", self.audience_total)
+
+    def _handle_resync(self, payload: dict) -> None:
+        self.lagged = True
+        self.stats["resyncs"] += 1
+
+    def resync(self, max_attempts: int = 16) -> list:
+        """Catch up after a lag-drop and re-enter the live stream:
+        fetch the deltas the dropped queue would have carried (from
+        ``last_seq``; a doc evicted to the cold tier meanwhile serves
+        this from its cold-head index without hydrating), then
+        ``viewer_resume`` — retrying at the server's ``retry_after_s``
+        hint when the resume storm is being laddered out. Returns the
+        caught-up messages."""
+        caught_up = self._fetch_gap()
+        for _ in range(max_attempts):
+            try:
+                hello = self._service._request({
+                    "op": "viewer_resume",
+                    "client_key": self._service._client_key})
+            except Exception as err:
+                retry = getattr(err, "retry_after_s", None)
+                if retry is None:
+                    raise
+                time.sleep(retry)
+                continue
+            if hello.get("seq", 0) > self.last_seq:
+                # Ops sequenced between the catch-up read and the
+                # resume (the resume loop may have slept through
+                # throttle hints) were never queued for the dead
+                # subscriber — close the remaining gap up to the
+                # resume point, where the live stream takes over.
+                caught_up += self._fetch_gap()
+            self.lagged = False
+            self.audience_total = hello.get("viewers",
+                                            self.audience_total)
+            return caught_up
+        raise TimeoutError("viewer_resume still throttled after "
+                           f"{max_attempts} attempts")
+
+    def _fetch_gap(self) -> list:
+        messages = self._service.delta_storage.get_deltas(self.last_seq)
+        for m in messages:
+            seq = getattr(m, "sequence_number", 0)
+            if seq > self.last_seq:
+                self.last_seq = seq
+        return messages

@@ -22,6 +22,8 @@
 //   bridge_send(handle, conn, data, len)  enqueue one framed body
 //       (0 ok, -1 unknown/closing, -2 outbox full — caller should close)
 //   bridge_set_max_outbox(handle, n)      tune the -2 threshold
+//   bridge_set_conn_max_outbox(handle, conn, n)  per-connection override
+//       (connection classes: viewers shallow, writers default)
 //   bridge_close(handle, conn)            server-side disconnect
 //   bridge_stop(handle)
 //
@@ -71,6 +73,10 @@ struct Conn {
     std::mutex out_mu;
     std::condition_variable out_cv;
     std::deque<std::string> outbox;
+    // Per-connection outbox bound; 0 = use the bridge-wide default.
+    // Lets connection CLASSES differ (a read-only viewer lag-drops at a
+    // shallow outbox while writer connections keep the deep default).
+    size_t max_outbox = 0;
     bool closing = false;
 };
 
@@ -282,7 +288,9 @@ int bridge_send(void* handle, int64_t conn, const char* data,
     {
         std::lock_guard<std::mutex> out_lock(c->out_mu);
         if (c->closing) return -1;
-        if (c->outbox.size() >= b->max_outbox.load()) return -2;
+        size_t limit = c->max_outbox ? c->max_outbox
+                                     : b->max_outbox.load();
+        if (c->outbox.size() >= limit) return -2;
         c->outbox.emplace_back(data, len);
     }
     c->out_cv.notify_one();
@@ -293,6 +301,19 @@ void bridge_set_max_outbox(void* handle, int64_t n) {
     if (n > 0)
         static_cast<Bridge*>(handle)->max_outbox.store(
             static_cast<size_t>(n));
+}
+
+// Per-connection override of the -2 threshold (n <= 0 restores the
+// bridge default). Returns 0, or -1 for an unknown connection.
+int bridge_set_conn_max_outbox(void* handle, int64_t conn, int64_t n) {
+    Bridge* b = static_cast<Bridge*>(handle);
+    std::lock_guard<std::mutex> lock(b->mu);
+    auto it = b->conns.find(conn);
+    if (it == b->conns.end()) return -1;
+    Conn* c = it->second.get();
+    std::lock_guard<std::mutex> out_lock(c->out_mu);
+    c->max_outbox = n > 0 ? static_cast<size_t>(n) : 0;
+    return 0;
 }
 
 int bridge_close(void* handle, int64_t conn) {

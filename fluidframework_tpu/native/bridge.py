@@ -48,6 +48,9 @@ def _load_library() -> ctypes.CDLL | None:
     lib.bridge_send.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                 ctypes.c_char_p, ctypes.c_uint32]
     lib.bridge_set_max_outbox.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.bridge_set_conn_max_outbox.restype = ctypes.c_int
+    lib.bridge_set_conn_max_outbox.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
     lib.bridge_close.restype = ctypes.c_int
     lib.bridge_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.bridge_stop.argtypes = [ctypes.c_void_p]
@@ -105,6 +108,17 @@ class NativeBridge:
         """Tune the per-connection outbox bound at which send returns -2."""
         if self._handle:
             self._lib.bridge_set_max_outbox(self._handle, n)
+
+    def set_conn_max_outbox(self, conn: int, n: int | None) -> int:
+        """Per-connection outbox override (None restores the bridge
+        default) — the connection-CLASS bound: viewer connections take a
+        shallow outbox so a stalled viewer trips the slow-consumer drop
+        (and its resync path) early, without touching writer bounds.
+        Returns the native rc (0 ok, -1 unknown connection)."""
+        if not self._handle:
+            return -1
+        return int(self._lib.bridge_set_conn_max_outbox(
+            self._handle, conn, 0 if n is None else n))
 
     def close_conn(self, conn: int) -> None:
         if self._handle:

@@ -17,6 +17,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <set>
 #include <string>
 #include <mutex>
@@ -26,18 +27,33 @@ namespace {
 
 // Slow-consumer bound: a subscriber that never polls is evicted once
 // this many payloads queue up (the socket.io Redis adapter analog drops
-// slow clients rather than buffering without bound).
+// slow clients rather than buffering without bound). Per-subscriber
+// overrides (fanout_set_queue_limit) let a connection CLASS pick a
+// different bound — read-only viewers lag-drop at a shallow queue while
+// writer subscribers keep the deep default.
 constexpr size_t kMaxQueue = 65536;
+
+// Queue entries are shared: a publish to a 100k-member room allocates
+// the payload ONCE and every member queues a refcounted pointer, so the
+// broadcast hop is O(members) pointer pushes, not O(members) copies.
+using Payload = std::shared_ptr<const std::string>;
 
 struct Fanout {
     std::mutex mu;
     int64_t next_sub = 1;
     int64_t delivered = 0;
-    std::map<int64_t, std::deque<std::string>> queues;
+    std::map<int64_t, std::deque<Payload>> queues;
     std::map<std::string, std::set<int64_t>> rooms;
     std::map<int64_t, std::set<std::string>> memberships;
+    std::map<int64_t, size_t> limits;  // per-sub override; absent = kMaxQueue
     std::set<int64_t> evicted;
 };
+
+// Caller holds f->mu.
+size_t limit_for(Fanout* f, int64_t sub) {
+    auto it = f->limits.find(sub);
+    return it == f->limits.end() ? kMaxQueue : it->second;
+}
 
 // Caller holds f->mu.
 void drop_subscriber(Fanout* f, int64_t sub) {
@@ -53,6 +69,7 @@ void drop_subscriber(Fanout* f, int64_t sub) {
         f->memberships.erase(member_it);
     }
     f->queues.erase(sub);
+    f->limits.erase(sub);
 }
 
 // Caller holds f->mu. Returns queues appended (the publish body shared
@@ -61,13 +78,13 @@ int64_t publish_locked(Fanout* f, const std::string& room,
                        const char* data, uint32_t data_len) {
     auto room_it = f->rooms.find(room);
     if (room_it == f->rooms.end()) return 0;
-    std::string payload(data, data_len);
+    Payload payload = std::make_shared<const std::string>(data, data_len);
     int64_t count = 0;
     std::vector<int64_t> over;
     for (int64_t sub : room_it->second) {
         auto queue_it = f->queues.find(sub);
         if (queue_it == f->queues.end()) continue;
-        if (queue_it->second.size() >= kMaxQueue) {
+        if (queue_it->second.size() >= limit_for(f, sub)) {
             over.push_back(sub);
             continue;
         }
@@ -193,7 +210,7 @@ int64_t fanout_next_size(void* handle, int64_t sub) {
     auto queue_it = f->queues.find(sub);
     if (queue_it == f->queues.end()) return -1;
     if (queue_it->second.empty()) return -2;
-    return static_cast<int64_t>(queue_it->second.front().size());
+    return static_cast<int64_t>(queue_it->second.front()->size());
 }
 
 // Pops the head message into buf. Returns bytes written (may be 0),
@@ -205,7 +222,7 @@ int64_t fanout_poll(void* handle, int64_t sub, char* buf, int64_t cap) {
     auto queue_it = f->queues.find(sub);
     if (queue_it == f->queues.end()) return -1;
     if (queue_it->second.empty()) return -3;
-    const std::string& head = queue_it->second.front();
+    const std::string& head = *queue_it->second.front();
     if (static_cast<int64_t>(head.size()) > cap) return -2;
     std::memcpy(buf, head.data(), head.size());
     int64_t written = static_cast<int64_t>(head.size());
@@ -217,6 +234,87 @@ int64_t fanout_delivered_total(void* handle) {
     Fanout* f = static_cast<Fanout*>(handle);
     std::lock_guard<std::mutex> lock(f->mu);
     return f->delivered;
+}
+
+// Batched drain — ONE native call pops the head message of up to n
+// subscribers (the 100k-viewer frontend drain; per-subscriber FFI was
+// the dominant cost of a big room's delivery loop). Payloads pack
+// contiguously into buf in subscriber order; lens[i] = payload length,
+// -1 = empty queue, -2 = unknown subscriber (disconnected or evicted —
+// the caller runs its slow-consumer policy). Returns total bytes
+// written, or -(needed) when cap is too small — nothing is popped in
+// that case, so the caller simply retries with a bigger buffer.
+int64_t fanout_poll_batch(void* handle, const int64_t* subs, int64_t n,
+                          char* buf, int64_t cap, int64_t* lens) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    int64_t needed = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        auto it = f->queues.find(subs[i]);
+        if (it != f->queues.end() && !it->second.empty())
+            needed += static_cast<int64_t>(it->second.front()->size());
+    }
+    if (needed > cap) return -needed;
+    int64_t off = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        auto it = f->queues.find(subs[i]);
+        if (it == f->queues.end()) {
+            lens[i] = -2;
+            continue;
+        }
+        if (it->second.empty()) {
+            lens[i] = -1;
+            continue;
+        }
+        const std::string& head = *it->second.front();
+        if (off + static_cast<int64_t>(head.size()) > cap) {
+            // Unreachable for unique sub ids (the pre-scan sized cap),
+            // but a duplicated id pops SUCCESSIVE entries whose sizes
+            // the scan never saw — leave the message queued for the
+            // next call rather than overflow the caller's buffer.
+            lens[i] = -1;
+            continue;
+        }
+        std::memcpy(buf + off, head.data(), head.size());
+        lens[i] = static_cast<int64_t>(head.size());
+        off += lens[i];
+        it->second.pop_front();
+    }
+    return off;
+}
+
+// Per-subscriber queue bound override (n <= 0 restores the default):
+// the slow-consumer eviction point becomes a per-connection-class
+// policy — viewer subscribers lag-drop shallow, writers keep the
+// default depth.
+int fanout_set_queue_limit(void* handle, int64_t sub, int64_t n) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    if (f->queues.find(sub) == f->queues.end()) return -1;
+    if (n <= 0)
+        f->limits.erase(sub);
+    else
+        f->limits[sub] = static_cast<size_t>(n);
+    return 0;
+}
+
+// Members of a room (0 for unknown/reclaimed rooms — an empty room is
+// erased, so "absent" and "empty" are the same observable state).
+int64_t fanout_room_size(void* handle, const char* room,
+                         uint32_t room_len) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    auto it = f->rooms.find(std::string(room, room_len));
+    if (it == f->rooms.end()) return 0;
+    return static_cast<int64_t>(it->second.size());
+}
+
+// Live (non-empty) rooms — the monitor's rooms gauge; also the
+// empty-room-reclamation observable (a fully-left room must not linger).
+int64_t fanout_room_count(void* handle) {
+    Fanout* f = static_cast<Fanout*>(handle);
+    std::lock_guard<std::mutex> lock(f->mu);
+    return static_cast<int64_t>(f->rooms.size());
 }
 
 }  // extern "C"

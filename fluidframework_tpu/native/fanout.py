@@ -60,6 +60,19 @@ def _load_library() -> ctypes.CDLL | None:
     lib.fanout_delivered_total.argtypes = [ctypes.c_void_p]
     lib.fanout_was_evicted.restype = ctypes.c_int
     lib.fanout_was_evicted.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.fanout_set_queue_limit.restype = ctypes.c_int
+    lib.fanout_set_queue_limit.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                           ctypes.c_int64]
+    lib.fanout_room_size.restype = ctypes.c_int64
+    lib.fanout_room_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint32]
+    lib.fanout_room_count.restype = ctypes.c_int64
+    lib.fanout_room_count.argtypes = [ctypes.c_void_p]
+    lib.fanout_poll_batch.restype = ctypes.c_int64
+    lib.fanout_poll_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_char), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
     _configured = lib
     return _configured
 
@@ -72,6 +85,11 @@ class NativeFanout:
     def __init__(self, lib: ctypes.CDLL) -> None:
         self._lib = lib
         self._handle = lib.fanout_create()
+        # Thread-local scratch for the poll() fast path: one FFI call
+        # per message in the common (small-payload) case instead of a
+        # next_size + poll pair — the 100k-viewer drain is poll-bound.
+        import threading
+        self._tls = threading.local()
 
     def __del__(self) -> None:
         handle = getattr(self, "_handle", None)
@@ -123,6 +141,20 @@ class NativeFanout:
         return max(0, int(self._lib.fanout_pending(self._handle, sub)))
 
     def poll(self, sub: int) -> bytes | None:
+        # Fast path: poll straight into the thread-local scratch (one
+        # FFI round trip); payloads over the scratch size fall back to
+        # the exact-size loop below.
+        scratch = getattr(self._tls, "buf", None)
+        if scratch is None:
+            scratch = self._tls.buf = ctypes.create_string_buffer(1 << 17)
+        written = self._lib.fanout_poll(self._handle, sub, scratch,
+                                        len(scratch))
+        if written >= 0:
+            # string_at copies exactly `written` bytes (scratch.raw
+            # would copy the whole scratch first).
+            return ctypes.string_at(scratch, int(written))
+        if written != -2:  # -1 unknown sub, -3 empty queue
+            return None
         size = self._lib.fanout_next_size(self._handle, sub)
         if size < 0:  # -1 unknown sub, -2 empty queue
             return None
@@ -148,6 +180,51 @@ class NativeFanout:
     def delivered_total(self) -> int:
         return int(self._lib.fanout_delivered_total(self._handle))
 
+    def set_queue_limit(self, sub: int, n: int | None) -> None:
+        """Per-subscriber slow-consumer bound (None restores the shared
+        default) — the per-connection-class eviction point: viewers
+        lag-drop at a shallow queue, writers keep the deep default."""
+        if self._lib.fanout_set_queue_limit(self._handle, sub,
+                                            0 if n is None else n) != 0:
+            raise KeyError(f"unknown subscriber {sub}")
+
+    def room_size(self, room: str) -> int:
+        key = room.encode()
+        return int(self._lib.fanout_room_size(self._handle, key, len(key)))
+
+    def room_count(self) -> int:
+        return int(self._lib.fanout_room_count(self._handle))
+
+    def poll_batch(self, subs) -> tuple[memoryview, "object"]:
+        """Pop the head message of every subscriber in ``subs`` (an
+        int64 numpy array) in ONE native call. Returns ``(buf, lens)``:
+        payloads packed contiguously in ``buf`` in subscriber order;
+        ``lens[i]`` is the payload byte length, -1 = empty queue, -2 =
+        unknown/evicted subscriber. The big-room frontend drain — FFI
+        cost O(1) per call instead of O(members). The returned view
+        aliases a REUSED thread-local scratch (allocating + zeroing a
+        fresh MB per call would dominate the drain loop): it is valid
+        only until this thread's next poll_batch — copy what you keep."""
+        import numpy as np
+
+        subs = np.ascontiguousarray(subs, np.int64)
+        n = len(subs)
+        lens = np.empty(n, np.int64)
+        subs_p = subs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        lens_p = lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        buf = getattr(self._tls, "batch_buf", None)
+        if buf is None:
+            buf = self._tls.batch_buf = ctypes.create_string_buffer(
+                1 << 20)
+        while True:
+            got = int(self._lib.fanout_poll_batch(
+                self._handle, subs_p, n, buf, len(buf), lens_p))
+            if got >= 0:
+                return memoryview(buf)[:got], lens
+            # Nothing was popped; grow the scratch to the exact need
+            # (kept for later calls) and retry.
+            buf = self._tls.batch_buf = ctypes.create_string_buffer(-got)
+
 
 class PyFanout:
     """Pure-Python twin (toolchain-free fallback; identical surface)."""
@@ -159,6 +236,7 @@ class PyFanout:
         self._queues: dict[int, deque[bytes]] = {}
         self._rooms: dict[str, set[int]] = {}
         self._memberships: dict[int, set[str]] = {}
+        self._limits: dict[int, int] = {}
         self._delivered = 0
         self._evicted: set[int] = set()
 
@@ -176,6 +254,7 @@ class PyFanout:
                 if not members:
                     del self._rooms[room]
         self._queues.pop(sub, None)
+        self._limits.pop(sub, None)
         self._evicted.discard(sub)
 
     def join(self, sub: int, room: str) -> None:
@@ -185,14 +264,18 @@ class PyFanout:
         self._memberships.setdefault(sub, set()).add(room)
 
     def leave(self, sub: int, room: str) -> None:
-        self._rooms.get(room, set()).discard(sub)
+        members = self._rooms.get(room)
+        if members is not None:
+            members.discard(sub)
+            if not members:  # empty-room reclamation, as in fanout.cpp
+                del self._rooms[room]
         self._memberships.get(sub, set()).discard(room)
 
     def publish(self, room: str, payload: bytes) -> int:
         count = 0
         over = []
         for sub in self._rooms.get(room, ()):  # set order is fine: queues
-            if len(self._queues[sub]) >= MAX_QUEUE:
+            if len(self._queues[sub]) >= self._limits.get(sub, MAX_QUEUE):
                 over.append(sub)
                 continue
             self._queues[sub].append(payload)  # are per-subscriber FIFO
@@ -220,6 +303,42 @@ class PyFanout:
 
     def delivered_total(self) -> int:
         return self._delivered
+
+    def set_queue_limit(self, sub: int, n: int | None) -> None:
+        if sub not in self._queues:
+            raise KeyError(f"unknown subscriber {sub}")
+        if n is None or n <= 0:
+            self._limits.pop(sub, None)
+        else:
+            self._limits[sub] = n
+
+    def room_size(self, room: str) -> int:
+        return len(self._rooms.get(room, ()))
+
+    def room_count(self) -> int:
+        return len(self._rooms)
+
+    def poll_batch(self, subs):
+        """Batched head-pop over many subscribers (NativeFanout twin):
+        (packed payload view, per-sub lengths with -1 empty / -2
+        unknown). CONTRACT (shared with the native impl, whose view
+        aliases a reused scratch): the returned view is only valid
+        until this thread's next poll_batch — copy what you keep."""
+        import numpy as np
+
+        lens = np.empty(len(subs), np.int64)
+        parts: list[bytes] = []
+        for i, sub in enumerate(subs):
+            queue = self._queues.get(int(sub))
+            if queue is None:
+                lens[i] = -2
+            elif not queue:
+                lens[i] = -1
+            else:
+                payload = queue.popleft()
+                parts.append(payload)
+                lens[i] = len(payload)
+        return memoryview(b"".join(parts)), lens
 
 
 def make_fanout(force_python: bool = False):

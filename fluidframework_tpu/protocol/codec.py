@@ -158,6 +158,11 @@ def from_wire(obj: Any) -> Any:
 STORM_MAGIC = 0x00
 _STORM_HDR = struct.Struct("<I")
 STORM_ACK_OP = "storm_ack"
+#: Viewer-plane broadcast frame: one binary body per (doc, tick) carrying
+#: the tick's sequenced window (first/last/msn/n) plus the raw op words —
+#: serialized ONCE per doc per tick by server/broadcaster.py and fanned
+#: out to every viewer of the doc's room as the same bytes.
+VIEWER_TICK_OP = "storm_tick"
 
 #: Trace-context header field: 1-in-N sampled storm frames carry an
 #: opaque trace id under this key; the serving stack timestamps the
@@ -318,18 +323,37 @@ def encode_storm_ack_body(ack: StormAck) -> bytes:
     return encode_storm_body(header, rows.tobytes())
 
 
+def encode_viewer_tick_body(doc_id: str, n_seq: int, first: int,
+                            last: int, msn: int, count: int,
+                            words) -> "RawBody":
+    """One viewer broadcast frame for one (doc, tick): the sequenced
+    window plus the tick's raw op words (``count`` u32 LE — the same
+    wire layout storm frames carry in). Encoded ONCE per doc per tick;
+    the returned :class:`RawBody` goes down every viewer transport
+    verbatim (the serialize-once invariant BENCH_r13 pins)."""
+    header = {"op": VIEWER_TICK_OP, "doc": doc_id, "n": n_seq,
+              "first": first, "last": last, "msn": msn, "count": count}
+    return RawBody(encode_storm_body(header, words))
+
+
 def decode_storm_push(body) -> dict:
     """Decode a server→client binary storm push into the legacy dict
-    shape ({"rid", "storm", "acks", "dw", ...}); non-ack storm headers
-    pass through as-is."""
+    shape ({"rid", "storm", "acks", "dw", ...}); viewer tick frames
+    decode to {"event": "storm_tick", "doc", "n", ..., "words"}; other
+    storm headers pass through as-is."""
     header, payload = decode_storm_body(body)
+    import numpy as np
+
+    if header.get("op") == VIEWER_TICK_OP:
+        out = {k: v for k, v in header.items() if k != "op"}
+        out["event"] = VIEWER_TICK_OP
+        out["words"] = np.frombuffer(payload, "<u4", out.get("count", 0))
+        return out
     if header.get("op") != STORM_ACK_OP:
         return header
     if len(payload) % 16:
         raise ValueError(f"storm ack payload not i32[n, 4]: "
                          f"{len(payload)} bytes")
-    import numpy as np
-
     out = {k: v for k, v in header.items() if k != "op"}
     out["event"] = STORM_ACK_OP
     out["storm"] = True

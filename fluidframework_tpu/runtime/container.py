@@ -33,6 +33,10 @@ class Audience:
 
     def __init__(self) -> None:
         self.members: dict[str, dict] = {}
+        # Exact audience size from the service (interest-sampled presence:
+        # a snapshot past the roster bound lists a member SAMPLE but
+        # always carries the true total — server/audience.py).
+        self.total = 0
         self.on_add_member: list[Callable[[str, dict], None]] = []
         self.on_remove_member: list[Callable[[str, dict], None]] = []
 
@@ -47,16 +51,31 @@ class Audience:
         if event == "snapshot":
             self.members = {m["client_id"]: dict(m)
                             for m in payload.get("members", [])}
+            self.total = payload.get("total", len(self.members))
         elif event == "join":
             member = dict(payload["member"])
+            if member["client_id"] not in self.members:
+                self.total += 1
             self.members[member["client_id"]] = member
             for cb in self.on_add_member:
                 cb(member["client_id"], member)
         elif event == "leave":
             member = self.members.pop(payload.get("client_id"), None)
+            self.total = max(0, self.total - 1)
             if member is not None:
                 for cb in self.on_remove_member:
                     cb(payload["client_id"], member)
+        elif event == "count":
+            # Sampled-presence count update (server/audience.py past the
+            # roster bound): the exact total, optionally naming a leaver
+            # a peer's SAMPLE may still hold.
+            self.total = payload.get("total", self.total)
+            left = payload.get("left")
+            if left is not None:
+                member = self.members.pop(left, None)
+                if member is not None:
+                    for cb in self.on_remove_member:
+                        cb(left, member)
 
 
 class Container:

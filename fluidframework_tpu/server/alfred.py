@@ -69,6 +69,10 @@ class RequestSession:
         self.connection = None  # service-side live connection
         self.doc_id: str | None = None
         self.tenant_id = "default"  # set from token claims on connect
+        # mode="viewer" sessions register on the viewer plane instead of
+        # the ordering service (server/broadcaster.py): no CLIENT_JOIN,
+        # no admission token debit, no ack bookkeeping.
+        self.viewer_id: str | None = None
 
     def push(self, payload: dict) -> None:
         raise NotImplementedError
@@ -83,6 +87,26 @@ class RequestSession:
     def drop(self) -> None:
         """Close this session's transport (service-initiated disconnect,
         e.g. slow-consumer eviction). Subclasses owning a socket override."""
+
+    def close_viewer(self) -> None:
+        """Tear down this session's viewer-plane registration (transport
+        death / explicit disconnect)."""
+        if self.viewer_id is not None:
+            viewers = getattr(self.server.service, "viewers", None)
+            if viewers is not None:
+                viewers.leave(self.viewer_id)
+            self.viewer_id = None
+
+    def _pending_probe(self):
+        """Transport-outbox depth probe for the viewer plane's lag
+        detection; None when the transport cannot report one (the
+        fan-out queue bound still applies)."""
+        return None
+
+    def _on_viewer_connected(self) -> None:
+        """Transport hook after a viewer connect: subclasses shrink the
+        connection's outbox bound to the viewer class (the native bridge
+        sets its per-connection -2 threshold here)."""
 
     def handle_binary(self, body: bytes,
                       ingress_ns: int | None = None) -> dict | None:
@@ -121,8 +145,14 @@ class RequestSession:
         op = req["op"]
         rid = req.get("rid")
         if op == "connect":
-            assert self.connection is None, "already connected"
+            # Symmetric guard: one session, one registration — a viewer
+            # session re-connecting in write mode would otherwise leak
+            # its plane registration and overwrite doc_id under it.
+            assert self.connection is None and self.viewer_id is None, \
+                "already connected"
             self.doc_id = req["doc_id"]
+            if req.get("mode") == "viewer":
+                return self._connect_viewer(req, rid)
             kwargs: dict = {"mode": req.get("mode", "write")}
             if self.server.tenants is not None:
                 # Auth-enabled front door (alfred index.ts:343): the token
@@ -261,7 +291,21 @@ class RequestSession:
             if self.connection is not None:
                 self.connection.close()
                 self.connection = None
+            self.close_viewer()
             return {"rid": rid, "ok": True}
+        if op == "viewer_resume":
+            # Re-enter the live stream after a lag-drop (the client has
+            # caught up via snapshot + get_deltas). A resync storm is a
+            # join storm: the same reservation gate applies.
+            viewers = getattr(service, "viewers", None)
+            if viewers is None or self.viewer_id is None:
+                return {"rid": rid, "error": "no viewer session"}
+            retry = viewers.admit_join(self.doc_id, req.get("client_key"))
+            if retry is not None:
+                return {"rid": rid, "error": "throttled",
+                        "retry_after_s": retry}
+            hello = viewers.resume(self.viewer_id)
+            return {"rid": rid, **hello}
         if op == "storm_flush":
             storm = getattr(service, "storm", None)
             if storm is None:
@@ -269,6 +313,51 @@ class RequestSession:
             storm.flush()
             return {"rid": rid, "ok": True}
         return {"rid": rid, "error": f"unknown op {op!r}"}
+
+    def _connect_viewer(self, req: dict, rid) -> dict:
+        """``mode="viewer"`` connect (the broadcast viewer plane,
+        server/broadcaster.py): token-authenticated like any connect but
+        NEVER debits write/connect admission, never sequences a
+        CLIENT_JOIN, never allocates merge/ack state — the session joins
+        the doc's fan-out room and drains broadcast frames. Join storms
+        gate through the plane's own TokenBucket with claimable
+        reservations."""
+        # Mirror the write-path connect guard: a second connect on one
+        # socket must not leak the first plane registration (an orphaned
+        # viewer would double-push frames and outlive the session).
+        assert self.viewer_id is None, "already connected"
+        service = self.server.service
+        viewers = getattr(service, "viewers", "unsupported")
+        if viewers == "unsupported":
+            return {"rid": rid, "error": "viewer plane not enabled"}
+        if viewers is None:
+            # Assemblies that carry the seam but were built without a
+            # plane (bare RouterliciousService) get the default lazily —
+            # same contract as an in-process mode="viewer" connect.
+            from .broadcaster import ViewerPlane
+            viewers = ViewerPlane(service,
+                                  metrics=getattr(service, "metrics",
+                                                  None))
+        if self.server.tenants is not None:
+            from .riddler import AuthError
+            token = req.get("token")
+            if not token:
+                raise AuthError("connect requires a token")
+            claims = self.server.tenants.validate_token(
+                token, document_id=self.doc_id)
+            self.tenant_id = claims.get("tenantId", "default")
+        retry = viewers.admit_join(self.doc_id, req.get("client_key"))
+        if retry is not None:
+            return {"rid": rid, "error": "throttled",
+                    "retry_after_s": retry}
+        hello = viewers.join(self.doc_id, self.push,
+                             pending_probe=self._pending_probe())
+        self.viewer_id = hello["viewer_id"]
+        self._on_viewer_connected()
+        self.server.metrics.counter("alfred.viewer_connects").inc()
+        return {"rid": rid, "client_id": hello["viewer_id"],
+                "viewer": True, "seq": hello["seq"],
+                "viewers": hello["viewers"]}
 
     def _require_agent_scope(self, req: dict) -> None:
         if self.server.tenants is None:
@@ -294,6 +383,11 @@ class _ClientSession(RequestSession):
 
     def push(self, payload: dict) -> None:
         self.outbox.put_nowait(payload)
+
+    def _pending_probe(self):
+        # Viewer lag detection: the session outbox depth IS the
+        # transport backlog for the asyncio door.
+        return self.outbox.qsize
 
     async def writer_loop(self) -> None:
         while True:
@@ -380,6 +474,7 @@ class AlfredServer:
         finally:
             if session.connection is not None:
                 session.connection.close()
+            session.close_viewer()
             try:
                 session.push(None)
                 await writer_task
@@ -427,7 +522,13 @@ def build_default_service(data_dir: str | None = None, merge_host=True,
         kwargs["store"] = FileStateStore(f"{data_dir}/state")
         kwargs["snapshots"] = Historian(GitSnapshotStore(f"{data_dir}/git"),
                                         metrics=metrics)
-    return RouterliciousService(**kwargs)
+    service = RouterliciousService(**kwargs)
+    # The broadcast viewer plane (mode="viewer" connects) rides every
+    # standalone assembly: construction is O(1) — its fan-out spine is
+    # lazy, so a deployment that never sees a viewer pays nothing.
+    from .broadcaster import ViewerPlane
+    ViewerPlane(service, metrics=metrics)
+    return service
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -58,6 +58,15 @@ class _BridgeSession(RequestSession):
         # resulting EV_CLOSE finishes session cleanup in the pump.
         self.server._bridge.close_conn(self.conn_id)
 
+    def _on_viewer_connected(self) -> None:
+        # Viewer connection class: shrink THIS connection's outbox bound
+        # (bridge_set_conn_max_outbox) so a stalled viewer trips the
+        # slow-consumer -2 early and resyncs, without touching writer
+        # connections' deep default.
+        bound = self.server.viewer_max_outbox
+        if bound is not None:
+            self.server._bridge.set_conn_max_outbox(self.conn_id, bound)
+
 
 class BridgeFrontDoor:
     """Pumps bridge events through the alfred request dispatch."""
@@ -65,7 +74,8 @@ class BridgeFrontDoor:
     def __init__(self, service, port: int = 0,
                  logger: TelemetryLogger | None = None,
                  metrics: MetricsRegistry | None = None,
-                 tenants=None, throttler=None, admission=None) -> None:
+                 tenants=None, throttler=None, admission=None,
+                 viewer_max_outbox: int | None = 1024) -> None:
         bridge = start_bridge(port)
         if bridge is None:
             raise RuntimeError("native bridge unavailable (no toolchain)")
@@ -76,6 +86,9 @@ class BridgeFrontDoor:
         self.throttler = throttler
         # Same admission seam as AlfredServer (RequestSession reads it).
         self.admission = admission
+        # Viewer-class outbox bound (per-connection override of the
+        # bridge's -2 threshold); None keeps viewers at the default.
+        self.viewer_max_outbox = viewer_max_outbox
         self._bridge = bridge
         self.port = bridge.port
         self._sessions: dict[int, _BridgeSession] = {}
@@ -117,6 +130,16 @@ class BridgeFrontDoor:
                     except Exception as err:
                         self.logger.send_error("BridgeEvictIdleFailed",
                                                err)
+                # Viewer-plane idle drain: flush queued broadcast frames
+                # to viewer transports between ticks (resumed viewers,
+                # per-op traffic on otherwise-quiet docs).
+                viewers = getattr(self.service, "viewers", None)
+                if viewers is not None and viewers.active_rooms:
+                    try:
+                        viewers.drain_all()
+                    except Exception as err:
+                        self.logger.send_error("BridgeViewerDrainFailed",
+                                               err)
                 continue
             try:
                 self._dispatch(*event)
@@ -128,8 +151,10 @@ class BridgeFrontDoor:
             self._sessions[conn_id] = _BridgeSession(self, conn_id)
         elif kind == EV_CLOSE:
             session = self._sessions.pop(conn_id, None)
-            if session is not None and session.connection is not None:
-                session.connection.close()
+            if session is not None:
+                if session.connection is not None:
+                    session.connection.close()
+                session.close_viewer()
             # Reap the native side (fd + writer thread) too.
             self._bridge.close_conn(conn_id)
         elif kind == EV_DATA:
@@ -174,6 +199,7 @@ class BridgeFrontDoor:
         for session in list(self._sessions.values()):
             if session.connection is not None:
                 session.connection.close()
+            session.close_viewer()
         self._sessions.clear()
         if self._thread.is_alive():
             # A request is wedged inside the service; freeing the native

@@ -349,6 +349,9 @@ class _DurablePartition:
     def __init__(self, path: Path) -> None:
         self._oplog = OpLog(path)
         self.log: list[BusMessage] = []
+        #: Offset of ``log[0]`` (bus retention trims the consumed
+        #: in-memory prefix; the on-disk oplog keeps full history).
+        self.base = 0
         # Appends since the last fsync: the offset journal must never
         # claim a message consumed that the data log could still lose, so
         # commit() group-syncs dirty partitions first (one fsync covers
@@ -359,7 +362,7 @@ class _DurablePartition:
             self.log.append(BusMessage(i, key, value))
 
     def append(self, key: str, value: Any) -> int:
-        offset = len(self.log)
+        offset = self.base + len(self.log)
         data = _dump([key, value])
         self._oplog.append(data)
         self.dirty = True
@@ -367,6 +370,16 @@ class _DurablePartition:
         # shapes (tuples→lists etc.) before and after a restart replay.
         self.log.append(BusMessage(offset, key, _load(data)[1]))
         return offset
+
+    def trim(self, upto: int) -> int:
+        """In-memory retention trim (bus.MessageBus contract): the
+        durable oplog is untouched — a restart replays full history and
+        re-trims as groups re-commit."""
+        cut = min(max(0, upto - self.base), len(self.log))
+        if cut:
+            del self.log[:cut]
+            self.base += cut
+        return cut
 
     def sync_if_dirty(self) -> None:
         if self.dirty:
@@ -395,8 +408,9 @@ class DurableMessageBus(MessageBus):
 
     OFFSET_COMPACT_THRESHOLD = 4096
 
-    def __init__(self, root: str | os.PathLike) -> None:
-        super().__init__()
+    def __init__(self, root: str | os.PathLike,
+                 retention_messages: int | None = None) -> None:
+        super().__init__(retention_messages=retention_messages)
         self._root = Path(root)
         self._root.mkdir(parents=True, exist_ok=True)
         # Topic metadata journal: partition counts are fixed at creation
@@ -413,6 +427,11 @@ class DurableMessageBus(MessageBus):
         for i in range(len(self._offset_log)):
             topic, group, partition, nxt = _load(self._offset_log.read(i))
             self._offsets[(topic, group, partition)] = nxt
+            # Groups with durable offsets pin the retention floor from
+            # the moment the bus reopens — a group whose Consumer
+            # re-attaches LATE must not find its position trimmed out
+            # from under it by an earlier group's commits.
+            self.register_group(topic, group)
         self._offset_records = len(self._offset_log)
 
     def create_topic(self, name: str, num_partitions: int = 4) -> Topic:
@@ -558,12 +577,65 @@ class GitSnapshotStore:
     the chunk hashes. Heads are per-document ref files. Implements the
     snapshot-backend surface RouterliciousService uses (upload / get /
     head / set_head).
+
+    GC: every upload journals a refcount increment for its tree + chunk
+    objects (``refcounts.log``, fsynced before the handle is returned —
+    an object can never be reachable without its count being durable);
+    :meth:`release` decrements a superseded snapshot's references and
+    deletes objects whose count reaches zero. Content sharing stays safe
+    across documents (a chunk two cold docs dedup into survives until
+    BOTH release), and objects that predate the journal (legacy stores)
+    are never deleted — an untracked sha is pinned, not collectable.
+    The residency manager releases each cold doc's superseded head on
+    eviction (head flip), closing the round-12 leftover: a churned cold
+    doc's disk cost stays one snapshot, not one per eviction.
     """
 
     def __init__(self, root: str | os.PathLike) -> None:
         self._root = Path(root)
         (self._root / "objects").mkdir(parents=True, exist_ok=True)
         (self._root / "refs").mkdir(parents=True, exist_ok=True)
+        # sha -> reference count, rebuilt from the journal ("+"/"-"
+        # records of sha lists). Missing shas are LEGACY (pre-journal)
+        # objects: pinned forever rather than risk deleting a blob an
+        # untracked head still needs.
+        self._rc_path = self._root / "refcounts.log"
+        self._rc_log = OpLog(self._rc_path)
+        self._rc: dict[str, int] = {}
+        for i in range(len(self._rc_log)):
+            sign, shas = _load(self._rc_log.read(i))
+            delta = 1 if sign == "+" else -1
+            for sha in shas:
+                self._rc[sha] = self._rc.get(sha, 0) + delta
+        self._rc_records = len(self._rc_log)
+
+    RC_COMPACT_THRESHOLD = 8192
+
+    def _journal_refs(self, sign: str, shas: list[str]) -> None:
+        self._rc_log.append(_dump([sign, shas]))
+        self._rc_log.sync()
+        delta = 1 if sign == "+" else -1
+        for sha in shas:
+            self._rc[sha] = self._rc.get(sha, 0) + delta
+        self._rc_records += 1
+        if self._rc_records > max(self.RC_COMPACT_THRESHOLD,
+                                  8 * len(self._rc)):
+            self._compact_refs()
+
+    def _compact_refs(self) -> None:
+        self._rc_log.close()
+        tmp = self._rc_path.with_suffix(".compact")
+        tmp.unlink(missing_ok=True)
+        fresh = OpLog(tmp)
+        live = sorted(sha for sha, n in self._rc.items() if n > 0)
+        for sha in live:
+            fresh.append(_dump(["+", [sha] * self._rc[sha]]))
+        fresh.sync()
+        fresh.close()
+        tmp.replace(self._rc_path)
+        self._rc = {sha: self._rc[sha] for sha in live}
+        self._rc_log = OpLog(self._rc_path)
+        self._rc_records = len(self._rc_log)
 
     # -- object plumbing ------------------------------------------------------
 
@@ -604,7 +676,57 @@ class GitSnapshotStore:
             # tree — the head ref still points at the previous snapshot.
             faults.crashpoint("snapshot.mid_upload")
         tree = json.dumps({"chunks": chunks, "doc": doc_id}).encode()
-        return put(tree)
+        handle = put(tree)
+        # Refcounts durable BEFORE the handle escapes: a reachable
+        # snapshot always has tracked references (a kill between journal
+        # and caller leaves a +1 orphan — a leak, never an over-delete).
+        # IDEMPOTENT re-upload of the doc's CURRENT head claims nothing
+        # new (callers skip the matching release when the head doesn't
+        # move) — journaling it would inflate the count one-sidedly and
+        # make the snapshot undeletable forever.
+        if self.head(doc_id) != handle:
+            self._journal_refs("+", chunks + [handle])
+        return handle
+
+    def release(self, doc_id: str, handle: str) -> list[str]:
+        """Release one SUPERSEDED snapshot's references (the caller just
+        flipped ``doc_id``'s head ref off ``handle``); deletes objects
+        whose refcount reaches zero. Refuses to release the current
+        head. Returns the deleted object shas (caching fronts invalidate
+        exactly these). Legacy (untracked) objects and chunks still
+        shared by other snapshots survive."""
+        if handle is None or self.head(doc_id) == handle:
+            return []
+        try:
+            tree = json.loads(self.get_object(handle).decode())
+            shas = list(tree.get("chunks", ())) + [handle]
+        except (OSError, ValueError, KeyError):
+            return []  # already gone / unreadable: nothing to release
+        # Decide deletability from the PRE-decrement counts (the journal
+        # append below may trigger a compaction that drops zeroed shas
+        # from the map — reading counts afterwards would mistake them
+        # for legacy-pinned objects and leak forever): a sha whose whole
+        # remaining tracked count is the occurrences THIS release drops
+        # reaches zero. Legacy objects (absent from the journal) and
+        # over-released shas (count would go negative) stay pinned.
+        occurrences: dict[str, int] = {}
+        for sha in shas:
+            occurrences[sha] = occurrences.get(sha, 0) + 1
+        deletable = [sha for sha, occ in occurrences.items()
+                     if self._rc.get(sha) == occ]
+        # Journal the decrement BEFORE deleting files: a kill in between
+        # orphans files (a leak the next release of the same sha cannot
+        # double-free — its count is already zero and it skips).
+        self._journal_refs("-", shas)
+        deleted: list[str] = []
+        for sha in deletable:
+            try:
+                self._object_path(sha).unlink(missing_ok=True)
+                deleted.append(sha)
+            except (OSError, KeyError):
+                pass
+            self._rc.pop(sha, None)
+        return deleted
 
     def get(self, doc_id: str, handle: str | None,
             read_object=None) -> dict | None:

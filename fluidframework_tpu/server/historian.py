@@ -124,6 +124,21 @@ class Historian:
         self._backend.set_head(doc_id, handle)
         self._cache_head(doc_id, handle, self._clock())
 
+    def release(self, doc_id: str, handle: str) -> list[str]:
+        """GC pass-through (GitSnapshotStore refcounted release), with
+        exactly the DELETED objects dropped from the cache — a deleted
+        blob must not keep serving from memory as if alive (objects the
+        backend kept — shared chunks — stay cached)."""
+        release = getattr(self._backend, "release", None)
+        if release is None:
+            return []
+        deleted = release(doc_id, handle)
+        for sha in deleted:
+            cached = self._objects.pop(sha, None)
+            if cached is not None:
+                self._bytes -= len(cached)
+        return deleted
+
     # -- observability --------------------------------------------------------
 
     def stats(self) -> dict:

@@ -116,8 +116,9 @@ class LocalCollabServer:
         document.connections[client_id] = connection
         # Audience wiring (container.ts:1700): announce EVERY connection
         # (read-only ones included — they never reach the quorum).
-        from .audience import announce_connect
-        announce_connect(document.connections, connection)
+        from .audience import MAX_ROSTER, announce_connect
+        announce_connect(document.connections, connection,
+                         max_roster=MAX_ROSTER)
         # Read clients receive the broadcast stream but never enter the
         # quorum or the MSN calculation (the reference sequences joins only
         # for write connections — a reader must not pin minSeq).
@@ -137,8 +138,9 @@ class LocalCollabServer:
         document = self._document(doc_id)
         connection = document.connections.pop(client_id, None)
         if connection is not None:
-            from .audience import announce_leave
-            announce_leave(document.connections, client_id)
+            from .audience import MAX_ROSTER, announce_leave
+            announce_leave(document.connections, client_id,
+                           max_roster=MAX_ROSTER)
         if connection is not None and connection.mode == "read":
             return
         self._sequence_raw(document, RawOperation(

@@ -436,12 +436,26 @@ class ResidencyManager:
                 f"{doc_id!r} quarantined during the eviction flush")
         snap = self._export(doc_id)
         key = self._cold_key(doc_id)
+        superseded = self.cold_handle(doc_id)
         handle = self.snapshots.upload(key, snap)
         # Chaos kill class "mid-evict": snapshot uploaded, head ref NOT
         # yet flipped, rows still live — recovery sees the doc resident
         # (global snapshot + WAL) and the orphan upload is harmless.
         faults.crashpoint("residency.mid_evict")
         self.snapshots.set_head(key, handle)
+        if superseded and superseded != handle:
+            # Cold-store GC: the old head's unreferenced blobs delete on
+            # the flip (content-addressed refcounts — chunks another
+            # doc's snapshot shares survive). A churned cold doc's disk
+            # cost stays ONE snapshot, not one per eviction. Kill-window
+            # safety: the release runs after the flip, so a crash in
+            # between leaks at most one superseded snapshot.
+            release = getattr(self.snapshots, "release", None)
+            if release is not None:
+                try:
+                    release(key, superseded)
+                except Exception:
+                    pass  # GC is best-effort; serving state is already safe
         # Kill window between the flip and the release: the doc is
         # durable BOTH ways (cold head == live state), so either recovery
         # choice reconverges byte-identically.

@@ -262,11 +262,21 @@ class _BatchedDeliFactory:
 
 class ScriptoriumDocumentLambda:
     """Durable op log writer (scriptorium/lambda.ts insertOp). Idempotent on
-    replay: ops at-or-below the stored tail sequence number drop."""
+    replay: ops at-or-below the stored tail sequence number drop.
 
-    def __init__(self, doc_id: str, store: StateStore) -> None:
+    ``retention_ops`` (opt-in) bounds the per-doc ops store: past 2x the
+    horizon the head trims back to the horizon (amortized — one rewrite
+    per horizon's worth of appends). Catch-up reads older than the
+    horizon become impossible (clients that far behind reload from a
+    snapshot) — the same trade the storm tier's
+    ``doc_index_retention_ticks`` makes, and the rest of BENCH_r12's
+    service-plane RAM slope."""
+
+    def __init__(self, doc_id: str, store: StateStore,
+                 retention_ops: int | None = None) -> None:
         self.doc_id = doc_id
         self._store = store
+        self._retention_ops = retention_ops
 
     def handler(self, message: BusMessage) -> None:
         if message.value["kind"] != "op":
@@ -275,6 +285,11 @@ class ScriptoriumDocumentLambda:
         log: list = self._store.get(f"ops/{self.doc_id}", [])
         if log and op.sequence_number <= log[-1].sequence_number:
             return  # replay after crash-before-commit
+        retention = self._retention_ops
+        if retention is not None and len(log) >= 2 * retention:
+            # Amortized horizon trim: ONE put per retention-window of
+            # appends rewrites the key to its newest `retention` ops.
+            self._store.put(f"ops/{self.doc_id}", log[-retention:])
         self._store.append(f"ops/{self.doc_id}", [op])
 
     def checkpoint(self, next_offset: int) -> None:
@@ -288,11 +303,14 @@ class ScriptoriumDocumentLambda:
 
 
 class _ScriptoriumFactory:
-    def __init__(self, store: StateStore) -> None:
+    def __init__(self, store: StateStore,
+                 retention_ops: int | None = None) -> None:
         self._store = store
+        self._retention_ops = retention_ops
 
     def create(self, doc_id: str) -> ScriptoriumDocumentLambda:
-        return ScriptoriumDocumentLambda(doc_id, self._store)
+        return ScriptoriumDocumentLambda(doc_id, self._store,
+                                         self._retention_ops)
 
 
 # -- broadcaster --------------------------------------------------------------
@@ -333,9 +351,13 @@ class BroadcasterDocumentLambda:
     replayed messages after a crash dedupe naturally."""
 
     def __init__(self, doc_id: str,
-                 connections: dict[str, _LiveConnection]) -> None:
+                 connections: dict[str, _LiveConnection],
+                 viewers=None) -> None:
         self.doc_id = doc_id
         self._connections = connections
+        # Zero-arg callable resolving the service's viewer plane at
+        # delivery time (the plane may attach after this lambda exists).
+        self._viewers = viewers
         self._delivered_seq: dict[str, int] = {}
 
     def handler(self, message: BusMessage) -> None:
@@ -361,6 +383,12 @@ class BroadcasterDocumentLambda:
                 ))
             return
         self._deliver_op(value["message"])
+        # Viewer plane (read-only audience): the sequenced op fans out
+        # to the doc's viewer room, encoded once per batch (the plane
+        # dedupes crash-replay by sequence number).
+        viewers = self._viewers() if self._viewers is not None else None
+        if viewers is not None and viewers.has_viewers(self.doc_id):
+            viewers.publish_ops(self.doc_id, [value["message"]])
 
     def _deliver_op(self, op: SequencedDocumentMessage) -> None:
         # ONE shared batch for every subscriber: sessions serialize the
@@ -389,8 +417,8 @@ class FanoutBroadcasterDocumentLambda(BroadcasterDocumentLambda):
     connection. Per-connection crash-replay dedup moves to the drain."""
 
     def __init__(self, doc_id: str, connections: dict[str, _LiveConnection],
-                 fanout) -> None:
-        super().__init__(doc_id, connections)
+                 fanout, viewers=None) -> None:
+        super().__init__(doc_id, connections, viewers)
         self._fanout = fanout
 
     def _deliver_op(self, op: SequencedDocumentMessage) -> None:
@@ -406,12 +434,13 @@ class _BroadcasterFactory:
         self._service = service
 
     def create(self, doc_id: str) -> BroadcasterDocumentLambda:
+        viewers = lambda: self._service.viewers  # noqa: E731
         if self._service.fanout is not None:
             return FanoutBroadcasterDocumentLambda(
                 doc_id, self._service._connections_for(doc_id),
-                self._service.fanout)
+                self._service.fanout, viewers)
         return BroadcasterDocumentLambda(
-            doc_id, self._service._connections_for(doc_id))
+            doc_id, self._service._connections_for(doc_id), viewers)
 
 
 # -- merger (device merge host consumer) --------------------------------------
@@ -635,11 +664,16 @@ class RouterliciousService:
                  batched_deli_host=None,
                  auto_pump: bool = True,
                  fanout=None,
-                 idle_check_interval: int = 64) -> None:
+                 idle_check_interval: int = 64,
+                 ops_retention: int | None = None) -> None:
         self.bus = bus if bus is not None else MessageBus()
         self.merge_host = merge_host
         # Optional columnar fast path (server/storm.py attaches itself).
         self.storm = None
+        # Broadcast viewer plane (server/broadcaster.py attaches itself;
+        # connect(mode="viewer") lazily builds a default one): read-only
+        # audiences ride fan-out rooms, never the merge/ack path.
+        self.viewers = None
         # Optional native pub/sub broadcast hop (native/fanout.py — the
         # Redis + socket.io-adapter analog). None = direct callbacks.
         self.fanout = fanout
@@ -690,7 +724,8 @@ class RouterliciousService:
         self._deli = PartitionManager(self.bus, RAWDELTAS, "deli",
                                       deli_factory)
         self._scriptorium = PartitionManager(
-            self.bus, DELTAS, "scriptorium", _ScriptoriumFactory(self.store))
+            self.bus, DELTAS, "scriptorium",
+            _ScriptoriumFactory(self.store, ops_retention))
         self._broadcaster = PartitionManager(
             self.bus, DELTAS, "broadcaster", _BroadcasterFactory(self))
         self._scribe = PartitionManager(
@@ -844,6 +879,21 @@ class RouterliciousService:
         mode: str = "write",
         scopes: tuple[str, ...] = ScopeType.ALL,
     ) -> _LiveConnection:
+        if mode == "viewer":
+            # Viewer-plane connect: no CLIENT_JOIN, no quorum, no deli
+            # row, no residency hydration (reads must not churn the
+            # pool) — the handler receives broadcast payloads exactly as
+            # the wire carries them (server/broadcaster.py).
+            if self.viewers is None:
+                from .broadcaster import ViewerPlane
+                ViewerPlane(self, metrics=self.metrics)
+            hello = self.viewers.join(doc_id, handler)
+            from .broadcaster import ViewerConnection
+            connection = ViewerConnection(self.viewers,
+                                          hello["viewer_id"], doc_id)
+            self.logger.send_event("ViewerConnect", docId=doc_id,
+                                   clientId=hello["viewer_id"])
+            return connection
         residency = getattr(self.storm, "residency", None)
         if residency is not None:
             # Tiered residency: the first connect against a cold doc
@@ -878,8 +928,13 @@ class RouterliciousService:
         return connection
 
     def _announce_audience(self, doc_id: str, connection) -> None:
-        from .audience import announce_connect
-        announce_connect(self._connections_for(doc_id), connection)
+        from .audience import MAX_ROSTER, announce_connect
+        # Interest-sampled presence: a pathological writer/reader fan-in
+        # on one doc gets a bounded roster sample + exact total instead
+        # of a join event per member (read-only VIEWERS never reach this
+        # map at all — server/broadcaster.py).
+        announce_connect(self._connections_for(doc_id), connection,
+                         max_roster=MAX_ROSTER)
 
     def disconnect(self, doc_id: str, client_id: str) -> None:
         residency = getattr(self.storm, "residency", None)
@@ -897,8 +952,9 @@ class RouterliciousService:
             self._fanout_last_seq.pop((doc_id, client_id), None)
         connection = self._connections_for(doc_id).pop(client_id, None)
         if connection is not None:
-            from .audience import announce_leave
-            announce_leave(self._connections_for(doc_id), client_id)
+            from .audience import MAX_ROSTER, announce_leave
+            announce_leave(self._connections_for(doc_id), client_id,
+                           max_roster=MAX_ROSTER)
         if connection is not None and connection.open:
             # Service-initiated close (the client-initiated path flips
             # `open` before calling us): mark it dead so further submits

@@ -1080,6 +1080,13 @@ class StormController:
                 if frame.trace is not None:
                     self.tracer.mark(frame.trace, "sequenced", t_readback)
         fanout = self.service.fanout
+        viewers = getattr(self.service, "viewers", None)
+        if viewers is not None and (self._replay
+                                    or not viewers.active_rooms):
+            viewers = None
+        # Desc indices whose docs have viewer rooms — collected inside
+        # the one existing per-desc loop (no second O(descs) pass).
+        viewer_idx: list[int] = []
         now = rec["now"]
         mrows = rec["mrows"]
         # scriptorium tick record: ONE blob per tick — a json header of
@@ -1132,6 +1139,9 @@ class StormController:
                 # broadcaster: compact tick frame into the pub/sub hop.
                 if pubs is not None:
                     pubs.append((doc, b"\x00storm%d:%d:%d" % (fs, ls, m)))
+                if viewers is not None and ns > 0 \
+                        and viewers.has_viewers(doc):
+                    viewer_idx.append(i)
         t_assembled = _time.monotonic_ns()
         stage_ns["ack_pack"] = t_assembled - t_readback
         if pubs:
@@ -1144,6 +1154,40 @@ class StormController:
             else:  # duck-typed fanout without the batch surface
                 for room, body in pubs:
                     fanout.publish(room, body)
+        # Viewer plane: docs with viewer rooms get this tick's broadcast
+        # frame (sequenced window + raw words) serialized ONCE per doc
+        # and fanned out in one batched publish — encodes-per-tick ==
+        # hot docs with viewers, independent of viewer count (the
+        # serialize-once invariant BENCH_r13 pins). Words resolve
+        # straight from each frame's receive-buffer view (the same
+        # positional layout the WAL appends); only frames CONTAINING a
+        # viewer doc pay an offsets walk — a 10k-doc tick with one
+        # viewer room touches one frame, not every desc.
+        if viewer_idx:
+            import bisect
+            frame_words = rec["frame_words"]
+            items = []
+            for f_idx, (_frame, i0, i1) in enumerate(rec["acks"]):
+                lo = bisect.bisect_left(viewer_idx, i0)
+                hi = bisect.bisect_left(viewer_idx, i1)
+                if lo == hi:
+                    continue  # no viewer docs in this frame
+                fcounts = counts_col[i0:i1].tolist()
+                target = viewer_idx[lo]
+                off = 0
+                for local, count in enumerate(fcounts):
+                    gi = i0 + local
+                    if gi == target:
+                        words = frame_words[f_idx][off:off + count]
+                        items.append((rec["descs"][gi][0], ns_l[gi],
+                                      fs_l[gi], ls_l[gi], m_l[gi],
+                                      count, words.tobytes()))
+                        lo += 1
+                        if lo == hi:
+                            break
+                        target = viewer_idx[lo]
+                    off += count
+            viewers.publish_ticks(items)
         t_fanout = _time.monotonic_ns()
         stage_ns["fanout_publish"] = t_fanout - t_assembled
         import json as _json

@@ -162,6 +162,32 @@ def render_residency(metrics: dict, prev: dict | None = None,
             f"rss {rss:,.0f}MB")
 
 
+def render_viewers(metrics: dict, prev: dict | None = None,
+                   interval: float = 1.0) -> str:
+    """Viewer-plane line (the round-13 broadcast tier): rooms/viewers
+    gauge levels, broadcast bytes/s and lag-drop rate over the poll
+    window (cumulative counters with no window), and the serialize-once
+    evidence (tick encodes vs frames delivered). Empty when no viewer
+    has ever joined (the gauges never appear)."""
+    if "viewer.rooms" not in metrics:
+        return ""
+    rooms = metrics.get("viewer.rooms", 0)
+    viewers = metrics.get("viewer.viewers", 0)
+    byts = metrics.get("viewer.broadcast_bytes", 0)
+    drops = metrics.get("viewer.lag_drops", 0)
+    encodes = metrics.get("viewer.tick_encodes", 0)
+    frames = metrics.get("viewer.delivered_frames", 0)
+    per_s = max(interval, 1e-9)
+    if prev:
+        w_b = byts - prev.get("viewer.broadcast_bytes", 0)
+        w_d = drops - prev.get("viewer.lag_drops", 0)
+        if w_b >= 0 and w_d >= 0:  # negative = service restarted
+            byts, drops = w_b / per_s, w_d / per_s
+    return (f"viewers: rooms {rooms:g}  viewers {viewers:g}  "
+            f"broadcast {byts:,.0f}B/s  lag-drops {drops:,.1f}/s  "
+            f"encodes {encodes:,.0f} / frames {frames:,.0f}")
+
+
 def render_human(now: dict, prev: dict, interval: float) -> str:
     """Operator view of one poll: headline rates (per-second deltas of
     the interesting counters), the stage bar, and the hop decomposition
@@ -191,6 +217,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     residency = render_residency(now, prev or None, interval)
     if residency:
         lines.append(residency)
+    viewer_line = render_viewers(now, prev or None, interval)
+    if viewer_line:
+        lines.append(viewer_line)
     hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
                        if k.startswith("storm.hop.")})
     if hop_keys:

@@ -163,6 +163,48 @@ def test_bridge_standalone_service():
         proc.wait(timeout=10)
 
 
+def test_per_connection_outbox_bound_is_class_scoped():
+    """Round-13 satellite: bridge_set_conn_max_outbox overrides the -2
+    threshold for ONE connection (the viewer class takes a shallow
+    outbox) while other connections keep the bridge-wide default."""
+    import socket
+
+    from fluidframework_tpu.native.bridge import start_bridge
+
+    bridge = start_bridge(0)
+    try:
+        viewer_sock = socket.create_connection(("127.0.0.1", bridge.port))
+        writer_sock = socket.create_connection(("127.0.0.1", bridge.port))
+        conns = []
+        deadline = time.monotonic() + 15
+        while len(conns) < 2 and time.monotonic() < deadline:
+            ev = bridge.poll(wait_ms=50)
+            if ev is not None and ev[1] == 0:  # EV_OPEN
+                conns.append(ev[0])
+        assert len(conns) == 2
+        viewer_conn, writer_conn = conns
+        assert bridge.set_conn_max_outbox(viewer_conn, 3) == 0
+        assert bridge.set_conn_max_outbox(999999, 3) == -1
+        # Stall both readers; flood. The viewer trips -2 at its shallow
+        # bound; the writer keeps absorbing at the deep default.
+        body = b"x" * 65536
+        viewer_rc = writer_rc = 0
+        for _ in range(64):
+            if viewer_rc == 0:
+                viewer_rc = bridge.send(viewer_conn, body)
+            writer_rc = bridge.send(writer_conn, body)
+            if viewer_rc == -2:
+                break
+        assert viewer_rc == -2
+        assert writer_rc == 0
+        # Resetting restores the default for later sends.
+        assert bridge.set_conn_max_outbox(viewer_conn, None) == 0
+        viewer_sock.close()
+        writer_sock.close()
+    finally:
+        bridge.stop()
+
+
 def test_stalled_reader_is_disconnected_not_silently_dropped():
     """bridge_send rc -2 (outbox full behind a reader that stopped
     reading): the front door must DISCONNECT the slow consumer — close

@@ -244,6 +244,53 @@ def test_rebalance_line_renders_fire_rate():
     assert "0.25/tick" in render_rebalance(m, prev_big)
 
 
+def test_viewer_line_renders_broadcast_plane():
+    """Round-13 viewer-plane line: silent until a viewer ever joins,
+    gauge levels + windowed broadcast-bytes and lag-drop rates, the
+    serialize-once evidence column, cumulative fallback across
+    restarts — and the raw metrics flow through --json untouched."""
+    import io
+    import json
+
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_viewers
+
+    assert render_viewers({}) == ""  # no viewer plane → no line
+    m = {"viewer.rooms": 2.0,
+         "viewer.viewers": 100000.0,
+         "viewer.broadcast_bytes": 4096.0,
+         "viewer.lag_drops": 10.0,
+         "viewer.tick_encodes": 20.0,
+         "viewer.delivered_frames": 2000000.0}
+    text = render_viewers(m)
+    assert "rooms 2" in text and "viewers 100000" in text
+    assert "encodes 20 / frames 2,000,000" in text
+    # Windowed rates over a 2s poll: (4096-2048)/2 and (10-6)/2.
+    prev = {"viewer.broadcast_bytes": 2048.0, "viewer.lag_drops": 6.0}
+    windowed = render_viewers(m, prev, interval=2.0)
+    assert "1,024B/s" in windowed
+    assert "lag-drops 2.0/s" in windowed
+    # Restart (negative window): fall back to cumulative counts.
+    prev_big = {"viewer.broadcast_bytes": 99999.0, "viewer.lag_drops": 0.0}
+    assert "4,096B/s" in render_viewers(m, prev_big, interval=1.0)
+    # Human watch mode carries the line; --json carries raw metrics.
+    human = monitor.render_human(m, prev, interval=2.0)
+    assert "viewers: rooms 2" in human
+
+    scrapes = iter([dict(m)])
+    real_scrape = monitor.scrape
+    monitor.scrape = lambda *a, **k: next(scrapes)
+    try:
+        out = io.StringIO()
+        monitor.watch("h", 1, interval=0.0, out=out, as_json=True,
+                      max_polls=1)
+    finally:
+        monitor.scrape = real_scrape
+    line = json.loads(out.getvalue().strip())
+    assert line["viewer.viewers"] == 100000.0
+    assert line["viewer.tick_encodes"] == 20.0
+
+
 def test_residency_line_renders_tiering_state():
     """Round-12 residency line: silent without a residency manager,
     gauge levels + windowed hydration/eviction rates + hydration p99 +

@@ -79,6 +79,74 @@ class TestFanoutCore:
         fanout.publish("r", b"z")
         assert fanout.delivered_total() == before + 2
 
+    def test_room_membership_under_churn(self, fanout):
+        """Round-13 satellite: join/leave/disconnect interleavings keep
+        room membership exact, empty rooms reclaim, and publishing to a
+        dead subscriber's old room never wedges or miscounts."""
+        rooms_before = fanout.room_count()
+        subs = [fanout.connect() for _ in range(8)]
+        for i, sub in enumerate(subs):
+            fanout.join(sub, "churn-a")
+            if i % 2:
+                fanout.join(sub, "churn-b")
+        assert fanout.room_size("churn-a") == 8
+        assert fanout.room_size("churn-b") == 4
+        assert fanout.room_count() == rooms_before + 2
+
+        # Interleave: leave a, disconnect mid-membership, re-join.
+        fanout.leave(subs[0], "churn-a")
+        fanout.disconnect(subs[1])  # was in both rooms
+        fanout.join(subs[0], "churn-b")
+        assert fanout.room_size("churn-a") == 6
+        assert fanout.room_size("churn-b") == 4  # -subs[1] +subs[0]
+
+        # Publish-to-dead-subscriber: disconnect then publish — dead
+        # members are skipped, live members still count exactly.
+        fanout.disconnect(subs[2])
+        assert fanout.publish("churn-a", b"alive") == 5
+        assert fanout.poll(subs[3]) == b"alive"
+        # A dead sub cannot re-join and polls nothing.
+        with pytest.raises(KeyError):
+            fanout.join(subs[1], "churn-a")
+        assert fanout.poll(subs[1]) is None
+
+        # Empty-room reclamation: drain every member out both ways.
+        for sub in subs:
+            fanout.leave(sub, "churn-a")  # no-op for gone members
+            fanout.disconnect(sub)
+        assert fanout.room_size("churn-a") == 0
+        assert fanout.room_size("churn-b") == 0
+        assert fanout.room_count() == rooms_before
+        assert fanout.publish("churn-a", b"nobody") == 0
+
+    def test_per_subscriber_queue_limit(self, fanout):
+        """Per-room outbox bounds: a shallow-limit subscriber (the
+        viewer class) evicts early; default-limit peers are untouched;
+        resetting the limit restores the default."""
+        viewer = fanout.connect()
+        writer = fanout.connect()
+        fanout.join(viewer, "lim")
+        fanout.join(writer, "lim")
+        fanout.set_queue_limit(viewer, 3)
+        for i in range(5):
+            fanout.publish("lim", b"m%d" % i)
+        assert fanout.was_evicted(viewer)
+        assert not fanout.was_evicted(writer)
+        assert fanout.pending(writer) == 5
+        with pytest.raises(KeyError):
+            fanout.set_queue_limit(viewer, None)  # evicted = unknown
+        # A fresh subscriber with the limit RESET takes the default.
+        fresh = fanout.connect()
+        fanout.join(fresh, "lim")
+        fanout.set_queue_limit(fresh, 2)
+        fanout.set_queue_limit(fresh, None)
+        for i in range(4):
+            fanout.publish("lim", b"x")
+        assert not fanout.was_evicted(fresh)
+        fanout.disconnect(viewer)
+        fanout.disconnect(writer)
+        fanout.disconnect(fresh)
+
     def test_publish_batch_matches_sequential_publishes(self, fanout):
         """One batched call == the same per-room publishes, in order —
         the O(batch) broadcast hop of a storm tick."""

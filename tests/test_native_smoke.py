@@ -42,9 +42,13 @@ def test_bridge_and_fanout_serve_one_storm_tick_together():
     """One real tick over both native components: a storm frame enters
     through the C++ bridge socket, sequences on the device, broadcasts
     through the C++ fanout rooms in one batched publish, and acks back
-    over the wire as a binary columnar frame."""
+    over the wire as a binary columnar frame — and a mode="viewer"
+    session on the same bridge receives the tick's viewer broadcast
+    frame (the round-13 plane riding the same native pair)."""
+    import json
     from fluidframework_tpu.native.fanout import NativeFanout, make_fanout
     from fluidframework_tpu.protocol.codec import (
+        decode_body,
         decode_storm_push,
         encode_storm_frame,
         is_storm_body,
@@ -76,6 +80,27 @@ def test_bridge_and_fanout_serve_one_storm_tick_together():
         for d, sub in subs.items():
             fanout.join(sub, d)
 
+        # A read-only VIEWER session over the same bridge: mode="viewer"
+        # hello, then the tick's broadcast frame as a binary push.
+        import struct
+
+        def read_frame(s):
+            length = struct.unpack(">I",
+                                   s.recv(4, socket.MSG_WAITALL))[0]
+            return s.recv(length, socket.MSG_WAITALL)
+
+        viewer_sock = socket.create_connection(("127.0.0.1", front.port))
+        viewer_sock.settimeout(30)
+        hello_req = json.dumps({"rid": 7, "op": "connect",
+                                "doc_id": "smoke-a",
+                                "mode": "viewer"}).encode()
+        viewer_sock.sendall(len(hello_req).to_bytes(4, "big") + hello_req)
+        frames = [read_frame(viewer_sock) for _ in range(2)]
+        hello = next(decode_body(f) for f in frames
+                     if not is_storm_body(f) and b'"rid"' in bytes(f))
+        assert hello["viewer"] is True
+        assert hello["client_id"].startswith("viewer-")
+
         k = 8
         words = pack_map_words([0] * k, list(range(k)), [7] * k)
         sock = socket.create_connection(("127.0.0.1", front.port))
@@ -85,13 +110,26 @@ def test_bridge_and_fanout_serve_one_storm_tick_together():
              "docs": [[d, clients[d], 1, 1, k] for d in docs]},
             words.astype(np.uint32).tobytes() * len(docs)))
 
-        import struct
         length = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))[0]
         body = sock.recv(length, socket.MSG_WAITALL)
         assert is_storm_body(body), "ack must be a binary storm push"
         ack = decode_storm_push(body)
         assert ack["rid"] == 1
         assert [a[0] for a in ack["acks"]] == [k, k]
+
+        # The viewer received the tick's once-per-doc broadcast frame.
+        deadline = time.monotonic() + 15
+        tick = None
+        while tick is None and time.monotonic() < deadline:
+            frame = read_frame(viewer_sock)
+            if is_storm_body(frame):
+                decoded = decode_storm_push(frame)
+                if decoded.get("event") == "storm_tick":
+                    tick = decoded
+        assert tick is not None and tick["doc"] == "smoke-a"
+        assert tick["n"] == k
+        assert list(tick["words"]) == list(words)
+        viewer_sock.close()
 
         # The batched room publish reached every subscriber.
         deadline = time.monotonic() + 10

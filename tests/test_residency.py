@@ -295,6 +295,112 @@ class TestLifecycle:
         assert res2.stats["cold_hydrations"] >= 1  # the digest hydrated a
 
 
+class TestColdStoreGC:
+    def test_superseded_cold_head_blobs_deleted_on_flip(self, tmp_path):
+        """Round-13 satellite: re-evicting a churned doc releases the
+        superseded cold head's unreferenced content-addressed blobs —
+        a cold doc's disk cost stays ONE snapshot, not one per
+        eviction — while chunks another doc's snapshot shares survive."""
+        import os
+
+        service, storm, seq_host, merge_host, res = build_stack(tmp_path)
+        clients = connect_docs(service, ["g1", "g2"])
+
+        def blob_count():
+            n = 0
+            for root, _dirs, files in os.walk(tmp_path / "git" / "objects"):
+                n += len(files)
+            return n
+
+        drive(storm, "g1", clients["g1"], 0, words=set_words(0))
+        h1 = res.evict("g1")
+        blobs_one_head = blob_count()
+        # Churn: hydrate, mutate, re-evict — the head flips and the
+        # superseded snapshot's unique blobs delete.
+        for r in range(1, 4):
+            drive(storm, "g1", clients["g1"], r, words=set_words(r))
+            h2 = res.evict("g1")
+            assert h2 != h1
+            h1 = h2
+        assert storm.snapshots.get(COLD_KEY_PREFIX + "g1", h1) is not None
+        # Disk stays O(one snapshot) per cold doc (+ tree object churn
+        # tolerance), not O(evictions).
+        assert blob_count() <= blobs_one_head + 2
+        # The live head still hydrates byte-exactly.
+        res.ensure_resident("g1", gate=False)
+        assert res.stats["cold_hydrations"] >= 1
+
+    def test_shared_chunks_survive_one_docs_release(self, tmp_path):
+        from fluidframework_tpu.server.durable_store import GitSnapshotStore
+        store = GitSnapshotStore(tmp_path / "gc")
+        payload = {"planes": "z" * 200}
+        ha = store.upload("__cold__::a", payload)
+        hb = store.upload("__cold__::b", payload)
+        store.set_head("__cold__::a", ha)
+        store.set_head("__cold__::b", hb)
+        assert ha != hb  # trees differ (doc id); the CHUNKS dedup
+        ha2 = store.upload("__cold__::a", {"planes": "w"})
+        store.set_head("__cold__::a", ha2)
+        deleted = store.release("__cold__::a", ha)
+        # Only a's superseded TREE deletes; the content chunk b's
+        # snapshot shares survives and b still reads byte-exactly.
+        assert deleted == [ha]
+        assert store.get("__cold__::b", hb) == payload
+        # Releasing the current head is refused outright.
+        assert store.release("__cold__::b", hb) == []
+        # Refcounts survive a reopen (the journal is the authority):
+        # once b's head flips too, the LAST reference release deletes.
+        store2 = GitSnapshotStore(tmp_path / "gc")
+        hb2 = store2.upload("__cold__::b", {"planes": "w2"})
+        store2.set_head("__cold__::b", hb2)
+        assert len(store2.release("__cold__::b", hb)) > 0
+        assert store2.get("__cold__::b", hb) is None
+        assert store2.get("__cold__::b", hb2) == {"planes": "w2"}
+
+    def test_idempotent_reupload_does_not_inflate_refcounts(self,
+                                                            tmp_path):
+        """Re-evicting an UNCHANGED doc re-uploads the identical
+        snapshot (same handle, head never moves, caller skips release) —
+        the refcount must not inflate, or the eventual real supersession
+        could never delete it."""
+        from fluidframework_tpu.server.durable_store import GitSnapshotStore
+        store = GitSnapshotStore(tmp_path / "gci")
+        h1 = store.upload("__cold__::y", {"v": "same"})
+        store.set_head("__cold__::y", h1)
+        for _ in range(3):  # unchanged re-evictions
+            assert store.upload("__cold__::y", {"v": "same"}) == h1
+            store.set_head("__cold__::y", h1)
+        h2 = store.upload("__cold__::y", {"v": "changed"})
+        store.set_head("__cold__::y", h2)
+        deleted = store.release("__cold__::y", h1)
+        assert len(deleted) == 2  # tree + chunk: the old head really GCs
+        assert store.get("__cold__::y", h1) is None
+        assert store.get("__cold__::y", h2) == {"v": "changed"}
+
+    def test_release_deletes_across_refcount_compaction(self, tmp_path):
+        """Regression: deletability is decided from PRE-decrement counts
+        — a journal compaction triggered by the release's own decrement
+        drops zeroed shas from the map, and reading counts afterwards
+        mistook them for legacy-pinned objects (leaking forever)."""
+        from fluidframework_tpu.server.durable_store import GitSnapshotStore
+        store = GitSnapshotStore(tmp_path / "gcc")
+        orig = store._journal_refs
+
+        def journal_then_compact(sign, shas):
+            orig(sign, shas)
+            store._compact_refs()  # worst case: compact EVERY append
+
+        store._journal_refs = journal_then_compact
+        h1 = store.upload("__cold__::x", {"v": 1})
+        store.set_head("__cold__::x", h1)
+        h2 = store.upload("__cold__::x", {"v": 2})
+        store.set_head("__cold__::x", h2)
+        deleted = store.release("__cold__::x", h1)
+        assert len(deleted) == 2  # tree + chunk deleted, not leaked
+        assert store.get("__cold__::x", h1) is None
+        assert store.get("__cold__::x", h2) == {"v": 2}
+
+
 class TestRefusals:
     def test_quarantined_doc_pinned_resident(self, tmp_path):
         clk = [0.0]
