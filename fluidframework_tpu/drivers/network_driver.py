@@ -309,6 +309,21 @@ class NetworkDocumentService:
                               else "__stop__"})
 
     def _dispatch(self, payload: dict) -> None:
+        if isinstance(payload, dict) and payload.get("storm"):
+            # JSON-path storm pushes (busy/shed nacks from the storm
+            # ingress, quarantine refusals): these carry the SENDER's
+            # frame rid, not an RPC correlation id — routing them into
+            # the RPC waiters would drop them on the floor (no waiter
+            # ever registered that rid), and the flow-control window
+            # MUST see every refusal: a shed frame that vanishes here
+            # frees client budget silently, as if it had been sequenced.
+            # Deliver through the same pushed-event channel as binary
+            # storm acks, with the same reader-thread rx stamp.
+            payload.setdefault("event", "storm_ack")
+            if self._stamp_storm_rx:
+                payload["_rx_ns"] = time.monotonic_ns()
+            self._events.put(payload)
+            return
         rid = payload.get("rid")
         if rid is not None:
             q = self._pending.pop(rid, None)
@@ -423,17 +438,34 @@ class StormStream:
     span on :attr:`tracer` — ack latency decomposed into
     send→ingress→admit→dispatch→sequenced[→durable]→ack_tx→rx.
 
+    Windowed flow control (round 14): with ``window=N`` at most N frames
+    stay in flight (submitted, neither acked nor nacked) — :meth:`submit`
+    blocks until the ack watermark frees a slot, so a sender can never
+    build the multi-second socket/ingress backlog BENCH_r10 measured in
+    front of the serving tick (4.0 s of "latency" that was client
+    queueing, not the server). Size the window at least
+    ``server pipeline_depth + 1``: acks lag dispatch by up to ``depth``
+    ticks, and a window smaller than that starves the cohort. A
+    busy-nack (``retry_after_s``) frees its slot — the frame is dead
+    server-side — but counts on :attr:`nacked`, never :attr:`acked`,
+    and arms a send-side backoff honoring the hint; the frame must be
+    resubmitted to be sequenced.
+
     Registers itself as the service's ``storm_ack`` handler; pass
-    ``on_ack`` to also observe every ack payload (traced or not).
+    ``on_ack`` to also observe every ack payload (traced or not) and
+    ``on_nack`` to observe refusals.
     """
 
     def __init__(self, service: NetworkDocumentService,
                  sample_every: int = 64,
-                 on_ack: Callable[[dict], None] | None = None) -> None:
+                 on_ack: Callable[[dict], None] | None = None,
+                 window: int | None = None,
+                 on_nack: Callable[[dict], None] | None = None) -> None:
         from ..utils import TraceSpans
         self._service = service
         self.sample_every = max(0, sample_every)
         self._on_ack = on_ack
+        self._on_nack = on_nack
         self._sent = 0
         self._next_tc = itertools.count(1)
         # Guarded: submit() runs on the app thread while _handle_ack
@@ -442,6 +474,17 @@ class StormStream:
         self._send_ns: dict[Any, int] = {}
         self.tracer = TraceSpans()
         self.acked = 0
+        self.nacked = 0
+        if window is not None and window < 1:
+            raise ValueError(f"flow-control window must be >= 1, "
+                             f"got {window}")
+        self.window = window
+        self.inflight = 0
+        self._flow = threading.Condition()
+        # Monotonic deadline from the latest busy-nack's retry_after_s:
+        # submit() sleeps it off before sending (never the dispatcher
+        # thread, which must keep draining acks).
+        self._backoff_until = 0.0
         service._handlers["storm_ack"] = self._handle_ack
         service._stamp_storm_rx = True
 
@@ -450,11 +493,46 @@ class StormStream:
     #: leak its send timestamp forever.
     MAX_PENDING_TRACES = 1024
 
-    def submit(self, docs: list, payload, rid=None):
+    def submit(self, docs: list, payload, rid=None,
+               timeout: float | None = 30.0):
         """One storm frame: ``docs`` is the header doc list
         ([[doc_id, client_id, cseq0, ref_seq, count], ...]), ``payload``
-        the packed op words. Returns the trace id when this frame drew
-        the sample, else None."""
+        the packed op words. With a flow-control window, blocks while
+        the window is full (``timeout`` bounds the wait; None waits
+        forever) and sleeps out any pending busy-nack backoff first.
+        Returns the trace id when this frame drew the sample, else
+        None."""
+        if self.window is not None:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            with self._flow:
+                while self.inflight >= self.window:
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(
+                            f"storm flow-control window {self.window} "
+                            f"still full after {timeout}s "
+                            f"({self.inflight} in flight)")
+                    self._flow.wait(timeout=remaining)
+                self.inflight += 1
+            # Honor the latest retry_after_s OUTSIDE the lock: the
+            # dispatcher thread must stay free to drain acks meanwhile.
+            # The hint is server-controlled and uncapped (the admission
+            # ladder can hand out minutes), so it must respect the
+            # caller's timeout bound — fail loudly rather than hang a
+            # 30s-bounded submit for 2 minutes holding a window slot.
+            wait_s = self._backoff_until - time.monotonic()
+            if wait_s > 0:
+                if deadline is not None \
+                        and time.monotonic() + wait_s > deadline:
+                    with self._flow:
+                        self.inflight = max(0, self.inflight - 1)
+                        self._flow.notify_all()
+                    raise TimeoutError(
+                        f"busy-nack backoff {wait_s:.2f}s exceeds the "
+                        f"submit timeout {timeout}s")
+                time.sleep(wait_s)
         header = {"op": "storm", "rid": rid, "docs": docs}
         tc = None
         if self.sample_every and self._sent % self.sample_every == 0:
@@ -465,12 +543,41 @@ class StormStream:
                     self._send_ns.pop(next(iter(self._send_ns)), None)
                 self._send_ns[tc] = time.monotonic_ns()
         self._sent += 1
-        self._service.send_storm(header, payload)
+        try:
+            self._service.send_storm(header, payload)
+        except BaseException:
+            # The frame never left: its window slot must not leak (the
+            # reconnect path resubmits through a fresh submit()).
+            if self.window is not None:
+                with self._flow:
+                    self.inflight = max(0, self.inflight - 1)
+                    self._flow.notify_all()
+            raise
         return tc
 
     def _handle_ack(self, payload: dict) -> None:
         rx_ns = payload.pop("_rx_ns", None) or time.monotonic_ns()
-        self.acked += 1
+        err = payload.get("error")
+        if err is None:
+            self.acked += 1
+        else:
+            # Busy/shed nack: the frame DIED server-side. It frees its
+            # flow-control slot (the budget really is available again)
+            # but must never count as acked — the ops were not
+            # sequenced, and the caller resubmits after the hint.
+            # Treating it as an ack was the round-13 leak: a shed frame
+            # silently freed budget as if it had been served.
+            self.nacked += 1
+            retry = payload.get("retry_after_s")
+            if retry:
+                until = time.monotonic() + float(retry)
+                if until > self._backoff_until:
+                    self._backoff_until = until
+        if self.window is not None:
+            with self._flow:
+                if self.inflight > 0:
+                    self.inflight -= 1
+                self._flow.notify_all()
         tc = payload.get("tc")
         with self._send_lock:
             send_ns = self._send_ns.pop(tc, None) if tc is not None \
@@ -481,6 +588,8 @@ class StormStream:
                 self.tracer.mark(tc, hop, t_ns)
             self.tracer.mark(tc, "client_rx", rx_ns)
             self.tracer.finish(tc, rid=payload.get("rid"))
+        if err is not None and self._on_nack is not None:
+            self._on_nack(payload)
         if self._on_ack is not None:
             self._on_ack(payload)
 

@@ -104,14 +104,18 @@ class BridgeFrontDoor:
             # bounded timeout keeps close() responsive.
             event = self._bridge.poll(wait_ms=50)
             if event is None:
-                # Idle: drain any storm frames below the tick threshold
-                # (the batched-cadence operator tick) so connection-skewed
-                # tails never starve waiting for a full cohort.
+                # Idle: non-blocking storm service — drain acks whose
+                # group commit completed, run partial-cohort tails, and
+                # harvest ready in-flight ticks. Deliberately NOT a full
+                # flush(): a windowed (flow-controlled) sender goes
+                # quiet between frames while ticks are still in flight,
+                # and a forced settle on every quiet poll would collapse
+                # the dispatch/fsync overlap back into lockstep ticks.
                 storm = getattr(self.service, "storm", None)
                 if storm is not None and (storm._frames or storm._inflight
                                           or storm._unacked):
                     try:
-                        storm.flush()
+                        storm.idle_drain()
                     except Exception as err:
                         self.logger.send_error("BridgeStormFlushFailed", err)
                 # Idle residency sweep on the serving thread: docs idle

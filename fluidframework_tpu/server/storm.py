@@ -550,18 +550,34 @@ class StormController:
         # ingress decode / admission ns spent on frames buffered toward
         # the NEXT tick (consumed by its ledger record at flush).
         self._staged_ns = {"ingress_decode": 0, "admission": 0}
-        # Depth-N pipeline (SURVEY §7 hard part (c)): a tick's readbacks,
-        # durable records and acks are harvested only after N later
-        # ticks' device work is enqueued, so the host↔device round trip
-        # (a full transport RTT on a tunneled/remote attachment) hides
-        # under in-flight compute. Acks lag by ≤ depth ticks. Depth 1 is
-        # the safe default: clients that gate their NEXT frame on the
-        # previous ack (the request-response shape) would stall the
-        # cohort against a deeper ack debt; raise it only for senders
-        # that stream ahead of their acks.
-        self.pipeline_depth = max(1, pipeline_depth)
+        # Depth-N pipeline (SURVEY §7 hard part (c), round-14 tentpole):
+        # up to N ticks stay in flight; each round HARVESTS the due tick
+        # BEFORE staging the next one, so tick N's WAL append (and its
+        # group fsync, on the writer thread) starts as soon as N's
+        # readback lands and then runs CONCURRENT with tick N+1's
+        # scatter + device dispatch — the two dominant stages of the
+        # durable tick (BENCH_r10: wal_commit_wait 0.52 + device_dispatch
+        # 0.41, formerly back-to-back). Acks stay withheld on the durable
+        # watermark exactly as before — they lag dispatch by ≤ depth
+        # ticks, which is why flow-controlled senders size their window
+        # ≥ depth + 1. Depth 1 is the default; depth 0 is the serial
+        # fallback (dispatch → readback → append → fsync barrier → ack,
+        # per round — the pre-pipelining shape, kept as the A/B twin and
+        # for request-response senders that gate on every ack).
+        self.pipeline_depth = max(0, pipeline_depth)
         self._inflight: list[dict] = []
         self._last_harvest: float | None = None
+        # Monotonic-ns completion of the last NON-replay harvest: the
+        # ledger's wall-clock slice per tick (cadence) derives from it.
+        self._last_harvest_done_ns: int | None = None
+        # Host staging generations (double/N-buffered): the scatter
+        # arrays a dispatched tick's device transfer may still alias are
+        # never the ones the next round writes — see _staging_gen.
+        self._staging: list[dict | None] = [None] * (self.pipeline_depth
+                                                     + 1)
+        self._staging_idx = 0
+        merge_host.metrics.gauge("storm.pipeline.depth").set(
+            self.pipeline_depth)
         service.storm = self
 
     # -- front-door entry ------------------------------------------------------
@@ -823,6 +839,13 @@ class StormController:
         the serving thread (harvest / forced flush), never the writer
         thread, so session pushes stay single-threaded."""
         dw = self._group_wal.durable_len
+        if self._inflight and self._unacked and self._unacked[0][0] < dw:
+            # Chaos kill class "fsync-complete-before-readback": tick N
+            # is durable and about to ack while a later tick's device
+            # work is still in flight (its readback not yet taken).
+            # Recovery must replay N byte-identically and must never
+            # treat the in-flight tick as acked or durable.
+            faults.crashpoint("storm.overlap_fsynced")
         while self._unacked and self._unacked[0][0] < dw:
             _tick, acks, t_harvested, led = self._unacked.pop(0)
             t_drain = time.monotonic_ns()
@@ -921,6 +944,16 @@ class StormController:
         self._pending_docs += sum(len(f.docs) for f in deferred)
         if not descs:
             return True
+        # HARVEST-FIRST (the round-14 pipelining order): settle the due
+        # tick BEFORE staging this one, so its readback is taken the
+        # moment it matters and its WAL append reaches the writer thread
+        # NOW — the group fsync then runs concurrent with this round's
+        # scatter + device dispatch instead of queueing behind them
+        # (BENCH_r10 measured the two stages back-to-back at 0.52 + 0.41
+        # of every durable tick). This also frees the harvested tick's
+        # staging generation for reuse below.
+        while len(self._inflight) >= max(1, self.pipeline_depth):
+            self._harvest_one(self._inflight.pop(0))
         # Stage ledger: the tick that runs consumes the decode/admission
         # ns staged by its frames' submit_frame calls (a frame DEFERRED
         # to the next round charges the round it was decoded in —
@@ -972,14 +1005,28 @@ class StormController:
 
         b_seq = seq_host._capacity
         b_map = merge_host._map_capacity
-        slot_full = np.zeros(b_seq, np.int32)
-        cseq0_full = np.zeros(b_seq, np.int32)
-        ref_full = np.zeros(b_seq, np.int32)
-        seq_counts = np.zeros(b_seq, np.int32)
-        ts_full = np.full(b_seq, now, np.int32)
-        words_full = np.zeros((b_map, k), np.uint32)
-        map_counts = np.zeros(b_map, np.int32)
-        gather = np.zeros(b_map, np.int32)
+        # Double-buffered staging generations: this round scatters into
+        # the IDLE generation while the one a still-in-flight tick's
+        # device transfer may alias stays untouched (pipeline_depth + 1
+        # generations rotate round-robin; the harvest-first loop above
+        # guarantees the generation coming up for reuse was harvested
+        # ≥ one round ago). The per-doc vectors re-zero (cheap memsets);
+        # the [B, K] words plane deliberately does NOT: every window the
+        # tick consumes lies inside the [0, count) prefix freshly
+        # scattered for its row this round (rows without a batch have
+        # count 0 and an empty ticket window), so stale words from the
+        # generation's previous tick are unreachable by construction and
+        # the ~MB-scale memset stays off the hot path.
+        gen = self._staging_gen(b_seq, b_map, k)
+        slot_full = gen["slot"]
+        cseq0_full = gen["cseq0"]
+        ref_full = gen["ref"]
+        seq_counts = gen["seq_counts"]
+        ts_full = gen["ts"]
+        ts_full.fill(now)
+        words_full = gen["words"]
+        map_counts = gen["map_counts"]
+        gather = gen["gather"]
         slot_full[seq_rows] = slots
         cseq0_full[seq_rows] = desc_arr[:, 0]
         ref_full[seq_rows] = desc_arr[:, 1]
@@ -1026,6 +1073,7 @@ class StormController:
             map_rows=map_rows, mrows=mrows,
             acks=acks, now=now, submitted=int(counts_col.sum()),
             out=(n_seq, first, last, msn, bad, kstats), start=round_start,
+            start_ns=t_scatter0, depth=self.pipeline_depth,
             stage_ns=stage_ns, queue_depth=queue_depth)
         for out_arr in rec["out"]:
             copy_async = getattr(out_arr, "copy_to_host_async", None)
@@ -1039,9 +1087,116 @@ class StormController:
                 if frame.trace is not None:
                     self.tracer.mark(frame.trace, "dispatch", t_dispatched)
         self._inflight.append(rec)
-        while len(self._inflight) > self.pipeline_depth:
+        if self._group_wal is not None and not self._replay:
+            # Chaos kill class "mid-overlap dispatch": this tick's device
+            # work is enqueued while the previous tick's group commit may
+            # still be in flight on the writer thread. The previous tick
+            # must replay byte-identically from whatever the WAL made
+            # durable, and THIS tick (never appended, never acked) must
+            # come back only via client resend.
+            faults.crashpoint("storm.overlap_dispatch")
+        if self.pipeline_depth == 0:
+            # Serial fallback: settle this tick NOW — readback, WAL
+            # append, the full durability barrier (measured inline as
+            # its commit-wait stage) and its acks — before anything else
+            # may stage. The conservative pre-pipelining shape (and the
+            # A/B twin the pipelined path diffs against).
             self._harvest_one(self._inflight.pop(0))
+        elif self._group_wal is not None and self._unacked \
+                and not self._replay:
+            # Opportunistic NON-blocking drain: a tick whose fsync
+            # completed while this round staged and dispatched acks now
+            # instead of waiting for the next harvest — the client-side
+            # flow-control window is keyed off these acks, so releasing
+            # them a round late would stall windowed senders a full
+            # cadence.
+            self._drain_durable_acks()
         return True
+
+    def _staging_gen(self, b_seq: int, b_map: int, k: int) -> dict:
+        """The next idle host staging generation. ``pipeline_depth + 1``
+        generations rotate round-robin, so the arrays this round
+        scatters into are NEVER ones a still-in-flight tick's device
+        transfer may alias (jax.Array transfers on some backends keep a
+        view of the host buffer until the computation consumes it) —
+        a frame scattered into generation B while generation A is in
+        flight must never touch A's device feed. A geometry change
+        (capacity growth, a different per-round K) reallocates just the
+        generation it lands on; a runtime pipeline_depth change resizes
+        the ring."""
+        n = self.pipeline_depth + 1
+        if len(self._staging) != n:
+            self._staging = [None] * n
+            self._staging_idx = 0
+        self._staging_idx = (self._staging_idx + 1) % n
+        gen = self._staging[self._staging_idx]
+        if gen is None or gen["shape"] != (b_seq, b_map, k):
+            gen = {
+                "shape": (b_seq, b_map, k),
+                "slot": np.zeros(b_seq, np.int32),
+                "cseq0": np.zeros(b_seq, np.int32),
+                "ref": np.zeros(b_seq, np.int32),
+                "ts": np.zeros(b_seq, np.int32),
+                "seq_counts": np.zeros(b_seq, np.int32),
+                "words": np.zeros((b_map, k), np.uint32),
+                "map_counts": np.zeros(b_map, np.int32),
+                "gather": np.zeros(b_map, np.int32),
+            }
+            self._staging[self._staging_idx] = gen
+        else:
+            # Re-zero the per-doc vectors only — the words plane's stale
+            # content is unreachable (see the _flush_round comment).
+            for f in ("slot", "cseq0", "ref", "seq_counts", "map_counts",
+                      "gather"):
+                gen[f].fill(0)
+        return gen
+
+    def idle_drain(self) -> bool:
+        """Bounded, NON-blocking idle-path service (the bridge pump's
+        no-event branch): release acks whose group commit completed, run
+        buffered partial-cohort tails, and harvest an in-flight tick
+        whose device results are already materialized. Unlike
+        :meth:`flush`, this never blocks on the durability barrier and
+        never collapses the pipeline — a flow-controlled client waiting
+        out its ack window goes quiet between frames, and forcing a full
+        settle on every quiet poll would serialize the server back into
+        lockstep dispatch→fsync ticks. Returns True when anything
+        progressed."""
+        moved = False
+        if self._group_wal is not None and self._unacked:
+            before = len(self._unacked)
+            self._drain_durable_acks()
+            moved = len(self._unacked) != before
+        if self._frames:
+            # A partial tail below the tick threshold: the senders are
+            # BLOCKED on these acks (nothing else is coming) — the full
+            # settle is the right shape here, exactly as before.
+            self.flush()
+            return True
+        if self._inflight:
+            ready = all(
+                getattr(arr, "is_ready", lambda: True)()
+                for arr in self._inflight[0]["out"])
+            if ready:
+                self._harvest_one(self._inflight.pop(0))
+                moved = True
+        if not self._inflight and self._unacked \
+                and self._group_wal is not None:
+            # Pipeline EMPTY, only the group commit outstanding: a
+            # lockstep (ack-gated, unwindowed) sender is blocked on
+            # exactly this fsync, and there is nothing in flight the
+            # barrier could serialize against — take it, bounded by one
+            # group-commit latency, and release the acks now instead of
+            # next poll.
+            from .durable_store import WalDegradedError
+            try:
+                self._group_wal.sync()
+            except WalDegradedError:
+                pass  # degraded: acks stay withheld until healed
+            else:
+                self._drain_durable_acks()
+                moved = True
+        return moved
 
     def _harvest(self) -> None:
         while self._inflight:
@@ -1057,6 +1212,13 @@ class StormController:
         # readback): sequenced / dup-dropped / sentinel docs, device-true.
         kstats = kstats.tolist()
         t_readback = _time.monotonic_ns()
+        if self._group_wal is not None and not self._replay:
+            # Chaos kill class "readback-before-fsync": this tick's
+            # results are read back but its durable record has not yet
+            # reached the writer thread — the whole tick is volatile and
+            # must be reconstructible from snapshot + WAL replay +
+            # client resend (nothing of it was ever acked).
+            faults.crashpoint("storm.readback_pre_wal")
         stage_ns = rec.get("stage_ns", {})
         stage_ns["readback"] = t_readback - t_read0
         map_rows = rec["map_rows"]
@@ -1207,6 +1369,24 @@ class StormController:
             # write this replaces was the round-5 regression suspect.
             idx = self._group_wal.append([prefix, *word_parts])
             assert idx == tick_id, (idx, tick_id)
+            if self.pipeline_depth == 0 and not self._replay:
+                # Serial fallback: the durability barrier is tick time
+                # ON this thread — nothing overlaps it — so it is
+                # measured directly as the commit-wait stage and the
+                # tick's wall-clock slice covers it (no amend-at-drain;
+                # the ledger must never report phantom overlap for a
+                # genuinely sequential tick).
+                from .durable_store import WalDegradedError
+                t_sync0 = _time.monotonic_ns()
+                try:
+                    self._group_wal.sync()
+                except WalDegradedError:
+                    # Breaker open: acks stay withheld (not durable);
+                    # _admit is already shedding new writes.
+                    self.merge_host.metrics.counter(
+                        "storm.degraded_flushes").inc()
+                stage_ns["wal_commit_wait"] = (_time.monotonic_ns()
+                                               - t_sync0)
         elif self._blob_log is not None:
             blob_bytes = prefix + b"".join(
                 bytes(memoryview(p)) for p in word_parts)
@@ -1279,14 +1459,32 @@ class StormController:
         # passes the tick; the drain backfills it on the record object.
         led = None
         if not self._replay:
+            # The tick's exclusive wall-clock slice: harvest-to-harvest
+            # cadence at steady state, its own stage span after an idle
+            # gap (min of the two — an idle wait is not tick time).
+            # Under pipelining the per-stage splits legitimately sum
+            # PAST this wall slice; attribution() reports the difference
+            # as overlap_ms instead of double-counting it.
+            start_ns = rec.get("start_ns", t_harvest_done)
+            wall_ns = t_harvest_done - start_ns
+            if self._last_harvest_done_ns is not None:
+                wall_ns = min(wall_ns,
+                              t_harvest_done - self._last_harvest_done_ns)
+            self._last_harvest_done_ns = t_harvest_done
             led = self.ledger.record(tick_id, rec.get("queue_depth", 0),
                                      len(rec["descs"]), rec["submitted"],
-                                     stage_ns)
+                                     stage_ns, wall_ns=max(0, wall_ns),
+                                     depth=rec.get("depth",
+                                                   self.pipeline_depth))
         if self._group_wal is not None and not self._replay:
             # Withhold until fsynced — then deliver in tick order with the
             # durability watermark stamped on (clients resubmit anything
-            # above the watermark after a reconnect).
-            self._unacked.append((tick_id, acks, t_harvest_done, led))
+            # above the watermark after a reconnect). The serial fallback
+            # already measured its inline barrier as wal_commit_wait, so
+            # its record must NOT be amended at drain (led=None there).
+            self._unacked.append((tick_id, acks, t_harvest_done,
+                                  led if self.pipeline_depth > 0
+                                  else None))
             self._drain_durable_acks()
         else:
             dw = self.durable_watermark

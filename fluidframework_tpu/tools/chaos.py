@@ -83,6 +83,19 @@ SMOKE_POINTS = ("storm.mid_tick", "wal.pre_fsync", "snapshot.pre_publish")
 RESIDENCY_KILL_POINTS = ("residency.mid_hydrate", "residency.mid_evict",
                          "residency.post_evict")
 
+#: Overlap-window kill classes (ISSUE 11): the child serves PIPELINED
+#: (``pipelined=`` in run_chaos — rounds step through the un-forced
+#: flush path, so tick N's group fsync runs concurrent with tick N+1's
+#: dispatch and acks lag the durable watermark). Each point kills
+#: inside the overlap window: N+1 dispatched while N's commit is in
+#: flight / results read back before the record reached the writer /
+#: N durable and acking while N+1 is still in flight. Recovery must
+#: replay the durable prefix byte-identically, the volatile tick must
+#: come back only via client resend, and nothing unfsynced may ever
+#: have been acked.
+OVERLAP_KILL_POINTS = ("storm.overlap_dispatch", "storm.readback_pre_wal",
+                       "storm.overlap_fsynced")
+
 
 # -- child process (the serving host under test) ------------------------------
 
@@ -202,9 +215,47 @@ def child_main(args) -> None:
     faults.arm()
 
     k = args.k
+    # Pipelined serving mode (the ISSUE 11 overlap window): rounds go
+    # through submit_frame's un-forced threshold flush (threshold 1), so
+    # a tick stays in flight while the next round stages and its ack
+    # drains at a LATER round's watermark pass — ACKED lines lag by up
+    # to pipeline_depth rounds and the final settle prints the rest.
+    pipelined = bool(getattr(args, "pipelined", False))
+    # Fail loudly on the unsupported combination: a residency child
+    # serves per-doc frames through barrier flushes, so "pipelined"
+    # would silently never exercise the overlap windows while the
+    # parent's report claimed it had.
+    assert not (pipelined and residency is not None), \
+        "--pipelined and --residency cannot combine (the residency " \
+        "workload serves through per-frame barriers)"
+    pipe_acks: list = []
+    printed: set[int] = set()
+
+    def drain_ack_prints() -> None:
+        for a in pipe_acks:
+            if isinstance(a, dict) and a.get("error"):
+                continue
+            rid = a.get("rid")
+            if isinstance(rid, int) and rid not in printed:
+                printed.add(rid)
+                print(f"ACKED {rid}", flush=True)
+        pipe_acks.clear()
+
     for r in range(start, args.ticks):
         acks: list = []
-        if residency is not None:
+        if pipelined:
+            entries = [[d, clients[d], 1 + r * k, 1, k] for d in docs]
+            payload = b"".join(
+                _tick_words(args.seed, r, i, k).tobytes()
+                for i in range(len(docs)))
+            # flush_threshold_docs == 1: submit_frame runs the round
+            # itself, un-forced — NO durability barrier here, the whole
+            # point of the scenario.
+            storm.submit_frame(pipe_acks.append,
+                               {"rid": r, "docs": entries},
+                               memoryview(payload))
+            drain_ack_prints()
+        elif residency is not None:
             # Per-doc frames so the residency gate sees each doc alone
             # (a whole-cohort frame could never fit the capped pool);
             # the round is ACKED only when EVERY doc's frame acked.
@@ -232,6 +283,11 @@ def child_main(args) -> None:
                 print(f"ACKED {r}", flush=True)
         if (r + 1) % args.cp_every == 0:
             storm.checkpoint()
+            if pipelined:
+                drain_ack_prints()  # the checkpoint settle drained acks
+    if pipelined:
+        storm.flush()  # final settle: harvest + durability barrier
+        drain_ack_prints()
     faults.disarm()
     digest = _digest(service, storm, seq_host, merge_host, docs,
                      residency=residency)
@@ -244,13 +300,16 @@ def child_main(args) -> None:
 def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 cp_every: int, resume_from: int | None,
                 kill_env: str | None, timeout: float,
-                residency: int | None = None) -> dict:
+                residency: int | None = None,
+                pipelined: bool = False) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
            "--cp-every", str(cp_every)]
     if residency is not None:
         cmd += ["--residency", str(residency)]
+    if pipelined:
+        cmd += ["--pipelined"]
     if resume_from is not None:
         cmd += ["--resume-from", str(resume_from)]
     env = dict(os.environ)
@@ -274,17 +333,27 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               seed: int = 0, docs: int = 2, k: int = 8, ticks: int = 5,
               cp_every: int = 2, timeout: float = 300.0,
               twin_digest: dict | None = None,
-              residency: int | None = None) -> dict:
+              residency: int | None = None,
+              pipelined: bool = False) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
     twin across scenarios of the same configuration. ``residency`` caps
     the child's device pool BELOW ``docs`` so every round crosses the
-    hot/cold boundary (the RESIDENCY_KILL_POINTS scenarios)."""
+    hot/cold boundary (the RESIDENCY_KILL_POINTS scenarios).
+    ``pipelined`` serves the child through the overlapped tick pipeline
+    (the OVERLAP_KILL_POINTS scenarios) — and because the digest planes
+    are pipelining-agnostic, an UNPIPELINED twin_digest may be shared
+    in: equality then also proves pipelined ≡ barrier serving."""
     from ..utils import faults
 
+    if pipelined and residency is not None:
+        raise ValueError(
+            "pipelined=True cannot combine with residency= (the "
+            "residency workload serves through per-frame barriers, so "
+            "the overlap windows would never be exercised)")
     cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
-               residency=residency)
+               residency=residency, pipelined=pipelined)
     if twin_digest is None:
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
                            kill_env=None, timeout=timeout, **cfg)
@@ -852,6 +921,10 @@ def main(argv=None) -> None:
     parser.add_argument("--residency", type=int, default=None,
                         help="cap the device pool at N resident docs "
                              "(tiered hot/cold residency under test)")
+    parser.add_argument("--pipelined", action="store_true",
+                        help="serve through the overlapped tick pipeline "
+                             "(acks lag the durable watermark; the "
+                             "OVERLAP_KILL_POINTS scenarios)")
     parser.add_argument("--resume-from", type=int, default=None)
     parser.add_argument("--kill-point", default=None)
     parser.add_argument("--kill-hits", type=int, default=1)
@@ -871,7 +944,8 @@ def main(argv=None) -> None:
     assert args.kill_point, "--kill-point or --matrix required"
     report = run_chaos(args.workdir, args.kill_point, args.kill_hits,
                        seed=args.seed, docs=args.docs, k=args.k,
-                       ticks=args.ticks, cp_every=args.cp_every)
+                       ticks=args.ticks, cp_every=args.cp_every,
+                       pipelined=args.pipelined)
     report.pop("twin_digest", None)
     print(json.dumps(report, indent=1))
 

@@ -58,7 +58,8 @@ def _rss_mb() -> float:
 
 
 def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
-                   k: int = 256, sample_docs: int = 4) -> dict:
+                   k: int = 256, sample_docs: int = 4,
+                   window: int = 2) -> dict:
     """The reference's FULL-profile op volume (testConfig.json:10-16 —
     240 clients, 10M ops; the ``full10m`` CLI profile runs exactly that
     shape: 240 single-writer documents) pushed through the real serving
@@ -120,37 +121,76 @@ def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
         dims_series = []
         sample_every = max(1, ticks // 16)
         start = time.perf_counter()
-        for tick in range(ticks):
-            header, chunks = [], []
-            for d in docs:
-                chunks.append(pack_map_words(
-                    rng.choice([0, 0, 0, 1, 2], size=k),
-                    rng.integers(0, 32, k),
-                    rng.integers(0, 1 << 20, k)))
-                header.append([d, clients[d], cseq[d], 1, k])
-                cseq[d] += k
-            sock.sendall(encode_storm_frame(
-                {"op": "storm", "rid": tick, "docs": header},
-                b"".join(c.tobytes() for c in chunks)))
-            sent += num_docs * k
+        # Windowed flow control (round 14): at most ``window`` frames in
+        # flight, keyed off the ack stream — enough to keep the server's
+        # tick pipeline full (window >= pipeline_depth + 1) without the
+        # unbounded send-side backlog that used to masquerade as server
+        # latency. A busy-nack frees its slot but the frame resends
+        # after the hint — it was never sequenced.
+        window = max(1, window)
+        inflight = 0
+        to_send = list(range(ticks))
+        acked_ticks = 0
+        high_water = 0  # first-send watermark (resends never re-sample)
+
+        def read_one_ack() -> None:
+            nonlocal inflight, acked_ticks
             # MSG_WAITALL is ignored on a socket with a timeout (the fd
             # goes non-blocking) — exact reads must loop.
             length = struct.unpack(">I", _recv_exact(sock, 4))[0]
             ack_body = _recv_exact(sock, length)
             if is_storm_body(ack_body):
-                decode_storm_push(ack_body)  # binary columnar ack
+                ack = decode_storm_push(ack_body)  # binary columnar ack
             else:
-                json.loads(ack_body.decode())
-            if (tick + 1) % sample_every == 0 or tick == ticks - 1:
-                t = time.perf_counter() - start
-                rss_series.append((tick + 1, round(_rss_mb(), 1)))
-                rate_series.append((tick + 1, round(sent / t / 1e6, 3)))
-                # Device table dims: growth must converge after warm-up
-                # (a monotone series here would mean unbounded pools).
-                dims_series.append((tick + 1, seq_host._capacity,
-                                    seq_host._alloc_slots,
-                                    merge_host._map_capacity,
-                                    merge_host._map_slots))
+                ack = json.loads(ack_body.decode())
+            if not ack.get("storm"):
+                return
+            inflight -= 1
+            if ack.get("error"):
+                hint = ack.get("retry_after_s", 0.01)
+                time.sleep(float(hint))
+                to_send.append(int(ack["rid"]))
+            else:
+                acked_ticks += 1
+
+        tick = -1
+        while acked_ticks < ticks:
+            if to_send and inflight < window:
+                tick = to_send.pop(0)
+                header, chunks = [], []
+                for d in docs:
+                    chunks.append(pack_map_words(
+                        rng.choice([0, 0, 0, 1, 2], size=k),
+                        rng.integers(0, 32, k),
+                        rng.integers(0, 1 << 20, k)))
+                    header.append([d, clients[d], cseq[d], 1, k])
+                    cseq[d] += k
+                sock.sendall(encode_storm_frame(
+                    {"op": "storm", "rid": tick, "docs": header},
+                    b"".join(c.tobytes() for c in chunks)))
+                sent += num_docs * k
+                inflight += 1
+                # Sample only on FIRST sends (high-water): a busy-nack
+                # resend re-pops an old tick id, and re-sampling it
+                # would append duplicate/out-of-order x-values into the
+                # slope/plateau series.
+                if tick < high_water:
+                    continue
+                high_water = tick + 1
+                if (tick + 1) % sample_every == 0 or tick == ticks - 1:
+                    t = time.perf_counter() - start
+                    rss_series.append((tick + 1, round(_rss_mb(), 1)))
+                    rate_series.append((tick + 1,
+                                        round(sent / t / 1e6, 3)))
+                    # Device table dims: growth must converge after
+                    # warm-up (a monotone series here would mean
+                    # unbounded pools).
+                    dims_series.append((tick + 1, seq_host._capacity,
+                                        seq_host._alloc_slots,
+                                        merge_host._map_capacity,
+                                        merge_host._map_slots))
+            else:
+                read_one_ack()
         elapsed = time.perf_counter() - start
 
         # Transport-retention CONTROL: the experimental axon attachment
@@ -200,6 +240,7 @@ def run_storm_load(total_ops: int = 1_000_000, num_docs: int = 512,
                 if half else 0.0)
     return {
         "profile": "full_storm",
+        "client_window": window,
         "ops_sent": sent,
         "ops_sequenced": sequenced,
         "clients": num_docs,

@@ -105,6 +105,39 @@ def render_stage_bar(metrics: dict, width: int = 52,
     return "\n".join(lines)
 
 
+def render_pipeline(metrics: dict, prev: dict | None = None) -> str:
+    """Tick-pipelining line (the round-14 overlap plane): configured
+    pipeline depth, wall-clock vs attributed stage time over the poll
+    window, and the overlap share — how much concurrent stage time
+    (tick N's WAL commit-wait under tick N+1's dispatch) the pipeline
+    bought per unit of wall clock. Empty before any tick records a wall
+    split (pre-r14 service, or no storm ticks yet)."""
+    def totals(snap):
+        wall = snap.get("storm.stage.wall.mean", 0.0) \
+            * snap.get("storm.stage.wall.count", 0.0)
+        att = sum(snap.get(f"storm.stage.{s}.mean", 0.0)
+                  * snap.get(f"storm.stage.{s}.count", 0.0)
+                  for s in STORM_STAGES)
+        return wall, att, snap.get("storm.stage.wall.count", 0.0)
+
+    wall, att, ticks = totals(metrics)
+    if wall <= 0:
+        return ""
+    if prev is not None:
+        p_wall, p_att, p_ticks = totals(prev)
+        w_wall, w_att = wall - p_wall, att - p_att
+        # Negative windows mean the service restarted (registry reset);
+        # fall back to cumulative totals like the stage bar does.
+        if w_wall > 0 and w_att >= 0:
+            wall, att, ticks = w_wall, w_att, ticks - p_ticks
+    overlap = max(0.0, att - wall)
+    depth = metrics.get("storm.pipeline.depth", 0)
+    return (f"pipeline: depth {depth:g}  wall {wall * 1e3:,.0f}ms  "
+            f"attributed {att * 1e3:,.0f}ms  "
+            f"overlap {overlap * 1e3:,.0f}ms "
+            f"({100.0 * overlap / wall:.0f}% of wall)  ticks {ticks:g}")
+
+
 def render_rebalance(metrics: dict, prev: dict | None = None) -> str:
     """Block-table maintenance line from the device kstats counters
     (``storm.device.rebalance_fired`` / ``blocks_touched`` — the
@@ -211,6 +244,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
         lines.extend(f"  {name:<32} +{delta / per_s:,.1f}/s"
                      for delta, name in rates[:16])
     lines.append(render_stage_bar(now, prev=prev or None))
+    pipeline = render_pipeline(now, prev or None)
+    if pipeline:
+        lines.append(pipeline)
     rebal = render_rebalance(now, prev or None)
     if rebal:
         lines.append(rebal)

@@ -18,6 +18,16 @@ Registered points (grep for ``crashpoint(`` to audit):
 ``storm.mid_tick``          device state mutated by the fused tick, durable
                             record NOT yet enqueued (volatile-state window)
 ``storm.pre_ack``           durable record fsynced, ack NOT yet pushed
+``storm.overlap_dispatch``  pipelined tick N+1 dispatched while tick N's
+                            group commit may still be in flight (the
+                            mid-overlap window: N replays byte-identically,
+                            N+1 returns only via client resend)
+``storm.readback_pre_wal``  tick results read back, durable record NOT yet
+                            handed to the WAL writer (readback-before-fsync:
+                            the whole tick is volatile, nothing acked)
+``storm.overlap_fsynced``   tick N durable and about to ack while tick N+1
+                            is still in flight (fsync-complete-before-
+                            readback: N+1 must never be acked early)
 ``pool.mid_rebalance``      block merge pool mid-rebalance (layout moving)
 ``pool.mid_retune``         block geometry retune mid-move (whole-pool
                             re-block; the replayed retune must re-decide

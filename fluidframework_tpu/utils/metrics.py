@@ -153,6 +153,16 @@ class StageLedger:
     The record dict is intentionally flat and identical every tick
     (stages absent from a split map record 0 ns), so downstream consumers
     (bench columns, the monitor bar) never branch on shape.
+
+    Pipelined ticks (round 14) overlap stages across tick boundaries —
+    tick N's ``wal_commit_wait`` runs on the WAL writer thread while
+    tick N+1's ``device_dispatch`` runs on the serving thread — so the
+    per-stage splits of one tick can SUM past the wall clock it
+    occupied. ``record`` therefore also takes the tick's exclusive
+    wall-clock slice (``wall_ns``, the harvest-to-harvest cadence) and
+    the serving pipeline depth; :meth:`attribution` reports wall time
+    and an explicit ``overlap_ms`` instead of pretending the concurrent
+    spans were sequential.
     """
 
     def __init__(self, stages: Iterable[str] = STORM_STAGES,
@@ -163,24 +173,33 @@ class StageLedger:
         self._ring: deque = deque(maxlen=max(1, capacity))
         self._lock = threading.Lock()
         self._hists = None
+        self._wall_hist = None
         if registry is not None:
             self._hists = {s: registry.histogram(f"{prefix}.{s}")
                            for s in self.stages}
+            self._wall_hist = registry.histogram(f"{prefix}.wall")
 
     def record(self, tick_id: int, queue_depth: int, batch_docs: int,
-               batch_ops: int, splits_ns: dict) -> dict:
+               batch_ops: int, splits_ns: dict, wall_ns: int = 0,
+               depth: int = 0) -> dict:
         """Commit one tick's record; unknown split keys are rejected
         (a typo'd stage would silently vanish from the attribution —
-        and must fail under ``python -O`` too, hence no assert)."""
+        and must fail under ``python -O`` too, hence no assert).
+        ``wall_ns`` is the tick's exclusive wall-clock slice (0 =
+        unknown, the pre-pipelining shape); ``depth`` the serving
+        pipeline depth that produced it."""
         unknown = set(splits_ns) - set(self.stages)
         if unknown:
             raise ValueError(f"unknown ledger stages: {sorted(unknown)}")
         rec = {"tick": int(tick_id), "queue_depth": int(queue_depth),
-               "batch_docs": int(batch_docs), "batch_ops": int(batch_ops)}
+               "batch_docs": int(batch_docs), "batch_ops": int(batch_ops),
+               "wall": int(wall_ns), "depth": int(depth)}
         for s in self.stages:
             rec[s] = int(splits_ns.get(s, 0))
         with self._lock:
             self._ring.append(rec)
+        if self._wall_hist is not None and rec["wall"] > 0:
+            self._wall_hist.observe(rec["wall"] / 1e9)
         if self._hists is not None:
             for s in self.stages:
                 ns = rec[s]
@@ -219,13 +238,24 @@ class StageLedger:
         "_window" row (ticks covered, attributed vs total ns). The shares
         sum to 1.0 over stages with any time recorded. p50/p99 cover the
         ticks where the stage RAN (nonzero split) — the same population
-        the registry histograms observe, so the two surfaces agree."""
+        the registry histograms observe, so the two surfaces agree.
+
+        When the records carry wall-clock slices (pipelined serving),
+        each stage also reports ``of_wall`` — the fraction of real wall
+        time it was active, which can sum PAST 1.0 across stages when
+        they overlap — and "_window" reports the honest time budget:
+        ``wall_ms`` (what the ticks actually occupied), ``overlap_ms``
+        (attributed − wall, the concurrency the pipeline bought; 0 when
+        stages ran sequentially) and ``pipeline_depth``. Summing the
+        per-stage totals and calling it tick time double-counts under
+        overlap — wall_ms is the denominator that does not lie."""
         recs = self.records()
         out: dict[str, Any] = {}
         if not recs:
             return {"_window": {"ticks": 0}}
         totals = {s: sum(r[s] for r in recs) for s in self.stages}
         grand = sum(totals.values()) or 1
+        wall_total = sum(r.get("wall", 0) for r in recs)
         for s in self.stages:
             samples = sorted(r[s] for r in recs if r[s] > 0)
             out[s] = {
@@ -234,9 +264,17 @@ class StageLedger:
                 "p99_ms": round(percentile(samples, 0.99) / 1e6, 3),
                 "total_ms": round(totals[s] / 1e6, 3),
             }
+            if wall_total > 0:
+                out[s]["of_wall"] = round(totals[s] / wall_total, 4)
+        depths = [r.get("depth", 0) for r in recs if r.get("depth", 0) > 0]
         out["_window"] = {
             "ticks": len(recs),
             "attributed_ms": round(grand / 1e6, 3),
+            "wall_ms": round(wall_total / 1e6, 3),
+            "overlap_ms": round(max(0, grand - wall_total) / 1e6, 3)
+            if wall_total > 0 else 0.0,
+            "pipeline_depth": round(sum(depths) / len(depths), 2)
+            if depths else 0,
             "mean_batch_docs": round(sum(r["batch_docs"] for r in recs)
                                      / len(recs), 1),
             "mean_queue_depth": round(sum(r["queue_depth"] for r in recs)

@@ -137,6 +137,64 @@ def test_residency_chaos_full_matrix(seed, tmp_path):
         [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
 
 
+# -- overlap-window kill classes (ISSUE 11): tier-1 smoke + slow matrix --------
+
+#: Deterministically-firing overlap points for the smoke (the
+#: fsync-complete-before-readback point needs the writer thread to win a
+#: race, so it rides the slow matrix with the >=half-killed tolerance).
+_OVERLAP_SMOKE = [("storm.overlap_dispatch", 2),
+                  ("storm.readback_pre_wal", 2)]
+
+
+@pytest.mark.parametrize("point,hits", _OVERLAP_SMOKE,
+                         ids=[p for p, _ in _OVERLAP_SMOKE])
+def test_overlap_chaos_smoke_recovers_byte_identical(point, hits, tmp_path,
+                                                     twin_digest):
+    """Kill inside the dispatch/fsync overlap window of the PIPELINED
+    serving tick (ISSUE 11): tick N+1 dispatched while tick N's group
+    commit is in flight, or results read back before the durable record
+    reached the writer. Recovery must replay the durable prefix
+    byte-identically and lose zero acked-durable ops — and because the
+    shared twin ran UNPIPELINED, digest equality also proves pipelined
+    serving converges identically to barrier serving."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=twin_digest, pipelined=True,
+                             **_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_CFG["ticks"]))
+
+
+def test_pipelined_clean_run_matches_unpipelined_twin(tmp_path,
+                                                      twin_digest):
+    """No kill at all: a pipelined child run (acks lagging the durable
+    watermark, overlapped fsync/dispatch) must produce the exact same
+    digest planes as the unpipelined twin — the pipelining is a
+    scheduling change, never a semantic one."""
+    life = chaos._spawn_life(str(tmp_path), resume_from=None,
+                             kill_env=None, timeout=300, pipelined=True,
+                             **_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert json.dumps(life["digest"], sort_keys=True) \
+        == json.dumps(twin_digest, sort_keys=True)
+    assert sorted(life["acked"]) == list(range(_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_overlap_chaos_full_matrix(seed, tmp_path):
+    """Every overlap-window kill point × two hit positions, per seed,
+    through the pipelined child."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.OVERLAP_KILL_POINTS, seeds=(seed,),
+        hit_positions=(1, 2), docs=2, k=8, ticks=6, cp_every=2,
+        pipelined=True)
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
+
+
 # -- overload fault classes (ISSUE 5): tier-1 smoke + slow matrix --------------
 
 

@@ -244,6 +244,67 @@ def test_rebalance_line_renders_fire_rate():
     assert "0.25/tick" in render_rebalance(m, prev_big)
 
 
+def test_pipeline_line_renders_depth_and_overlap():
+    """Round-14 pipeline line: silent until a tick records a wall split,
+    then configured depth + wall vs attributed stage time + the overlap
+    share (the fsync/dispatch concurrency), windowed against the
+    previous poll with the cumulative fallback across restarts — and
+    the raw metrics (storm.pipeline.depth, storm.stage.wall.*) flow
+    through --json untouched."""
+    import io
+    import json
+
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_pipeline
+
+    assert render_pipeline({}) == ""  # no wall splits ever → no line
+    # 10 ticks: wall 1.0s each, dispatch 0.8s + commit-wait 0.6s each →
+    # attributed 14s over 10s of wall = 4s overlap (40% of wall).
+    m = {"storm.pipeline.depth": 1.0,
+         "storm.stage.wall.mean": 1.0, "storm.stage.wall.count": 10.0,
+         "storm.stage.device_dispatch.mean": 0.8,
+         "storm.stage.device_dispatch.count": 10.0,
+         "storm.stage.wal_commit_wait.mean": 0.6,
+         "storm.stage.wal_commit_wait.count": 10.0}
+    text = render_pipeline(m)
+    assert "depth 1" in text
+    assert "wall 10,000ms" in text
+    assert "overlap 4,000ms" in text and "(40% of wall)" in text
+    # Windowed: only the poll window's 5 ticks count — wall 5s,
+    # attributed 7s, overlap 2s.
+    prev = {"storm.stage.wall.mean": 1.0, "storm.stage.wall.count": 5.0,
+            "storm.stage.device_dispatch.mean": 0.8,
+            "storm.stage.device_dispatch.count": 5.0,
+            "storm.stage.wal_commit_wait.mean": 0.6,
+            "storm.stage.wal_commit_wait.count": 5.0}
+    windowed = render_pipeline(m, prev)
+    assert "wall 5,000ms" in windowed
+    assert "overlap 2,000ms" in windowed and "ticks 5" in windowed
+    # Restart (negative window): fall back to cumulative totals.
+    prev_big = {"storm.stage.wall.mean": 1.0,
+                "storm.stage.wall.count": 99.0}
+    assert "wall 10,000ms" in render_pipeline(m, prev_big)
+    # Human watch mode carries the line; --json mode passes the raw
+    # snapshot through, so the new metrics ride it untouched.
+    human = monitor.render_human(m, prev, interval=2.0)
+    assert "pipeline: depth 1" in human
+    scrapes = iter([dict(m)])
+    out = io.StringIO()
+
+    def fake_scrape(host, port, timeout=10.0):
+        return next(scrapes)
+
+    real_scrape, monitor.scrape = monitor.scrape, fake_scrape
+    try:
+        monitor.watch("h", 1, interval=0.0, out=out, as_json=True,
+                      max_polls=1)
+    finally:
+        monitor.scrape = real_scrape
+    line = json.loads(out.getvalue().splitlines()[0])
+    assert line["storm.pipeline.depth"] == 1.0
+    assert line["storm.stage.wall.count"] == 10.0
+
+
 def test_viewer_line_renders_broadcast_plane():
     """Round-13 viewer-plane line: silent until a viewer ever joins,
     gauge levels + windowed broadcast-bytes and lag-drop rates, the

@@ -1,0 +1,374 @@
+"""Pipelined serving tick (round 14): tick N's group fsync overlaps
+tick N+1's scatter+dispatch, staged into double-buffered host
+generations, with acks still withheld on the durable watermark.
+
+Oracles: (1) a pipelined controller must converge byte-identically with
+an unpipelined (pipeline_depth=0, serial dispatch→readback→fsync→ack)
+twin fed the same frames — pipelining is a scheduling change, never a
+semantic one; (2) a frame scattered into staging generation B while
+generation A's tick is in flight must never alias A's arrays; (3) the
+stage ledger must report wall-clock tick time with an explicit
+overlap_ms instead of double-counting the concurrent commit-wait and
+dispatch spans; (4) the client flow-control window frees on acks AND on
+busy-nacks, but only acks count as acked.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.dds.map_data import MapData
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+from fluidframework_tpu.server.storm import StormController
+
+
+def build(tmp_path, name, pipeline_depth, num_docs=4, durability="group"):
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=num_docs)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False)
+    storm = StormController(
+        service, seq_host, merge_host, flush_threshold_docs=num_docs,
+        pipeline_depth=pipeline_depth,
+        spill_dir=str(tmp_path / name) if durability else None,
+        durability=durability)
+    return service, storm, seq_host, merge_host
+
+
+def join_docs(service, docs):
+    clients = {d: service.connect(d, lambda m: None).client_id
+               for d in docs}
+    service.pump()
+    return clients
+
+
+def make_words(seed, tick, doc_i, k, num_slots=16):
+    rng = np.random.default_rng([seed, tick, doc_i])
+    kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
+    slots = rng.integers(0, num_slots, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def replay_oracle(service, doc_id):
+    data = MapData()
+    for m in service.get_deltas(doc_id, 0):
+        if m.type != MessageType.OPERATION or not isinstance(m.contents,
+                                                             dict):
+            continue
+        inner = m.contents.get("contents", {}).get("contents")
+        if inner:
+            data.process(inner, False, None)
+    return dict(data.items())
+
+
+def run_workload(service, storm, docs, clients, ticks=4, k=8,
+                 ragged_tick=None):
+    """``ticks`` frames per doc through the un-forced threshold flush
+    (each frame IS one tick at threshold == len(docs)); a ragged tick
+    (different K) exercises the staging-generation geometry change."""
+    acks = []
+    ack_counts = []
+    for t in range(ticks):
+        kk = k * 2 if t == ragged_tick else k
+        entries = [[d, clients[d], 1 + t * k * 2, 1, kk] for d in docs]
+        payload = b"".join(make_words(7, t, i, kk).tobytes()
+                           for i in range(len(docs)))
+        storm.submit_frame(acks.append, {"rid": t, "docs": entries},
+                           memoryview(payload))
+        ack_counts.append(len(acks))
+    storm.flush()
+    return acks, ack_counts
+
+
+def digest(service, storm, seq_host, merge_host, docs):
+    import dataclasses
+    out = {}
+    for d in docs:
+        cp = dataclasses.asdict(seq_host.checkpoint(d))
+        cp.pop("log_offset", None)
+        for client in cp["clients"]:
+            client["last_update"] = 0  # arrival clock, not replica state
+        out[d] = {
+            "map": merge_host.map_entries(d, storm.datastore,
+                                          storm.channel),
+            "history": [
+                [m.sequence_number, m.client_sequence_number,
+                 m.reference_sequence_number,
+                 m.minimum_sequence_number, int(m.type)]
+                for m in service.get_deltas(d, 0)],
+            "sequencer": cp,
+        }
+    return out
+
+
+class TestPipelinedMatchesUnpipelinedTwin:
+    def test_two_tick_twin_diff_with_group_wal(self, tmp_path):
+        """The generation-isolation pin: a pipelined run (frames
+        scattered into generation B while generation A's tick is in
+        flight, fsync overlapped with dispatch) must produce every
+        plane byte-identical to the serial twin — including across a
+        mid-run K change that reallocates a staging generation."""
+        docs = [f"d{i}" for i in range(4)]
+        planes = {}
+        for name, depth in (("pipe", 1), ("serial", 0)):
+            service, storm, seq_host, merge_host = build(
+                tmp_path, name, pipeline_depth=depth)
+            clients = join_docs(service, docs)
+            acks, _counts = run_workload(service, storm, docs, clients,
+                                         ticks=4, ragged_tick=2)
+            assert len(acks) == 4 and not any(
+                a.get("error") for a in acks)
+            # acked ⇒ durable: every ack carries the watermark PAST its
+            # tick, pipelined or not.
+            for a in acks:
+                assert a["dw"] > a["rid"]
+            planes[name] = digest(service, storm, seq_host, merge_host,
+                                  docs)
+            for d in docs:
+                assert merge_host.map_entries(
+                    d, storm.datastore, storm.channel) \
+                    == replay_oracle(service, d), (name, d)
+            storm._group_wal.close()
+        assert planes["pipe"] == planes["serial"]
+
+    def test_pipelined_acks_lag_serial_acks_do_not(self, tmp_path):
+        """Depth 1: a tick's ack is withheld while it (or its group
+        commit) is still in flight — at most the earlier ticks have
+        acked after each submit. Depth 0 (the fallback config): every
+        submit returns with its own ack already delivered (dispatch →
+        readback → fsync barrier → ack, inline)."""
+        docs = ["a", "b"]
+        service, storm, *_ = build(tmp_path, "pipe", pipeline_depth=1,
+                                   num_docs=2)
+        clients = join_docs(service, docs)
+        _acks, counts = run_workload(service, storm, docs, clients,
+                                     ticks=4)
+        assert all(c <= t + 1 for t, c in enumerate(counts))
+        assert counts[0] == 0  # first tick still in flight → no ack yet
+        storm._group_wal.close()
+
+        service, storm, *_ = build(tmp_path, "serial", pipeline_depth=0,
+                                   num_docs=2)
+        assert storm.pipeline_depth == 0
+        clients = join_docs(service, docs)
+        _acks, counts = run_workload(service, storm, docs, clients,
+                                     ticks=4)
+        assert counts == [1, 2, 3, 4]  # inline barrier: ack per round
+        storm._group_wal.close()
+
+
+class TestStagingGenerations:
+    def test_consecutive_rounds_never_share_arrays(self, tmp_path):
+        """Two ticks in flight windows never alias: consecutive rounds
+        scatter into DISTINCT generation arrays (depth+1 ring), and a
+        geometry change reallocates only the generation it lands on."""
+        docs = ["a", "b"]
+        service, storm, *_ = build(tmp_path, "gens", pipeline_depth=1,
+                                   num_docs=2, durability=None)
+        clients = join_docs(service, docs)
+        seen = []
+        real = storm._staging_gen
+
+        def spy(b_seq, b_map, k):
+            gen = real(b_seq, b_map, k)
+            seen.append((id(gen["words"]), id(gen["slot"]), gen["shape"]))
+            return gen
+
+        storm._staging_gen = spy
+        run_workload(service, storm, docs, clients, ticks=4,
+                     ragged_tick=2)
+        assert len(seen) == 4
+        # Round t and t+1 never share a single staging array.
+        for a, b in zip(seen, seen[1:]):
+            assert a[0] != b[0] and a[1] != b[1]
+        # The ragged tick (2x K) landed in a generation with the wider
+        # shape; the steady rounds kept theirs.
+        assert seen[2][2][2] == 2 * seen[0][2][2]
+        assert len(storm._staging) == storm.pipeline_depth + 1
+        for d in docs:
+            assert replay_oracle(service, d) \
+                == service.storm.merge_host.map_entries(
+                    d, storm.datastore, storm.channel)
+
+    def test_depth_zero_single_generation(self, tmp_path):
+        service, storm, *_ = build(tmp_path, "one", pipeline_depth=0,
+                                   num_docs=2, durability=None)
+        assert len(storm._staging) == 1  # nothing ever in flight
+
+
+class TestOverlapAttribution:
+    def test_known_distribution_overlap_regression(self):
+        """Known distribution: 4 ticks, wall 100 ms each; dispatch 60 ms
+        inside the record, commit-wait 80 ms backfilled at drain (the
+        pipelined shape — it ran under the NEXT tick's dispatch). The
+        attribution must report wall 400 ms and overlap = attributed −
+        wall = 160 ms — never a 560 ms "tick time" sum — and per-stage
+        of_wall fractions that legitimately sum past 1.0."""
+        from fluidframework_tpu.utils import StageLedger
+        led = StageLedger()
+        for t in range(4):
+            rec = led.record(t, 0, 2, 64,
+                             {"device_dispatch": 60_000_000},
+                             wall_ns=100_000_000, depth=1)
+            led.amend(rec, "wal_commit_wait", 80_000_000)
+        att = led.attribution()
+        win = att["_window"]
+        assert win["wall_ms"] == 400.0
+        assert win["attributed_ms"] == 560.0
+        assert win["overlap_ms"] == 160.0
+        assert win["pipeline_depth"] == 1
+        assert att["device_dispatch"]["of_wall"] == 0.6
+        assert att["wal_commit_wait"]["of_wall"] == 0.8
+        # Shares (of attributed) still sum to 1 — the legacy surface.
+        shares = [v["share"] for s, v in att.items() if s != "_window"]
+        assert abs(sum(shares) - 1.0) < 0.01
+
+    def test_no_wall_records_keep_legacy_shape(self):
+        """Pre-pipelining records (wall 0): no of_wall keys, overlap 0 —
+        the r10 consumers see exactly the shape they always did."""
+        from fluidframework_tpu.utils import StageLedger
+        led = StageLedger()
+        led.record(0, 0, 1, 8, {"scatter": 1_000_000,
+                                "device_dispatch": 3_000_000})
+        att = led.attribution()
+        assert "of_wall" not in att["scatter"]
+        assert att["_window"]["overlap_ms"] == 0.0
+        assert att["_window"]["wall_ms"] == 0.0
+        assert att["_window"]["pipeline_depth"] == 0
+
+    def test_serial_ticks_report_no_phantom_overlap(self, tmp_path):
+        """A depth-0 controller's durability barrier is serving-thread
+        time: it lands INSIDE the record (wall covers it), so the
+        attribution of a genuinely sequential run shows ~zero overlap
+        while the commit-wait stage itself is nonzero."""
+        docs = ["a", "b"]
+        service, storm, *_ = build(tmp_path, "ser", pipeline_depth=0,
+                                   num_docs=2)
+        clients = join_docs(service, docs)
+        run_workload(service, storm, docs, clients, ticks=3)
+        att = storm.ledger.attribution()
+        win = att["_window"]
+        assert win["wall_ms"] > 0
+        assert att["wal_commit_wait"]["total_ms"] > 0
+        # The serial run's overlap is measurement residue, never a
+        # stage-sized artifact: bounded well below the commit-wait +
+        # dispatch total that a double-counting ledger would report.
+        assert win["overlap_ms"] < 0.5 * (
+            att["wal_commit_wait"]["total_ms"]
+            + att["device_dispatch"]["total_ms"])
+        storm._group_wal.close()
+
+
+class _FakeFlowService:
+    """Duck-typed NetworkDocumentService surface StormStream touches."""
+
+    def __init__(self):
+        self._handlers = {}
+        self._stamp_storm_rx = False
+        self.sent = []
+
+    def send_storm(self, header, payload):
+        self.sent.append((header, payload))
+
+
+class TestStormStreamWindow:
+    def test_window_blocks_until_ack_frees_slot(self):
+        from fluidframework_tpu.drivers.network_driver import StormStream
+        svc = _FakeFlowService()
+        stream = StormStream(svc, sample_every=0, window=1)
+        stream.submit([["d", "c", 1, 1, 4]], b"\x00" * 16, rid=0)
+        assert stream.inflight == 1
+        submitted = threading.Event()
+
+        def second():
+            stream.submit([["d", "c", 5, 1, 4]], b"\x00" * 16, rid=1)
+            submitted.set()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not submitted.is_set()  # window full: submit blocks
+        svc._handlers["storm_ack"]({"rid": 0, "storm": True,
+                                    "acks": [[4, 1, 4, 1]]})
+        assert submitted.wait(5.0)
+        t.join(5.0)
+        assert stream.acked == 1 and stream.nacked == 0
+        assert len(svc.sent) == 2
+
+    def test_window_full_times_out(self):
+        from fluidframework_tpu.drivers.network_driver import StormStream
+        svc = _FakeFlowService()
+        stream = StormStream(svc, sample_every=0, window=1)
+        stream.submit([["d", "c", 1, 1, 4]], b"", rid=0)
+        with pytest.raises(TimeoutError, match="window 1 still full"):
+            stream.submit([["d", "c", 5, 1, 4]], b"", rid=1,
+                          timeout=0.05)
+
+    def test_busy_nack_frees_slot_but_never_counts_acked(self):
+        """The round-14 satellite fix: a shed frame's busy-nack frees
+        the window slot (the budget really is free) but counts on
+        .nacked — not .acked, it was never sequenced — and arms the
+        retry_after_s backoff the next submit honors."""
+        from fluidframework_tpu.drivers.network_driver import StormStream
+        svc = _FakeFlowService()
+        nacks = []
+        stream = StormStream(svc, sample_every=0, window=1,
+                             on_nack=nacks.append)
+        stream.submit([["d", "c", 1, 1, 4]], b"", rid=0)
+        t0 = time.monotonic()
+        svc._handlers["storm_ack"]({"rid": 0, "storm": True,
+                                    "error": "busy", "retryable": True,
+                                    "retry_after_s": 0.15})
+        assert stream.inflight == 0
+        assert stream.acked == 0 and stream.nacked == 1
+        assert nacks and nacks[0]["error"] == "busy"
+        # The next submit sleeps out the hint before sending.
+        stream.submit([["d", "c", 1, 1, 4]], b"", rid=1)
+        assert time.monotonic() - t0 >= 0.10
+        assert len(svc.sent) == 2
+
+    def test_unwindowed_stream_keeps_legacy_shape(self):
+        from fluidframework_tpu.drivers.network_driver import StormStream
+        svc = _FakeFlowService()
+        stream = StormStream(svc, sample_every=0)
+        for rid in range(8):  # never blocks, inflight never enforced
+            stream.submit([["d", "c", 1, 1, 4]], b"", rid=rid)
+        assert len(svc.sent) == 8
+        with pytest.raises(ValueError, match="window must be >= 1"):
+            StormStream(svc, window=0)
+
+
+def test_dispatch_routes_json_storm_nack_to_ack_handler():
+    """A JSON-path storm nack (shed/quarantine refusal) carries the
+    SENDER's frame rid — before round 14 the rid routing dropped it on
+    the floor (no RPC waiter ever registered it), silently freeing
+    client budget. It must reach the storm_ack handler like any binary
+    ack, rx-stamped when a trace consumer is attached."""
+    from types import SimpleNamespace
+
+    from fluidframework_tpu.drivers.network_driver import (
+        NetworkDocumentService,
+    )
+
+    stub = SimpleNamespace(_events=queue.Queue(), _pending={},
+                           _stamp_storm_rx=True)
+    nack = {"rid": 7, "storm": True, "error": "busy",
+            "retry_after_s": 0.05}
+    NetworkDocumentService._dispatch(stub, nack)
+    routed = stub._events.get_nowait()
+    assert routed["event"] == "storm_ack"
+    assert routed["error"] == "busy" and routed["_rx_ns"] > 0
+    assert not stub._pending  # never consumed as an RPC response
+    # Plain RPC responses still route to their waiters untouched.
+    waiter = queue.Queue()
+    stub._pending[3] = waiter
+    NetworkDocumentService._dispatch(stub, {"rid": 3, "ok": True})
+    assert waiter.get_nowait() == {"rid": 3, "ok": True}
