@@ -88,7 +88,7 @@ class _MergeRow:
     __slots__ = ("pool", "row", "client_slots", "key_slots", "pending",
                  "raw_log", "scalar", "min_seq", "last_seq",
                  "repack_at", "applied_seq", "applied_min_seq",
-                 "readmit_seen_min")
+                 "readmit_seen_min", "mega_idle")
 
     def __init__(self) -> None:
         self.pool: "_MergePool | None" = None
@@ -114,6 +114,9 @@ class _MergeRow:
         # the writer set only shrinks when the window advances, so a
         # rescan before then is wasted work.
         self.readmit_seen_min = -1
+        # Flushes since a mega-promoted row last had pending ops — the
+        # cooling signal maybe_demote_megadocs keys on.
+        self.mega_idle = 0
 
 
 class _MapRow:
@@ -535,13 +538,21 @@ class _ShardedMergePool(_MergePool):
     (ops/mergetree_sharded.py, the sequence-parallel path). Everything
     else about the pool (rows, text, migration) is inherited; device
     dispatch goes through the collective kernel and every host-side
-    rebuild is re-placed with the segment sharding."""
+    rebuild is re-placed with the segment sharding.
+
+    Two populations live in pools of this class: documents whose
+    segment tables OUTGREW one chip (``sharded_slot_threshold``, the
+    size tier) and documents PROMOTED for write rate (``mega=True`` —
+    the mega-doc residency class: not necessarily huge, but co-written
+    hard enough that the merge walk itself wants device lanes)."""
 
     def __init__(self, slots: int, num_props: int, mesh,
-                 row_capacity: int = 1, overlap_words: int = 1) -> None:
+                 row_capacity: int = 1, overlap_words: int = 1,
+                 mega: bool = False) -> None:
         from ..ops import mergetree_sharded as mts
         self._mts = mts
         self.mesh = mesh
+        self.mega = mega
         super().__init__(slots, num_props, row_capacity, overlap_words)
         self.state = self.place(self.state)
 
@@ -564,7 +575,9 @@ class KernelMergeHost:
                  flush_threshold: int = 256, metrics=None,
                  seg_mesh=None, sharded_slot_threshold: int = 65536,
                  tree_slots: int = 32,
-                 max_client_slots: int = 1024) -> None:
+                 max_client_slots: int = 1024,
+                 megadoc_writer_threshold: int | None = None,
+                 megadoc_demote_idle_flushes: int = 64) -> None:
         from ..utils import MetricsRegistry
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         # Sequence-parallel escape hatch: documents whose segment tables
@@ -605,6 +618,21 @@ class KernelMergeHost:
         # batching); maps are uniform-small and keep one state; matrices
         # (two embedded merge states + a cell table) lazily allocate one.
         self._merge_pools: dict[int, _MergePool] = {}
+        # Mega-doc pools (the round-15 residency class): sequence-
+        # parallel pools for PROMOTED docs — same _ShardedMergePool
+        # machinery as the size tier, keyed separately because a mega
+        # doc at (say) 128 slots must not hijack the block bucket every
+        # ordinary 128-slot doc serves from. Promotion/demotion moves a
+        # row between the tiers through the exact packed-flat seam
+        # (promote_merge_row / demote_merge_row).
+        self._mega_pools: dict[int, _ShardedMergePool] = {}
+        # Auto-promotion by OBSERVED writer count (None = explicit-only):
+        # a doc whose device-tracked writer set crosses the threshold
+        # promotes at the next flush; a promoted row idle for
+        # ``megadoc_demote_idle_flushes`` flushes demotes back.
+        self.megadoc_writer_threshold = megadoc_writer_threshold
+        self.megadoc_demote_idle_flushes = max(
+            1, megadoc_demote_idle_flushes)
         self._xstate = mk.init_state(self._map_capacity, self._map_slots)
         self._matrix_state: mxk.MatrixState | None = None
         self._matrix_capacity = max(1, row_capacity)
@@ -639,7 +667,8 @@ class KernelMergeHost:
                       "migrations": 0, "readmissions": 0,
                       "block_overflow_replays": 0,
                       "quarantined_channels": 0,
-                      "rebalances": 0, "geometry_retunes": 0}
+                      "rebalances": 0, "geometry_retunes": 0,
+                      "megadoc_promotions": 0, "megadoc_demotions": 0}
 
     # -- interning -------------------------------------------------------------
 
@@ -684,9 +713,25 @@ class KernelMergeHost:
     def _migrate_merge_row(self, mrow: _MergeRow, target_slots: int) -> None:
         """Move a channel to a bigger bucket (its segment table no longer
         fits even after compaction). One host round-trip per migration;
-        doubling makes them geometrically rare."""
+        doubling makes them geometrically rare. A mega-promoted row
+        grows WITHIN the mega tier — capacity pressure must never
+        silently demote the write-rate placement."""
+        if getattr(mrow.pool, "mega", False):
+            self._move_row(mrow, self._mega_pool_for(target_slots))
+        else:
+            self._move_row(mrow, self._pool_for(target_slots))
+        self.stats["migrations"] += 1
+
+    def _move_row(self, mrow: _MergeRow, dst_pool: _MergePool) -> None:
+        """Relocate one channel's row between pools through the exact
+        packed-flat seam (row_arrays → write_row — the host twin of
+        ``from_block_state``/``from_flat``: block sources flatten to
+        document order, block destinations re-block, flat↔flat installs
+        verbatim). Layout-agnostic, so bucket migration, mega-doc
+        promotion and demotion all share it; pending (not-yet-applied)
+        ops ride along — their encodings index the row's text pool,
+        which moves with the row."""
         src_pool, src_row = mrow.pool, mrow.row
-        dst_pool = self._pool_for(target_slots)
         assert dst_pool is not src_pool
         if src_pool.num_props > dst_pool.num_props:
             dst_pool.grow_props(src_pool.num_props)
@@ -713,7 +758,90 @@ class KernelMergeHost:
         dst_pool.text.chunks[mrow.row] = src_pool.text.chunks[src_row]
         dst_pool.text.used[mrow.row] = src_pool.text.used[src_row]
         src_pool.release(src_row)
-        self.stats["migrations"] += 1
+
+    # -- mega-doc promotion (the round-15 residency class) ---------------------
+
+    def _mega_pool_for(self, slots: int) -> _ShardedMergePool:
+        assert self.seg_mesh is not None, "mega promotion needs a seg_mesh"
+        slots = max(_next_pow2(slots), self._merge_slots,
+                    2 * self.seg_mesh.devices.size)
+        pool = self._mega_pools.get(slots)
+        if pool is None:
+            pool = _ShardedMergePool(slots, self._num_props,
+                                     self.seg_mesh, mega=True)
+            self._mega_pools[slots] = pool
+        return pool
+
+    def is_mega_row(self, key: ChannelKey) -> bool:
+        row = self._merge_rows.get(key)
+        return (row is not None and row.pool is not None
+                and getattr(row.pool, "mega", False))
+
+    def promote_merge_row(self, key: ChannelKey) -> None:
+        """Mega-doc promotion: move one channel's segment table from its
+        block bucket into a sequence-parallel pool — the segment axis
+        placed ACROSS device lanes — through the packed-flat seam
+        (:func:`ops.mergetree_sharded.from_block_state` is the kernel
+        twin of this host move; the round-trip is exact and pinned by
+        tests/test_megadoc_roundtrip.py). Pending ops ride along.
+        Idempotent on an already-promoted row; scalar-routed channels
+        refuse (there is no device row to shard)."""
+        row = self._merge_rows[key]
+        if row.scalar is not None:
+            raise ValueError(
+                f"{key} is scalar-routed; readmit before promoting")
+        if getattr(row.pool, "mega", False):
+            return
+        dst = self._mega_pool_for(row.pool.slots)
+        # Kill window: the layout is about to move wholesale; a crash
+        # here loses only volatile device state (the durable log +
+        # snapshot replay rebuilds the row and re-decides the same
+        # promotion).
+        faults.crashpoint("megadoc.mid_promotion")
+        self._move_row(row, dst)
+        row.mega_idle = 0
+        self.stats["megadoc_promotions"] += 1
+        self.metrics.counter("megadoc.text_promotions").inc()
+
+    def demote_merge_row(self, key: ChannelKey) -> bool:
+        """Demote a promoted channel back to its single-chip block
+        bucket through ``mergetree_blocks.from_flat`` (the block pool's
+        write_row re-blocks the packed document order exactly). A doc
+        whose table genuinely exceeds ``sharded_slot_threshold`` stays
+        sequence-parallel (that is the SIZE tier, not the write-rate
+        tier) — returns False then."""
+        row = self._merge_rows[key]
+        if not getattr(row.pool, "mega", False):
+            return False
+        if row.pool.slots >= self.sharded_slot_threshold:
+            return False
+        faults.crashpoint("megadoc.mid_demotion")
+        self._move_row(row, self._pool_for(row.pool.slots))
+        row.mega_idle = 0
+        self.stats["megadoc_demotions"] += 1
+        self.metrics.counter("megadoc.text_demotions").inc()
+        return True
+
+    def maybe_adapt_megadocs(self) -> None:
+        """Flush-cadence auto promotion/demotion from OBSERVED load:
+        distinct writers in the PENDING tick promote (instantaneous
+        concurrency, not the historical client table — slots never
+        shrink, so the historical count would re-promote forever after
+        one swarm), idle flushes demote. No-op unless
+        ``megadoc_writer_threshold`` is armed and a seg_mesh exists."""
+        if self.megadoc_writer_threshold is None or self.seg_mesh is None:
+            return
+        for key, row in list(self._merge_rows.items()):
+            if row.scalar is not None or row.pool is None:
+                continue
+            if getattr(row.pool, "mega", False):
+                row.mega_idle = 0 if row.pending else row.mega_idle + 1
+                if row.mega_idle >= self.megadoc_demote_idle_flushes:
+                    self.demote_merge_row(key)
+            elif row.pending and len(
+                    {op["client"] for op in row.pending}
+                    ) >= self.megadoc_writer_threshold:
+                self.promote_merge_row(key)
 
     def _map_row(self, key: ChannelKey) -> _MapRow:
         state = self._map_rows.get(key)
@@ -1705,6 +1833,10 @@ class KernelMergeHost:
         self.metrics.gauge("merge_host.queue_depth").set(self._pending_ops)
         start = _time.perf_counter()
         self._readmit_scalar_rows()
+        # Mega tier adaptation BEFORE the merge tick: a row promoted
+        # here serves this very flush from the sequence-parallel pool
+        # (pending ops ride the move).
+        self.maybe_adapt_megadocs()
         self._flush_merge()
         self._flush_map()
         self._flush_matrix()
@@ -1912,6 +2044,16 @@ class KernelMergeHost:
             batch = mtk.make_merge_op_batch(per_doc, pool.capacity, k,
                                             pool.client_capacity)
             pool.state = pool.apply(batch)
+            if isinstance(pool, _ShardedMergePool):
+                # Sequence-parallel attribution: ops served across the
+                # mesh, and the boundary-exchange bound — each op's
+                # split/place moves at most 2 one-hop ppermute edge
+                # exchanges (ShardPrims.roll; merge_apply_vec shifts by
+                # <= 2), the "ring step" cost the monitor renders.
+                n_ops = sum(len(r.pending) for r in pool_rows)
+                self.metrics.counter("megadoc.sharded_ops").inc(n_ops)
+                self.metrics.counter(
+                    "megadoc.boundary_exchanges").inc(2 * n_ops)
             overflow = pool.take_overflow()
             if overflow is not None:
                 for r in pool_rows:
@@ -2330,13 +2472,17 @@ class KernelMergeHost:
         self.flush()
         pools = []
         pool_index: dict[int, int] = {}
-        for slots, pool in sorted(self._merge_pools.items()):
+        all_pools = ([(False, s, p) for s, p
+                      in sorted(self._merge_pools.items())]
+                     + [(True, s, p) for s, p
+                        in sorted(self._mega_pools.items())])
+        for mega, slots, pool in all_pools:
             kind = ("sharded" if isinstance(pool, _ShardedMergePool)
                     else "block" if isinstance(pool, _BlockMergePool)
                     else "flat")
             pool_index[id(pool)] = len(pools)
             pools.append({
-                "kind": kind, "slots": pool.slots,
+                "kind": kind, "mega": mega, "slots": pool.slots,
                 "num_props": pool.num_props,
                 "overlap_words": pool.overlap_words,
                 "capacity": pool.capacity,
@@ -2450,7 +2596,8 @@ class KernelMergeHost:
                         "host has no seg_mesh")
                 pool = _ShardedMergePool(p["slots"], p["num_props"],
                                          self.seg_mesh, p["capacity"],
-                                         p["overlap_words"])
+                                         p["overlap_words"],
+                                         mega=p.get("mega", False))
             cls = type(pool.state)
             pool.state = pool.place(jax.device_put(cls(
                 **{f: _nd_unpack(p["planes"][f]) for f in cls._fields})))
@@ -2461,7 +2608,10 @@ class KernelMergeHost:
             pool.text.used = list(p["text_used"])
             pool.free = list(p["free"])
             pool.members = [None] * p["n_members"]
-            self._merge_pools[p["slots"]] = pool
+            if p.get("mega", False):
+                self._mega_pools[p["slots"]] = pool
+            else:
+                self._merge_pools[p["slots"]] = pool
             pools.append(pool)
 
         for rec in snap["merge_rows"]:

@@ -384,6 +384,16 @@ class ResidencyManager:
             raise EvictionRefused(
                 f"{doc_id!r} is quarantined — its device rows are the "
                 "readmission evidence; readmit before evicting")
+        megadoc = getattr(storm, "megadoc", None)
+        if megadoc is not None and (megadoc.is_promoted(doc_id)
+                                    or megadoc.parent_of(doc_id)):
+            # A promoted doc's live state spans lane rows + the combiner
+            # mirror; the per-doc cold record would capture only the
+            # frozen baseline row. Mega docs are pinned resident.
+            self.stats["evict_refusals"] += 1
+            raise EvictionRefused(
+                f"{doc_id!r} is mega-promoted (write scale-out); demote "
+                "before evicting")
         if storm._replay:
             self.stats["evict_refusals"] += 1
             raise EvictionRefused("eviction during WAL replay")

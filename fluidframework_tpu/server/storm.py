@@ -120,6 +120,7 @@ class _Frame(NamedTuple):
     meta: np.ndarray    # i32[n_docs, 3] (cseq0, ref, count) columns
     trace: Any = None   # (client tc, session scope) tracer key or None
     staged_ns: tuple = (0, 0)  # (decode, admit) ns refunded on shed
+    mega: Any = None    # per-entry mega-doc descriptors (megadoc.py)
 
 
 def _map_leg(map_state: mk.MapState, words, lo, hi, seq0_for):
@@ -347,8 +348,39 @@ _storm_tick = compile_cache.uncached(_storm_tick)
 #: storm snapshot records ("format_version"). Version 0 = the pre-stamp
 #: round-7 format (no field); readers accept 0..CURRENT and refuse
 #: anything newer (a downgrade must fail loudly, not misparse).
-STORM_WAL_VERSION = 1
-STORM_SNAPSHOT_VERSION = 1
+#: v2 (round 15) adds mega-doc lifecycle CONTROL records (docs-less tick
+#: headers carrying an "mg" event) and lane-id tick entries — a
+#: rolled-back binary must refuse rather than silently drop a promotion.
+STORM_WAL_VERSION = 2
+STORM_SNAPSHOT_VERSION = 2
+
+
+def choose_pipeline_depth(attribution: dict, current: int = 1) -> int:
+    """Pick the serving pipeline depth from OBSERVED stage attribution
+    (the round-15 adaptive-depth satellite). BENCH_r14's depth-scaling
+    rows are the evidence: at the 10k-doc shape the group fsync is
+    commensurate with the dispatch (wal_commit_wait 0.52 vs
+    device_dispatch 0.41 of the tick) and overlapping them wins 1.48x;
+    at the 2048-doc shape blobs are small, the fsync is cheap, and the
+    SERIAL tick wins (pipelining pays a staging generation + lagged acks
+    for nothing). So: commit-wait under a quarter of the dispatch time
+    -> depth 0 (serial); at least half -> depth >= 1 (overlap); the band
+    between is hysteresis (keep the current depth — flapping would
+    resize the staging ring every window). Needs >= 8 ticks of ledger
+    window to act; returns ``current`` until then."""
+    win = attribution.get("_window", {})
+    if win.get("ticks", 0) < 8:
+        return current
+    commit = attribution.get("wal_commit_wait", {}).get("total_ms", 0.0)
+    dispatch = attribution.get("device_dispatch", {}).get("total_ms", 0.0)
+    if dispatch <= 0.0:
+        return current
+    ratio = commit / dispatch
+    if ratio < 0.25:
+        return 0
+    if ratio >= 0.5:
+        return max(current, 1)
+    return current
 
 
 class StormController:
@@ -379,7 +411,7 @@ class StormController:
                  channel: str = "root",
                  flush_threshold_docs: int = 4096,
                  max_key_slots: int = 64,
-                 pipeline_depth: int = 1,
+                 pipeline_depth: int | str = 1,
                  spill_dir: str | None = None,
                  durability: str | None = None,
                  snapshots=None,
@@ -509,6 +541,10 @@ class StormController:
         # stampede), WAL replay hydrates on first touch, and eviction
         # trims the per-doc bookkeeping below.
         self.residency = None
+        # Mega-doc write scale-out (server/megadoc.py attaches itself):
+        # promoted docs serve up to L writer frames per tick through
+        # per-lane sub-sequencer rows + the host combiner.
+        self.megadoc = None
         self._in_round = False  # mid-_flush_round (evictions refuse)
         # Opt-in retention for the per-doc (first, last, tick) index:
         # entries whose tick falls below ``tick_counter - retention``
@@ -564,7 +600,17 @@ class StormController:
         # fallback (dispatch → readback → append → fsync barrier → ack,
         # per round — the pre-pipelining shape, kept as the A/B twin and
         # for request-response senders that gate on every ack).
-        self.pipeline_depth = max(0, pipeline_depth)
+        # pipeline_depth="auto" (the round-15 adaptive-depth satellite):
+        # start overlapped and re-decide from the ledger's OBSERVED
+        # wal_commit_wait vs device_dispatch shares every adaptation
+        # window (choose_pipeline_depth) — the BENCH_r14 depth-scaling
+        # rows showed the serial tick wins exactly where the fsync is
+        # cheap, which no static constant can know up front.
+        self._auto_depth = pipeline_depth == "auto"
+        self._depth_adapted_at = 0
+        self.depth_adapt_every = 64  # ticks between adaptation checks
+        self.pipeline_depth = 1 if self._auto_depth \
+            else max(0, pipeline_depth)
         self._inflight: list[dict] = []
         self._last_harvest: float | None = None
         # Monotonic-ns completion of the last NON-replay harvest: the
@@ -685,8 +731,18 @@ class StormController:
                 self._traced_pending += 1
                 self.tracer.mark(trace, "ingress", ingress_ns)
                 self.tracer.mark(trace, "admit", t_admitted)
+        # Mega-doc ingress: promoted-doc entries are rewritten to their
+        # writers' LANE sub-doc ids (stateless hash — up to L writer
+        # frames of one doc become DISJOINT cohort members and serve in
+        # ONE tick). Doc-level sequencing decisions wait for cohort
+        # selection (decide_frame) so doc-seq order == WAL order ==
+        # replay order. Admission above ran on the PARENT ids.
+        mega = None
+        if self.megadoc is not None and not self._replay:
+            self.megadoc.observe_writers(docs)
+            mega = self.megadoc.ingress_frame(docs)
         self._frames.append(_Frame(push, header.get("rid"), docs, words,
-                                   counts, meta, trace, staged))
+                                   counts, meta, trace, staged, mega))
         self._pending_docs += len(docs)
         self.stats["submitted_ops"] += offset
         if self._pending_docs >= self.flush_threshold_docs:
@@ -809,11 +865,33 @@ class StormController:
                 and self._tick_counter - self._last_checkpoint_tick
                 >= self.snapshot_interval_ticks):
             self.checkpoint()
+        # Maintenance cadence OFF the per-tick path: mega-doc auto
+        # promotion/demotion and the adaptive pipeline depth re-decide
+        # here (never inside a round), then the RSS arena trim.
+        if self.megadoc is not None and not self._replay:
+            self.megadoc.maybe_adapt()
+        if self._auto_depth and not self._replay and (
+                self.stats["ticks"] - self._depth_adapted_at
+                >= self.depth_adapt_every):
+            self._depth_adapted_at = self.stats["ticks"]
+            self.set_pipeline_depth(choose_pipeline_depth(
+                self.ledger.attribution(), self.pipeline_depth))
         # RSS hygiene OFF the per-tick path: at most one arena trim per
         # flush, gated on tick count AND a wall-clock floor (the round-5
         # serving-loop stall suspect — see _TrimGate).
         if self._trim_gate.due(self.stats["ticks"]):
             _malloc_trim()
+
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Change the serving pipeline depth between rounds: settle the
+        in-flight ticks first (a shrink must not orphan them), then the
+        staging-generation ring resizes lazily on the next round."""
+        depth = max(0, int(depth))
+        if depth == self.pipeline_depth:
+            return
+        self._harvest()
+        self.pipeline_depth = depth
+        self.merge_host.metrics.gauge("storm.pipeline.depth").set(depth)
 
     @property
     def wal_degraded(self) -> bool:
@@ -861,6 +939,36 @@ class StormController:
                     self.tracer.mark(frame.trace, "durable", t_drain)
                     self._stamp_trace_ack(frame, payload)
                 frame.push(payload)
+
+    def _push_synth_acks(self, acks: list, mega_plans: dict) -> None:
+        """Deliver acks for a cohort that collapsed to zero descs (every
+        entry decided zero-op by the mega combiner). Nothing sequenced —
+        but a refseq outcome journaled a state-bearing mark CONTROL
+        record, and the client acts on the nack (rebases, advances its
+        resend window), so the acked-before-durable discipline applies
+        here too: barrier the group commit before pushing. A degraded
+        WAL withholds these acks exactly like tick acks (the client
+        retries; live and recovered decisions are deterministic either
+        way)."""
+        from ..protocol.codec import StormAck
+        if self._group_wal is not None and not self._replay:
+            from .durable_store import WalDegradedError
+            try:
+                self._group_wal.sync()
+            except WalDegradedError:
+                return  # not durable: withhold (clients resend)
+        dw = self.durable_watermark
+        for ack_i, (frame, _i0, _i1) in enumerate(acks):
+            if frame.push is None:
+                continue
+            plan = mega_plans.get(ack_i) or []
+            rows = np.asarray([v for kind, v in plan if kind == "s"],
+                              np.int32).reshape(-1, 4)
+            payload = StormAck(frame.rid, rows)
+            payload["dw"] = dw
+            if frame.trace is not None:
+                self._stamp_trace_ack(frame, payload)
+            frame.push(payload)
 
     def _stamp_trace_ack(self, frame: _Frame, payload: dict) -> None:
         """Finish a sampled frame's span at ack transmit: the joined hop
@@ -911,27 +1019,35 @@ class StormController:
             self._in_round = False
 
         taken: set[str] = set()
-        descs: list[tuple[str, str, int, int, int]] = []
-        frame_words: list[np.ndarray] = []   # one payload view per frame
-        frame_counts: list[np.ndarray] = []
-        metas: list[np.ndarray] = []
-        acks: list[tuple[_Frame, int, int]] = []  # frame -> desc [i0, i1)
+        blocked_parents: set[str] = set()
+        selected: list[_Frame] = []
         deferred: list[_Frame] = []
         for frame in frames:
             fdocs = {doc for doc, *_ in frame.docs}
-            if not taken.isdisjoint(fdocs):
+            # Mega FIFO fence: once any frame of a promoted doc defers
+            # (lane collision), every LATER frame of that doc defers too
+            # — the combiner stamps doc seqs in cohort order, and taking
+            # a later lane's frame past a deferred earlier one would
+            # reorder the doc's total order relative to the single-lane
+            # path (the sharded ≡ single-lane bar). Each tick therefore
+            # serves a PREFIX of the doc's pending frames with distinct
+            # lanes — up to L per tick instead of one.
+            parents = (set() if frame.mega is None else
+                       {info["doc"] for info in frame.mega
+                        if info is not None})
+            if not taken.isdisjoint(fdocs) \
+                    or not blocked_parents.isdisjoint(parents):
                 deferred.append(frame)
+                blocked_parents |= parents
                 continue
-            i0 = len(descs)
-            descs.extend(frame.docs)
             taken |= fdocs
-            frame_words.append(frame.words)
-            frame_counts.append(frame.counts)
-            metas.append(frame.meta)
-            acks.append((frame, i0, len(descs)))
-        if require_full and len(descs) < self.flush_threshold_docs:
+            selected.append(frame)
+        if require_full and sum(len(f.docs) for f in selected) \
+                < self.flush_threshold_docs:
             # Undersized cohort: put everything back; the idle drain (or
-            # the cohort completing) will run it.
+            # the cohort completing) will run it. No mega decision has
+            # run yet (decisions happen only below, on a committed
+            # cohort), so re-buffering is side-effect free.
             self._frames = frames + self._frames
             self._pending_docs += sum(len(f.docs) for f in frames)
             return False
@@ -942,8 +1058,6 @@ class StormController:
         self._frames.extend(f._replace(staged_ns=(0, 0))
                             for f in deferred)
         self._pending_docs += sum(len(f.docs) for f in deferred)
-        if not descs:
-            return True
         # HARVEST-FIRST (the round-14 pipelining order): settle the due
         # tick BEFORE staging this one, so its readback is taken the
         # moment it matters and its WAL append reaches the writer thread
@@ -951,9 +1065,69 @@ class StormController:
         # scatter + device dispatch instead of queueing behind them
         # (BENCH_r10 measured the two stages back-to-back at 0.52 + 0.41
         # of every durable tick). This also frees the harvested tick's
-        # staging generation for reuse below.
+        # staging generation for reuse below, and it must precede the
+        # mega cohort transform: the combiner may journal CONTROL
+        # records, which have to land AFTER the due tick's WAL record so
+        # replay re-applies mirror updates in live order.
         while len(self._inflight) >= max(1, self.pipeline_depth):
             self._harvest_one(self._inflight.pop(0))
+        # WAL replay re-runs the tick with its RECORDED timestamp so the
+        # sequencer planes (client last_update) rebuild byte-identically.
+        # Computed before cohort assembly: the mega combiner stamps the
+        # same clock the device ts plane carries.
+        now = (self._replay_ts if self._replay_ts is not None
+               else self.service._clock())
+        descs: list[tuple[str, str, int, int, int]] = []
+        frame_words: list[np.ndarray] = []   # one payload view per frame
+        frame_counts: list[np.ndarray] = []
+        metas: list[np.ndarray] = []
+        acks: list[tuple[_Frame, int, int]] = []  # frame -> desc [i0, i1)
+        mega_rows: dict[int, tuple] = {}   # desc idx -> doc-space quad
+        mega_plans: dict[int, list] = {}   # ack idx -> per-entry plan
+        for frame in selected:
+            i0 = len(descs)
+            if frame.mega is not None and not self._replay:
+                # The combiner: doc-space tickets in cohort admission
+                # order (== the single-lane interleaving), dup prefixes
+                # trimmed out of the words, zero-op entries dropped with
+                # synthesized ack rows.
+                (fdesc, fwords, fcounts, fmeta, plan,
+                 desc_rows) = self.megadoc.decide_frame(frame, now)
+                descs.extend(fdesc)
+                frame_words.append(fwords)
+                frame_counts.append(fcounts)
+                metas.append(fmeta)
+                for rel, row in enumerate(desc_rows):
+                    if row is not None:
+                        mega_rows[i0 + rel] = row
+                if len(fdesc) != len(frame.docs):
+                    # Dropped entries: the ack is rebuilt positionally
+                    # from this plan (synth row or kept-desc index).
+                    mega_plans[len(acks)] = [
+                        ("s", item.synth) if item.synth is not None
+                        else ("l", i0 + item.desc_rel)
+                        for item in plan]
+            else:
+                descs.extend(frame.docs)
+                frame_words.append(frame.words)
+                frame_counts.append(frame.counts)
+                metas.append(frame.meta)
+            acks.append((frame, i0, len(descs)))
+        if not descs:
+            # Every selected entry resolved to a zero-op outcome: no
+            # tick to ride — deliver the synthesized acks now (nothing
+            # was sequenced, so there is no durability to wait on; the
+            # one state-bearing zero-op outcome journaled its own
+            # control record in decide_frame).
+            self._push_synth_acks(acks, mega_plans)
+            return True
+        if self._replay and self.megadoc is not None:
+            # Replayed lane entries are already cleaned: rebuild the
+            # combiner's mirrors + combine logs in desc order (== the
+            # order live decisions ran).
+            self.megadoc.replay_decide(descs, now)
+        if self.megadoc is not None and not self._replay:
+            self.megadoc.finish_cohort(descs)
         # Stage ledger: the tick that runs consumes the decode/admission
         # ns staged by its frames' submit_frame calls (a frame DEFERRED
         # to the next round charges the round it was decoded in —
@@ -969,10 +1143,6 @@ class StormController:
         t_scatter0 = _time.monotonic_ns()
 
         seq_host, merge_host = self.seq_host, self.merge_host
-        # WAL replay re-runs the tick with its RECORDED timestamp so the
-        # sequencer planes (client last_update) rebuild byte-identically.
-        now = (self._replay_ts if self._replay_ts is not None
-               else self.service._clock())
         desc_arr = metas[0] if len(metas) == 1 else np.concatenate(metas)
         counts_col = desc_arr[:, 2]
         k = _next_pow2(int(counts_col.max()))
@@ -986,7 +1156,7 @@ class StormController:
                       tuple((d, c) for d, c, *_ in descs))
         cached = self._cohort_cache.get(cohort_key)
         if cached is not None:
-            seq_rows, slots, map_rows, mrows = cached
+            seq_rows, slots, map_rows, mrows, lane_rows = cached
         else:
             seq_rows = np.empty(len(descs), np.int32)
             slots = np.empty(len(descs), np.int32)
@@ -1000,8 +1170,16 @@ class StormController:
                 mrow = self._storm_mrow(doc)
                 map_rows[i] = mrow.row
                 mrows.append(mrow)
+            # Lane sub-sequencer rows keep their cref planes pinned at 0
+            # (the doc-space refseq/MSN law lives in the mega combiner);
+            # cached alongside the cohort so the per-round forcing below
+            # is one vectorized store, not a per-desc string scan.
+            lane_rows = (self.megadoc.lane_seq_rows(descs, seq_rows)
+                         if self.megadoc is not None
+                         else np.empty(0, np.int32))
             self._cohort_cache.put(cohort_key,
-                                   (seq_rows, slots, map_rows, mrows))
+                                   (seq_rows, slots, map_rows, mrows,
+                                    lane_rows))
 
         b_seq = seq_host._capacity
         b_map = merge_host._map_capacity
@@ -1030,6 +1208,12 @@ class StormController:
         slot_full[seq_rows] = slots
         cseq0_full[seq_rows] = desc_arr[:, 0]
         ref_full[seq_rows] = desc_arr[:, 1]
+        if lane_rows.size:
+            # Live metas already carry 0 here (megadoc._meta_for); the
+            # REPLAY path rebuilds metas from WAL entries, whose ref
+            # column is the doc-space ref the records need — force the
+            # device feed back to the lane contract either way.
+            ref_full[lane_rows] = 0
         seq_counts[seq_rows] = desc_arr[:, 2]
         map_counts[map_rows] = desc_arr[:, 2]
         gather[map_rows] = seq_rows
@@ -1074,7 +1258,8 @@ class StormController:
             acks=acks, now=now, submitted=int(counts_col.sum()),
             out=(n_seq, first, last, msn, bad, kstats), start=round_start,
             start_ns=t_scatter0, depth=self.pipeline_depth,
-            stage_ns=stage_ns, queue_depth=queue_depth)
+            stage_ns=stage_ns, queue_depth=queue_depth,
+            mega_rows=mega_rows or None, mega_plans=mega_plans or None)
         for out_arr in rec["out"]:
             copy_async = getattr(out_arr, "copy_to_host_async", None)
             if copy_async is not None:
@@ -1432,16 +1617,45 @@ class StormController:
         if self._last_harvest is not None:
             self.harvest_intervals.append(done - self._last_harvest)
         self._last_harvest = done
+        # Mega combiner egress: lane descs' device rows carry LANE-space
+        # seqs (what the WAL header above recorded — reads translate);
+        # the CLIENT sees doc-space quads, pre-decided by the combiner.
+        # The device count must agree with the decision (cleaned lane
+        # batches sequence in full by construction) — a drift here means
+        # the lane contract broke, which must fail loudly, not misack.
+        mega_rows_rec = rec.get("mega_rows")
+        if mega_rows_rec:
+            if not replaying:
+                self.megadoc.note_harvest(rec["descs"])
+            for gi, row in mega_rows_rec.items():
+                if ns_l[gi] != row[0]:
+                    raise AssertionError(
+                        f"mega lane desc {rec['descs'][gi][:2]} sequenced "
+                        f"{ns_l[gi]} ops on device, combiner decided "
+                        f"{row[0]}")
+                ack_rows[gi] = row
+        elif self.megadoc is not None and not replaying:
+            self.megadoc.note_harvest(rec["descs"])
         # Each frame's ack is a contiguous row slice of the tick's ack
         # matrix — a StormAck that session push paths binary-encode
-        # without ever building per-doc dicts.
+        # without ever building per-doc dicts. Frames the mega transform
+        # shrank rebuild their rows positionally from the plan
+        # (synthesized zero-op quads interleaved with harvested rows).
         from ..protocol.codec import StormAck
         t_ack0 = _time.monotonic_ns()
+        mega_plans = rec.get("mega_plans") or {}
         acks = []
-        for frame, i0, i1 in rec["acks"]:
+        for ack_i, (frame, i0, i1) in enumerate(rec["acks"]):
             if frame.push is None:
                 continue
-            payload = StormAck(frame.rid, ack_rows[i0:i1])
+            plan = mega_plans.get(ack_i)
+            if plan is None:
+                payload = StormAck(frame.rid, ack_rows[i0:i1])
+            else:
+                rows = np.empty((len(plan), 4), np.int32)
+                for j, (kind, v) in enumerate(plan):
+                    rows[j] = v if kind == "s" else ack_rows[v]
+                payload = StormAck(frame.rid, rows)
             if any_bad and bad_rows[i0:i1].any():
                 # The tick's sequencing is durable and correct (the
                 # ticket is exact; the poison is in the served planes) —
@@ -1557,6 +1771,11 @@ class StormController:
                     for doc, cp in self.seq_host.checkpoint_all().items()},
                 "merge_host": self.merge_host.export_state(),
             }
+            if self.megadoc is not None and self.megadoc.docs:
+                # Lane DEVICE rows already ride checkpoint_all (lane ids
+                # are sequencer docs) and the merge-host export; this is
+                # the combiner's host state (mirrors + combine logs).
+                snap["megadoc"] = self.megadoc.export_state()
             handle = self.snapshots.upload(self.SNAPSHOT_DOC, snap)
             faults.crashpoint("snapshot.pre_publish")
             self.snapshots.set_head(self.SNAPSHOT_DOC, handle)
@@ -1589,6 +1808,12 @@ class StormController:
                 for doc, cp in sorted(snap["sequencer"].items()):
                     self.seq_host.restore(doc, SequencerCheckpoint(**cp))
                 self.merge_host.import_state(snap["merge_host"])
+                if snap.get("megadoc") is not None:
+                    if self.megadoc is None:
+                        raise RuntimeError(
+                            "snapshot holds mega-doc combiner state but "
+                            "no MegaDocManager is attached")
+                    self.megadoc.import_state(snap["megadoc"])
                 start = snap["tick_watermark"]
                 restored_from = head
                 if self.residency is not None:
@@ -1649,6 +1874,20 @@ class StormController:
             for tick in range(start, end):
                 blob = self._read_blob(tick)
                 header, off = self._parse_header(blob)
+                mg = header.get("mg")
+                if mg is not None:
+                    # Mega-doc lifecycle control record: re-apply the
+                    # event at the identical point in the total order
+                    # (promotion re-seeds from the recovered checkpoint,
+                    # demotion re-folds the recovered lanes).
+                    if self.megadoc is None:
+                        raise RuntimeError(
+                            "WAL holds mega-doc control records but no "
+                            "MegaDocManager is attached — attach one "
+                            "before recover()")
+                    self._tick_counter = tick + 1
+                    self.megadoc.apply_control(mg, header["ts"])
+                    continue
                 self._tick_counter = tick
                 self._replay_ts = header["ts"]
                 entries = [e[:5] for e in header["docs"]]
@@ -1699,6 +1938,17 @@ class StormController:
     def _quarantine_doc(self, doc_id: str, reason: str,
                         tick_id: int) -> None:
         self.quarantined[doc_id] = {"reason": reason, "tick": tick_id}
+        if self.megadoc is not None:
+            # A poisoned LANE freezes the whole promoted doc: submits
+            # name the parent (admission checks run pre-rewrite), and a
+            # partial freeze would let sibling lanes advance the doc's
+            # total order past an unservable range. Readmission of a
+            # promoted doc is demote-after-readmit (see module doc).
+            parent = self.megadoc.parent_of(doc_id)
+            if parent is not None:
+                for other in [parent] + self.megadoc.lane_ids(parent):
+                    if other not in self.quarantined:
+                        self._quarantine_doc(other, reason, tick_id)
         self.stats["quarantined_docs"] += 1
         self.merge_host.metrics.counter("storm.quarantines").inc()
         # In-flight ops: nack every BUFFERED frame touching the doc with
@@ -1880,7 +2130,17 @@ class StormController:
         """Columnar scriptorium records of ``doc_id`` whose seq windows
         overlap (from_seq, to_seq] — resolved from the per-tick blobs via
         the compact in-RAM (first, last, tick) index. The shape matches
-        what :func:`materialize_storm_records` consumes."""
+        what :func:`materialize_storm_records` consumes. A doc with
+        mega-lane history merges its lane records translated to doc seq
+        space through the combine logs."""
+        if self.megadoc is not None and self.megadoc.has_history(doc_id):
+            return self.megadoc.records(doc_id, from_seq, to_seq,
+                                        self._records_for)
+        return self._records_for(doc_id, from_seq, to_seq)
+
+    def _records_for(self, doc_id: str, from_seq: int,
+                     to_seq: int | None = None) -> list[dict]:
+        """Untranslated per-id record resolution (lane ids included)."""
         out = []
         ticks = self._doc_ticks.get(doc_id)
         if ticks is None and self.residency is not None \

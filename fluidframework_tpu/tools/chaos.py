@@ -83,6 +83,22 @@ SMOKE_POINTS = ("storm.mid_tick", "wal.pre_fsync", "snapshot.pre_publish")
 RESIDENCY_KILL_POINTS = ("residency.mid_hydrate", "residency.mid_evict",
                          "residency.post_evict")
 
+#: Mega-doc kill classes (ISSUE 12): the child serves ONE doc co-written
+#: by several writers through the sequence-parallel tier (``megadoc=``
+#: in run_chaos promotes it onto N lanes after arming, so the promotion
+#: itself is inside the kill window). Each point kills mid-transition:
+#: promotion control journaled but lanes not yet seeded / combiner
+#: advanced (doc seqs assigned) but the tick neither dispatched nor
+#: journaled / demotion control journaled but the cross-lane fold not
+#: yet applied. Recovery must replay the whole lifecycle — promote,
+#: every lane tick, demote — and reconverge byte-identically with no
+#: acked-durable op lost.
+MEGADOC_KILL_POINTS = ("megadoc.mid_promotion", "megadoc.mid_combine",
+                       "megadoc.mid_demotion")
+
+#: Writers co-editing the one mega doc in the megadoc child mode.
+MEGADOC_WRITERS = 4
+
 #: Overlap-window kill classes (ISSUE 11): the child serves PIPELINED
 #: (``pipelined=`` in run_chaos — rounds step through the un-forced
 #: flush path, so tick N's group fsync runs concurrent with tick N+1's
@@ -125,6 +141,11 @@ def _build_stack(data_dir: str, num_docs: int):
         service, seq_host, merge_host, flush_threshold_docs=1,
         spill_dir=os.path.join(data_dir, "spill"), durability="group",
         snapshots=GitSnapshotStore(os.path.join(data_dir, "git")))
+    # Always attached: recovery of a WAL holding mega-doc control
+    # records requires a manager, and an idle manager costs one None
+    # check per hook.
+    from ..server.megadoc import MegaDocManager
+    MegaDocManager(storm, default_lanes=2)
     return service, storm, seq_host, merge_host
 
 
@@ -156,7 +177,7 @@ def _digest(service, storm, seq_host, merge_host, docs: list[str],
             history.append([
                 m.sequence_number, m.client_sequence_number,
                 m.reference_sequence_number, m.minimum_sequence_number,
-                int(m.type),
+                int(m.type), m.client_id,
                 json.dumps(to_wire(m.contents), sort_keys=True)])
         cp = dataclasses.asdict(seq_host.checkpoint(doc))
         cp.pop("log_offset", None)
@@ -180,6 +201,7 @@ def child_main(args) -> None:
     from ..utils import compile_cache, faults
 
     compile_cache.enable()
+    mega_lanes = getattr(args, "megadoc", None)
     docs = [f"chaos-doc-{i}" for i in range(args.docs)]
     service, storm, seq_host, merge_host = _build_stack(args.dir, args.docs)
 
@@ -195,11 +217,20 @@ def child_main(args) -> None:
                                      idle_evict_s=1e9,
                                      hydration_rate_per_s=1e9)
 
+    writers: list[str] = []
     if args.resume_from is None:
         # Fresh life: joins + the genesis checkpoint (so every recovery
         # has a snapshot to restore — the harness arms kills only after).
-        clients = {d: service.connect(d, lambda m: None).client_id
-                   for d in docs}
+        if mega_lanes:
+            # One doc, several co-writers (the mega shape): every writer
+            # joins the SAME doc; promotion happens after arm() so the
+            # promotion window itself is killable.
+            writers = [service.connect(docs[0], lambda m: None).client_id
+                       for _ in range(MEGADOC_WRITERS)]
+            clients = {}
+        else:
+            clients = {d: service.connect(d, lambda m: None).client_id
+                       for d in docs}
         service.pump()
         storm.checkpoint()
         start = 0
@@ -209,10 +240,20 @@ def child_main(args) -> None:
         assert info["restored_from"] is not None, "no snapshot to recover"
         # Client ids are deterministic: the durable client counter handed
         # them out join-order in the fresh life.
-        clients = {d: f"client-{i + 1}" for i, d in enumerate(docs)}
+        if mega_lanes:
+            writers = [f"client-{i + 1}" for i in range(MEGADOC_WRITERS)]
+            clients = {}
+        else:
+            clients = {d: f"client-{i + 1}" for i, d in enumerate(docs)}
         start = args.resume_from
     print("READY", flush=True)
     faults.arm()
+    if mega_lanes:
+        _megadoc_child_rounds(args, storm, docs[0], writers, start)
+        faults.disarm()
+        digest = _digest(service, storm, seq_host, merge_host, docs)
+        print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
+        return
 
     k = args.k
     # Pipelined serving mode (the ISSUE 11 overlap window): rounds go
@@ -294,6 +335,38 @@ def child_main(args) -> None:
     print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
 
 
+def _megadoc_child_rounds(args, storm, doc: str, writers: list[str],
+                          start: int) -> None:
+    """The mega-doc workload: promote (idempotent across lives — a life
+    that recovered the promotion skips it), serve ``ticks`` rounds of
+    one frame per writer (the lanes combine them into few ticks), then
+    demote before the digest so every compared plane lives on the
+    single-lane doc row. A round is ACKED only when every writer's
+    frame durably acked."""
+    mgr = storm.megadoc
+    if not mgr.is_promoted(doc) and not mgr.has_history(doc):
+        mgr.promote(doc, lanes=args.megadoc)
+    k = args.k
+    for r in range(start, args.ticks):
+        acks: list = []
+        for w, client in enumerate(writers):
+            payload = _tick_words(args.seed, r, w, k).tobytes()
+            storm.submit_frame(
+                acks.append,
+                {"rid": r * len(writers) + w,
+                 "docs": [[doc, client, 1 + r * k, 1, k]]},
+                memoryview(payload))
+        storm.flush()
+        ok = [a for a in acks
+              if not (isinstance(a, dict) and a.get("error"))]
+        if len(ok) == len(writers):
+            print(f"ACKED {r}", flush=True)
+        if (r + 1) % args.cp_every == 0:
+            storm.checkpoint()
+    if mgr.is_promoted(doc):
+        mgr.demote(doc)
+
+
 # -- parent (kill / restart / diff) -------------------------------------------
 
 
@@ -301,7 +374,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 cp_every: int, resume_from: int | None,
                 kill_env: str | None, timeout: float,
                 residency: int | None = None,
-                pipelined: bool = False) -> dict:
+                pipelined: bool = False,
+                megadoc: int | None = None) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
@@ -310,6 +384,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
         cmd += ["--residency", str(residency)]
     if pipelined:
         cmd += ["--pipelined"]
+    if megadoc is not None:
+        cmd += ["--megadoc", str(megadoc)]
     if resume_from is not None:
         cmd += ["--resume-from", str(resume_from)]
     env = dict(os.environ)
@@ -334,7 +410,8 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               cp_every: int = 2, timeout: float = 300.0,
               twin_digest: dict | None = None,
               residency: int | None = None,
-              pipelined: bool = False) -> dict:
+              pipelined: bool = False,
+              megadoc: int | None = None) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
@@ -352,8 +429,10 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
             "pipelined=True cannot combine with residency= (the "
             "residency workload serves through per-frame barriers, so "
             "the overlap windows would never be exercised)")
+    if megadoc is not None and docs != 1:
+        raise ValueError("megadoc= serves exactly ONE co-written doc")
     cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
-               residency=residency, pipelined=pipelined)
+               residency=residency, pipelined=pipelined, megadoc=megadoc)
     if twin_digest is None:
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
                            kill_env=None, timeout=timeout, **cfg)
@@ -403,6 +482,22 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
             assert not missing, (
                 f"acked round {r} lost ops {sorted(missing)[:4]}… "
                 f"for {doc}")
+    if megadoc is not None:
+        # Per-WRITER retention (the co-writers share cseq ranges, so the
+        # union check above cannot distinguish them): every acked round
+        # covers every writer's batch — history rows carry client ids.
+        doc0 = next(iter(digest["docs"]))
+        per_client: dict[str, set[int]] = {}
+        for h in digest["docs"][doc0]["history"]:
+            if h[4] == int(MessageType.OPERATION):
+                per_client.setdefault(h[5], set()).add(h[1])
+        for r in acked:
+            want = set(range(1 + r * k, 1 + (r + 1) * k))
+            for w in range(MEGADOC_WRITERS):
+                missing = want - per_client.get(f"client-{w + 1}", set())
+                assert not missing, (
+                    f"acked round {r} lost writer client-{w + 1} ops "
+                    f"{sorted(missing)[:4]}…")
     report["twin_digest"] = twin_digest
     return report
 
@@ -925,6 +1020,11 @@ def main(argv=None) -> None:
                         help="serve through the overlapped tick pipeline "
                              "(acks lag the durable watermark; the "
                              "OVERLAP_KILL_POINTS scenarios)")
+    parser.add_argument("--megadoc", type=int, default=None,
+                        help="promote the one doc onto N sequence-"
+                             "parallel lanes co-written by "
+                             f"{MEGADOC_WRITERS} writers (the "
+                             "MEGADOC_KILL_POINTS scenarios)")
     parser.add_argument("--resume-from", type=int, default=None)
     parser.add_argument("--kill-point", default=None)
     parser.add_argument("--kill-hits", type=int, default=1)

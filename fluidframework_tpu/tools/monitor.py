@@ -221,6 +221,36 @@ def render_viewers(metrics: dict, prev: dict | None = None,
             f"encodes {encodes:,.0f} / frames {frames:,.0f}")
 
 
+def render_megadoc(metrics: dict, prev: dict | None = None,
+                   interval: float = 1.0) -> str:
+    """Mega-doc write-tier line (the round-15 scale-out plane):
+    promoted-doc / total-lane gauge levels, mean lanes per doc, the
+    combiner's lane occupancy (active lane batches per tick / total
+    lanes — how much of the promoted width the writer mix actually
+    fills), combined-op rate over the poll window (cumulative with no
+    window), and the sequence-parallel merge tier's boundary-exchange
+    rate (ppermute edge hops, the ring-step cost). Empty when no doc was
+    ever promoted (the gauges never appear)."""
+    if "megadoc.promoted_docs" not in metrics:
+        return ""
+    promoted = metrics.get("megadoc.promoted_docs", 0)
+    lanes = metrics.get("megadoc.total_lanes", 0)
+    occupancy = metrics.get("megadoc.combiner_occupancy", 0.0)
+    combined = metrics.get("megadoc.combined_ops", 0)
+    exchanges = metrics.get("megadoc.boundary_exchanges", 0)
+    per_s = max(interval, 1e-9)
+    if prev:
+        w_c = combined - prev.get("megadoc.combined_ops", 0)
+        w_x = exchanges - prev.get("megadoc.boundary_exchanges", 0)
+        if w_c >= 0 and w_x >= 0:  # negative = service restarted
+            combined, exchanges = w_c / per_s, w_x / per_s
+    lanes_per_doc = lanes / promoted if promoted else 0.0
+    return (f"megadoc: promoted {promoted:g}  lanes {lanes:g} "
+            f"({lanes_per_doc:.1f}/doc)  occupancy {occupancy:.2f}  "
+            f"combined {combined:,.1f}/s  "
+            f"boundary-exchanges {exchanges:,.1f}/s")
+
+
 def render_human(now: dict, prev: dict, interval: float) -> str:
     """Operator view of one poll: headline rates (per-second deltas of
     the interesting counters), the stage bar, and the hop decomposition
@@ -256,6 +286,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     viewer_line = render_viewers(now, prev or None, interval)
     if viewer_line:
         lines.append(viewer_line)
+    mega_line = render_megadoc(now, prev or None, interval)
+    if mega_line:
+        lines.append(mega_line)
     hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
                        if k.startswith("storm.hop.")})
     if hop_keys:

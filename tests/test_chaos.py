@@ -414,3 +414,67 @@ def test_kill_mid_rebalance_recovers_from_durable_log(tmp_path):
     c.runtime.get_datastore("default").get_channel("text") \
         .insert_text(0, "recovered ")
     assert host.text("doc", "default", "text").startswith("recovered ")
+
+
+# -- mega-doc kill classes (ISSUE 12): tier-1 smoke + slow matrix --------------
+
+_MEGA_CFG = dict(docs=1, k=8, ticks=4, cp_every=2, megadoc=2, seed=0)
+
+#: Deterministically-firing mega points for the smoke: the promotion
+#: window (control journaled, lanes unseeded) and the combiner window
+#: (doc seqs assigned, tick neither dispatched nor journaled). The
+#: demotion point rides the slow matrix alongside.
+_MEGA_SMOKE = [("megadoc.mid_promotion", 1), ("megadoc.mid_combine", 3)]
+
+
+@pytest.fixture(scope="session")
+def megadoc_twin_digest(tmp_path_factory):
+    """Uninterrupted twin of the co-written mega-doc workload."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("mega_twin")), resume_from=None,
+        kill_env=None, timeout=300, **_MEGA_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _MEGA_SMOKE,
+                         ids=[p for p, _ in _MEGA_SMOKE])
+def test_megadoc_chaos_smoke_recovers_byte_identical(
+        point, hits, tmp_path, megadoc_twin_digest):
+    """Kill mid-promotion / mid-combiner-tick: recovery must replay the
+    whole promoted lifecycle (control records re-promote at the same
+    point, lane ticks re-combine in the same order) and reconverge
+    byte-identically with zero acked-durable ops lost for EVERY writer
+    (the ISSUE 12 acceptance bar)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=megadoc_twin_digest, **_MEGA_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_MEGA_CFG["ticks"]))
+
+
+def test_megadoc_demotion_chaos_recovers_byte_identical(
+        tmp_path, megadoc_twin_digest):
+    """Kill mid-demotion (control journaled, cross-lane fold not yet
+    applied): recovery replays promote + every lane tick + the demote
+    control and re-folds the identical doc row."""
+    report = chaos.run_chaos(str(tmp_path), "megadoc.mid_demotion",
+                             kill_hits=1, twin_digest=megadoc_twin_digest,
+                             **_MEGA_CFG)
+    assert report["killed"], report
+    assert report["acked_rounds"] == list(range(_MEGA_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_megadoc_chaos_full_matrix(seed, tmp_path):
+    """Every mega kill point × two hit positions, per seed."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.MEGADOC_KILL_POINTS, seeds=(seed,),
+        hit_positions=(1, 2), docs=1, k=8, ticks=5, cp_every=2,
+        megadoc=2)
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]

@@ -72,3 +72,41 @@ def test_sequencer_and_storm_ticks_are_wrapped():
     for fn in (storm._storm_tick, storm._mixed_tick,
                kernel_host._step_one):
         assert getattr(fn, "__wrapped__", None) is not None, fn
+
+
+def test_donated_jit_registry_is_audited():
+    """ADVICE §1 re-audit guard, round-15 edition: the compile-cache
+    bypass must cover EVERY donated serving tick — including any new
+    sharded/combiner entry points a later round adds. The set of source
+    files declaring ``donate_argnums`` is pinned here; a new donated jit
+    in a new module fails this test until it is wrapped in
+    ``compile_cache.uncached`` and added to both lists (the double-free
+    was jaxlib-version-dependent — new tick functions must not silently
+    re-enter the persistent cache). The round-15 mega-doc tier
+    deliberately adds NO donated device entry points: the sequence-
+    parallel merge kernel (ops/mergetree_sharded.py) is undonated and
+    the doc combiner is host-side scalar work."""
+    import pathlib
+
+    import fluidframework_tpu
+
+    root = pathlib.Path(fluidframework_tpu.__file__).parent
+    files = {p.relative_to(root).as_posix()
+             for p in root.rglob("*.py")
+             if "donate_argnums" in p.read_text()}
+    assert files == {"server/kernel_host.py", "server/storm.py"}, (
+        "new donate_argnums site(s) — wrap them in "
+        f"compile_cache.uncached and pin them here: {sorted(files)}")
+    # And every known donated entry point IS wrapped (incl. the ones
+    # new round-15 code paths dispatch through).
+    from fluidframework_tpu.ops import mergetree_sharded as mts
+    from fluidframework_tpu.server import kernel_host, storm
+
+    for fn in (storm._storm_tick, storm._mixed_tick,
+               kernel_host._step_one):
+        assert getattr(fn, "__wrapped__", None) is not None, fn
+    # The sharded kernel's tick is jit WITHOUT donation — the cache is
+    # safe for it by the bypass docstring's own analysis; donation being
+    # added there later would flip the file-set assertion above.
+    assert "donate_argnums" not in pathlib.Path(
+        mts.__file__).read_text()

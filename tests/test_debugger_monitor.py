@@ -401,3 +401,54 @@ def test_residency_line_renders_tiering_state():
     line = json.loads(out.getvalue().strip())
     assert line["residency.hot_docs"] == 100.0
     assert line["residency.rss_mb"] == 512.0
+
+
+def test_megadoc_line_renders_write_scaleout_plane():
+    """Round-15 mega-doc line: silent until a doc is promoted, then
+    promoted/lane gauge levels, lanes per doc, combiner occupancy, and
+    windowed combined-op / boundary-exchange rates with the cumulative
+    fallback across restarts — and the same metrics flow through --json
+    watch mode untouched."""
+    import io
+    import json
+
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_megadoc
+
+    assert render_megadoc({}) == ""  # nothing ever promoted → no line
+    m = {"megadoc.promoted_docs": 2.0,
+         "megadoc.total_lanes": 8.0,
+         "megadoc.combiner_occupancy": 0.75,
+         "megadoc.combined_ops": 1000.0,
+         "megadoc.boundary_exchanges": 640.0}
+    text = render_megadoc(m)
+    assert "promoted 2" in text
+    assert "lanes 8 (4.0/doc)" in text
+    assert "occupancy 0.75" in text
+    # Windowed rates over a 2s poll window.
+    prev = {"megadoc.combined_ops": 900.0,
+            "megadoc.boundary_exchanges": 600.0}
+    windowed = render_megadoc(m, prev, interval=2.0)
+    assert "combined 50.0/s" in windowed
+    assert "boundary-exchanges 20.0/s" in windowed
+    # Restart (negative window): fall back to cumulative counts.
+    prev_big = {"megadoc.combined_ops": 99999.0,
+                "megadoc.boundary_exchanges": 0.0}
+    assert "combined 1,000.0/s" in render_megadoc(m, prev_big,
+                                                  interval=1.0)
+    # Human watch mode carries the line; --json the raw metrics.
+    human = monitor.render_human(m, prev, interval=2.0)
+    assert "megadoc: promoted 2" in human
+
+    scrapes = iter([dict(m)])
+    real_scrape = monitor.scrape
+    monitor.scrape = lambda *a, **k: next(scrapes)
+    try:
+        out = io.StringIO()
+        monitor.watch("h", 1, interval=0.0, out=out, as_json=True,
+                      max_polls=1)
+    finally:
+        monitor.scrape = real_scrape
+    line = json.loads(out.getvalue().strip())
+    assert line["megadoc.total_lanes"] == 8.0
+    assert line["megadoc.combiner_occupancy"] == 0.75

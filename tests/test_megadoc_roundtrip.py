@@ -1,0 +1,118 @@
+"""Promotion/demotion round-trip property (round-15 satellite): the
+``from_block_state`` → shard → serve → gather → ``from_flat`` cycle is
+byte-identical to a block-table twin that never left — under TOMBSTONE
+pressure and with a CONCURRENT geometry retune (the two seams PR 11/12
+added after the original conversion tests were written: the deferred
+tombstone zamboni and the packed-flat re-block)."""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import mergetree_blocks as mtb
+from fluidframework_tpu.ops import mergetree_kernel as mtk
+from fluidframework_tpu.ops import mergetree_sharded as mts
+from tests.test_mergetree_blocks import gen_stream, occupied_rows
+
+
+def _tomb_stream(rng: random.Random, n_ops: int) -> list[dict]:
+    """gen_stream reshaped toward removes: ~half the ops tombstone, so
+    every conversion crosses a table thick with in-window tombstones."""
+    ops, length, pool = [], 0, 0
+    for seq in range(1, n_ops + 1):
+        client = rng.randrange(5)
+        ref_seq = rng.randrange(max(seq - 4, 0), seq)
+        if length > 4 and rng.random() < 0.55:
+            start = rng.randrange(length - 2)
+            end = start + rng.randint(0, min(3, length - start))
+            ops.append(dict(kind=mtk.MT_REMOVE, pos=start, end=end,
+                            seq=seq, ref_seq=ref_seq, client=client))
+            length -= end - start
+        else:
+            tlen = rng.randint(1, 4)
+            ops.append(dict(kind=mtk.MT_INSERT, pos=rng.randint(0, length),
+                            seq=seq, ref_seq=ref_seq, client=client,
+                            pool_start=pool, text_len=tlen))
+            pool += tlen
+            length += tlen
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_promote_serve_demote_roundtrip_byte_identical(cpu_mesh_devices,
+                                                       seed):
+    rng = random.Random(500 + seed)
+    mesh = mts.make_seg_mesh(cpu_mesh_devices)
+    n = len(cpu_mesh_devices)
+    slots = 32 * n  # sharded capacity: 32 segment slots per lane
+    stream = _tomb_stream(rng, 72)
+    half = 40
+    k = 8
+
+    def ticks(ops, k):
+        for start in range(0, len(ops), k):
+            yield mtk.make_merge_op_batch([ops[start:start + k]], 1, k)
+
+    # Twin: the block table serves EVERYTHING, with the serving-path
+    # maintenance in between (maybe_rebalance re-decides per tick; a
+    # mid-run geometry retune re-blocks through the packed-flat seam).
+    twin = mtb.init_state(1, num_blocks=slots // 16, block_slots=16)
+    cand = mtb.init_state(1, num_blocks=slots // 16, block_slots=16)
+    min_seq = jnp.zeros((1,), jnp.int32)
+
+    def serve_block(state, batch):
+        state, ovf = mtb.apply_tick_blocks(state, batch)
+        assert int(np.asarray(ovf)[0]) == int(mtb.OVF_NONE)
+        return mtb.maybe_rebalance(state, min_seq, k)
+
+    for i, batch in enumerate(ticks(stream[:half], k)):
+        twin = serve_block(twin, batch)
+        cand = serve_block(cand, batch)
+        if i == 2:
+            # Concurrent geometry retune (the PR 11 seam): both sides
+            # re-block to a coarser Bk through the packed flat form.
+            twin = mtb.from_flat(mtb.to_flat(twin, slots=slots),
+                                 num_blocks=slots // 32)
+            cand = mtb.from_flat(mtb.to_flat(cand, slots=slots),
+                                 num_blocks=slots // 32)
+
+    # PROMOTE the candidate: block table -> packed flat (the
+    # from_block_state seam) -> segment shards across the mesh lanes.
+    flat = mts.from_block_state(cand, slots=slots)
+    sharded = mts.shard_merge_state(flat, mesh)
+    devices = {s.device for s in sharded.length.addressable_shards}
+    assert len(devices) == n  # genuinely lane-placed
+
+    for batch in ticks(stream[half:], k):
+        sharded = mts.apply_tick_sharded(sharded, batch, mesh)
+        twin = serve_block(twin, batch)
+
+    # DEMOTE: gather -> pack -> from_flat, into the RETUNED geometry.
+    gathered = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), sharded)
+    packed = mtk.compact(gathered, jnp.full((1,), -1, jnp.int32))
+    back = mtb.from_flat(mtb.to_flat(mtb.from_flat(packed,
+                                                   num_blocks=slots // 32),
+                                     slots=slots),
+                         num_blocks=slots // 32)
+
+    # Byte-identity in document order: every occupied slot's full plane
+    # tuple (tombstones, overlap words, props included) and the
+    # recomputed per-block summaries agree with the never-promoted twin.
+    assert occupied_rows(mtb.flat_view(back), 0) == \
+        occupied_rows(mtb.flat_view(twin), 0)
+    rebuilt = mtb.recompute_summaries(back)
+    for f in ("blk_live_len", "blk_max_seq", "blk_tomb", "count"):
+        assert np.array_equal(np.asarray(getattr(back, f)),
+                              np.asarray(getattr(rebuilt, f))), f
+    # Tombstone pressure really was present across the conversions.
+    assert int(np.asarray(back.blk_tomb).sum()) > 0
+
+    # Text materializes identically through either lineage.
+    pool = mtk.TextPool(1)
+    pool.append(0, "x" * 4096)
+    assert mtb.materialize(back, pool, 0) == mtb.materialize(twin, pool, 0)

@@ -53,3 +53,44 @@ def test_huge_doc_migrates_to_sharded_pool(cpu_mesh_devices):
     t1 = get_string(c1).get_text()
     assert get_string(c2).get_text() == t1
     assert host.text("huge", "default", "text") == t1
+
+
+def test_writer_count_auto_promotes_and_idle_demotes(cpu_mesh_devices):
+    """The mega-doc residency class by OBSERVED load (ISSUE 12): a doc
+    whose device-tracked writer set crosses megadoc_writer_threshold
+    promotes to a sequence-parallel pool at the next flush (pending ops
+    ride the move and serve from the mesh the same tick); a promoted row
+    idle long enough demotes back to its block bucket — text identical
+    throughout to an untouched twin."""
+    mesh = make_seg_mesh(cpu_mesh_devices)
+
+    def play(threshold):
+        host = KernelMergeHost(merge_slots=16, seg_mesh=mesh,
+                               sharded_slot_threshold=4096,
+                               megadoc_writer_threshold=threshold,
+                               megadoc_demote_idle_flushes=2)
+        server = LocalCollabServer(merge_host=host)
+        c1 = make_string_doc(server, "swarm")
+        containers = [c1] + [
+            Container.load(LocalDocumentService(server, "swarm"))
+            for _ in range(3)]
+        rng = random.Random(5)
+        for _ in range(40):
+            random_edit(rng, get_string(rng.choice(containers)))
+        host.flush()
+        mid = host.text("swarm", "default", "text")
+        for _ in range(4):
+            host.flush()  # idle flushes: the cooling signal
+        for _ in range(10):
+            random_edit(rng, get_string(containers[0]))
+        host.flush()
+        return host, host.text("swarm", "default", "text"), mid
+
+    host, text, _mid = play(threshold=3)   # 4 writers >= 3: promotes
+    twin, t_text, _ = play(threshold=None)  # auto tier off
+    assert text == t_text
+    assert host.stats["megadoc_promotions"] >= 1
+    assert host.stats["megadoc_demotions"] >= 1
+    key = next(iter(host._merge_rows))
+    assert not host.is_mega_row(key)  # cooled back to the block bucket
+    assert not twin.stats["megadoc_promotions"]

@@ -292,3 +292,68 @@ def test_shard_residency_resolve_skips_pending_victims(mesh):
     assert res.is_resident("doc-a") and res.is_resident("doc-c")
     assert not res.is_resident("doc-b")
     assert row_c != row_a
+
+
+def test_megadoc_lanes_match_single_row_twin(mesh):
+    """Lane placement in the serving assembly (ISSUE 12): one logical
+    doc spread over 4 ROWS (device lanes) through the doc-space
+    combiner must converge byte-identically — entries AND doc-seq ack
+    quads — with a single-row twin serving the same writer batches
+    sequentially, while dup resends and gap batches never touch a
+    lane."""
+    from fluidframework_tpu.parallel.serving import MegaDocLanes
+
+    k, writers = 6, 6
+    serving = ShardedServing(mesh, num_docs=8, k=k, num_hosts=1,
+                             num_clients=4, map_slots=16)
+    serving.join_all(slots=list(range(4)))
+    lanes = MegaDocLanes(serving, lane_rows=[0, 1, 2, 3])
+
+    twin = ShardedServing(mesh, num_docs=8, k=k, num_hosts=1,
+                          num_clients=writers + 1, map_slots=16)
+    twin.join_all(slots=list(range(writers)))
+    # Writers join up front on BOTH sides (each join revs the doc seq).
+    for w in range(writers):
+        lanes.join(f"writer-{w}")
+
+    rng = np.random.default_rng(42)
+    cseqs = {w: 1 for w in range(writers)}
+    prev = {}
+    mega_acks, twin_acks = [], []
+    twin_seq = 0
+    for r in range(4):
+        for w in range(writers):
+            client = f"writer-{w}"
+            action = rng.choice(["fresh", "fresh", "dup", "gap"])
+            words = (rng.integers(0, 1 << 20, k).astype(np.uint32) << 12
+                     | (rng.integers(0, 16, k).astype(np.uint32) << 2))
+            if action == "dup" and w in prev:
+                cseq0, words = prev[w]
+            elif action == "gap":
+                cseq0 = cseqs[w] + 3
+            else:
+                cseq0 = cseqs[w]
+                cseqs[w] += k
+                prev[w] = (cseq0, words)
+            dec = lanes.submit(client, words, cseq0, ref_seq=1)
+            mega_acks.append((r, w, dec.n_seq, dec.first, dec.last))
+            # Twin: the same batch on ONE row, its own tick (the
+            # single-lane shape), writer = its own client slot.
+            h = twin.submit(0, words, cseq0, ref_seq=1, client_slot=w)
+            harvest = twin.tick()
+            n_ok, first, last = harvest[0][0]
+            twin_seq = last if n_ok else twin_seq
+            twin_acks.append((r, w, n_ok,
+                              first if n_ok else 2**31 - 1, last))
+        serving.flush()
+    twin.flush()
+    serving.flush()
+    assert mega_acks == twin_acks
+    twin_vals = {s: int(v) for s, v in enumerate(
+        np.asarray(twin.map_state.value[0]))
+        if np.asarray(twin.map_state.present[0])[s]}
+    assert lanes.entries() == twin_vals
+    # The lanes really spread the doc: >1 row holds sequenced state.
+    active_rows = {row for row in lanes.rows
+                   if int(np.asarray(serving.seq_state.seq[row])) > 0}
+    assert len(active_rows) > 1
