@@ -460,12 +460,19 @@ class StormStream:
                  sample_every: int = 64,
                  on_ack: Callable[[dict], None] | None = None,
                  window: int | None = None,
-                 on_nack: Callable[[dict], None] | None = None) -> None:
+                 on_nack: Callable[[dict], None] | None = None,
+                 on_moved: Callable[[dict], None] | None = None) -> None:
         from ..utils import TraceSpans
         self._service = service
         self.sample_every = max(0, sample_every)
         self._on_ack = on_ack
         self._on_nack = on_nack
+        self._on_moved = on_moved
+        #: doc -> owning-host label learned from "moved" nacks (live
+        #: migration redirects): the caller redials the named host —
+        #: through the same reconnect/backoff machinery as any
+        #: transport loss — and resubmits the frame there.
+        self.moved: dict[str, str] = {}
         self._sent = 0
         self._next_tc = itertools.count(1)
         # Guarded: submit() runs on the app thread while _handle_ack
@@ -568,11 +575,22 @@ class StormStream:
             # Treating it as an ack was the round-13 leak: a shed frame
             # silently freed budget as if it had been served.
             self.nacked += 1
-            retry = payload.get("retry_after_s")
-            if retry:
-                until = time.monotonic() + float(retry)
-                if until > self._backoff_until:
-                    self._backoff_until = until
+            moved_to = payload.get("moved_to")
+            if err == "moved" and isinstance(moved_to, dict):
+                # Live-migration redirect: the docs are served by
+                # another host now. Record the hints (the caller
+                # redials via the reconnect path) and do NOT arm the
+                # send backoff — the right move is a different host,
+                # not a slower retry here.
+                self.moved.update(moved_to)
+                if self._on_moved is not None:
+                    self._on_moved(payload)
+            else:
+                retry = payload.get("retry_after_s")
+                if retry:
+                    until = time.monotonic() + float(retry)
+                    if until > self._backoff_until:
+                        self._backoff_until = until
         if self.window is not None:
             with self._flow:
                 if self.inflight > 0:
@@ -622,8 +640,13 @@ class ViewerStream:
         self.last_seq = 0
         self.audience_total = 0
         self.lagged = False
+        #: Owning-host label from a re-home directive (live migration):
+        #: after the catch-up read, resume against THIS host — a fresh
+        #: service dial through the reconnect path, not viewer_resume
+        #: on the old one.
+        self.moved_to: str | None = None
         self.stats = {"ticks": 0, "ops": 0, "resyncs": 0,
-                      "presence_updates": 0}
+                      "presence_updates": 0, "rehomes": 0}
         service._handlers["storm_tick"] = self._handle_tick
         service._handlers["ops"] = self._handle_ops
         service._handlers["viewer_presence"] = self._handle_presence
@@ -663,6 +686,10 @@ class ViewerStream:
     def _handle_resync(self, payload: dict) -> None:
         self.lagged = True
         self.stats["resyncs"] += 1
+        moved_to = payload.get("moved_to")
+        if moved_to is not None:
+            self.moved_to = moved_to
+            self.stats["rehomes"] += 1
 
     def resync(self, max_attempts: int = 16) -> list:
         """Catch up after a lag-drop and re-enter the live stream:

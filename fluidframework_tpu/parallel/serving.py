@@ -962,7 +962,8 @@ class ShardResidency:
     retune path already requires a settled assembly)."""
 
     def __init__(self, serving: ShardedServing,
-                 join_slots: tuple[int, ...] = (0,)) -> None:
+                 join_slots: tuple[int, ...] = (0,),
+                 active_hosts: tuple[int, ...] | None = None) -> None:
         self.serving = serving
         self._join_slots = tuple(join_slots)
         # Free rows per host = the intersection of the host's range and
@@ -980,17 +981,99 @@ class ShardResidency:
         self._lru: dict[str, None] = {}
         #: doc_id -> cold record (the demoted row's full state).
         self.cold: dict[str, dict] = {}
+        # LIVE placement directory (the round-16 tentpole): the hash
+        # default is pinned to the GENESIS active-host set — activating
+        # a host later must never silently re-route a doc whose state
+        # lives elsewhere; new hosts receive docs only through explicit
+        # :meth:`migrate` entries in the overlay.
+        self.active = (list(active_hosts) if active_hosts is not None
+                       else [p.host_id for p in serving.hosts])
+        self._genesis = tuple(self.active)
+        #: doc -> host overlay (migrated docs); absent = genesis hash.
+        self.placement: dict[str, int] = {}
         self.stats = {"hydrations": 0, "cold_hydrations": 0,
-                      "evictions": 0}
+                      "evictions": 0, "migrations": 0}
+        #: Per-migration blackout seconds (freeze -> serving again on
+        #: the target) — the bench's p50/p99 source.
+        self.blackouts_s: list[float] = []
         self._blank1: tuple[Any, dict] | None = None  # (geometry, states)
 
     # -- directory -------------------------------------------------------------
 
     def host_for(self, doc_id: str) -> int:
-        """Stable doc->host assignment (the bus-partition analog); any
-        process computes the same owner."""
+        """The doc's CURRENT owning host: the migration overlay when
+        present, else the stable genesis hash (the bus-partition
+        analog); any process computes the same owner."""
+        host = self.placement.get(doc_id)
+        if host is not None:
+            return host
         import zlib
-        return zlib.crc32(doc_id.encode()) % len(self.serving.hosts)
+        return self._genesis[zlib.crc32(doc_id.encode())
+                             % len(self._genesis)]
+
+    def activate_host(self, host_id: int) -> None:
+        """Bring one host range online as a migration TARGET (the 2->4
+        scale-out step): existing docs keep their genesis-hash homes
+        until the placement controller migrates them over."""
+        if host_id not in range(len(self.serving.hosts)):
+            raise KeyError(host_id)
+        if host_id not in self.active:
+            self.active.append(host_id)
+
+    def hosts_list(self) -> list[int]:
+        """Active host ids (the placement-controller backend surface)."""
+        return list(self.active)
+
+    def owned(self, host_id: int) -> list[str]:
+        """Docs this host currently owns, cold first (cheapest to
+        migrate — a cold doc moves by directory flip alone), then
+        residents in LRU order (the same order eviction would pick)."""
+        return ([d for d in self.cold if self.host_for(d) == host_id]
+                + [d for d in self._lru if self.host_for(d) == host_id])
+
+    def load_signals(self, host_id: int) -> dict:
+        """One host's load inputs (the PlacementController backend
+        surface): owned docs, pending (unticked) submissions as the
+        queue depth; the fused tick is one SPMD program so per-host
+        tick cost is uniform in this tier (0 = unweighted)."""
+        return {"docs": len(self.owned(host_id)),
+                "queue_depth": len(self.serving._pending[host_id]),
+                "tick_cost_ms": 0.0}
+
+    def migrate(self, doc_id: str, target_host: int) -> int | None:
+        """LIVE migration of one doc to another host range: evict to
+        the cold record (snapshot + durable-log tail — the PR 12
+        carrier), flip the directory, hydrate into the target's row
+        pool. Zero acked-durable ops lost: eviction refuses while a
+        submission is pending (tick first), and the cold record carries
+        every family plane + the durable log across the placement.
+        Returns the new device row (None when the doc was cold — a
+        directory flip alone moves it). Chaos kill points bracket the
+        three phases (tools/chaos.py MIGRATION_KILL_POINTS)."""
+        import time as _time
+        if target_host not in range(len(self.serving.hosts)):
+            raise KeyError(target_host)
+        if target_host not in self.active:
+            raise ValueError(f"host {target_host} is not active")
+        src = self.host_for(doc_id)
+        if target_host == src:
+            return self.row_of.get(doc_id)
+        t0 = _time.perf_counter()
+        was_resident = doc_id in self.row_of
+        faults.crashpoint("placement.pre_evict")
+        if was_resident:
+            self.evict(doc_id)  # refuses while a submission is pending
+        faults.crashpoint("placement.post_evict")
+        self.placement[doc_id] = target_host
+        row = None
+        if was_resident:
+            # Live migration keeps a resident doc resident; a cold doc
+            # moves by directory flip alone and hydrates on next touch.
+            row = self.resolve(doc_id, host_id=target_host)
+        faults.crashpoint("placement.post_hydrate")
+        self.stats["migrations"] += 1
+        self.blackouts_s.append(_time.perf_counter() - t0)
+        return row
 
     def is_resident(self, doc_id: str) -> bool:
         return doc_id in self.row_of

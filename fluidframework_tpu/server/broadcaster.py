@@ -470,14 +470,17 @@ class ViewerPlane:
         between ticks."""
         return self._drain(list(self._rooms))
 
-    def _lag_drop(self, viewer: _Viewer, reason: str) -> None:
+    def _lag_drop(self, viewer: _Viewer, reason: str,
+                  moved_to: str | None = None) -> None:
         """Drop one slow viewer out of the live stream: its queue is
         abandoned (the fan-out already evicted it, or we disconnect it
         here), a ``viewer_resync`` directive tells the client to catch
         up via snapshot + get_deltas — the round-12 cold-read path, so a
         doc that went cold meanwhile still serves the gap from its
         cold-head tick index — and ``viewer_resume`` re-enters the
-        stream. The serving tick never waits."""
+        stream. The serving tick never waits. ``moved_to`` (live
+        migration re-home) names the doc's new owning host: the client
+        resumes THERE after the catch-up."""
         if viewer.sub is not None:
             self.fanout.disconnect(viewer.sub)
             viewer.sub = None
@@ -491,13 +494,34 @@ class ViewerPlane:
         viewer.lag_drops += 1
         self.stats["lag_drops"] += 1
         self.metrics.counter("viewer.lag_drops").inc()
+        directive = {"event": "viewer_resync", "doc": viewer.doc_id,
+                     "seq": self._last_seq.get(viewer.doc_id, 0),
+                     "reason": reason}
+        if moved_to is not None:
+            directive["moved_to"] = moved_to
         try:
-            viewer.push({"event": "viewer_resync", "doc": viewer.doc_id,
-                         "seq": self._last_seq.get(viewer.doc_id, 0),
-                         "reason": reason})
+            viewer.push(directive)
         except Exception:
             pass  # transport already dead; the session teardown cleans up
         self._update_gauges()
+
+    def resync_room(self, doc_id: str, reason: str = "moved",
+                    moved_to: str | None = None) -> int:
+        """Re-home one doc's WHOLE viewer room (live migration): every
+        member is dropped to the resync dance with the new owner in the
+        directive — catch-up rides the cold-read path (the migrated
+        doc's cold head serves the gap without hydrating here), the
+        resume lands on ``moved_to``. Returns viewers re-homed."""
+        room = self._rooms.get(doc_id)
+        if not room:
+            return 0
+        members = list(room.values())
+        for viewer in members:
+            self._lag_drop(viewer, reason, moved_to=moved_to)
+        self.stats["rehomes"] = self.stats.get("rehomes", 0) \
+            + len(members)
+        self.metrics.counter("viewer.rehomes").inc(len(members))
+        return len(members)
 
     # -- presence --------------------------------------------------------------
 

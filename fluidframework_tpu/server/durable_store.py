@@ -26,6 +26,7 @@ import json
 import os
 import re
 import threading
+import time
 from pathlib import Path
 from typing import Any, Callable
 
@@ -170,9 +171,16 @@ class GroupCommitLog:
 
     def __init__(self, path: str | os.PathLike, max_queue: int = 256,
                  fsync: bool = True,
-                 breaker: CircuitBreaker | None = None) -> None:
+                 breaker: CircuitBreaker | None = None,
+                 commit_latency_s: float = 0.0) -> None:
         self._log = OpLog(path)
         self._fsync = fsync
+        # Modeled additional commit latency per fsync BATCH (writer
+        # thread only, after the real fsync): benches use it to put the
+        # WAL in the replicated-log regime (quorum append / networked
+        # disk) where a host's commit round trip — not its CPU — bounds
+        # its serving rate. 0 (default, production) = local disk only.
+        self._commit_latency_s = max(0.0, commit_latency_s)
         # Serializes ALL OpLog access: neither backend is thread-safe
         # (the Python one shares a single seek position between read and
         # append; the native one grows its index vector unsynchronized),
@@ -304,6 +312,12 @@ class GroupCommitLog:
                     if self._fsync:
                         faults.failpoint("wal.fsync")
                         self._log.sync()
+                if self._fsync and self._commit_latency_s:
+                    # Modeled commit round trip OUTSIDE the io lock:
+                    # it delays the durable watermark (as a replicated
+                    # log's quorum ack would), never reads of records
+                    # already appended to the local file.
+                    time.sleep(self._commit_latency_s)
                 faults.crashpoint("wal.post_fsync")
             except OSError as err:
                 # Transient I/O (the breaker's whole domain): keep the

@@ -62,16 +62,21 @@ identically. Chaos kill points: ``megadoc.mid_promotion``,
 ``megadoc.mid_combine``, ``megadoc.mid_demotion``.
 
 Known bounds (documented, not silent): the combine log grows one
-segment per combined batch until demotion (a promoted doc's history
-index — same order as the doc's tick index); a client that JOINS while
+segment per combined batch; with ``trim_combine_logs=True`` the
+maintenance pass retires segments below the translated doc-MSN horizon
+(converged reads stay exact through slot-aligned vseq floors; catch-up
+reads below the horizon raise a reload-from-snapshot error — the
+``doc_index_retention_ticks`` contract). A client that JOINS while
 the doc is promoted is adopted by the mirror with join-at-current-MSN
 semantics, but the join op itself sequences on the (frozen) doc row and
 its seq-rev is discarded at demotion — join/leave churn belongs before
 promotion or after demotion; quarantine of any lane freezes the whole
-doc (readmission of a promoted doc means demote-after-readmit); the
-viewer broadcast plane keys rooms by the ids in the tick header, so
-per-tick viewer frames pause for promoted docs (viewers catch up via
-records, which translate).
+doc (readmission of a promoted doc means demote-after-readmit). A
+demoted doc RE-promotes into a fresh lane EPOCH (``::~mg<e>.<i>`` ids),
+so both cycles' records translate forever and replay re-decides both
+identically. Viewer rooms key by the PARENT doc at harvest, so
+per-tick viewer frames keep flowing for promoted docs (doc-space
+windows via the combiner's ack quads).
 """
 
 from __future__ import annotations
@@ -88,25 +93,41 @@ from ..utils import faults
 
 INT32_MAX = int(oc.INT32_MAX)
 
-#: Lane sub-doc id separator: ``<doc>::~mg<i>``. The marker can't appear
-#: in user doc ids submitted through the validated storm front door
-#: without *being* a lane id, and parse/format stay exact inverses.
+#: Lane sub-doc id separator: ``<doc>::~mg<i>`` (promotion epoch 0, the
+#: round-15 wire format) or ``<doc>::~mg<e>.<i>`` (re-promotion epochs —
+#: a demoted doc that promotes AGAIN gets fresh lane seq spaces, so its
+#: second-cycle lane ids must never alias the first cycle's WAL entries
+#: or combine logs). The marker can't appear in user doc ids submitted
+#: through the validated storm front door without *being* a lane id, and
+#: parse/format stay exact inverses in both shapes.
 LANE_SEP = "::~mg"
 
 
-def lane_id(doc: str, lane: int) -> str:
+def lane_id(doc: str, lane: int, epoch: int = 0) -> str:
+    if epoch:
+        return f"{doc}{LANE_SEP}{epoch}.{lane}"
     return f"{doc}{LANE_SEP}{lane}"
+
+
+def parse_lane_full(doc_id: str) -> tuple[str, int, int] | None:
+    """(parent doc, epoch, lane index) for a lane sub-doc id, else
+    None. Epoch-0 ids keep the round-15 ``<doc>::~mg<i>`` shape."""
+    base, sep, idx = doc_id.rpartition(LANE_SEP)
+    if not sep:
+        return None
+    epoch_s, dot, lane_s = idx.partition(".")
+    try:
+        if dot:
+            return base, int(epoch_s), int(lane_s)
+        return base, 0, int(epoch_s)
+    except ValueError:
+        return None
 
 
 def parse_lane(doc_id: str) -> tuple[str, int] | None:
     """(parent doc, lane index) for a lane sub-doc id, else None."""
-    base, sep, idx = doc_id.rpartition(LANE_SEP)
-    if not sep:
-        return None
-    try:
-        return base, int(idx)
-    except ValueError:
-        return None
+    full = parse_lane_full(doc_id)
+    return None if full is None else (full[0], full[2])
 
 
 def lane_of_writer(client_id: str, lanes: int) -> int:
@@ -344,10 +365,23 @@ class LaneCombineLog:
     mapped to their doc-seq windows — the per-range summary the seq
     transforms roll up through. Lane seqs tile [1, seq] with no holes
     (every sequenced lane op was combined exactly once), so lane→doc
-    translation is one binary search + an affine offset."""
+    translation is one binary search + an affine offset.
+
+    Bounded memory (ROADMAP mega-doc residue): the log grows one segment
+    per combined batch, so a long-lived promotion would accumulate the
+    doc's whole lane-era history. :meth:`trim_below` retires segments
+    wholly below a lane horizon (the translated doc MSN) AFTER capturing
+    the exact doc-space translation of every live map-plane entry at or
+    below it into a slot-aligned floor — the per-slot rebased vseq the
+    LWW fold keeps using, so converged reads stay exact forever while
+    the segment list is bounded by the collab window. Catch-up record
+    translation below the floor becomes impossible (the
+    ``doc_index_retention_ticks`` contract: readers that far behind
+    reload from a snapshot)."""
 
     __slots__ = ("seq", "lane_firsts", "doc_firsts", "lane_lasts",
-                 "msns")
+                 "msns", "floor_lane", "floor_doc", "_vseq_floor",
+                 "_cleared_floor")
 
     def __init__(self) -> None:
         self.seq = 0               # lane seq high water
@@ -355,6 +389,12 @@ class LaneCombineLog:
         self.lane_lasts: list[int] = []
         self.doc_firsts: list[int] = []
         self.msns: list[int] = []  # doc MSN after each combined batch
+        #: Lane seqs <= floor_lane have had their segments retired; the
+        #: slot-aligned floors below carry their exact doc translations.
+        self.floor_lane = 0
+        self.floor_doc = 0
+        self._vseq_floor: np.ndarray | None = None
+        self._cleared_floor = -1
 
     def append(self, n: int, doc_first: int, msn: int) -> tuple[int, int]:
         """Combine one cleaned batch of ``n`` ops; returns its
@@ -368,8 +408,13 @@ class LaneCombineLog:
         return lane_first, self.seq
 
     def to_doc(self, lane_seq: int) -> int:
-        """Doc seq of one lane seq (total over [1, seq])."""
+        """Doc seq of one lane seq (total over (floor_lane, seq])."""
         import bisect
+        if 1 <= lane_seq <= self.floor_lane:
+            raise ValueError(
+                f"lane seq {lane_seq} is below the trimmed combine-log "
+                f"floor {self.floor_lane} (doc seq {self.floor_doc}); "
+                "readers that far behind reload from a snapshot")
         i = bisect.bisect_right(self.lane_firsts, lane_seq) - 1
         if i < 0 or lane_seq > self.lane_lasts[i]:
             raise ValueError(f"lane seq {lane_seq} outside combined "
@@ -377,10 +422,21 @@ class LaneCombineLog:
         return self.doc_firsts[i] + (lane_seq - self.lane_firsts[i])
 
     def to_doc_array(self, lane_seqs: np.ndarray) -> np.ndarray:
-        """Vectorized :meth:`to_doc` for a vseq plane; entries < 1
-        (absent slots / unset cleared_seq) pass through unchanged."""
+        """Vectorized :meth:`to_doc` for a SLOT-ALIGNED vseq plane;
+        entries < 1 (absent slots / unset cleared_seq) pass through
+        unchanged. Entries at or below a trimmed floor resolve through
+        the slot-aligned floor captured at trim time (exact: it was
+        translated while the segments were still live)."""
         out = np.asarray(lane_seqs, np.int64).copy()
-        mask = out >= 1
+        low = (out >= 1) & (out <= self.floor_lane)
+        if low.any():
+            assert self._vseq_floor is not None, "floor without capture"
+            out[low] = self._vseq_floor[low]
+        mask = out > self.floor_lane
+        # NB ``mask`` re-reads OUT, so floor-resolved doc seqs (already
+        # > floor_lane numerically) must not re-translate: restrict to
+        # the untouched entries.
+        mask &= ~low
         if mask.any():
             firsts = np.asarray(self.lane_firsts, np.int64)
             idx = np.searchsorted(firsts, out[mask], side="right") - 1
@@ -388,13 +444,63 @@ class LaneCombineLog:
             out[mask] = docs[idx] + (out[mask] - firsts[idx])
         return out
 
+    def translate_cleared(self, cleared_seq: int) -> int:
+        """Doc-space cleared_seq: < 1 passes through; at/below the floor
+        resolves to the cleared translation captured at trim time."""
+        if cleared_seq < 1:
+            return cleared_seq
+        if cleared_seq <= self.floor_lane:
+            return self._cleared_floor
+        return self.to_doc(cleared_seq)
+
+    def trim_below(self, lane_horizon: int,
+                   vseq_plane: np.ndarray | None = None,
+                   cleared_seq: int = -1) -> int:
+        """Retire segments wholly at/below ``lane_horizon`` (the lane
+        floor of the translated doc MSN). ``vseq_plane`` is the lane's
+        live map-row vseq plane (lane seqs, slot-aligned); its entries
+        at/below the new floor are translated NOW — while the segments
+        still exist — into the slot floor future translations read.
+        Returns the number of segments dropped. New lane seqs are always
+        above the high water (hence above any floor), so a trimmed entry
+        can only go stale by being overwritten, never resurrected."""
+        import bisect
+        cut = bisect.bisect_right(self.lane_lasts, lane_horizon)
+        if cut == 0:
+            return 0
+        if vseq_plane is not None:
+            plane = np.asarray(vseq_plane, np.int64)
+            translated = self.to_doc_array(plane)
+            new_floor = self.lane_lasts[cut - 1]
+            capture = (plane >= 1) & (plane <= new_floor)
+            if self._vseq_floor is None:
+                self._vseq_floor = np.full(plane.shape[0], -1, np.int64)
+            self._vseq_floor[capture] = translated[capture]
+        if 1 <= cleared_seq <= self.lane_lasts[cut - 1]:
+            self._cleared_floor = self.translate_cleared(cleared_seq)
+        self.floor_lane = self.lane_lasts[cut - 1]
+        self.floor_doc = (self.doc_firsts[cut - 1]
+                          + (self.lane_lasts[cut - 1]
+                             - self.lane_firsts[cut - 1]))
+        del self.lane_firsts[:cut]
+        del self.lane_lasts[:cut]
+        del self.doc_firsts[:cut]
+        del self.msns[:cut]
+        return cut
+
     def to_lane_floor(self, doc_seq: int) -> int:
         """Largest lane seq whose doc seq is <= ``doc_seq`` (0 when the
-        lane has none) — the doc→lane window bound for catch-up reads."""
+        lane has none) — the doc→lane window bound for catch-up reads.
+        At/above a trimmed floor but below the first live segment the
+        answer is exactly ``floor_lane``; BELOW the trimmed floor the
+        exact lane seq is gone and -1 is returned (callers detect the
+        reload-from-snapshot case against ``floor_lane``)."""
         import bisect
         i = bisect.bisect_right(self.doc_firsts, doc_seq) - 1
         if i < 0:
-            return 0
+            if doc_seq >= self.floor_doc:
+                return self.floor_lane
+            return -1 if self.floor_lane else 0
         span = self.lane_lasts[i] - self.lane_firsts[i]
         return self.lane_firsts[i] + min(
             max(doc_seq - self.doc_firsts[i], 0), span)
@@ -411,9 +517,15 @@ class LaneCombineLog:
         return self.doc_firsts[i], self.msns[i]
 
     def export(self) -> dict:
-        return {"seq": self.seq, "lf": self.lane_firsts,
-                "ll": self.lane_lasts, "df": self.doc_firsts,
-                "msn": self.msns}
+        out = {"seq": self.seq, "lf": self.lane_firsts,
+               "ll": self.lane_lasts, "df": self.doc_firsts,
+               "msn": self.msns}
+        if self.floor_lane:
+            out["floor"] = [self.floor_lane, self.floor_doc,
+                            self._cleared_floor]
+            if self._vseq_floor is not None:
+                out["vfloor"] = [int(v) for v in self._vseq_floor]
+        return out
 
     @classmethod
     def load(cls, snap: dict) -> "LaneCombineLog":
@@ -423,6 +535,11 @@ class LaneCombineLog:
         log.lane_lasts = list(snap["ll"])
         log.doc_firsts = list(snap["df"])
         log.msns = list(snap["msn"])
+        floor = snap.get("floor")
+        if floor:
+            log.floor_lane, log.floor_doc, log._cleared_floor = floor
+            if snap.get("vfloor") is not None:
+                log._vseq_floor = np.asarray(snap["vfloor"], np.int64)
         return log
 
 
@@ -463,17 +580,23 @@ def fold_map_rows(sources: list[dict]) -> dict[str, np.ndarray]:
 
 
 class _MegaDoc:
-    """Per-doc promotion state (mirror + per-lane combine logs).
-    Retained after demotion with ``promoted=False`` — the lane combine
-    logs keep translating the doc's lane-era WAL records."""
+    """Per-doc promotion state for ONE promotion epoch (mirror +
+    per-lane combine logs). Retained after demotion with
+    ``promoted=False`` — the lane combine logs keep translating the
+    doc's lane-era WAL records. Re-promotion pushes the retired state
+    into the manager's past-epoch list and starts a fresh epoch with
+    EPOCHED lane ids, so the new cycle's lane seq spaces never alias
+    the old cycle's records."""
 
-    __slots__ = ("lanes", "mirror", "logs", "promoted")
+    __slots__ = ("lanes", "mirror", "logs", "promoted", "epoch")
 
-    def __init__(self, lanes: int, mirror: DocSequencerMirror) -> None:
+    def __init__(self, lanes: int, mirror: DocSequencerMirror,
+                 epoch: int = 0) -> None:
         self.lanes = lanes
         self.mirror = mirror
         self.logs = [LaneCombineLog() for _ in range(lanes)]
         self.promoted = True
+        self.epoch = epoch
 
 
 class _FramePlanItem(NamedTuple):
@@ -500,13 +623,25 @@ class MegaDocManager:
     def __init__(self, storm, default_lanes: int = 4,
                  writer_threshold: int | None = None,
                  demote_idle_ticks: int | None = None,
-                 writer_window_ticks: int = 64) -> None:
+                 writer_window_ticks: int = 64,
+                 trim_combine_logs: bool = False) -> None:
         self.storm = storm
         self.default_lanes = max(1, default_lanes)
         self.writer_threshold = writer_threshold
         self.demote_idle_ticks = demote_idle_ticks
         self.writer_window_ticks = max(1, writer_window_ticks)
+        # Opt-in combine-log retention (the doc_index_retention_ticks
+        # contract): trim each promoted doc's per-lane segments below
+        # the translated MSN horizon on the flush-cadence maintenance
+        # pass. Catch-up reads below the horizon then raise a clear
+        # reload-from-snapshot error; converged reads stay exact via
+        # the slot-aligned vseq floors.
+        self.trim_combine_logs = trim_combine_logs
         self.docs: dict[str, _MegaDoc] = {}
+        #: Retired promotion epochs per doc (re-promotion pushes the
+        #: previous cycle here) — their combine logs keep translating
+        #: that epoch's WAL records forever.
+        self.past_epochs: dict[str, list[_MegaDoc]] = {}
         #: doc -> {client, ...} seen in the current observation window
         #: (auto-promotion signal) and doc -> idle harvests (demotion).
         self._writers_seen: dict[str, set[str]] = {}
@@ -538,45 +673,67 @@ class MegaDocManager:
 
     def parent_of(self, doc_id: str) -> str | None:
         """Parent doc of a lane id known to this manager (else None)."""
-        parsed = parse_lane(doc_id)
+        parsed = parse_lane_full(doc_id)
         if parsed is not None and parsed[0] in self.docs:
             return parsed[0]
         return None
 
+    def _state_for(self, doc: str, epoch: int) -> "_MegaDoc | None":
+        """The promotion-epoch state a lane id's records translate
+        through: the current epoch or a retired one."""
+        st = self.docs.get(doc)
+        if st is not None and st.epoch == epoch:
+            return st
+        for past in self.past_epochs.get(doc, ()):
+            if past.epoch == epoch:
+                return past
+        return None
+
     def lane_ids(self, doc: str) -> list[str]:
-        return [lane_id(doc, i) for i in range(self.docs[doc].lanes)]
+        st = self.docs[doc]
+        return [lane_id(doc, i, st.epoch) for i in range(st.lanes)]
 
     # -- lifecycle -------------------------------------------------------------
 
     def promote(self, doc: str, lanes: int | None = None) -> None:
         """Pin a doc into the mega class. Idempotent; settles the
         pipeline first; journals a WAL control record so replay
-        re-promotes at the identical point."""
+        re-promotes at the identical point. A doc demoted earlier this
+        life RE-promotes into a fresh EPOCH: new lane ids
+        (``::~mg<e>.<i>``), fresh sub-sequencer seq spaces, the retired
+        cycle's combine logs kept for its records' translation — replay
+        re-decides both cycles identically."""
         if self.is_promoted(doc):
             return
         lanes = max(1, lanes or self.default_lanes)
         storm = self.storm
         if doc in storm.quarantined:
             raise RuntimeError(f"cannot promote quarantined doc {doc!r}")
-        if self.has_history(doc):
-            raise RuntimeError(
-                f"{doc!r} was already promoted once this life; "
-                "re-promotion would fork its lane seq spaces")
+        prior = self.docs.get(doc)
+        epoch = prior.epoch + 1 if prior is not None else 0
         storm.flush()
         now = int(storm.service._clock())
-        self._append_control({"op": "promote", "doc": doc,
-                              "lanes": lanes}, now)
+        event = {"op": "promote", "doc": doc, "lanes": lanes}
+        if epoch:
+            event["epoch"] = epoch
+        self._append_control(event, now)
         # Kill window: control journaled, lane rows NOT yet seeded —
         # recovery replays the control and re-seeds from the identical
         # recovered doc checkpoint.
         faults.crashpoint("megadoc.mid_promotion")
-        self._apply_promote(doc, lanes)
+        self._apply_promote(doc, lanes, epoch)
 
-    def _apply_promote(self, doc: str, lanes: int) -> None:
+    def _apply_promote(self, doc: str, lanes: int, epoch: int = 0) -> None:
+        prior = self.docs.get(doc)
+        if prior is not None:
+            assert not prior.promoted and epoch == prior.epoch + 1, (
+                doc, epoch, prior.epoch, prior.promoted)
+            self.past_epochs.setdefault(doc, []).append(prior)
         seq_host = self.storm.seq_host
         seq_host._row(doc)  # a never-served doc promotes from an empty row
         cp = seq_host.checkpoint(doc)
-        st = _MegaDoc(lanes, DocSequencerMirror.from_checkpoint(cp, lanes))
+        st = _MegaDoc(lanes, DocSequencerMirror.from_checkpoint(cp, lanes),
+                      epoch=epoch)
         self.docs[doc] = st
         for i in range(lanes):
             self._sync_lane_row(doc, i)
@@ -675,7 +832,8 @@ class MegaDocManager:
         try:
             op = event["op"]
             if op == "promote":
-                self._apply_promote(event["doc"], event["lanes"])
+                self._apply_promote(event["doc"], event["lanes"],
+                                    event.get("epoch", 0))
             elif op == "demote":
                 self._apply_demote(event["doc"])
             elif op == "mark":
@@ -721,7 +879,8 @@ class MegaDocManager:
             if infos is None:
                 infos = [None] * len(docs)  # type: ignore[list-item]
             infos[i] = {"doc": doc, "lane": lane}
-            docs[i] = (lane_id(doc, lane), client, cseq0, ref, count)
+            docs[i] = (lane_id(doc, lane, st.epoch), client, cseq0, ref,
+                       count)
         return infos
 
     def observe_writers(self, docs: list[tuple]) -> None:
@@ -811,8 +970,8 @@ class MegaDocManager:
                 chunk = chunk[dec.dups:]
             plan.append(_FramePlanItem(None, len(kept_docs)))
             desc_rows.append(dec.ack_row)
-            kept_docs.append((lane_id(info["doc"], lane), client,
-                              lane_cseq0, ref, dec.n_seq))
+            kept_docs.append((lane_id(info["doc"], lane, st.epoch),
+                              client, lane_cseq0, ref, dec.n_seq))
             kept_words.append(chunk)
             combined += dec.n_seq
         if combined:
@@ -860,11 +1019,14 @@ class MegaDocManager:
         the sequenced branch of the algebra to rebuild mirrors and
         combine logs deterministically."""
         for doc_id, client, lane_cseq0, ref, count in descs:
-            parsed = parse_lane(doc_id)
+            parsed = parse_lane_full(doc_id)
             if parsed is None or parsed[0] not in self.docs:
                 continue
-            doc, lane = parsed
+            doc, epoch, lane = parsed
             st = self.docs[doc]
+            # Controls replay strictly by WAL position, so the current
+            # epoch at any lane entry's replay equals its live epoch.
+            assert st.epoch == epoch, (doc_id, st.epoch)
             mirror = st.mirror
             w = mirror.writers.get(client)
             if w is None:
@@ -920,7 +1082,8 @@ class MegaDocManager:
             "can_summarize": w.summarize, "nack": False,
         } for cid, w in sorted(st.mirror.writers.items())
             if w.active and w.lane == lane]
-        self.storm.seq_host.restore(lane_id(doc, lane), SequencerCheckpoint(
+        self.storm.seq_host.restore(
+            lane_id(doc, lane, st.epoch), SequencerCheckpoint(
             sequence_number=st.logs[lane].seq,
             minimum_sequence_number=0,
             last_sent_msn=0,
@@ -954,7 +1117,7 @@ class MegaDocManager:
         if base_key in mh._map_rows:
             sources.append(row_planes(mh._map_rows[base_key].row))
         for i in range(st.lanes):
-            key = ChannelKey(lane_id(doc, i), storm.datastore,
+            key = ChannelKey(lane_id(doc, i, st.epoch), storm.datastore,
                              storm.channel)
             mrow = mh._map_rows.get(key)
             if mrow is None:
@@ -962,8 +1125,8 @@ class MegaDocManager:
             planes = row_planes(mrow.row)
             log = st.logs[i]
             planes["vseq"] = log.to_doc_array(planes["vseq"])
-            cs = planes["cleared_seq"]
-            planes["cleared_seq"] = (log.to_doc(cs) if cs >= 1 else cs)
+            planes["cleared_seq"] = log.translate_cleared(
+                planes["cleared_seq"])
             sources.append(planes)
         return sources
 
@@ -1016,31 +1179,39 @@ class MegaDocManager:
         doc-space) merged with every lane's records translated through
         its combine log, sorted by doc first_seq. ``base_fn`` is the
         controller's untranslated per-id record resolver."""
-        st = self.docs[doc]
         out = list(base_fn(doc, from_seq, to_seq))
-        for i in range(st.lanes):
-            log = st.logs[i]
-            # Bound the lane query to the requested doc window (floor
-            # translation) — an incremental catch-up read must not scan
-            # a long-lived promoted doc's full lane history per call.
-            lane_from = log.to_lane_floor(from_seq)
-            lane_to = (None if to_seq is None
-                       else log.to_lane_floor(to_seq))
-            for rec in base_fn(lane_id(doc, i), lane_from, lane_to):
-                if rec["n_seq"] <= 0:
-                    continue
-                doc_first, msn = log.segment_at(rec["first_seq"])
-                w = st.mirror.writers.get(rec["client"])
-                offset = w.offset if w is not None else 0
-                doc_rec = dict(rec)
-                doc_rec["first_seq"] = doc_first
-                doc_rec["last_seq"] = doc_first + rec["n_seq"] - 1
-                doc_rec["msn"] = msn
-                doc_rec["first_cseq"] = rec["first_cseq"] + offset
-                if doc_rec["last_seq"] <= from_seq or (
-                        to_seq is not None and doc_first > to_seq):
-                    continue
-                out.append(doc_rec)
+        epochs = (*self.past_epochs.get(doc, ()), self.docs[doc])
+        for st in epochs:
+            for i in range(st.lanes):
+                log = st.logs[i]
+                # Bound the lane query to the requested doc window
+                # (floor translation) — an incremental catch-up read
+                # must not scan a long-lived promoted doc's full lane
+                # history per call.
+                lane_from = log.to_lane_floor(from_seq)
+                if lane_from < log.floor_lane:
+                    raise ValueError(
+                        f"{doc!r} catch-up from doc seq {from_seq} is "
+                        f"below the trimmed combine-log horizon (doc "
+                        f"seq {log.floor_doc}); reload from a snapshot")
+                lane_to = (None if to_seq is None
+                           else log.to_lane_floor(to_seq))
+                for rec in base_fn(lane_id(doc, i, st.epoch), lane_from,
+                                   lane_to):
+                    if rec["n_seq"] <= 0:
+                        continue
+                    doc_first, msn = log.segment_at(rec["first_seq"])
+                    w = st.mirror.writers.get(rec["client"])
+                    offset = w.offset if w is not None else 0
+                    doc_rec = dict(rec)
+                    doc_rec["first_seq"] = doc_first
+                    doc_rec["last_seq"] = doc_first + rec["n_seq"] - 1
+                    doc_rec["msn"] = msn
+                    doc_rec["first_cseq"] = rec["first_cseq"] + offset
+                    if doc_rec["last_seq"] <= from_seq or (
+                            to_seq is not None and doc_first > to_seq):
+                        continue
+                    out.append(doc_rec)
         out.sort(key=lambda r: (r["first_seq"], r["tick"]))
         return out
 
@@ -1078,9 +1249,11 @@ class MegaDocManager:
         if self.writer_threshold is not None \
                 and self._window_ticks >= self.writer_window_ticks:
             for doc, writers in list(self._writers_seen.items()):
+                # A doc demoted earlier this life may RE-promote: lane
+                # epoching forks the new cycle's seq spaces away from
+                # the retired one's records.
                 if (len(writers) >= self.writer_threshold
                         and not self.is_promoted(doc)
-                        and not self.has_history(doc)
                         and doc not in self.storm.quarantined):
                     self.promote(doc)
             self._writers_seen.clear()
@@ -1090,29 +1263,84 @@ class MegaDocManager:
                         if n >= self.demote_idle_ticks
                         and self.is_promoted(d)]:
                 self.demote(doc)
+        if self.trim_combine_logs:
+            self.trim_logs()
+
+    def trim_logs(self, doc: str | None = None) -> int:
+        """Bounded-memory maintenance for promoted docs' combine logs
+        (ROADMAP mega-doc residue): retire each lane's segments below
+        the lane floor of the doc MSN — the collab-window floor below
+        which no active writer can reference — capturing the lane map
+        row's live vseq plane translations first so the cross-lane LWW
+        fold stays exact. Returns segments dropped."""
+        from .merge_host import ChannelKey
+        storm = self.storm
+        mh = storm.merge_host
+        dropped = 0
+        for d, st in self.docs.items():
+            if (doc is not None and d != doc) or not st.promoted:
+                continue
+            msn = st.mirror.msn
+            for i in range(st.lanes):
+                log = st.logs[i]
+                horizon = log.to_lane_floor(msn)
+                if horizon <= log.floor_lane:
+                    continue
+                key = ChannelKey(lane_id(d, i, st.epoch),
+                                 storm.datastore, storm.channel)
+                mrow = mh._map_rows.get(key)
+                plane = cleared = None
+                if mrow is not None:
+                    xs = mh._xstate
+                    plane = np.asarray(xs.vseq[mrow.row])
+                    cleared = int(np.asarray(xs.cleared_seq[mrow.row]))
+                dropped += log.trim_below(horizon, plane,
+                                          -1 if cleared is None
+                                          else cleared)
+        return dropped
 
     # -- snapshot --------------------------------------------------------------
 
+    @staticmethod
+    def _export_epoch(st: _MegaDoc) -> dict:
+        out = {"lanes": st.lanes, "promoted": st.promoted,
+               "mirror": st.mirror.export(),
+               "logs": [log.export() for log in st.logs]}
+        if st.epoch:
+            out["epoch"] = st.epoch
+        return out
+
+    @staticmethod
+    def _load_epoch(rec: dict) -> _MegaDoc:
+        st = _MegaDoc(rec["lanes"],
+                      DocSequencerMirror.load(rec["mirror"]),
+                      epoch=rec.get("epoch", 0))
+        st.logs = [LaneCombineLog.load(s) for s in rec["logs"]]
+        st.promoted = rec["promoted"]
+        return st
+
     def export_state(self) -> dict:
-        return {"docs": {
-            doc: {"lanes": st.lanes, "promoted": st.promoted,
-                  "mirror": st.mirror.export(),
-                  "logs": [log.export() for log in st.logs]}
-            for doc, st in self.docs.items()}}
+        out: dict = {"docs": {}}
+        for doc, st in self.docs.items():
+            rec = self._export_epoch(st)
+            past = self.past_epochs.get(doc)
+            if past:
+                rec["past"] = [self._export_epoch(p) for p in past]
+            out["docs"][doc] = rec
+        return out
 
     def import_state(self, snap: dict | None) -> None:
         if not snap:
             return
         assert not self.docs, "import_state needs a fresh manager"
         for doc, rec in snap["docs"].items():
-            st = _MegaDoc(rec["lanes"],
-                          DocSequencerMirror.load(rec["mirror"]))
-            st.logs = [LaneCombineLog.load(s) for s in rec["logs"]]
-            st.promoted = rec["promoted"]
-            self.docs[doc] = st
+            self.docs[doc] = self._load_epoch(rec)
+            if rec.get("past"):
+                self.past_epochs[doc] = [self._load_epoch(p)
+                                         for p in rec["past"]]
         self._export_gauges()
 
 
 __all__ = ["MegaDocManager", "DocSequencerMirror", "LaneCombineLog",
-           "fold_map_rows", "lane_id", "parse_lane", "lane_of_writer",
-           "LANE_SEP"]
+           "fold_map_rows", "lane_id", "parse_lane", "parse_lane_full",
+           "lane_of_writer", "LANE_SEP"]

@@ -91,9 +91,21 @@ class ResidencyManager:
                  hydration_rate_per_s: float = 200.0,
                  hydration_burst: float | None = None,
                  cold_handle_cache: int = 4096,
+                 host_label: str | None = None,
                  clock=time.monotonic) -> None:
         from .riddler import TokenBucket
         self.storm = storm
+        # Cluster identity (parallel/placement.py): cold snapshots are
+        # stamped with the host that wrote them, because their compact
+        # tick index references THAT host's WAL — a doc hydrating on
+        # another host (live migration over the shared store) must not
+        # resolve foreign tick ids into its own WAL. None (single-host)
+        # keeps the round-12 behavior bit-for-bit.
+        self.host_label = host_label
+        #: doc -> {origin host -> its tick index}: the migrated doc's
+        #: pre-migration catch-up indexes, carried through subsequent
+        #: evictions so every host keeps serving its own WAL segments.
+        self.foreign_ticks: dict[str, dict[str, list]] = {}
         self.snapshots = (snapshots if snapshots is not None
                           else storm.snapshots)
         if self.snapshots is None:
@@ -163,13 +175,28 @@ class ResidencyManager:
     def cold_doc_ticks(self, doc_id: str) -> list[tuple[int, int, int]]:
         """A COLD doc's compact catch-up index, read from its cold head
         WITHOUT hydrating — a gap fetch is a read and must not churn the
-        pool. Empty for fresh registrations (no cold head)."""
+        pool. Empty for fresh registrations (no cold head). Tick ids
+        resolve into THIS host's WAL only: a foreign-home snapshot (the
+        doc migrated away and was re-evicted elsewhere) serves this
+        host's segment from its ``foreign_ticks`` carry-through."""
         handle = self.cold_handle(doc_id)
         if not handle:
             return []
         snap = self.snapshots.get(self._cold_key(doc_id), handle)
         if snap is None:
-            return []
+            # The cached head was superseded by ANOTHER host's eviction
+            # and its chunks GC'd (cluster re-home + re-evict): refresh
+            # from the authoritative ref and retry once.
+            handle = self.snapshots.head(self._cold_key(doc_id))
+            self._cold_handles.put(doc_id, handle or "")
+            snap = (self.snapshots.get(self._cold_key(doc_id), handle)
+                    if handle else None)
+            if snap is None:
+                return []
+        home = snap.get("home")
+        if home is not None and home != self.host_label:
+            return [tuple(t) for t in snap.get(
+                "foreign_ticks", {}).get(self.host_label or "", ())]
         return [tuple(t) for t in snap.get("doc_ticks", ())]
 
     def touch(self, doc_id: str, now: float | None = None) -> None:
@@ -302,7 +329,14 @@ class ResidencyManager:
         (rows lazy-allocate on the doc's first tick)."""
         assert doc_id not in self.resident, doc_id
         t0 = time.perf_counter()
-        handle = self.cold_handle(doc_id)
+        # Authoritative head read, NOT the cached handle: in a cluster
+        # another host may have flipped this doc's cold head since we
+        # cached ours (live migration re-homes + re-evictions), and the
+        # superseded snapshot may already be GC'd — hydrating from a
+        # stale handle would silently restore nothing. One ref-file
+        # read on the already-expensive hydration path.
+        handle = self.snapshots.head(self._cold_key(doc_id))
+        self._cold_handles.put(doc_id, handle or "")
         snap = (self.snapshots.get(self._cold_key(doc_id), handle)
                 if handle else None)
         restored = False
@@ -361,10 +395,35 @@ class ResidencyManager:
         # The compact catch-up index travels with the doc. During
         # recovery the __init__ blob scan already rebuilt a COMPLETE
         # index (it covers post-snapshot ticks too) — never overwrite it
-        # with the snapshot's shorter one.
-        if snap.get("doc_ticks") and doc_id not in storm._doc_ticks:
-            storm._doc_ticks[doc_id] = [tuple(t)
-                                        for t in snap["doc_ticks"]]
+        # with the snapshot's shorter one. A FOREIGN-home snapshot (live
+        # migration over the shared store) must not adopt at all: its
+        # tick ids reference the origin host's WAL, and adopting them
+        # here would resolve catch-up reads into the wrong blobs — the
+        # origin index is carried as foreign_ticks instead, so every
+        # host keeps serving its own WAL segments.
+        home = snap.get("home")
+        if home is not None and home != self.host_label:
+            carried = dict(snap.get("foreign_ticks", {}))
+            if snap.get("doc_ticks"):
+                carried[home] = [list(t) for t in snap["doc_ticks"]]
+            # A doc migrating BACK to a prior home re-adopts that
+            # home's own segment into the live index (its tick ids
+            # resolve HERE; the next local eviction then exports a
+            # complete local doc_ticks again) — leaving it only in
+            # foreign_ticks would drop this host's pre-migration
+            # segment from every later catch-up read.
+            own = (carried.pop(self.host_label, None)
+                   if self.host_label is not None else None)
+            if own and doc_id not in storm._doc_ticks:
+                storm._doc_ticks[doc_id] = [tuple(t) for t in own]
+            if carried:
+                self.foreign_ticks[doc_id] = carried
+        else:
+            if snap.get("doc_ticks") and doc_id not in storm._doc_ticks:
+                storm._doc_ticks[doc_id] = [tuple(t)
+                                            for t in snap["doc_ticks"]]
+            if snap.get("foreign_ticks"):
+                self.foreign_ticks[doc_id] = dict(snap["foreign_ticks"])
         if doc_id not in storm.doc_tick_counts:
             storm.doc_tick_counts[doc_id] = snap.get("tick_count", 0)
 
@@ -478,6 +537,7 @@ class ResidencyManager:
         # bound): the tick index and telemetry count restore on hydrate.
         storm._doc_ticks.pop(doc_id, None)
         storm.doc_tick_counts.pop(doc_id, None)
+        self.foreign_ticks.pop(doc_id, None)  # exported above
         self.resident.pop(doc_id)
         self._cold_handles.put(doc_id, handle)
         self._known_cold += 1
@@ -535,6 +595,10 @@ class ResidencyManager:
                           for t in storm._doc_ticks.get(doc_id, ())],
             "tick_count": storm.doc_tick_counts.get(doc_id, 0),
         }
+        if self.host_label is not None:
+            snap["home"] = self.host_label
+            if doc_id in self.foreign_ticks:
+                snap["foreign_ticks"] = self.foreign_ticks[doc_id]
         ckey = ChannelKey(doc_id, storm.datastore, storm.channel)
         mrow = storm.merge_host._map_rows.get(ckey)
         if mrow is not None:
@@ -586,8 +650,14 @@ class ResidencyManager:
                 self.resident[doc] = now  # fresh doc: adopt, rows lazy
                 out.append(entry)
                 continue
-            if tick < snap.get("tick_watermark", 0):
+            home = snap.get("home")
+            if (home is None or home == self.host_label) \
+                    and tick < snap.get("tick_watermark", 0):
                 continue  # already inside the cold snapshot
+            # A FOREIGN-home snapshot's watermark counts the ORIGIN
+            # host's ticks — it never filters local entries (every
+            # local entry for a migrated-in doc post-dates the
+            # hydration by construction).
             self._restore(doc, snap)
             self.resident[doc] = now
             self.stats["replay_hydrations"] += 1

@@ -420,6 +420,7 @@ class StormController:
                  max_pending_docs: int | None = None,
                  busy_retry_s: float = 0.05,
                  doc_index_retention_ticks: int | None = None,
+                 wal_commit_latency_s: float = 0.0,
                  logger=None) -> None:
         self.service = service
         self.seq_host = seq_host
@@ -494,7 +495,10 @@ class StormController:
             root.mkdir(parents=True, exist_ok=True)
             path = root / "storm_tick_words.log"
             if durability == "group":
-                self._group_wal = GroupCommitLog(path)
+                # commit_latency_s models a replicated durable log's
+                # quorum round trip (bench regime); 0 = local disk.
+                self._group_wal = GroupCommitLog(
+                    path, commit_latency_s=wal_commit_latency_s)
                 self._blob_log = self._group_wal
             else:
                 self._blob_log = OpLog(path)
@@ -545,6 +549,13 @@ class StormController:
         # promoted docs serve up to L writer frames per tick through
         # per-lane sub-sequencer rows + the host combiner.
         self.megadoc = None
+        # Cluster placement (parallel/placement.py attaches a per-host
+        # router): when set, frames naming docs another host owns shed
+        # with a "moved" nack carrying the owner as ``moved_to`` (the
+        # client redials through the reconnect/backoff path), and docs
+        # mid-migration shed "migrating" with a retry hint — never
+        # sequenced on the wrong host, never silently dropped.
+        self.placement = None
         self._in_round = False  # mid-_flush_round (evictions refuse)
         # Opt-in retention for the per-doc (first, last, tick) index:
         # entries whose tick falls below ``tick_counter - retention``
@@ -757,6 +768,33 @@ class StormController:
         quarantine, degraded (WAL breaker open), bounded queue, token
         buckets. A refusal pushes ONE busy-nack with ``retry_after_s``
         and returns the hint; None admits."""
+        if self.placement is not None:
+            # Ownership first — the cheapest check, and a frame for a
+            # foreign doc must never consume this host's quarantine /
+            # queue / token state. Whole-frame refusal (acks are
+            # positional per frame); ``moved_to`` names each moved
+            # doc's owning host so the client redials it directly.
+            moved: dict[str, str] = {}
+            frozen = False
+            for d, *_ in docs:
+                code, owner = self.placement.route(d)
+                if code == "moved":
+                    moved[d] = owner
+                elif code == "migrating":
+                    frozen = True
+            if frozen:
+                # Mid-migration blackout: the doc is between hosts
+                # (evict-to-cold → hydrate); the retry hint is the
+                # expected blackout window, after which the route
+                # resolves to "moved" (or back to this host).
+                return self._shed(push, header, n_ops, "migrating",
+                                  self.placement.retry_after_s,
+                                  docs=[d for d, *_ in docs])
+            if moved:
+                return self._shed(push, header, n_ops, "moved",
+                                  self.placement.retry_after_s,
+                                  docs=[d for d, *_ in docs],
+                                  moved_to=moved)
         qdocs = [d for d, *_ in docs if d in self.quarantined]
         if qdocs:
             # The WHOLE frame is refused (acks are positional per frame,
@@ -818,7 +856,8 @@ class StormController:
     def _shed(self, push, header: dict, n_ops: int, code: str,
               retry_after_s: float, docs: list | None = None,
               quarantined: list | None = None,
-              retryable: bool = True) -> float:
+              retryable: bool = True,
+              moved_to: dict | None = None) -> float:
         self.stats["shed_frames"] += 1
         self.stats["shed_ops"] += n_ops
         self.merge_host.metrics.counter("storm.shed_ops").inc(n_ops)
@@ -830,6 +869,8 @@ class StormController:
                 nack["docs"] = docs  # EVERY doc whose ops were dropped
             if quarantined:
                 nack["quarantined"] = quarantined
+            if moved_to:
+                nack["moved_to"] = moved_to  # doc -> owning host label
             push(nack)
         return retry_after_s
 
@@ -1433,7 +1474,13 @@ class StormController:
             viewers = None
         # Desc indices whose docs have viewer rooms — collected inside
         # the one existing per-desc loop (no second O(descs) pass).
+        # Rooms key by the PARENT doc for mega-lane descs (viewer frames
+        # must keep flowing while a doc is promoted), publishing the
+        # combiner's DOC-space quad instead of the lane-space device row.
         viewer_idx: list[int] = []
+        viewer_rooms: dict[int, str] = {}
+        megadoc = self.megadoc
+        mega_rows_all = rec.get("mega_rows") or {}
         now = rec["now"]
         mrows = rec["mrows"]
         # scriptorium tick record: ONE blob per tick — a json header of
@@ -1486,9 +1533,15 @@ class StormController:
                 # broadcaster: compact tick frame into the pub/sub hop.
                 if pubs is not None:
                     pubs.append((doc, b"\x00storm%d:%d:%d" % (fs, ls, m)))
-                if viewers is not None and ns > 0 \
-                        and viewers.has_viewers(doc):
-                    viewer_idx.append(i)
+                if viewers is not None and ns > 0:
+                    room_doc = doc
+                    if megadoc is not None:
+                        parent = megadoc.parent_of(doc)
+                        if parent is not None:
+                            room_doc = parent
+                    if viewers.has_viewers(room_doc):
+                        viewer_idx.append(i)
+                        viewer_rooms[i] = room_doc
         t_assembled = _time.monotonic_ns()
         stage_ns["ack_pack"] = t_assembled - t_readback
         if pubs:
@@ -1526,8 +1579,14 @@ class StormController:
                     gi = i0 + local
                     if gi == target:
                         words = frame_words[f_idx][off:off + count]
-                        items.append((rec["descs"][gi][0], ns_l[gi],
-                                      fs_l[gi], ls_l[gi], m_l[gi],
+                        # Lane descs broadcast the combiner's doc-space
+                        # quad (the same rewrite the client ack gets);
+                        # viewers of a promoted doc see continuous doc
+                        # seq windows, never lane-space ones.
+                        quad = mega_rows_all.get(gi) or (
+                            ns_l[gi], fs_l[gi], ls_l[gi], m_l[gi])
+                        items.append((viewer_rooms[gi], quad[0],
+                                      quad[1], quad[2], quad[3],
                                       count, words.tobytes()))
                         lo += 1
                         if lo == hi:

@@ -99,6 +99,23 @@ MEGADOC_KILL_POINTS = ("megadoc.mid_promotion", "megadoc.mid_combine",
 #: Writers co-editing the one mega doc in the megadoc child mode.
 MEGADOC_WRITERS = 4
 
+#: Live-migration kill classes (ISSUE 13): the child serves a TWO-HOST
+#: in-process cluster (``cluster=`` in run_chaos — per-host WAL/bus/
+#: state over ONE shared content-addressed store + durable placement
+#: directory) and migrates one doc between hosts mid-workload
+#: (``migrate_at=``). Each point kills one migration phase: intent
+#: durable but the source still resident / doc evicted to the shared
+#: cold record with no owner serving / target hydrated (volatile) but
+#: the directory not yet flipped. Recovery rolls the migration FORWARD
+#: from the durable intent and must reconverge byte-identical to a
+#: NEVER-MIGRATED twin with zero acked-durable ops lost — the
+#: differential + chaos acceptance bar in one diff.
+MIGRATION_KILL_POINTS = ("placement.pre_evict", "placement.post_evict",
+                         "placement.post_hydrate")
+
+#: Host labels of the in-process chaos cluster.
+CLUSTER_HOSTS = ("hostA", "hostB")
+
 #: Overlap-window kill classes (ISSUE 11): the child serves PIPELINED
 #: (``pipelined=`` in run_chaos — rounds step through the un-forced
 #: flush path, so tick N's group fsync runs concurrent with tick N+1's
@@ -147,6 +164,134 @@ def _build_stack(data_dir: str, num_docs: int):
     from ..server.megadoc import MegaDocManager
     MegaDocManager(storm, default_lanes=2)
     return service, storm, seq_host, merge_host
+
+
+def _build_cluster(data_dir: str, num_docs: int):
+    """Two in-process serving hosts over one shared snapshot store +
+    durable placement directory (the ISSUE 13 scenario stack)."""
+    from ..parallel.placement import StormCluster, make_cluster_host
+    from ..server.durable_store import GitSnapshotStore
+    from ..server.megadoc import MegaDocManager
+
+    git = GitSnapshotStore(os.path.join(data_dir, "git"))
+    hosts = {}
+    for label in CLUSTER_HOSTS:
+        storm = make_cluster_host(label, os.path.join(data_dir, label),
+                                  git, num_docs=num_docs)
+        MegaDocManager(storm, default_lanes=2)
+        hosts[label] = storm
+    return git, hosts
+
+
+def _cluster_clients(cluster, docs: list[str],
+                     connect: bool) -> dict[str, str]:
+    """Deterministic doc->client-id map: docs connect to their GENESIS
+    owner in doc order, so each host's durable client counter hands out
+    the same ids in every life — a later migration moves the sequencer
+    row (client identities ride it), never the id assignment."""
+    per_host_count: dict[str, int] = {}
+    clients: dict[str, str] = {}
+    for d in docs:
+        owner = cluster.directory.genesis_owner(d)
+        per_host_count[owner] = per_host_count.get(owner, 0) + 1
+        if connect:
+            storm = cluster.hosts[owner]
+            clients[d] = storm.service.connect(d, lambda m: None).client_id
+        else:
+            clients[d] = f"client-{per_host_count[owner]}"
+    return clients
+
+
+def _cluster_digest(cluster, docs: list[str]) -> dict:
+    """The cluster twin-diff surface: per doc, the MERGED cross-host
+    history (each host serves its own WAL segment of a migrated doc)
+    plus the owning host's map row + sequencer checkpoint — placement-
+    agnostic by construction, so a migrated run must digest identical
+    to a never-migrated twin."""
+    from ..protocol.codec import to_wire
+
+    out: dict = {"docs": {}}
+    for doc in docs:
+        owner = cluster.owner_of(doc)
+        storm = cluster.hosts[owner]
+        storm.residency.ensure_resident(doc, gate=False)
+        history = []
+        for m in cluster.get_deltas(doc, 0):
+            history.append([
+                m.sequence_number, m.client_sequence_number,
+                m.reference_sequence_number, m.minimum_sequence_number,
+                int(m.type), m.client_id,
+                json.dumps(to_wire(m.contents), sort_keys=True)])
+        cp = dataclasses.asdict(storm.seq_host.checkpoint(doc))
+        cp.pop("log_offset", None)
+        for client in cp["clients"]:
+            client["last_update"] = 0  # arrival clock, not replica state
+        out["docs"][doc] = {
+            "history": history,
+            "map": storm.merge_host.map_entries(doc, storm.datastore,
+                                                storm.channel),
+            "sequencer": cp,
+        }
+    return out
+
+
+def _cluster_child(args) -> None:
+    """One cluster serving life: two hosts, per-doc frames routed by
+    the live directory, ONE scripted migration of doc 0 to the other
+    host at round ``migrate_at`` (-1 = never — the differential twin).
+    Kill plans land inside the migration phases; a resumed life rolls
+    any durable intent forward before serving."""
+    from ..parallel.placement import StormCluster
+    from ..utils import faults
+
+    docs = [f"chaos-doc-{i}" for i in range(args.docs)]
+    git, hosts = _build_cluster(args.dir, args.docs)
+    if args.resume_from is None:
+        cluster = StormCluster(hosts, git)
+        clients = _cluster_clients(cluster, docs, connect=True)
+        for storm in hosts.values():
+            storm.service.pump()
+            storm.checkpoint()
+        start = 0
+        print("GENESIS", flush=True)
+    else:
+        for storm in hosts.values():
+            storm.recover()
+        cluster = StormCluster(hosts, git)  # directory loads from store
+        cluster.recover()  # roll forward any durable migration intent
+        clients = _cluster_clients(cluster, docs, connect=False)
+        start = args.resume_from
+    print("READY", flush=True)
+    faults.arm()
+    k = args.k
+    genesis_owner = cluster.directory.genesis_owner(docs[0])
+    target = next(h for h in CLUSTER_HOSTS if h != genesis_owner)
+    for r in range(start, args.ticks):
+        if r == args.migrate_at \
+                and cluster.owner_of(docs[0]) == genesis_owner:
+            # The scripted live migration (skipped in resumed lives
+            # where recovery already rolled it forward).
+            cluster.migrate(docs[0], target)
+        acks: list = []
+        for i, d in enumerate(docs):
+            payload = _tick_words(args.seed, r, i, k).tobytes()
+            storm = cluster.hosts[cluster.owner_of(d)]
+            storm.submit_frame(
+                acks.append,
+                {"rid": r * len(docs) + i,
+                 "docs": [[d, clients[d], 1 + r * k, 1, k]]},
+                memoryview(payload))
+            storm.flush()
+        ok = [a for a in acks
+              if not (isinstance(a, dict) and a.get("error"))]
+        if len(ok) == len(docs):
+            print(f"ACKED {r}", flush=True)
+        if (r + 1) % args.cp_every == 0:
+            for storm in hosts.values():
+                storm.checkpoint()
+    faults.disarm()
+    digest = _cluster_digest(cluster, docs)
+    print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
 
 
 def _tick_words(seed: int, round_no: int, doc_i: int, k: int,
@@ -201,6 +346,9 @@ def child_main(args) -> None:
     from ..utils import compile_cache, faults
 
     compile_cache.enable()
+    if getattr(args, "cluster", False):
+        _cluster_child(args)
+        return
     mega_lanes = getattr(args, "megadoc", None)
     docs = [f"chaos-doc-{i}" for i in range(args.docs)]
     service, storm, seq_host, merge_host = _build_stack(args.dir, args.docs)
@@ -337,17 +485,30 @@ def child_main(args) -> None:
 
 def _megadoc_child_rounds(args, storm, doc: str, writers: list[str],
                           start: int) -> None:
-    """The mega-doc workload: promote (idempotent across lives — a life
-    that recovered the promotion skips it), serve ``ticks`` rounds of
-    one frame per writer (the lanes combine them into few ticks), then
-    demote before the digest so every compared plane lives on the
-    single-lane doc row. A round is ACKED only when every writer's
-    frame durably acked."""
+    """The mega-doc workload: TWO promotion cycles (promote → serve →
+    demote → RE-promote into epoch 1 → serve → demote), one frame per
+    writer per round (the lanes combine them into few ticks), with the
+    final demote before the digest so every compared plane lives on the
+    single-lane doc row. Lifecycle steps are keyed off the RECOVERED
+    manager state (epoch + promoted flag), so a resumed life lands at
+    the identical point whatever phase the kill hit and replay
+    re-decides BOTH cycles identically. A round is ACKED only when
+    every writer's frame durably acked."""
     mgr = storm.megadoc
-    if not mgr.is_promoted(doc) and not mgr.has_history(doc):
-        mgr.promote(doc, lanes=args.megadoc)
+    half = max(1, args.ticks // 2)
     k = args.k
     for r in range(start, args.ticks):
+        st = mgr.docs.get(doc)
+        if r < half:
+            if st is None:
+                mgr.promote(doc, lanes=args.megadoc)
+        else:
+            if st is not None and st.epoch == 0:
+                if st.promoted:
+                    mgr.demote(doc)
+                mgr.promote(doc, lanes=args.megadoc)  # epoch 1
+            elif st is None:
+                mgr.promote(doc, lanes=args.megadoc)
         acks: list = []
         for w, client in enumerate(writers):
             payload = _tick_words(args.seed, r, w, k).tobytes()
@@ -375,7 +536,9 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 kill_env: str | None, timeout: float,
                 residency: int | None = None,
                 pipelined: bool = False,
-                megadoc: int | None = None) -> dict:
+                megadoc: int | None = None,
+                cluster: bool = False,
+                migrate_at: int = -1) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
@@ -386,6 +549,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
         cmd += ["--pipelined"]
     if megadoc is not None:
         cmd += ["--megadoc", str(megadoc)]
+    if cluster:
+        cmd += ["--cluster", "--migrate-at", str(migrate_at)]
     if resume_from is not None:
         cmd += ["--resume-from", str(resume_from)]
     env = dict(os.environ)
@@ -411,7 +576,9 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               twin_digest: dict | None = None,
               residency: int | None = None,
               pipelined: bool = False,
-              megadoc: int | None = None) -> dict:
+              megadoc: int | None = None,
+              cluster: bool = False,
+              migrate_at: int | None = None) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
@@ -421,7 +588,12 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
     ``pipelined`` serves the child through the overlapped tick pipeline
     (the OVERLAP_KILL_POINTS scenarios) — and because the digest planes
     are pipelining-agnostic, an UNPIPELINED twin_digest may be shared
-    in: equality then also proves pipelined ≡ barrier serving."""
+    in: equality then also proves pipelined ≡ barrier serving.
+    ``cluster`` serves a two-host cluster with one scripted live
+    migration (round ``migrate_at``, default mid-run — the
+    MIGRATION_KILL_POINTS scenarios); its TWIN never migrates, so the
+    digest equality is simultaneously the migrated ≡ never-migrated
+    differential bar AND the kill-recovery bar."""
     from ..utils import faults
 
     if pipelined and residency is not None:
@@ -431,11 +603,17 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
             "the overlap windows would never be exercised)")
     if megadoc is not None and docs != 1:
         raise ValueError("megadoc= serves exactly ONE co-written doc")
+    if cluster and (residency is not None or pipelined or megadoc):
+        raise ValueError("cluster=True is its own scenario stack")
     cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
-               residency=residency, pipelined=pipelined, megadoc=megadoc)
+               residency=residency, pipelined=pipelined, megadoc=megadoc,
+               cluster=cluster,
+               migrate_at=(migrate_at if migrate_at is not None
+                           else ticks // 2) if cluster else -1)
     if twin_digest is None:
+        twin_cfg = dict(cfg, migrate_at=-1) if cluster else cfg
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
-                           kill_env=None, timeout=timeout, **cfg)
+                           kill_env=None, timeout=timeout, **twin_cfg)
         assert twin["returncode"] == 0, twin["stderr"]
         twin_digest = twin["digest"]
 
@@ -1025,6 +1203,14 @@ def main(argv=None) -> None:
                              "parallel lanes co-written by "
                              f"{MEGADOC_WRITERS} writers (the "
                              "MEGADOC_KILL_POINTS scenarios)")
+    parser.add_argument("--cluster", action="store_true",
+                        help="serve a two-host in-process cluster over "
+                             "one shared snapshot store with a durable "
+                             "placement directory (the "
+                             "MIGRATION_KILL_POINTS scenarios)")
+    parser.add_argument("--migrate-at", type=int, default=-1,
+                        help="cluster mode: round at which doc 0 live-"
+                             "migrates to the other host (-1 = never)")
     parser.add_argument("--resume-from", type=int, default=None)
     parser.add_argument("--kill-point", default=None)
     parser.add_argument("--kill-hits", type=int, default=1)
@@ -1045,7 +1231,9 @@ def main(argv=None) -> None:
     report = run_chaos(args.workdir, args.kill_point, args.kill_hits,
                        seed=args.seed, docs=args.docs, k=args.k,
                        ticks=args.ticks, cp_every=args.cp_every,
-                       pipelined=args.pipelined)
+                       pipelined=args.pipelined, cluster=args.cluster,
+                       migrate_at=(args.migrate_at if args.migrate_at >= 0
+                                   else None))
     report.pop("twin_digest", None)
     print(json.dumps(report, indent=1))
 

@@ -221,6 +221,35 @@ def render_viewers(metrics: dict, prev: dict | None = None,
             f"encodes {encodes:,.0f} / frames {frames:,.0f}")
 
 
+def render_cluster(metrics: dict, prev: dict | None = None,
+                   interval: float = 1.0) -> str:
+    """Cluster-placement line (the round-16 elastic tier): active host
+    count, docs this host owns, live migrations (in flight + rate over
+    the poll window; cumulative counter with no window), viewer
+    re-homes, and the last migration's blackout ms — the operator's
+    first read on whether the placement controller is draining a hot
+    host or a migration is wedged. Empty when no cluster directory is
+    attached (the gauges never appear)."""
+    if "cluster.hosts" not in metrics:
+        return ""
+    hosts = metrics.get("cluster.hosts", 0)
+    docs = metrics.get("cluster.host_docs", 0)
+    in_flight = metrics.get("cluster.migrations_in_flight", 0)
+    migrations = metrics.get("cluster.migrations", 0)
+    rehomes = metrics.get("viewer.rehomes", 0)
+    blackout = metrics.get("cluster.last_blackout_ms", 0.0)
+    per_s = max(interval, 1e-9)
+    rate = ""
+    if prev:
+        w_m = migrations - prev.get("cluster.migrations", 0)
+        if w_m >= 0:  # negative = service restarted
+            rate = f" ({w_m / per_s:,.2f}/s)"
+    return (f"cluster: hosts {hosts:g}  docs/host {docs:g}  "
+            f"migrations {migrations:g}{rate} in-flight {in_flight:g}  "
+            f"viewer re-homes {rehomes:g}  "
+            f"last blackout {blackout:,.1f}ms")
+
+
 def render_megadoc(metrics: dict, prev: dict | None = None,
                    interval: float = 1.0) -> str:
     """Mega-doc write-tier line (the round-15 scale-out plane):
@@ -289,6 +318,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     mega_line = render_megadoc(now, prev or None, interval)
     if mega_line:
         lines.append(mega_line)
+    cluster_line = render_cluster(now, prev or None, interval)
+    if cluster_line:
+        lines.append(cluster_line)
     hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
                        if k.startswith("storm.hop.")})
     if hop_keys:

@@ -478,3 +478,69 @@ def test_megadoc_chaos_full_matrix(seed, tmp_path):
     killed = [r for r in reports if r["killed"]]
     assert len(killed) >= len(reports) // 2, \
         [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
+
+
+# -- migration kill classes (ISSUE 13): tier-1 smoke + slow matrix -------------
+
+_CLUSTER_CFG = dict(seed=0, docs=2, k=8, ticks=5, cp_every=2,
+                    cluster=True, migrate_at=2)
+
+#: Tier-1 smoke: the post-evict window (doc cold in the shared store,
+#: NO host serving it, directory intent durable) — the nastiest phase.
+#: The other two phases ride the slow matrix.
+_MIGRATION_SMOKE = [("placement.post_evict", 1)]
+
+
+@pytest.fixture(scope="session")
+def cluster_twin_digest(tmp_path_factory):
+    """The NEVER-MIGRATED twin cluster: digest equality against it is
+    simultaneously the migrated ≡ never-migrated differential bar and
+    the kill-recovery bar."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("cluster_twin")), resume_from=None,
+        kill_env=None, timeout=300,
+        **dict(_CLUSTER_CFG, migrate_at=-1))
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _MIGRATION_SMOKE,
+                         ids=[p for p, _ in _MIGRATION_SMOKE])
+def test_migration_chaos_smoke_recovers_byte_identical(
+        point, hits, tmp_path, cluster_twin_digest):
+    """Kill mid-migration: recovery rolls the durable intent FORWARD
+    (the doc ends owned + served by the target) and the cluster
+    reconverges byte-identical to a twin that never migrated, with
+    zero acked-durable ops lost (the ISSUE 13 acceptance bar)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=cluster_twin_digest,
+                             **_CLUSTER_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_CLUSTER_CFG["ticks"]))
+
+
+def test_cluster_clean_run_matches_never_migrated_twin(
+        tmp_path, cluster_twin_digest):
+    """No kill at all: the scripted live migration under writes alone
+    must leave the cluster byte-identical to the never-migrated twin
+    (migration is transparent to every compared plane)."""
+    life = chaos._spawn_life(str(tmp_path), resume_from=None,
+                             kill_env=None, timeout=300, **_CLUSTER_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert json.dumps(life["digest"], sort_keys=True) == json.dumps(
+        cluster_twin_digest, sort_keys=True)
+    assert life["acked"] == list(range(_CLUSTER_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_migration_chaos_full_matrix(seed, tmp_path):
+    """Slow soak: every migration phase × seed × hit position."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.MIGRATION_KILL_POINTS,
+        seeds=(seed,), hit_positions=(1,),
+        **{k: v for k, v in _CLUSTER_CFG.items() if k != "seed"})
+    assert all(r["killed"] for r in reports)

@@ -1,0 +1,384 @@
+"""Elastic multi-host serving (parallel/placement.py — the round-16
+tentpole): live doc migration between in-process serving hosts over one
+shared snapshot store, load-based placement, client redirects, and the
+viewer re-home dance. The kill-mid-migration recovery story rides the
+chaos harness (tests/test_chaos.py MIGRATION smoke); here the cluster
+runs in-process so the phase windows are directly observable."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.parallel.placement import (
+    MIGRATION_KILL_POINTS,
+    PlacementController,
+    StormCluster,
+    make_cluster_host,
+)
+from fluidframework_tpu.server.durable_store import GitSnapshotStore
+from fluidframework_tpu.tools.chaos import _cluster_digest
+
+
+def _words(seed, k=4):
+    rng = np.random.default_rng(seed)
+    kinds = rng.choice([0, 0, 1], size=k).astype(np.uint32)
+    slots = rng.integers(0, 16, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def _build(tmp_path, labels=("hostA", "hostB"), active=None):
+    git = GitSnapshotStore(str(tmp_path / "git"))
+    hosts = {label: make_cluster_host(label, str(tmp_path / label), git,
+                                      num_docs=8)
+             for label in labels}
+    return git, hosts, StormCluster(hosts, git, active=active)
+
+
+def _connect(cluster, docs):
+    clients = {}
+    for d in docs:
+        storm = cluster.storm_for(d)
+        clients[d] = storm.service.connect(d, lambda m: None).client_id
+        storm.service.pump()
+    return clients
+
+
+def _serve_round(cluster, docs, clients, cseq, r, k=4, sink=None):
+    for i, d in enumerate(docs):
+        storm = cluster.storm_for(d)
+        w = _words([r, i], k)
+        storm.submit_frame(
+            sink or (lambda p: None),
+            {"rid": (r, d), "docs": [[d, clients[d], cseq[d], 1, k]]},
+            memoryview(w.tobytes()))
+        storm.flush()
+        cseq[d] += k
+
+
+def test_migration_under_writes_matches_never_migrated_twin(tmp_path):
+    """THE acceptance differential: the same workload served with a
+    live mid-run migration must converge byte-identical (merged
+    history, map rows, sequencer checkpoints) to a twin cluster that
+    never migrated — zero acked-durable ops lost or reordered."""
+    docs = [f"doc-{i}" for i in range(3)]
+
+    def play(root, migrate):
+        git, hosts, cluster = _build(root)
+        clients = _connect(cluster, docs)
+        cseq = {d: 1 for d in docs}
+        for r in range(2):
+            _serve_round(cluster, docs, clients, cseq, r)
+        if migrate:
+            src = cluster.owner_of(docs[0])
+            dst = next(h for h in cluster.labels if h != src)
+            blackout = cluster.migrate(docs[0], dst)
+            assert blackout > 0
+            assert cluster.owner_of(docs[0]) == dst
+        for r in range(2, 4):
+            _serve_round(cluster, docs, clients, cseq, r)
+        return _cluster_digest(cluster, docs)
+
+    migrated = play(tmp_path / "migrated", migrate=True)
+    twin = play(tmp_path / "twin", migrate=False)
+    assert json.dumps(migrated, sort_keys=True) \
+        == json.dumps(twin, sort_keys=True)
+
+
+def test_moved_and_migrating_nacks_carry_redirect_hints(tmp_path):
+    """Client redirect (the PR 8 reconnect path's input): a frame at
+    the wrong host sheds ``moved`` with a ``moved_to`` hint; a frame
+    DURING the migration blackout sheds ``migrating`` with a retry
+    hint; after the flip the old owner redirects to the new one."""
+    docs = ["doc-0"]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {docs[0]: 1}
+    _serve_round(cluster, docs, clients, cseq, 0)
+    d = docs[0]
+    src = cluster.owner_of(d)
+    dst = next(h for h in cluster.labels if h != src)
+    nacks = []
+
+    def submit_to(label):
+        w = _words([9], 4)
+        cluster.hosts[label].submit_frame(
+            nacks.append, {"rid": "x", "docs": [[d, clients[d],
+                                                 cseq[d], 1, 4]]},
+            memoryview(w.tobytes()))
+
+    submit_to(dst)  # wrong host pre-migration
+    assert nacks[-1]["error"] == "moved"
+    assert nacks[-1]["moved_to"] == {d: src}
+    assert nacks[-1]["retryable"] and nacks[-1]["retry_after_s"] > 0
+
+    phases = []
+
+    def on_phase(phase):
+        phases.append(phase)
+        if phase in ("frozen", "evicted", "hydrated"):
+            # Mid-blackout: BOTH hosts shed "migrating" — the doc is
+            # between hosts and nothing may sequence on either.
+            for label in cluster.labels:
+                submit_to(label)
+                assert nacks[-1]["error"] == "migrating", (phase, label)
+                assert nacks[-1]["retry_after_s"] > 0
+
+    cluster.migrate(d, dst, on_phase=on_phase)
+    assert phases == ["frozen", "evicted", "hydrated", "completed"]
+    submit_to(src)  # old owner now redirects
+    assert nacks[-1]["error"] == "moved"
+    assert nacks[-1]["moved_to"] == {d: dst}
+    # ...and the new owner serves.
+    acks = []
+    w = _words([10], 4)
+    cluster.hosts[dst].submit_frame(
+        acks.append, {"rid": "ok", "docs": [[d, clients[d],
+                                             cseq[d], 1, 4]]},
+        memoryview(w.tobytes()))
+    cluster.hosts[dst].flush()
+    assert acks and not acks[-1].get("error")
+
+
+def test_cold_read_serves_gap_mid_migration_on_both_hosts(tmp_path):
+    """Eviction racing a viewer ``viewer_resync`` catch-up (ISSUE 13
+    satellite): at EVERY migration phase — mid-evict, post-evict (doc
+    cold, no owner), post-hydrate (target volatile) — ``get_deltas``
+    must serve the doc's full sequenced gap from the cold-read path on
+    whichever host holds the WAL segment, without hydrating."""
+    docs = ["doc-0"]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {docs[0]: 1}
+    for r in range(3):
+        _serve_round(cluster, docs, clients, cseq, r)
+    d = docs[0]
+    src = cluster.owner_of(d)
+    dst = next(h for h in cluster.labels if h != src)
+    want = [m.sequence_number for m in cluster.get_deltas(d, 0)]
+    assert len(want) >= 13  # join + 3 rounds of 4
+    seen = {}
+
+    def on_phase(phase):
+        if phase == "completed":
+            return
+        # The reader's gap fetch during the blackout: merged across
+        # hosts it must cover the full acked history at every phase.
+        got = [m.sequence_number for m in cluster.get_deltas(d, 0)]
+        seen[phase] = got
+        # The source serves its segment WITHOUT re-hydrating the doc.
+        if phase in ("evicted", "hydrated"):
+            assert not cluster.hosts[src].residency.is_resident(d)
+
+    cluster.migrate(d, dst, on_phase=on_phase)
+    for phase in ("frozen", "evicted", "hydrated"):
+        assert seen[phase] == want, phase
+    # Post-migration: reads still complete, and the source keeps its
+    # pre-migration segment readable (home-stamped cold head).
+    assert [m.sequence_number
+            for m in cluster.get_deltas(d, 0)] == want
+    src_only = [m.sequence_number
+                for m in cluster.hosts[src].service.get_deltas(d, 0)]
+    assert src_only == want  # all history predates the migration
+
+
+def test_viewer_room_rehomes_with_moved_hint(tmp_path):
+    """Viewer re-home (the PR 13 ``viewer_resync`` dance across
+    hosts): migrating a doc drops its source viewer room with a
+    ``moved_to`` directive; the viewer catches the gap via get_deltas
+    and resumes against the target plane."""
+    from fluidframework_tpu.server.broadcaster import ViewerPlane
+
+    docs = ["doc-0"]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {docs[0]: 1}
+    d = docs[0]
+    src = cluster.owner_of(d)
+    dst = next(h for h in cluster.labels if h != src)
+    src_plane = ViewerPlane(cluster.hosts[src].service)
+    dst_plane = ViewerPlane(cluster.hosts[dst].service)
+    events = []
+    src_plane.join(d, events.append)
+    _serve_round(cluster, docs, clients, cseq, 0)
+    ticks_before = [e for e in events if isinstance(e, dict)
+                    and e.get("event") == "viewer_resync"]
+    assert not ticks_before
+    cluster.migrate(d, dst)
+    directives = [e for e in events if isinstance(e, dict)
+                  and e.get("event") == "viewer_resync"]
+    assert directives and directives[-1]["moved_to"] == dst
+    assert directives[-1]["reason"] == "moved"
+    assert cluster.stats["rehomed_viewers"] == 1
+    # The re-home dance: gap via merged get_deltas, resume on TARGET.
+    gap = cluster.get_deltas(d, directives[-1]["seq"])
+    hello = dst_plane.join(d, events.append)
+    assert hello["viewer_id"]
+    # Live frames flow from the new owner.
+    encodes0 = dst_plane.stats["tick_encodes"]
+    _serve_round(cluster, docs, clients, cseq, 1)
+    assert dst_plane.stats["tick_encodes"] > encodes0
+
+
+def test_rebalance_2_to_4_hosts_converges(tmp_path):
+    """The scale-out driver: genesis on 2 hosts, 2 more activated, the
+    placement controller converges the owned-doc spread via live
+    migrations — and every doc still serves (values preserved)."""
+    labels = ("hostA", "hostB", "hostC", "hostD")
+    git, hosts, cluster = _build(tmp_path, labels=labels,
+                                 active=["hostA", "hostB"])
+    docs = [f"doc-{i}" for i in range(8)]
+    clients = _connect(cluster, docs)
+    assert {cluster.owner_of(d) for d in docs} <= {"hostA", "hostB"}
+    cseq = {d: 1 for d in docs}
+    _serve_round(cluster, docs, clients, cseq, 0)
+    cluster.activate_host("hostC")
+    cluster.activate_host("hostD")
+    ctrl = PlacementController(cluster, max_moves_per_round=8)
+    report = ctrl.rebalance()
+    assert report["converged"], report
+    assert report["doc_spread"] <= 1
+    assert set(report["docs_per_host"]) == set(labels)
+    assert report["moves"] >= 2  # real migrations happened
+    # Every doc keeps serving at its (possibly new) owner.
+    acks = []
+    _serve_round(cluster, docs, clients, cseq, 1, sink=acks.append)
+    assert len([a for a in acks if not a.get("error")]) == len(docs)
+
+
+def test_drain_host_moves_every_doc(tmp_path):
+    git, hosts, cluster = _build(tmp_path)
+    docs = [f"doc-{i}" for i in range(4)]
+    clients = _connect(cluster, docs)
+    cseq = {d: 1 for d in docs}
+    _serve_round(cluster, docs, clients, cseq, 0)
+    hot = max(cluster.labels, key=lambda h: len(cluster.owned(h)))
+    assert cluster.owned(hot)
+    ctrl = PlacementController(cluster)
+    report = ctrl.drain(hot)
+    assert report["remaining"] == 0
+    assert not cluster.owned(hot)
+
+
+def test_directory_intent_rolls_forward(tmp_path):
+    """A durable MIGRATING intent with no completed flip (the
+    post-evict crash window, simulated in-process) rolls FORWARD on
+    recover(): the doc ends owned and resident at the target."""
+    docs = ["doc-0"]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {docs[0]: 1}
+    _serve_round(cluster, docs, clients, cseq, 0)
+    d = docs[0]
+    src = cluster.owner_of(d)
+    dst = next(h for h in cluster.labels if h != src)
+    # Freeze + evict, then "crash" before the hydrate/flip.
+    cluster.directory.freeze(d, src, dst)
+    cluster.hosts[src].residency.evict(d, reason="migration")
+    code, _ = cluster._route(d, src)
+    assert code == "migrating"
+    completed = cluster.recover()
+    assert completed == [d]
+    assert cluster.owner_of(d) == dst
+    assert cluster.hosts[dst].residency.is_resident(d)
+    acks = []
+    _serve_round(cluster, docs, clients, cseq, 1, sink=acks.append)
+    assert acks and not acks[-1].get("error")
+
+
+def test_migration_kill_points_registered():
+    assert MIGRATION_KILL_POINTS == (
+        "placement.pre_evict", "placement.post_evict",
+        "placement.post_hydrate")
+
+
+def test_storm_stream_moved_nack_records_redirect():
+    """The client half: a "moved" nack updates the stream's redirect
+    map and fires on_moved WITHOUT arming the send backoff (the right
+    response is a different host, not a slower retry here)."""
+    from fluidframework_tpu.drivers.network_driver import StormStream
+
+    class _StubService:
+        def __init__(self):
+            self._handlers = {}
+            self._stamp_storm_rx = False
+
+    svc = _StubService()
+    moved_events = []
+    stream = StormStream(svc, sample_every=0, window=2,
+                         on_moved=moved_events.append)
+    stream.inflight = 1
+    svc._handlers["storm_ack"]({
+        "error": "moved", "retry_after_s": 0.5, "rid": 1,
+        "moved_to": {"doc-0": "hostB"}, "docs": ["doc-0"]})
+    assert stream.moved == {"doc-0": "hostB"}
+    assert stream.nacked == 1 and stream.acked == 0
+    assert stream.inflight == 0  # the slot freed
+    assert stream._backoff_until == 0.0  # no backoff armed
+    assert moved_events and moved_events[0]["moved_to"]
+
+
+def test_viewer_stream_records_rehome_hint():
+    from fluidframework_tpu.drivers.network_driver import ViewerStream
+
+    class _StubService:
+        def __init__(self):
+            self._handlers = {}
+            self._token = None
+            self._client_key = "ck"
+
+    svc = _StubService()
+    stream = ViewerStream(svc)
+    svc._handlers["viewer_resync"]({"event": "viewer_resync",
+                                    "doc": "d", "seq": 7,
+                                    "reason": "moved",
+                                    "moved_to": "hostB"})
+    assert stream.lagged and stream.moved_to == "hostB"
+    assert stream.stats["rehomes"] == 1
+
+
+def test_round_trip_migration_keeps_full_history_readable(tmp_path):
+    """Review regression: a doc migrating h->h' and BACK must re-adopt
+    the origin host's own tick index (its ids resolve there), so after
+    a further eviction on the original home every host still serves
+    its own WAL segment and the merged history stays complete."""
+    docs = ["doc-0"]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {docs[0]: 1}
+    d = docs[0]
+    for r in range(2):
+        _serve_round(cluster, docs, clients, cseq, r)
+    src = cluster.owner_of(d)
+    dst = next(h for h in cluster.labels if h != src)
+    cluster.migrate(d, dst)
+    for r in range(2, 4):
+        _serve_round(cluster, docs, clients, cseq, r)
+    cluster.migrate(d, src)  # back home
+    for r in range(4, 6):
+        _serve_round(cluster, docs, clients, cseq, r)
+    want = list(range(1, 1 + 1 + 6 * 4))  # join + 6 rounds of 4
+    got = [m.sequence_number for m in cluster.get_deltas(d, 0)]
+    assert got == want
+    # Evict on the original home: its exported index must still cover
+    # BOTH of its own segments, and the merged read stays complete.
+    cluster.hosts[src].residency.evict(d, reason="idle")
+    got_cold = [m.sequence_number for m in cluster.get_deltas(d, 0)]
+    assert got_cold == want
+
+
+def test_activation_survives_cluster_rebuild(tmp_path):
+    """Review regression: the activated-host set is durable directory
+    state — a rebuilt cluster (restart) resumes the completed 2->4
+    scale-out instead of silently shrinking back to genesis."""
+    labels = ("hostA", "hostB", "hostC", "hostD")
+    git, hosts, cluster = _build(tmp_path, labels=labels,
+                                 active=["hostA", "hostB"])
+    cluster.activate_host("hostC")
+    cluster.activate_host("hostD")
+    rebuilt = StormCluster(hosts, git)
+    assert sorted(rebuilt.active) == sorted(labels)
+    assert sorted(rebuilt.hosts_list()) == sorted(labels)

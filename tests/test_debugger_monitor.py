@@ -452,3 +452,36 @@ def test_megadoc_line_renders_write_scaleout_plane():
     line = json.loads(out.getvalue().strip())
     assert line["megadoc.total_lanes"] == 8.0
     assert line["megadoc.combiner_occupancy"] == 0.75
+
+
+def test_cluster_line_renders_placement_plane():
+    """Round-16 cluster line: silent without a placement directory,
+    then active hosts, docs on this host, migration count (windowed
+    rate) + in-flight gauge, viewer re-homes and the last migration's
+    blackout ms — and the line rides human watch mode."""
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_cluster
+
+    assert render_cluster({}) == ""  # no cluster directory → no line
+    m = {"cluster.hosts": 4.0,
+         "cluster.host_docs": 12.0,
+         "cluster.migrations": 9.0,
+         "cluster.migrations_in_flight": 1.0,
+         "cluster.last_blackout_ms": 23.5,
+         "viewer.rehomes": 3.0}
+    text = render_cluster(m)
+    assert "hosts 4" in text
+    assert "docs/host 12" in text
+    assert "migrations 9" in text
+    assert "in-flight 1" in text
+    assert "viewer re-homes 3" in text
+    assert "last blackout 23.5ms" in text
+    # Windowed migration rate over a 2s poll window.
+    windowed = render_cluster(m, {"cluster.migrations": 5.0},
+                              interval=2.0)
+    assert "(2.00/s)" in windowed
+    # Restart (negative window): cumulative count, no rate suffix.
+    assert "(" not in render_cluster(m, {"cluster.migrations": 99.0},
+                                     interval=1.0)
+    human = monitor.render_human(m, {}, interval=1.0)
+    assert "cluster: hosts 4" in human

@@ -643,3 +643,157 @@ def test_same_cohort_refnack_mark_replays_identically(tmp_path):
     assert mgr2.export_state() == live
     assert mgr2.map_entries(doc) == live_entries
     storm2._group_wal.close()
+
+
+# -- round-16 satellites: viewer frames, combine-log trim, re-promotion --------
+
+
+def _mega_serve(storm, doc, writers, rounds, r0=0, ref=-1):
+    """One frame per writer per round through the promoted tier
+    (``ref=-1`` rides the head so the doc MSN advances — the trim
+    horizon's input)."""
+    for r in range(r0, r0 + rounds):
+        for w, client in enumerate(writers):
+            storm.submit_frame(None, {
+                "rid": f"{r}.{w}",
+                "docs": [[doc, client, 1 + r * K, ref, K]]},
+                memoryview(storm_words(11, r, w).tobytes()))
+        storm.flush()
+
+
+def test_viewer_frames_keyed_by_parent_for_promoted_doc():
+    """ISSUE 13 satellite: viewer rooms key by the PARENT doc at
+    harvest, so per-tick viewer frames KEEP flowing for a promoted doc
+    (they used to pause — lane ids never matched the room) and carry
+    the combiner's doc-space windows, continuous across lanes."""
+    from fluidframework_tpu.protocol.codec import (
+        decode_storm_push,
+        is_storm_body,
+    )
+    from fluidframework_tpu.server.broadcaster import ViewerPlane
+
+    svc, storm, seq, mh, mgr = build_stack(lanes=2)
+    plane = ViewerPlane(svc)
+    doc = "mega-viewer"
+    writers = [svc.connect(doc, lambda m: None).client_id
+               for _ in range(2)]
+    svc.pump()
+    events = []
+
+    def push(p):
+        if isinstance(p, (bytes, bytearray, memoryview)) \
+                and is_storm_body(bytes(p)):
+            events.append(decode_storm_push(bytes(p)))
+
+    plane.join(doc, push)
+    mgr.promote(doc, lanes=2)
+    encodes0 = plane.stats["tick_encodes"]
+    rounds = 4
+    _mega_serve(storm, doc, writers, rounds)
+    ticks = [e for e in events if e.get("event") == "storm_tick"]
+    # Frames flowed (one encode per LANE batch per tick — L>1 means
+    # several doc-space windows per tick, never zero).
+    assert len(ticks) == 2 * rounds
+    assert plane.stats["tick_encodes"] - encodes0 == 2 * rounds
+    assert all(t["doc"] == doc for t in ticks)
+    # Doc-space continuity: the windows tile the doc's op seq range
+    # with no lane-space aliasing and the MSN column is doc-space.
+    seqs = sorted(s for t in ticks
+                  for s in range(t["first"], t["last"] + 1))
+    assert seqs == list(range(seqs[0], seqs[0] + 2 * rounds * K))
+
+
+def test_combine_log_trim_bounds_memory_with_exact_reads():
+    """ISSUE 13 satellite (ROADMAP mega residue): with
+    ``trim_combine_logs`` armed, a long promotion's per-lane segment
+    lists stay bounded by the collab window instead of growing one
+    segment per combined batch — while converged reads stay EXACT
+    (equal to an untrimmed twin serving the same frames) and catch-up
+    below the horizon fails with the reload-from-snapshot contract."""
+    doc = "mega-trim"
+
+    def play(trim):
+        svc, storm, seq, mh, mgr = build_stack(lanes=2)
+        mgr.trim_combine_logs = trim
+        writers = [svc.connect(doc, lambda m: None).client_id
+                   for _ in range(2)]
+        svc.pump()
+        mgr.promote(doc, lanes=2)
+        _mega_serve(storm, doc, writers, 24)
+        st = mgr.docs[doc]
+        return mgr, storm, st, mgr.map_entries(doc)
+
+    mgr_t, storm_t, st_t, entries_t = play(trim=True)
+    mgr_u, _storm_u, st_u, entries_u = play(trim=False)
+    # Exactness: trimmed ≡ untrimmed converged map.
+    assert entries_t == entries_u and entries_t
+    # Bounded memory: the untrimmed twin holds one segment per combined
+    # batch; the trimmed run holds a small suffix above the MSN floor.
+    untrimmed = sum(len(log.lane_firsts) for log in st_u.logs)
+    trimmed = sum(len(log.lane_firsts) for log in st_t.logs)
+    assert untrimmed == 48  # 2 writers x 24 rounds
+    assert trimmed <= 8, (trimmed, untrimmed)
+    assert any(log.floor_lane > 0 for log in st_t.logs)
+    # Recent catch-up (at/above the horizon) still serves...
+    floor_doc = max(log.floor_doc for log in st_t.logs)
+    recent = storm_t.records_overlapping(doc, floor_doc)
+    assert recent
+    # ...and below-horizon catch-up fails LOUDLY with the documented
+    # reload-from-snapshot contract, never a silent gap.
+    with pytest.raises(ValueError, match="reload from a snapshot"):
+        storm_t.records_overlapping(doc, 0)
+
+
+def test_re_promotion_epochs_match_never_promoted_twin(tmp_path):
+    """ISSUE 13 satellite: a demoted doc RE-promotes into a fresh lane
+    EPOCH (``::~mg1.<i>`` ids) — previously refused — and the full
+    two-cycle lifecycle converges byte-identical to a never-promoted
+    twin on entries, history and the sequencer checkpoint; a recovered
+    stack replays BOTH cycles identically."""
+    doc = "mega-epochs"
+
+    def digest(svc, storm, seq, mh):
+        cp = dataclasses.asdict(seq.checkpoint(doc))
+        cp.pop("log_offset", None)
+        for c in cp["clients"]:
+            c["last_update"] = 0
+        return {
+            "map": mh.map_entries(doc, storm.datastore, storm.channel),
+            "history": [[m.sequence_number, m.client_sequence_number,
+                         m.client_id]
+                        for m in svc.get_deltas(doc, 0)],
+            "sequencer": cp,
+        }
+
+    def play(root, promote):
+        svc, storm, seq, mh, mgr = build_stack(root, lanes=2)
+        writers = [svc.connect(doc, lambda m: None).client_id
+                   for _ in range(2)]
+        svc.pump()
+        storm.checkpoint()  # genesis: the recovery restore source
+        if promote:
+            mgr.promote(doc, lanes=2)
+            assert mgr.docs[doc].epoch == 0
+        _mega_serve(storm, doc, writers, 2, r0=0)
+        if promote:
+            mgr.demote(doc)
+            mgr.promote(doc, lanes=2)  # the re-promotion under test
+            assert mgr.docs[doc].epoch == 1
+            assert all("::~mg1." in lid for lid in mgr.lane_ids(doc))
+        _mega_serve(storm, doc, writers, 2, r0=2)
+        if promote:
+            mgr.demote(doc)
+        storm.flush()
+        return svc, storm, seq, mh, digest(svc, storm, seq, mh)
+
+    root = str(tmp_path / "cycles")
+    *_stack, cycled = play(root, promote=True)
+    *_twin, plain = play(str(tmp_path / "twin"), promote=False)
+    assert cycled == plain
+    # Recovery replays both promotion cycles from the WAL controls.
+    svc2, storm2, seq2, mh2, mgr2 = build_stack(root, lanes=2)
+    storm2.recover()
+    assert mgr2.has_history(doc) and not mgr2.is_promoted(doc)
+    assert mgr2.docs[doc].epoch == 1
+    assert mgr2.past_epochs[doc][0].epoch == 0
+    assert digest(svc2, storm2, seq2, mh2) == cycled

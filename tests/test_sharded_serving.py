@@ -357,3 +357,74 @@ def test_megadoc_lanes_match_single_row_twin(mesh):
     active_rows = {row for row in lanes.rows
                    if int(np.asarray(serving.seq_state.seq[row])) > 0}
     assert len(active_rows) > 1
+
+
+def test_shard_residency_live_migration_2_to_4_hosts(mesh):
+    """Live placement in the device-lane tier (ISSUE 13): genesis on 2
+    of 4 host ranges, activation + PlacementController rebalance moves
+    docs via the cold-record carrier — every value preserved, every
+    doc's row inside its NEW owner's range, genesis hashes never
+    silently re-route."""
+    from fluidframework_tpu.parallel.placement import PlacementController
+    from fluidframework_tpu.parallel.serving import ShardResidency
+
+    serving = ShardedServing(make_mesh(jax.devices()[:1]), num_docs=8,
+                             k=4, num_hosts=4, map_slots=8)
+    res = ShardResidency(serving, active_hosts=(0, 1))
+    docs = [f"doc-{i}" for i in range(8)]
+    want = {}
+    for i, doc in enumerate(docs):
+        row = res.resolve(doc)
+        assert res.host_for(doc) in (0, 1)
+        value = 10 + i
+        serving.submit(row, np.array([(value << 12) | (1 << 2)],
+                                     np.uint32), first_cseq=1)
+        serving.tick()
+        want[doc] = value
+    serving.flush()
+    before = {d: res.host_for(d) for d in docs}
+    res.activate_host(2)
+    res.activate_host(3)
+    # Activation alone must not re-route anything (sticky genesis).
+    assert {d: res.host_for(d) for d in docs} == before
+    ctrl = PlacementController(res, max_moves_per_round=8)
+    report = ctrl.rebalance()
+    assert report["converged"], report
+    assert set(report["docs_per_host"]) == {0, 1, 2, 3}
+    assert res.stats["migrations"] >= 2
+    assert len(res.blackouts_s) == res.stats["migrations"]
+    for doc in docs:
+        row = res.resolve(doc)
+        assert serving.hosts[res.host_for(doc)].owns(row)
+        got = int(np.asarray(serving.map_state.value)[row, 1])
+        assert got == want[doc], doc
+
+
+def test_shard_residency_migrate_refuses_pending_and_rolls_on(mesh):
+    """A doc with a pending (unticked) submission refuses migration —
+    tick first, then the move succeeds and serving resumes on the new
+    host with the doc's cseq dedup intact."""
+    from fluidframework_tpu.parallel.serving import ShardResidency
+
+    serving = ShardedServing(make_mesh(jax.devices()[:1]), num_docs=4,
+                             k=4, num_hosts=2, map_slots=8)
+    res = ShardResidency(serving)
+    doc = "doc-a"
+    row = res.resolve(doc)
+    src = res.host_for(doc)
+    dst = 1 - src
+    serving.submit(row, np.array([(5 << 12) | (1 << 2)], np.uint32),
+                   first_cseq=1)
+    with pytest.raises(ValueError):
+        res.migrate(doc, dst)
+    serving.tick()
+    serving.flush()
+    new_row = res.migrate(doc, dst)
+    assert res.host_for(doc) == dst
+    assert serving.hosts[dst].owns(new_row)
+    # Dedup survives the move: a verbatim resend sequences zero ops.
+    serving.submit(new_row, np.array([(5 << 12) | (1 << 2)], np.uint32),
+                   first_cseq=1)
+    harvest = serving.tick()
+    serving.flush()
+    assert int(np.asarray(serving.map_state.value)[new_row, 1]) == 5
