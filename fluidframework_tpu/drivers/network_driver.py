@@ -114,9 +114,16 @@ class NetworkDocumentService:
     def __init__(self, host: str, port: int, doc_id: str,
                  scopes=None, timeout: float = 30.0,
                  token: str | None = None,
-                 auto_dispatch: bool = True) -> None:
+                 auto_dispatch: bool = True,
+                 hosts: dict[str, tuple[str, int]] | None = None) -> None:
         self.doc_id = doc_id
         self._token = token
+        # Cluster address book: host label (the ``moved_to`` value the
+        # placement directory answers with) -> (host, port). A
+        # connect-time "moved" redirect redials the named owner
+        # directly; without an entry the error surfaces to the caller
+        # (who owns service discovery).
+        self.hosts = dict(hosts or {})
         self.storage = _NetworkSnapshotStorage(self)
         self.delta_storage = _NetworkDeltaStorage(self)
         self._scopes = scopes
@@ -382,6 +389,20 @@ class NetworkDocumentService:
                 from .utils import ThrottlingError
                 raise ThrottlingError("throttled by alfred",
                                       retry_after_s=resp["retry_after_s"])
+            if resp["error"] == "moved" and resp.get("moved_to"):
+                from .utils import DocumentMovedError
+                raise DocumentMovedError(
+                    f"doc served by {resp['moved_to']}",
+                    moved_to=resp["moved_to"],
+                    retry_after_s=resp.get("retry_after_s", 0.0))
+            if resp["error"] == "migrating":
+                # Mid-migration blackout: retryable after the hint (the
+                # route resolves to "moved" or back here once the
+                # directory flips).
+                from .utils import ThrottlingError
+                raise ThrottlingError(
+                    "doc mid-migration",
+                    retry_after_s=resp.get("retry_after_s", 0.05))
             raise RuntimeError(f"alfred error: {resp['error']}")
         return resp
 
@@ -402,8 +423,25 @@ class NetworkDocumentService:
             req["scopes"] = list(self._scopes)
         if self._token is not None:
             req["token"] = self._token
-        resp = self._request(req)
-        return _NetworkConnection(self, resp["client_id"])
+        from .utils import DocumentMovedError
+        for _hop in range(4):
+            try:
+                resp = self._request(req)
+            except DocumentMovedError as err:
+                # Connect-time cluster redirect: the placement directory
+                # named the owning host — redial IT (same session
+                # object, fresh socket) and re-issue the connect there.
+                # Unknown labels (no address-book entry) surface to the
+                # caller; a redirect chain is bounded (a directory flip
+                # racing the redial can bounce once, never forever).
+                addr = self.hosts.get(err.moved_to)
+                if addr is None:
+                    raise
+                self._addr = tuple(addr)
+                self.reconnect()
+                continue
+            return _NetworkConnection(self, resp["client_id"])
+        raise ConnectionError("connect redirect chain did not converge")
 
     # -- agent control surface (headless runner ↔ foreman over the wire) -------
 

@@ -51,6 +51,17 @@ class ThrottlingError(DriverError):
                          retry_after_s=retry_after_s)
 
 
+class DocumentMovedError(DriverError):
+    """Connect-time redirect (live cluster migration): the doc is served
+    by ``moved_to`` — redial THAT host, don't retry this one."""
+
+    def __init__(self, message: str, moved_to: str,
+                 retry_after_s: float = 0.0) -> None:
+        super().__init__(message, can_retry=True,
+                         retry_after_s=retry_after_s)
+        self.moved_to = moved_to
+
+
 class ReconnectPolicy:
     """Reconnect pacing: exponential backoff with full jitter, honoring
     server ``retry_after_s`` hints (deltaManager.ts reconnect delays +

@@ -168,6 +168,9 @@ class RequestSession:
                 self.tenant_id = claims.get("tenantId", "default")
             elif req.get("scopes") is not None:
                 kwargs["scopes"] = tuple(req["scopes"])
+            redirect = self._placement_redirect(rid)
+            if redirect is not None:
+                return redirect
             admission = self.server.admission
             if admission is not None:
                 # The client-tier key is the driver's stable per-client
@@ -300,7 +303,8 @@ class RequestSession:
             viewers = getattr(service, "viewers", None)
             if viewers is None or self.viewer_id is None:
                 return {"rid": rid, "error": "no viewer session"}
-            retry = viewers.admit_join(self.doc_id, req.get("client_key"))
+            retry = viewers.admit_join(self.doc_id, req.get("client_key"),
+                                       tenant_id=self.tenant_id)
             if retry is not None:
                 return {"rid": rid, "error": "throttled",
                         "retry_after_s": retry}
@@ -313,6 +317,29 @@ class RequestSession:
             storm.flush()
             return {"rid": rid, "ok": True}
         return {"rid": rid, "error": f"unknown op {op!r}"}
+
+    def _placement_redirect(self, rid) -> dict | None:
+        """Cluster-aware connect (ROADMAP item 2 residue): consult the
+        placement directory so a client dialing the wrong host learns
+        the owner AT CONNECT TIME (``moved_to``) instead of connecting
+        locally and only discovering the move from per-frame nacks; a
+        doc mid-migration answers "migrating" with the blackout hint.
+        Runs AFTER token validation (and claims the tenant) — placement
+        is cluster topology, and an unauthenticated prober must not
+        enumerate doc→host mappings through the connect path."""
+        placement = getattr(getattr(self.server.service, "storm", None),
+                            "placement", None)
+        if placement is None:
+            return None
+        code, owner = placement.route(self.doc_id)
+        if code == "moved":
+            return {"rid": rid, "error": "moved", "retryable": True,
+                    "moved_to": owner,
+                    "retry_after_s": placement.retry_after_s}
+        if code == "migrating":
+            return {"rid": rid, "error": "migrating", "retryable": True,
+                    "retry_after_s": placement.retry_after_s}
+        return None
 
     def _connect_viewer(self, req: dict, rid) -> dict:
         """``mode="viewer"`` connect (the broadcast viewer plane,
@@ -346,7 +373,11 @@ class RequestSession:
             claims = self.server.tenants.validate_token(
                 token, document_id=self.doc_id)
             self.tenant_id = claims.get("tenantId", "default")
-        retry = viewers.admit_join(self.doc_id, req.get("client_key"))
+        redirect = self._placement_redirect(rid)
+        if redirect is not None:
+            return redirect
+        retry = viewers.admit_join(self.doc_id, req.get("client_key"),
+                                   tenant_id=self.tenant_id)
         if retry is not None:
             return {"rid": rid, "error": "throttled",
                     "retry_after_s": retry}

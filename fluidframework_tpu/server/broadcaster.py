@@ -113,6 +113,8 @@ class ViewerPlane:
                  metrics: MetricsRegistry | None = None,
                  join_rate_per_s: float = 2000.0,
                  join_burst: float | None = None,
+                 tenant_join_rate_per_s: float | None = None,
+                 tenant_join_burst: float | None = None,
                  max_lag_frames: int = 256,
                  transport_lag_frames: int = 1024,
                  roster_sample: int = 16,
@@ -137,6 +139,17 @@ class ViewerPlane:
         # residency-hydration pattern — a refusal debits once; the
         # client claims the slot by returning at/after the hint).
         self.joins = TokenBucket(join_rate_per_s, join_burst, clock=clock)
+        # Per-tenant viewer-join budget (the round-17 noisy-neighbor
+        # extension of the read side): one tenant's 100k-viewer event
+        # must not consume the whole PLANE's join budget and lock every
+        # other tenant's viewers out. Stacked UNDER the plane bucket —
+        # the tenant tier debits first, and a plane-tier refusal refunds
+        # it (the AdmissionController two-tier pattern). None (default)
+        # = plane-wide gating only, the pre-QoS behavior.
+        self.tenant_joins = (
+            TokenBucket(tenant_join_rate_per_s, tenant_join_burst,
+                        clock=clock)
+            if tenant_join_rate_per_s is not None else None)
         self._reservations: dict[tuple[str, str], float] = {}
         self._next_vid = 1
         self._viewers: dict[str, _Viewer] = {}
@@ -189,18 +202,47 @@ class ViewerPlane:
 
     # -- join / leave ----------------------------------------------------------
 
+    def _tenant_nack(self, tenant_id: str | None, retry: float) -> float:
+        self.stats["join_nacks"] += 1
+        if tenant_id is not None:
+            self.metrics.counter(
+                f"viewer.tenant.{tenant_id}.join_nacks").inc()
+        return retry
+
     def admit_join(self, doc_id: str,
-                   client_key: str | None = None) -> float | None:
+                   client_key: str | None = None,
+                   tenant_id: str | None = None) -> float | None:
         """Viewer-join admission (the storm gate for 100k viewers
         arriving at a live event's start): None admits; a refusal
         returns ``retry_after_s`` and — when ``client_key`` is given —
-        reserves a claimable slot so the retry never re-debits."""
+        reserves a claimable slot so the retry never re-debits.
+        ``tenant_id`` (the SESSION's validated tenant) additionally
+        debits that tenant's join budget when one is configured — a
+        plane-tier refusal refunds it."""
+        # Claims are namespaced by tenant: client_key is CLIENT-
+        # controlled, so a reservation must only be claimable by the
+        # tenant that paid for it — a cross-tenant claim on a guessed
+        # key would admit past an exhausted tenant budget for free and
+        # steal the payer's slot.
+        rkey = None
+        if client_key is not None:
+            rkey = (doc_id, client_key if tenant_id is None
+                    else f"{tenant_id}:{client_key}")
+        if self.tenant_joins is not None and tenant_id is not None:
+            if rkey is None or rkey not in self._reservations:
+                # A claim of an existing reservation already paid the
+                # tenant tier when it was reserved — never re-debit it.
+                retry = self.tenant_joins.try_consume(
+                    f"tenant/{tenant_id}")
+                if retry is not None:
+                    return self._tenant_nack(tenant_id, retry)
         if client_key is None:
             retry = self.joins.try_consume(f"viewers/{doc_id}")
             if retry is not None:
                 self.stats["join_nacks"] += 1
+                if self.tenant_joins is not None and tenant_id is not None:
+                    self.tenant_joins.refund(f"tenant/{tenant_id}")
             return retry
-        rkey = (doc_id, client_key)
         reserved_at = self._reservations.get(rkey)
         now = self._clock()
         if reserved_at is not None:
@@ -222,7 +264,14 @@ class ViewerPlane:
         retry, reserved = self.joins.reserve(f"viewers/{doc_id}")
         if retry is not None:
             if reserved:
+                # The slot IS claimable later — the tenant debit stands
+                # and covers the claim (which never re-debits).
                 self._reservations[rkey] = now + retry
+            elif self.tenant_joins is not None and tenant_id is not None:
+                # Horizon-full refusal: nothing stayed debited on the
+                # plane tier, so nothing may stay debited on the tenant
+                # tier either (the retry pays both afresh).
+                self.tenant_joins.refund(f"tenant/{tenant_id}")
             self.stats["join_nacks"] += 1
         return retry
 

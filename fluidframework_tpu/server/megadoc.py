@@ -855,6 +855,13 @@ class MegaDocManager:
                 w.ref = new_ref
                 w.clu = event["ts"]
                 w.nack = True
+            elif op == "member":
+                # Re-apply a promotion-window CLIENT_JOIN/LEAVE at the
+                # identical WAL position (the bus holds the op itself
+                # for history; row/mirror state rebuilds from here — a
+                # bus-side re-sequence of an already-active client is an
+                # IGNORED dup-join, so the two replay domains compose).
+                self._apply_member(event)
             else:
                 raise ValueError(f"unknown megadoc control {op!r}")
         finally:
@@ -882,6 +889,103 @@ class MegaDocManager:
             docs[i] = (lane_id(doc, lane, st.epoch), client, cseq0, ref,
                        count)
         return infos
+
+    # -- promotion-window membership (round-17 satellite) ----------------------
+    #
+    # ROADMAP item 3 residue: a CLIENT_JOIN/LEAVE that lands while the
+    # doc is promoted used to sequence on the FROZEN doc row — a stale
+    # doc seq that collides with the lane-combined stream, discarded at
+    # demotion (adopt-without-sequence). Routerlicious now routes
+    # membership ops through this seam: the doc row is fast-forwarded to
+    # the combiner mirror's head (seq/msn + every active writer's
+    # doc-space cseq/ref), the op sequences at mirror.seq + 1 through
+    # the NORMAL deli path (history, quorum and audience all see it),
+    # and the mirror absorbs the outcome + journals a control record so
+    # replay re-applies it at the identical WAL position — promoted ≡
+    # single-lane holds for membership churn too (the join-mid-promotion
+    # differential test pins it).
+
+    def _sync_doc_row(self, doc: str) -> None:
+        """Pin the (frozen) doc sequencer row to the mirror's doc-space
+        head — the demotion restore, run early so a membership op
+        sequences at the doc's TRUE head instead of the stale
+        at-promotion seq."""
+        st = self.docs[doc]
+        self.storm.seq_host.restore(
+            doc, st.mirror.checkpoint(
+                self.storm.seq_host.DEFAULT_TIMEOUT_MS))
+
+    def intercept_membership(self, doc: str, raw) -> bool:
+        """Pre-order hook for one CLIENT_JOIN/LEAVE: False for
+        unpromoted docs (the caller proceeds unintercepted). For a
+        promoted doc: settle the pipeline (the mirror's head must be
+        final, and the control journaled later must land after every
+        already-composed tick's record), then fast-forward the doc row
+        so the deli path stamps the op the correct doc seq."""
+        if not self.is_promoted(doc):
+            return False
+        if self.storm._in_round:
+            # Idle-eject cadence firing INSIDE a storm round (the pump
+            # the round runs drains the eject path): the pipeline cannot
+            # settle mid-round. Fall back to the legacy adopt-at-decide
+            # semantics for this one op rather than recurse into the
+            # round being assembled.
+            return False
+        self.storm.flush()
+        self._sync_doc_row(doc)
+        return True
+
+    def complete_membership(self, doc: str, raw) -> None:
+        """Post-sequence hook (the service pumped the intercepted op):
+        absorb the outcome into the mirror + lane rows and journal the
+        ``"member"`` control so recovery re-applies it identically."""
+        from ..protocol.messages import MessageType
+        storm = self.storm
+        cp = storm.seq_host.checkpoint(doc)
+        join = raw.type == MessageType.CLIENT_JOIN
+        client = (getattr(raw.data, "client_id", raw.data) if join
+                  else raw.data)
+        event = {"op": "member", "doc": doc, "client": str(client),
+                 "join": bool(join), "ts": raw.timestamp,
+                 "seq": cp.sequence_number,
+                 "msn": cp.minimum_sequence_number,
+                 "lsm": cp.last_sent_msn}
+        if join:
+            event["can_summarize"] = bool(raw.can_summarize)
+            event["can_evict"] = bool(raw.can_evict)
+        self._append_control(event, raw.timestamp)
+        self._apply_member(event)
+
+    def _apply_member(self, event: dict) -> None:
+        """One journaled membership event into the mirror (+ the lane
+        and doc rows) — shared by the live path and WAL replay, so both
+        converge on identical state. The doc-space scalars come from the
+        RECORD (the sequenced outcome), never recomputed."""
+        st = self.docs[event["doc"]]
+        m = st.mirror
+        client = event["client"]
+        m.seq = event["seq"]
+        m.msn = event["msn"]
+        m.last_sent_msn = event["lsm"]
+        w = m.writers.get(client)
+        if event["join"]:
+            if w is None or not w.active:
+                w = m.adopt(client, st.lanes, event["ts"])
+            w.summarize = bool(event.get("can_summarize", True))
+            w.evict = bool(event.get("can_evict", True))
+            w.clu = event["ts"]
+            self._sync_lane_row(event["doc"], w.lane)
+        elif w is not None and w.active:
+            # Retire: drop the writer's cref from the MSN tracking (the
+            # removal half of _track_ref) — the recorded msn above
+            # already reflects the post-leave minimum.
+            w.active = False
+            m._ref_counts[w.ref] = m._ref_counts.get(w.ref, 1) - 1
+            self._sync_lane_row(event["doc"], w.lane)
+        # Pin the doc row to the post-membership mirror state: the live
+        # path just sequenced on it, replay never did — the restore
+        # makes both byte-identical.
+        self._sync_doc_row(event["doc"])
 
     def observe_writers(self, docs: list[tuple]) -> None:
         """Auto-promotion signal: distinct writers per doc over a

@@ -752,6 +752,23 @@ class RouterliciousService:
     def _connections_for(self, doc_id: str) -> dict[str, _LiveConnection]:
         return self._connections.setdefault(doc_id, {})
 
+    def _order_membership(self, doc_id: str, raw: RawOperation) -> None:
+        """Order one CLIENT_JOIN/LEAVE system op — through the mega-doc
+        membership seam when the doc is promoted (the frozen doc row's
+        head is stale; the mirror fast-forwards it, the op sequences at
+        the TRUE doc head through the normal deli path below, and the
+        mirror absorbs + journals the outcome), straight to the orderer
+        otherwise. Promoted-doc membership forces an immediate pump:
+        the mirror must see the sequenced outcome before any later lane
+        frame combines against it."""
+        mega = getattr(self.storm, "megadoc", None)
+        if mega is not None and mega.intercept_membership(doc_id, raw):
+            self.orderer.order_system(doc_id, raw)
+            self.pump()
+            mega.complete_membership(doc_id, raw)
+            return
+        self.orderer.order_system(doc_id, raw)
+
     def _maybe_pump(self) -> None:
         """Front-door writes pump inline only in auto mode; batched-cadence
         deployments pump on their own tick (the load harness / operator)."""
@@ -808,7 +825,7 @@ class RouterliciousService:
         for doc_id, client_id in ejected:
             self.logger.send_event("IdleClientEjected", docId=doc_id,
                                    clientId=client_id)
-            self.orderer.order_system(doc_id, RawOperation(
+            self._order_membership(doc_id, RawOperation(
                 client_id=None,
                 type=MessageType.CLIENT_LEAVE,
                 data=client_id,
@@ -916,7 +933,7 @@ class RouterliciousService:
                                clientId=client_id, mode=mode)
         self._announce_audience(doc_id, connection)
         if mode != "read":
-            self.orderer.order_system(doc_id, RawOperation(
+            self._order_membership(doc_id, RawOperation(
                 client_id=None,
                 type=MessageType.CLIENT_JOIN,
                 data=ClientDetail(client_id=client_id, mode=mode,
@@ -970,7 +987,7 @@ class RouterliciousService:
                                clientId=client_id)
         if connection is not None and connection.mode == "read":
             return
-        self.orderer.order_system(doc_id, RawOperation(
+        self._order_membership(doc_id, RawOperation(
             client_id=None,
             type=MessageType.CLIENT_LEAVE,
             data=client_id,

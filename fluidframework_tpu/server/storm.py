@@ -121,6 +121,8 @@ class _Frame(NamedTuple):
     trace: Any = None   # (client tc, session scope) tracer key or None
     staged_ns: tuple = (0, 0)  # (decode, admit) ns refunded on shed
     mega: Any = None    # per-entry mega-doc descriptors (megadoc.py)
+    tenant: str = "default"  # session-validated tenant (QoS composition)
+    t0: int = 0         # ingress monotonic ns (per-tenant ack latency)
 
 
 def _map_leg(map_state: mk.MapState, words, lo, hi, seq0_for):
@@ -421,6 +423,9 @@ class StormController:
                  busy_retry_s: float = 0.05,
                  doc_index_retention_ticks: int | None = None,
                  wal_commit_latency_s: float = 0.0,
+                 tenant_weights: dict[str, float] | None = None,
+                 tick_slot_budget: int | None = None,
+                 qos_borrow_fraction: float = 0.5,
                  logger=None) -> None:
         self.service = service
         self.seq_host = seq_host
@@ -536,6 +541,24 @@ class StormController:
         if admission is not None and max_pending_docs is not None:
             admission.add_pressure_probe(
                 lambda: self._pending_docs / max(1, self.max_pending_docs))
+        # Multi-tenant QoS plane (server/qos.py, the round-17 tentpole):
+        # deficit-weighted fair tick composition over per-tenant pending
+        # queues. ``tick_slot_budget`` bounds one tick's doc slots (None
+        # = unbounded — composition then only orders, exactly the
+        # legacy cohort for single-tenant serving); ``tenant_weights``
+        # configures relative shares (unlisted tenants weigh 1.0).
+        # Scheduler state (deficits + rotation) rides every
+        # multi-tenant tick's WAL header and the snapshot, so recovery
+        # resumes composing exactly where the crash stopped.
+        from .qos import TenantScheduler
+        self.qos = TenantScheduler(weights=tenant_weights,
+                                   registry=merge_host.metrics)
+        self.tick_slot_budget = tick_slot_budget
+        # Weighted-shed borrow threshold: a tenant past its weighted
+        # pending share still buffers while the GLOBAL queue is below
+        # this fraction of max_pending_docs (work conservation); past
+        # it, the over-share tenant sheds first.
+        self.qos_borrow_fraction = qos_borrow_fraction
         # Quarantine plane: doc -> {"reason", "tick"}. A quarantined doc
         # is frozen out of cohorts (submits nack retryable) and serves
         # reads through the scalar record fold until readmit_doc().
@@ -753,9 +776,13 @@ class StormController:
             self.megadoc.observe_writers(docs)
             mega = self.megadoc.ingress_frame(docs)
         self._frames.append(_Frame(push, header.get("rid"), docs, words,
-                                   counts, meta, trace, staged, mega))
+                                   counts, meta, trace, staged, mega,
+                                   tenant_id, ingress_ns))
         self._pending_docs += len(docs)
         self.stats["submitted_ops"] += offset
+        if not self._replay:
+            self.qos.note_submitted(tenant_id, offset)
+            self.qos.note_buffered(tenant_id, len(docs))
         if self._pending_docs >= self.flush_threshold_docs:
             # Threshold-triggered: only run FULL rounds; a partial tail
             # (next tick's early frames) waits for its cohort instead of
@@ -817,10 +844,30 @@ class StormController:
             cooldown = self._group_wal.breaker.cooldown_s
             return self._shed(push, header, n_ops, "degraded",
                               max(cooldown, self.busy_retry_s))
-        if (self.max_pending_docs is not None
-                and self._pending_docs + len(docs) > self.max_pending_docs):
-            return self._shed(push, header, n_ops, "busy",
-                              self.busy_retry_s)
+        if self.max_pending_docs is not None:
+            n = len(docs)
+            cap = self.qos.pending_cap(tenant_id, self.max_pending_docs)
+            # Shed when the GLOBAL bound is hit (nobody may buffer past
+            # it), or — weighted shed — when THIS tenant is past its
+            # weighted pending share while the global queue is past the
+            # borrow threshold: the over-share tenant sheds first, and
+            # borrowing beyond the share is free only while the queue
+            # is shallow. Either way the busy-nack's retry hint is
+            # per-tenant, scaled by the tenant's OWN backlog relative
+            # to its share — the abuser backs off hardest.
+            over_global = self._pending_docs + n > self.max_pending_docs
+            over_share = (
+                cap is not None
+                and self.qos.pending_docs.get(tenant_id, 0) + n > cap
+                and self._pending_docs + n > self.max_pending_docs
+                * self.qos_borrow_fraction)
+            if over_global or over_share:
+                self.qos.note_shed(tenant_id, n_ops)
+                return self._shed(
+                    push, header, n_ops, "busy",
+                    self.qos.shed_hint(tenant_id, self.busy_retry_s,
+                                       self.max_pending_docs),
+                    tenant=tenant_id)
         if self.admission is not None:
             retry = self.admission.admit_write(tenant_id, client_id,
                                                weight=n_ops)
@@ -857,10 +904,14 @@ class StormController:
               retry_after_s: float, docs: list | None = None,
               quarantined: list | None = None,
               retryable: bool = True,
-              moved_to: dict | None = None) -> float:
+              moved_to: dict | None = None,
+              tenant: str | None = None) -> float:
         self.stats["shed_frames"] += 1
         self.stats["shed_ops"] += n_ops
         self.merge_host.metrics.counter("storm.shed_ops").inc(n_ops)
+        if tenant is not None:
+            self.merge_host.metrics.counter(
+                f"storm.tenant.{tenant}.shed_frames").inc()
         if push is not None:
             nack = {"rid": header.get("rid"), "storm": True,
                     "error": code, "retryable": retryable,
@@ -979,6 +1030,12 @@ class StormController:
                 if frame.trace is not None:
                     self.tracer.mark(frame.trace, "durable", t_drain)
                     self._stamp_trace_ack(frame, payload)
+                if frame.t0:
+                    # Per-tenant SLO surface: submit→durable-ack latency
+                    # into the tenant's ack histogram (get_metrics
+                    # exports p50/p99; render_tenants renders them).
+                    self.qos.observe_ack(frame.tenant,
+                                         (t_drain - frame.t0) / 1e9)
                 frame.push(payload)
 
     def _push_synth_acks(self, acks: list, mega_plans: dict) -> None:
@@ -1059,46 +1116,63 @@ class StormController:
         finally:
             self._in_round = False
 
-        taken: set[str] = set()
-        blocked_parents: set[str] = set()
-        selected: list[_Frame] = []
-        deferred: list[_Frame] = []
-        for frame in frames:
-            fdocs = {doc for doc, *_ in frame.docs}
-            # Mega FIFO fence: once any frame of a promoted doc defers
-            # (lane collision), every LATER frame of that doc defers too
-            # — the combiner stamps doc seqs in cohort order, and taking
-            # a later lane's frame past a deferred earlier one would
-            # reorder the doc's total order relative to the single-lane
-            # path (the sharded ≡ single-lane bar). Each tick therefore
-            # serves a PREFIX of the doc's pending frames with distinct
-            # lanes — up to L per tick instead of one.
-            parents = (set() if frame.mega is None else
-                       {info["doc"] for info in frame.mega
-                        if info is not None})
-            if not taken.isdisjoint(fdocs) \
-                    or not blocked_parents.isdisjoint(parents):
-                deferred.append(frame)
-                blocked_parents |= parents
-                continue
-            taken |= fdocs
-            selected.append(frame)
+        # Tick composition is the QoS seam (server/qos.py): the deficit
+        # round robin drains per-tenant queues by weight into the tick's
+        # doc slots — an abusive tenant saturates only its own share.
+        # The plan keeps the two hard ordering rules: one frame per doc
+        # per tick (per-doc FIFO — a colliding frame stays buffered),
+        # and the mega FIFO fence (once any frame of a promoted doc is
+        # passed over, every LATER frame of that doc is too — the
+        # combiner stamps doc seqs in cohort order, and taking a later
+        # lane's frame past a deferred earlier one would reorder the
+        # doc's total order relative to the single-lane path). A
+        # single-tenant compose with no slot budget reduces exactly to
+        # the legacy first-come scan.
+        if self._replay:
+            # Replay never re-composes: the recorded cohort IS the
+            # composition (one frame per replayed tick), and scheduler
+            # state comes from the tick headers — a synthetic replay
+            # frame must not register phantom tenants.
+            qplan = {"selected": frames, "kept": [], "charge": {},
+                     "slices": {}, "quantum": None}
+        else:
+            qplan = self.qos.compose(frames, self.tick_slot_budget)
+        selected: list[_Frame] = qplan["selected"]
+        kept: list[_Frame] = qplan["kept"]
+        # A slot budget below the flush threshold caps every cohort
+        # under it — a full-budget tick IS a full round then, or
+        # threshold-triggered flushing would decline forever.
+        full_bar = self.flush_threshold_docs \
+            if self.tick_slot_budget is None \
+            else min(self.flush_threshold_docs, self.tick_slot_budget)
         if require_full and sum(len(f.docs) for f in selected) \
-                < self.flush_threshold_docs:
+                < full_bar:
             # Undersized cohort: put everything back; the idle drain (or
             # the cohort completing) will run it. No mega decision has
-            # run yet (decisions happen only below, on a committed
-            # cohort), so re-buffering is side-effect free.
+            # run yet and the scheduler plan was NOT committed, so
+            # re-buffering is side-effect free.
             self._frames = frames + self._frames
             self._pending_docs += sum(len(f.docs) for f in frames)
             return False
-        # A deferred frame's staged decode/admit ns is consumed by THIS
+        self.qos.commit(qplan)
+        if not self._replay:
+            # Chaos kill class "mid-composition": scheduler state moved
+            # (deficits charged, rotation advanced) but the tick neither
+            # dispatched nor journaled. Recovery restores the scheduler
+            # from the last durable tick's header; the selected frames
+            # come back via client resend and recompose deterministically
+            # against that state — the single-tenant twin diff proves
+            # fairness never changes converged replica state.
+            faults.crashpoint("storm.qos_mid_compose")
+        # A kept frame's staged decode/admit ns is consumed by THIS
         # round's record (it was already pooled) — zero it on the frame
         # so a later quarantine shed refunds exactly what is still
         # staged, never double-subtracting.
         self._frames.extend(f._replace(staged_ns=(0, 0))
-                            for f in deferred)
-        self._pending_docs += sum(len(f.docs) for f in deferred)
+                            for f in kept)
+        self._pending_docs += sum(len(f.docs) for f in kept)
+        if not self._replay:
+            self.qos.reset_pending(self._frames)
         # HARVEST-FIRST (the round-14 pipelining order): settle the due
         # tick BEFORE staging this one, so its readback is taken the
         # moment it matters and its WAL append reaches the writer thread
@@ -1300,7 +1374,15 @@ class StormController:
             out=(n_seq, first, last, msn, bad, kstats), start=round_start,
             start_ns=t_scatter0, depth=self.pipeline_depth,
             stage_ns=stage_ns, queue_depth=queue_depth,
-            mega_rows=mega_rows or None, mega_plans=mega_plans or None)
+            mega_rows=mega_rows or None, mega_plans=mega_plans or None,
+            # Scheduler state AS OF this tick's composition (harvest may
+            # run rounds later under pipelining — the WAL header must
+            # journal the state the tick was composed against, so replay
+            # restores it at the identical point) + the per-tenant slot
+            # slices for the windowed attribution ring.
+            qos_state=(None if self.qos.is_trivial()
+                       else self.qos.export_state()),
+            qos_slices=qplan["slices"] or None)
         for out_arr in rec["out"]:
             copy_async = getattr(out_arr, "copy_to_host_async", None)
             if copy_async is not None:
@@ -1599,9 +1681,16 @@ class StormController:
         import json as _json
         import struct as _struct
 
-        header = _json.dumps({"v": STORM_WAL_VERSION, "ts": now,
-                              "docs": header_docs},
-                             separators=(",", ":")).encode()
+        hdr: dict = {"v": STORM_WAL_VERSION, "ts": now,
+                     "docs": header_docs}
+        if rec.get("qos_state") is not None:
+            # Multi-tenant scheduler state as of this tick's composition
+            # (single-tenant headers stay byte-compatible with every
+            # pre-QoS reader/golden — the field simply never appears).
+            # Replay imports it tick by tick, so a recovered host's
+            # deficits equal the crashed host's at the durable frontier.
+            hdr["qos"] = rec["qos_state"]
+        header = _json.dumps(hdr, separators=(",", ":")).encode()
         prefix = _struct.pack("<I", len(header)) + header
         if self._replay:
             pass  # the blob IS the replay source; never re-persist it
@@ -1671,6 +1760,15 @@ class StormController:
             kstats[KSTAT_REBALANCE_FIRED])
         kmetrics.counter("storm.device.blocks_touched").inc(
             kstats[KSTAT_BLOCKS_TOUCHED])
+        if not replaying and rec.get("qos_slices"):
+            # Per-tenant slice of this tick: doc slots from the compose
+            # plan, sequenced ops from the harvested ack matrix — the
+            # windowed share attribution render_tenants reads.
+            seq_by_t: dict[str, int] = {}
+            for frame, i0, i1 in rec["acks"]:
+                seq_by_t[frame.tenant] = seq_by_t.get(frame.tenant, 0) \
+                    + int(sum(ns_l[i0:i1]))
+            self.qos.note_tick(tick_id, rec["qos_slices"], seq_by_t)
         done = _time.perf_counter()
         self.tick_seconds.append(done - rec["start"])
         if self._last_harvest is not None:
@@ -1761,11 +1859,15 @@ class StormController:
             self._drain_durable_acks()
         else:
             dw = self.durable_watermark
+            t_ack_tx = _time.monotonic_ns()
             for frame, payload in acks:
                 faults.crashpoint("storm.pre_ack")
                 payload["dw"] = dw
                 if frame.trace is not None:
                     self._stamp_trace_ack(frame, payload)
+                if frame.t0:
+                    self.qos.observe_ack(frame.tenant,
+                                         (t_ack_tx - frame.t0) / 1e9)
                 frame.push(payload)
 
     # -- snapshot / recovery ---------------------------------------------------
@@ -1835,6 +1937,12 @@ class StormController:
                 # are sequencer docs) and the merge-host export; this is
                 # the combiner's host state (mirrors + combine logs).
                 snap["megadoc"] = self.megadoc.export_state()
+            if not self.qos.is_trivial():
+                # Fair-composition state (deficits + rotation): restored
+                # at recover() and then rolled forward by the WAL tail's
+                # per-tick "qos" headers — deficit counters survive
+                # restarts exactly like the cohort machinery.
+                snap["qos"] = self.qos.export_state()
             handle = self.snapshots.upload(self.SNAPSHOT_DOC, snap)
             faults.crashpoint("snapshot.pre_publish")
             self.snapshots.set_head(self.SNAPSHOT_DOC, handle)
@@ -1873,6 +1981,8 @@ class StormController:
                             "snapshot holds mega-doc combiner state but "
                             "no MegaDocManager is attached")
                     self.megadoc.import_state(snap["megadoc"])
+                if snap.get("qos") is not None:
+                    self.qos.import_state(snap["qos"])
                 start = snap["tick_watermark"]
                 restored_from = head
                 if self.residency is not None:
@@ -1933,6 +2043,11 @@ class StormController:
             for tick in range(start, end):
                 blob = self._read_blob(tick)
                 header, off = self._parse_header(blob)
+                if header.get("qos") is not None:
+                    # Roll the scheduler forward to the state this tick
+                    # was composed against (composition itself is NOT
+                    # re-run — the recorded cohort IS the composition).
+                    self.qos.import_state(header["qos"])
                 mg = header.get("mg")
                 if mg is not None:
                     # Mega-doc lifecycle control record: re-apply the
@@ -2033,8 +2148,9 @@ class StormController:
                        sum(n for *_, n in frame.docs), "quarantined",
                        self.busy_retry_s,
                        docs=[d for d, *_ in frame.docs],
-                       quarantined=[doc_id])
+                       quarantined=[doc_id], tenant=frame.tenant)
         self._frames = kept
+        self.qos.reset_pending(self._frames)
 
     def quarantined_map_entries(self, doc_id: str) -> dict:
         """Scalar-engine serving for a quarantined doc: fold the durable

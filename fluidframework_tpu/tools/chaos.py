@@ -129,11 +129,31 @@ CLUSTER_HOSTS = ("hostA", "hostB")
 OVERLAP_KILL_POINTS = ("storm.overlap_dispatch", "storm.readback_pre_wal",
                        "storm.overlap_fsynced")
 
+#: Multi-tenant QoS kill classes (ISSUE 14): the child serves THREE
+#: tenants — one abusive at 10x the others' offered doc slots — through
+#: the deficit-round-robin composer with a per-tick slot budget, so one
+#: workload round spans SEVERAL budget-limited ticks and the scheduler
+#: state (deficits + rotation) moves between them. Each point kills a
+#: distinct window: mid-composition (scheduler charged, tick neither
+#: dispatched nor journaled — the frames come back via client resend
+#: and recompose against the WAL-restored deficits), mid-tick (device
+#: state moved, nothing durable), and pre-fsync (records appended, not
+#: durable). The TWIN is tenant-BLIND (same frames, one tenant, no
+#: weights, no budget): digest equality proves kill-recovery AND that
+#: fair composition never changes converged replica state — fairness
+#: moves latency, never bytes.
+QOS_KILL_POINTS = ("storm.qos_mid_compose", "storm.mid_tick",
+                   "wal.pre_fsync")
+
+#: QoS-child tenants; the first is the abuser (10x doc groups).
+QOS_TENANTS = ("tn-abuser", "tn-b", "tn-c")
+QOS_ABUSE_FACTOR = 10
+
 
 # -- child process (the serving host under test) ------------------------------
 
 
-def _build_stack(data_dir: str, num_docs: int):
+def _build_stack(data_dir: str, num_docs: int, **storm_kw):
     from ..server.durable_store import (
         DurableMessageBus,
         FileStateStore,
@@ -154,10 +174,12 @@ def _build_stack(data_dir: str, num_docs: int):
         store=FileStateStore(os.path.join(data_dir, "state")),
         merge_host=merge_host, batched_deli_host=seq_host,
         auto_pump=False, idle_check_interval=10**9)
+    storm_kw.setdefault("flush_threshold_docs", 1)
     storm = StormController(
-        service, seq_host, merge_host, flush_threshold_docs=1,
+        service, seq_host, merge_host,
         spill_dir=os.path.join(data_dir, "spill"), durability="group",
-        snapshots=GitSnapshotStore(os.path.join(data_dir, "git")))
+        snapshots=GitSnapshotStore(os.path.join(data_dir, "git")),
+        **storm_kw)
     # Always attached: recovery of a WAL holding mega-doc control
     # records requires a manager, and an idle manager costs one None
     # check per hook.
@@ -337,6 +359,87 @@ def _digest(service, storm, seq_host, merge_host, docs: list[str],
     return out
 
 
+def _qos_docs(g: int) -> dict[str, list[str]]:
+    """Tenant -> owned docs: the abuser owns ``QOS_ABUSE_FACTOR`` doc
+    groups of ``g``, the victims one group each — so per round the
+    abuser offers 10x the victims' doc slots."""
+    out: dict[str, list[str]] = {}
+    for ti, tenant in enumerate(QOS_TENANTS):
+        groups = QOS_ABUSE_FACTOR if ti == 0 else 1
+        out[tenant] = [f"chaos-{tenant}-{i}" for i in range(groups * g)]
+    return out
+
+
+def _qos_child(args) -> None:
+    """One multi-tenant serving life (``--qos fair|blind``): three
+    tenants, the first at 10x, one frame per doc group per round,
+    settled by a forced flush whose budget-limited rounds step the
+    deficit scheduler several times per workload round. ``fair`` runs
+    the DRR composer (weights + tick slot budget); ``blind`` is the
+    tenant-agnostic twin (every frame "default", no budget) — the
+    digest surface is identical by design."""
+    from ..utils import faults
+
+    fair = args.qos == "fair"
+    g = args.docs
+    tenants = _qos_docs(g)
+    all_docs = [d for docs in tenants.values() for d in docs]
+    doc_index = {d: i for i, d in enumerate(all_docs)}
+    storm_kw: dict = {"flush_threshold_docs": 10**9}
+    if fair:
+        storm_kw.update(
+            tenant_weights={t: 1.0 for t in QOS_TENANTS},
+            tick_slot_budget=2 * g)
+    service, storm, seq_host, merge_host = _build_stack(
+        args.dir, len(all_docs), **storm_kw)
+    if args.resume_from is None:
+        clients = {d: service.connect(d, lambda m: None).client_id
+                   for d in all_docs}
+        service.pump()
+        storm.checkpoint()
+        start = 0
+        print("GENESIS", flush=True)
+    else:
+        info = storm.recover()
+        assert info["restored_from"] is not None, "no snapshot to recover"
+        clients = {d: f"client-{i + 1}" for i, d in enumerate(all_docs)}
+        start = args.resume_from
+    print("READY", flush=True)
+    faults.arm()
+    k = args.k
+    for r in range(start, args.ticks):
+        acks: list = []
+        n_frames = 0
+        for tenant, docs in tenants.items():
+            for chunk0 in range(0, len(docs), g):
+                chunk = docs[chunk0:chunk0 + g]
+                entries = [[d, clients[d], 1 + r * k, 1, k]
+                           for d in chunk]
+                payload = b"".join(
+                    _tick_words(args.seed, r, doc_index[d], k).tobytes()
+                    for d in chunk)
+                storm.submit_frame(
+                    acks.append, {"rid": (r, tenant, chunk0),
+                                  "docs": entries},
+                    memoryview(payload),
+                    tenant_id=tenant if fair else "default")
+                n_frames += 1
+        # The settle: budget-limited composition rounds drain the
+        # per-tenant queues (several ticks per workload round in the
+        # fair arm — the scheduler state moves between them, which is
+        # what the mid-compose kill window exercises).
+        storm.flush()
+        ok = [a for a in acks
+              if not (isinstance(a, dict) and a.get("error"))]
+        if len(ok) == n_frames:
+            print(f"ACKED {r}", flush=True)
+        if (r + 1) % args.cp_every == 0:
+            storm.checkpoint()
+    faults.disarm()
+    digest = _digest(service, storm, seq_host, merge_host, all_docs)
+    print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
+
+
 def child_main(args) -> None:
     """One serving-process life. Protocol on stdout (parent parses):
     ``READY`` once serving can start, ``ACKED <round>`` per
@@ -348,6 +451,9 @@ def child_main(args) -> None:
     compile_cache.enable()
     if getattr(args, "cluster", False):
         _cluster_child(args)
+        return
+    if getattr(args, "qos", None):
+        _qos_child(args)
         return
     mega_lanes = getattr(args, "megadoc", None)
     docs = [f"chaos-doc-{i}" for i in range(args.docs)]
@@ -538,7 +644,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 pipelined: bool = False,
                 megadoc: int | None = None,
                 cluster: bool = False,
-                migrate_at: int = -1) -> dict:
+                migrate_at: int = -1,
+                qos: str | None = None) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
@@ -551,6 +658,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
         cmd += ["--megadoc", str(megadoc)]
     if cluster:
         cmd += ["--cluster", "--migrate-at", str(migrate_at)]
+    if qos is not None:
+        cmd += ["--qos", qos]
     if resume_from is not None:
         cmd += ["--resume-from", str(resume_from)]
     env = dict(os.environ)
@@ -578,7 +687,8 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               pipelined: bool = False,
               megadoc: int | None = None,
               cluster: bool = False,
-              migrate_at: int | None = None) -> dict:
+              migrate_at: int | None = None,
+              qos: bool = False) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
@@ -605,13 +715,20 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
         raise ValueError("megadoc= serves exactly ONE co-written doc")
     if cluster and (residency is not None or pipelined or megadoc):
         raise ValueError("cluster=True is its own scenario stack")
+    if qos and (cluster or residency is not None or pipelined or megadoc):
+        raise ValueError("qos=True is its own scenario stack")
     cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
                residency=residency, pipelined=pipelined, megadoc=megadoc,
                cluster=cluster,
                migrate_at=(migrate_at if migrate_at is not None
-                           else ticks // 2) if cluster else -1)
+                           else ticks // 2) if cluster else -1,
+               qos="fair" if qos else None)
     if twin_digest is None:
-        twin_cfg = dict(cfg, migrate_at=-1) if cluster else cfg
+        # The qos twin is tenant-BLIND (same frames, no fairness):
+        # digest equality then ALSO proves fair composition never
+        # changes converged replica state — the cluster-twin pattern.
+        twin_cfg = dict(cfg, migrate_at=-1) if cluster else (
+            dict(cfg, qos="blind") if qos else cfg)
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
                            kill_env=None, timeout=timeout, **twin_cfg)
         assert twin["returncode"] == 0, twin["stderr"]
@@ -1203,6 +1320,13 @@ def main(argv=None) -> None:
                              "parallel lanes co-written by "
                              f"{MEGADOC_WRITERS} writers (the "
                              "MEGADOC_KILL_POINTS scenarios)")
+    parser.add_argument("--qos", default=None,
+                        choices=("fair", "blind"),
+                        help="multi-tenant QoS child: three tenants, the "
+                             "first at 10x, through the deficit-fair "
+                             "composer (fair) or tenant-blind (blind — "
+                             "the differential twin; QOS_KILL_POINTS "
+                             "scenarios)")
     parser.add_argument("--cluster", action="store_true",
                         help="serve a two-host in-process cluster over "
                              "one shared snapshot store with a durable "

@@ -280,6 +280,55 @@ def render_megadoc(metrics: dict, prev: dict | None = None,
             f"boundary-exchanges {exchanges:,.1f}/s")
 
 
+def render_tenants(metrics: dict, prev: dict | None = None,
+                   interval: float = 1.0) -> str:
+    """Multi-tenant QoS table (the round-17 fairness plane): one SLO row
+    per tenant — windowed share of tick doc slots (the deficit
+    scheduler's actual allocation), sequenced-op and shed rates over the
+    poll window (cumulative with no window), pending queue depth, and
+    the per-tenant ack p50/p99 — the noisy-neighbor readout: an abusive
+    tenant shows a fat share/shed row while the victims' p99 columns
+    hold still. Empty when no tenant has ever sent (the metrics never
+    appear)."""
+    prefix = "storm.tenant."
+    tenants = sorted({k[len(prefix):].rsplit(".", 1)[0]
+                      for k in metrics
+                      if k.startswith(prefix)
+                      and k.rsplit(".", 1)[-1] in ("submitted_ops",
+                                                   "tick_docs")})
+    if not tenants:
+        return ""
+    per_s = max(interval, 1e-9)
+
+    def windowed(name: str) -> dict[str, float]:
+        out = {}
+        for t in tenants:
+            v = metrics.get(f"{prefix}{t}.{name}", 0.0)
+            if prev is not None:
+                w = v - prev.get(f"{prefix}{t}.{name}", 0.0)
+                if w >= 0:  # negative = service restarted
+                    v = w
+            out[t] = v
+        return out
+
+    docs = windowed("tick_docs")
+    seq = windowed("sequenced_ops")
+    shed = windowed("shed_ops")
+    grand = sum(docs.values())
+    lines = ["tenants:  share   seq/s      shed/s   pending  "
+             "ack p50      p99"]
+    for t in tenants:
+        share = docs[t] / grand if grand else 0.0
+        pending = metrics.get(f"{prefix}{t}.pending_docs", 0)
+        p50 = metrics.get(f"{prefix}{t}.ack_s.p50", 0.0) * 1e3
+        p99 = metrics.get(f"{prefix}{t}.ack_s.p99", 0.0) * 1e3
+        lines.append(
+            f"  {t:<12} {100 * share:5.1f}% {seq[t] / per_s:9,.1f} "
+            f"{shed[t] / per_s:9,.1f} {pending:8g} "
+            f"{p50:8.3f}ms {p99:8.3f}ms")
+    return "\n".join(lines)
+
+
 def render_human(now: dict, prev: dict, interval: float) -> str:
     """Operator view of one poll: headline rates (per-second deltas of
     the interesting counters), the stage bar, and the hop decomposition
@@ -321,6 +370,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     cluster_line = render_cluster(now, prev or None, interval)
     if cluster_line:
         lines.append(cluster_line)
+    tenant_line = render_tenants(now, prev or None, interval)
+    if tenant_line:
+        lines.append(tenant_line)
     hop_keys = sorted({k.rsplit(".", 1)[0] for k in now
                        if k.startswith("storm.hop.")})
     if hop_keys:

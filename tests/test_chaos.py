@@ -544,3 +544,73 @@ def test_migration_chaos_full_matrix(seed, tmp_path):
         seeds=(seed,), hit_positions=(1,),
         **{k: v for k, v in _CLUSTER_CFG.items() if k != "seed"})
     assert all(r["killed"] for r in reports)
+
+
+# -- multi-tenant QoS kill classes (ISSUE 14): tier-1 smoke + slow matrix ------
+
+#: Three tenants, the first at 10x (QOS_TENANTS/QOS_ABUSE_FACTOR in
+#: chaos.py), composed through the deficit scheduler with a tick slot
+#: budget — one workload round spans several budget-limited ticks, so
+#: scheduler state genuinely moves between durable records.
+_QOS_CFG = dict(seed=0, docs=2, k=8, ticks=4, cp_every=2)
+
+_QOS_SMOKE = [("storm.qos_mid_compose", 2), ("wal.pre_fsync", 1)]
+
+
+@pytest.fixture(scope="session")
+def qos_twin_digest(tmp_path_factory):
+    """Tenant-BLIND twin of the abusive-tenant workload (same frames,
+    one tenant, no weights, no budget): equality with the fair arm
+    proves fairness never changes converged replica state."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("qos_twin")), resume_from=None,
+        kill_env=None, timeout=300, qos="blind", **_QOS_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _QOS_SMOKE,
+                         ids=[p for p, _ in _QOS_SMOKE])
+def test_qos_chaos_smoke_recovers_byte_identical(
+        point, hits, tmp_path, qos_twin_digest):
+    """Kill mid-composition (scheduler charged, tick neither dispatched
+    nor journaled) and pre-fsync under the 10x-abuser workload:
+    recovery restores the deficit scheduler from the WAL headers, the
+    resent frames recompose against it, and every plane reconverges
+    byte-identical to the tenant-BLIND twin with zero acked-durable
+    ops lost (the ISSUE 14 robustness bar)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=qos_twin_digest, qos=True,
+                             **_QOS_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_QOS_CFG["ticks"]))
+
+
+def test_qos_fair_clean_run_matches_tenant_blind_twin(
+        tmp_path, qos_twin_digest):
+    """No kill at all: deficit-fair composition under a 10x abuser
+    must leave every compared plane byte-identical to the tenant-blind
+    FIFO twin — fairness moves latency, never bytes."""
+    life = chaos._spawn_life(str(tmp_path), resume_from=None,
+                             kill_env=None, timeout=300, qos="fair",
+                             **_QOS_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert json.dumps(life["digest"], sort_keys=True) == json.dumps(
+        qos_twin_digest, sort_keys=True)
+    assert life["acked"] == list(range(_QOS_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_qos_chaos_full_matrix(seed, tmp_path):
+    """Slow soak: every QoS kill point × hit position, per seed."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.QOS_KILL_POINTS, seeds=(seed,),
+        hit_positions=(1, 2), docs=2, k=8, ticks=5, cp_every=2,
+        qos=True)
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]

@@ -797,3 +797,81 @@ def test_re_promotion_epochs_match_never_promoted_twin(tmp_path):
     assert mgr2.docs[doc].epoch == 1
     assert mgr2.past_epochs[doc][0].epoch == 0
     assert digest(svc2, storm2, seq2, mh2) == cycled
+
+
+def test_join_mid_promotion_matches_single_lane_twin(tmp_path):
+    """Round-17 satellite (ROADMAP item 3 residue): a CLIENT_JOIN that
+    lands WHILE the doc is promoted now sequences at the doc's TRUE
+    head — routerlicious routes membership through the mirror, which
+    fast-forwards the frozen doc row, lets the join take mirror.seq+1
+    through the normal deli path, and journals a ``member`` control.
+    The full lifecycle (promote → serve → join → post-join writes →
+    demote) must converge byte-identical to a never-promoted twin, and
+    a recovered stack must replay the membership control identically.
+    Before the interception the join was adopt-without-sequence: its
+    stale doc-row seq collided with the lane-combined stream and the
+    twin's histories diverged."""
+    doc = "mega-join"
+
+    def digest(svc, storm, seq, mh):
+        cp = dataclasses.asdict(seq.checkpoint(doc))
+        cp.pop("log_offset", None)
+        for c in cp["clients"]:
+            c["last_update"] = 0
+        return {
+            "map": mh.map_entries(doc, storm.datastore, storm.channel),
+            "history": [[m.sequence_number, m.client_sequence_number,
+                         int(m.type), m.client_id]
+                        for m in svc.get_deltas(doc, 0)],
+            "sequencer": cp,
+        }
+
+    def serve(storm, participants, r0, rounds):
+        # participants: (client, base_round) — cseqs restart per client.
+        for r in range(r0, r0 + rounds):
+            for w, (client, base) in enumerate(participants):
+                storm.submit_frame(None, {
+                    "rid": f"{r}.{w}",
+                    "docs": [[doc, client, 1 + (r - base) * K, -1, K]]},
+                    memoryview(storm_words(21, r, w).tobytes()))
+            storm.flush()
+
+    def play(root, promote):
+        svc, storm, seq, mh, mgr = build_stack(root, lanes=2)
+        writers = [svc.connect(doc, lambda m: None).client_id
+                   for _ in range(2)]
+        svc.pump()
+        storm.checkpoint()
+        if promote:
+            mgr.promote(doc, lanes=2)
+        serve(storm, [(w, 0) for w in writers], 0, 2)
+        # THE mid-promotion join: a third client connects while the
+        # doc is sharded (the twin connects at the same point).
+        late = svc.connect(doc, lambda m: None).client_id
+        svc.pump()
+        if promote:
+            # Sequenced, not just adopted: the mirror's head advanced
+            # by exactly the join op.
+            st = mgr.docs[doc]
+            assert late in st.mirror.writers
+        serve(storm, [(w, 0) for w in writers] + [(late, 2)], 2, 2)
+        if promote:
+            mgr.demote(doc)
+        storm.flush()
+        return svc, storm, seq, mh, digest(svc, storm, seq, mh)
+
+    root = str(tmp_path / "sharded")
+    *_s, sharded = play(root, promote=True)
+    *_t, plain = play(str(tmp_path / "twin"), promote=False)
+    assert sharded == plain
+    # The join is IN the doc history exactly once, at the same seq.
+    from fluidframework_tpu.protocol.messages import MessageType
+    joins = [h for h in sharded["history"]
+             if h[2] == int(MessageType.CLIENT_JOIN)]
+    assert joins == [h for h in plain["history"]
+                     if h[2] == int(MessageType.CLIENT_JOIN)]
+    assert len(joins) == 3
+    # Recovery replays the membership control at the identical point.
+    svc2, storm2, seq2, mh2, mgr2 = build_stack(root, lanes=2)
+    storm2.recover()
+    assert digest(svc2, storm2, seq2, mh2) == sharded

@@ -254,3 +254,75 @@ def test_malformed_storm_push_fails_loudly_not_silently():
     with pytest.raises((ConnectionError, RuntimeError)):
         svc._request({"op": "get_deltas", "from_seq": 0})
     srv.close()
+
+
+class TestConnectTimeRedirect:
+    """Round-17 satellite (ROADMAP item 2 residue): alfred consults the
+    placement directory AT CONNECT TIME and answers ``moved_to``; the
+    driver redials the named owner instead of connecting locally and
+    only learning the move from per-frame nacks."""
+
+    def _serve_pair(self):
+        import asyncio
+        import threading
+        from types import SimpleNamespace
+
+        from fluidframework_tpu.server.alfred import (
+            AlfredServer,
+            build_default_service,
+        )
+
+        svc_a = build_default_service(merge_host=False)
+        svc_b = build_default_service(merge_host=False)
+        # Host A's placement says every doc moved to hostB.
+        svc_a.storm = SimpleNamespace(
+            placement=SimpleNamespace(
+                route=lambda d: ("moved", "hostB"), retry_after_s=0.01),
+            residency=None, megadoc=None)
+        ports = {}
+        ready = threading.Event()
+
+        def runner():
+            async def serve():
+                a = AlfredServer(svc_a, port=0)
+                ports["A"] = await a.start()
+                b = AlfredServer(svc_b, port=0)
+                ports["B"] = await b.start()
+                ready.set()
+                await asyncio.Event().wait()
+
+            asyncio.run(serve())
+
+        threading.Thread(target=runner, daemon=True).start()
+        assert ready.wait(15)
+        return ports
+
+    def test_connect_moved_redials_owner(self):
+        from fluidframework_tpu.drivers.network_driver import (
+            NetworkDocumentService,
+        )
+
+        ports = self._serve_pair()
+        svc = NetworkDocumentService(
+            "127.0.0.1", ports["A"], "doc-x",
+            hosts={"hostB": ("127.0.0.1", ports["B"])})
+        conn = svc.connect(lambda msgs: None)
+        # The session landed on the OWNER (host B) transparently.
+        assert conn.client_id
+        assert svc._addr == ("127.0.0.1", ports["B"])
+        # The redialed session serves normally end to end.
+        assert svc.delta_storage.get_deltas(0) is not None
+        svc.close()
+
+    def test_connect_moved_without_address_book_surfaces(self):
+        from fluidframework_tpu.drivers.network_driver import (
+            NetworkDocumentService,
+        )
+        from fluidframework_tpu.drivers.utils import DocumentMovedError
+
+        ports = self._serve_pair()
+        svc = NetworkDocumentService("127.0.0.1", ports["A"], "doc-y")
+        with pytest.raises(DocumentMovedError) as err:
+            svc.connect(lambda msgs: None)
+        assert err.value.moved_to == "hostB"
+        svc.close()
