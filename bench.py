@@ -3222,6 +3222,227 @@ def emit_round16(path: str = "BENCH_r16.json") -> dict:
     return out
 
 
+def _history_stack(root=None, **hist_kw):
+    """In-process storm stack + HistoryPlane (spill-backed when a root
+    is given — the disk-amplification arm needs a real file)."""
+    import os
+    import tempfile
+
+    from fluidframework_tpu.server.durable_store import GitSnapshotStore
+    from fluidframework_tpu.server.history import HistoryPlane
+    from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+    from fluidframework_tpu.server.merge_host import KernelMergeHost
+    from fluidframework_tpu.server.routerlicious import (
+        RouterliciousService,
+    )
+    from fluidframework_tpu.server.storm import StormController
+
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=4)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False,
+                                   idle_check_interval=10**9)
+    kw: dict = {}
+    snap_root = root if root is not None else tempfile.mkdtemp()
+    if root is not None:
+        kw.update(spill_dir=os.path.join(root, "spill"),
+                  durability="group")
+    storm = StormController(
+        service, seq_host, merge_host, flush_threshold_docs=10**9,
+        pipeline_depth=0,
+        snapshots=GitSnapshotStore(os.path.join(snap_root, "git")), **kw)
+    hist = HistoryPlane(storm, **hist_kw)
+    return service, storm, hist
+
+
+def _history_words(seed, r, k, slots=16, churn=False):
+    rng = np.random.default_rng([seed, r])
+    kinds = rng.choice([0, 0, 0, 1], size=k).astype(np.uint32)
+    width = 8 if churn else slots  # churn: few slots overwritten forever
+    s = rng.integers(0, width, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (s << 2) | (vals << 12)).astype(np.uint32)
+
+
+def bench_history_reads(rounds: int = 192, k: int = 64,
+                        interval_ops: int = 2048,
+                        reps: int = 15) -> dict:
+    """Historical-read latency vs depth behind head, with and without
+    summaries. Without summaries every read folds the records from seq
+    0 (cost grows with the ABSOLUTE position, i.e. shrinks with depth);
+    with the summarizer on cadence every read folds at most one
+    summary interval — the p99 curve goes FLAT across depths (the
+    acceptance bar)."""
+    import time as _time
+
+    def arm(summarize: bool) -> dict:
+        service, storm, hist = _history_stack(
+            summary_interval_ops=interval_ops if summarize else None,
+            compact_check_every=1)
+        client = service.connect("h0", lambda m: None).client_id
+        service.pump()
+        for r in range(rounds):
+            storm.submit_frame(
+                None, {"rid": r,
+                       "docs": [["h0", client, 1 + r * k, 1, k]]},
+                memoryview(_history_words(3, r, k).tobytes()))
+            storm.flush()
+        head = hist.head_seq("h0")
+        rows = {}
+        for depth in (1, 64, 512, 4096, head - 1):
+            seq = max(1, head - depth)
+            samples = []
+            for _ in range(reps):
+                t0 = _time.perf_counter()
+                hist.read_at("h0", seq)
+                samples.append(1e3 * (_time.perf_counter() - t0))
+            rows[f"depth_{depth}"] = {
+                "seq": seq,
+                "read_ms_p50": round(float(np.percentile(samples, 50)),
+                                     4),
+                "read_ms_p99": round(float(np.percentile(samples, 99)),
+                                     4),
+            }
+        p99s = [row["read_ms_p99"] for row in rows.values()]
+        return {"head_seq": head, "ops_total": rounds * k,
+                "summaries": hist.stats["compactions"],
+                "rows": rows,
+                "worst_read_ms_p50": max(row["read_ms_p50"]
+                                         for row in rows.values()),
+                "p99_flatness_max_over_min": round(max(p99s)
+                                                   / max(min(p99s),
+                                                         1e-9), 2)}
+
+    out = {"no_summaries": arm(False), "summarized": arm(True)}
+    # The flat-once-covered bar: with summaries, the WORST read across
+    # every depth is bounded by one summary-interval fold — it no
+    # longer scales with the absolute history length, which is exactly
+    # what the uncompacted arm's worst (near-head) read does. p50-based
+    # so a single scheduler hiccup cannot flip the bar.
+    out["flat_once_covered"] = (
+        out["summarized"]["worst_read_ms_p50"]
+        <= 0.5 * out["no_summaries"]["worst_read_ms_p50"])
+    return out
+
+
+def bench_history_compaction_disk(rounds: int = 96, k: int = 64) -> dict:
+    """Disk amplification on a long-tail churn workload (a few slots
+    overwritten forever, so history >> live state): spill bytes before
+    vs after summarization compaction + tail trim. Bar: after/before
+    < 0.5x — the churn tail collapses to its summary."""
+    import os
+    import tempfile
+    root = tempfile.mkdtemp()
+    service, storm, hist = _history_stack(
+        root, tail_retention_summaries=0, trim_batch_ticks=1)
+    client = service.connect("churn", lambda m: None).client_id
+    service.pump()
+    storm.checkpoint()
+    for r in range(rounds):
+        storm.submit_frame(
+            None, {"rid": r,
+                   "docs": [["churn", client, 1 + r * k, 1, k]]},
+            memoryview(_history_words(5, r, k, churn=True).tobytes()))
+        storm.flush()
+    storm.checkpoint()  # the trim floor: recovery never replays below
+    spill = os.path.join(root, "spill", "storm_tick_words.log")
+    before = os.path.getsize(spill)
+    live_entries = storm.merge_host.map_entries("churn", storm.datastore,
+                                                storm.channel)
+    t0 = time.perf_counter()
+    hist.compact("churn")
+    hist.trim_now()
+    compact_ms = 1e3 * (time.perf_counter() - t0)
+    after = os.path.getsize(spill)
+    # State-preservation sanity: the summary serves the identical head.
+    head = hist.head_seq("churn")
+    assert hist.read_at("churn", head)["entries"] == live_entries
+    if storm._group_wal is not None:
+        storm._group_wal.close()
+    ratio = after / max(1, before)
+    return {"ops_total": rounds * k, "live_keys": len(live_entries),
+            "spill_bytes_before": before, "spill_bytes_after": after,
+            "disk_amplification_after_over_before": round(ratio, 4),
+            "trimmed_ticks": hist.stats["trimmed_ticks"],
+            "compact_ms": round(compact_ms, 2),
+            "bar_half_x": ratio < 0.5}
+
+
+def bench_history_fork_merge(rounds: int = 48, k: int = 64) -> dict:
+    """Branch verbs: fork cost at mid-history, branch serving, and
+    merge-back of the branch's delta ops through the ordinary
+    sequencer."""
+    service, storm, hist = _history_stack()
+    client = service.connect("f0", lambda m: None).client_id
+    service.pump()
+    for r in range(rounds):
+        storm.submit_frame(
+            None, {"rid": r, "docs": [["f0", client, 1 + r * k, 1, k]]},
+            memoryview(_history_words(7, r, k).tobytes()))
+        storm.flush()
+    fork_seq = 1 + (rounds // 2) * k
+    t0 = time.perf_counter()
+    branch = hist.fork("f0", fork_seq, name="f0-branch", writer="w")
+    fork_ms = 1e3 * (time.perf_counter() - t0)
+    for r in range(4):
+        storm.submit_frame(
+            None, {"rid": ("b", r),
+                   "docs": [[branch, "w", 1 + r * k, fork_seq, k]]},
+            memoryview(_history_words(11, r, k).tobytes()))
+        storm.flush()
+    t0 = time.perf_counter()
+    report = hist.merge_back(branch)
+    merge_ms = 1e3 * (time.perf_counter() - t0)
+    return {"fork_seq": fork_seq, "fork_ms": round(fork_ms, 3),
+            "branch_ops": 4 * k, "merged_ops": report["merged_ops"],
+            "merge_ms": round(merge_ms, 2),
+            "parent_seq_after": report["parent_seq"]}
+
+
+def emit_round18(path: str = "BENCH_r18.json") -> dict:
+    """ISSUE 15 acceptance bars: the history plane. (1) historical-read
+    p99 vs depth behind head — flat once a summary covers the gap;
+    (2) disk amplification before/after summarization compaction on a
+    long-tail churn workload < 0.5x; plus the branch-verbs row.
+    Fail-soft: an arm that crashes records its error."""
+    import jax
+
+    from fluidframework_tpu.utils import compile_cache
+
+    compile_cache.enable()
+    out: dict = {"round": 18,
+                 "environment": {"backend": jax.default_backend(),
+                                 "devices": len(jax.devices())}}
+    for name, fn in (("historical_reads", bench_history_reads),
+                     ("compaction_disk", bench_history_compaction_disk),
+                     ("fork_merge", bench_history_fork_merge)):
+        try:
+            out[name] = fn()
+        except Exception as err:  # fail-soft: record, keep the file
+            out[name] = {"error": repr(err)}
+    out["environment"]["note"] = (
+        "Round-18 tentpole: the history plane (server/history.py). "
+        "read_at materializes any historical seq from the nearest "
+        "summary at-or-below it + a scalar fold of the WAL records in "
+        "between, entirely off the cold path (no device row hydrates). "
+        "Without summaries the fold starts at seq 0, so read cost "
+        "tracks the absolute position; the background summarizer "
+        "bounds it by one summary interval — the flat-p99 bar. "
+        "Compaction flips summary heads through the existing "
+        "Historian.set_head/release refcount GC and (with tail "
+        "retention) trims superseded WAL tick blobs to fillers under "
+        "the checkpoint watermark — the disk bar; chaos --history "
+        "proves kill-safety mid-compaction/mid-fork against a "
+        "never-compacted twin. Branches: fork seeds a cold-doc record "
+        "through the normal residency path; merge_back re-submits "
+        "branch deltas through the ordinary sequencer. All figures "
+        "CPU; tunneled-TPU bars remain hardware-gated as since r7.")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
 def _qos_arm(fair: bool, abuse: bool, rounds: int = 6, group: int = 4,
              k: int = 32, budget_groups: int = 3) -> dict:
     """One arm of the noisy-neighbor A/B: three tenants (the first at
@@ -3498,7 +3719,27 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--qos-r17" in sys.argv:
+    if "--history-r18" in sys.argv:
+        res = emit_round18()
+        reads = res.get("historical_reads", {})
+        disk = res.get("compaction_disk", {})
+        print(json.dumps({
+            "metric": "history plane: historical-read p99 vs depth "
+                      "behind head + disk amplification after "
+                      "summarization compaction (BENCH_r18)",
+            "value": disk.get("disk_amplification_after_over_before"),
+            "unit": "spill_bytes_after / before (churn workload)",
+            "bar_half_x": disk.get("bar_half_x"),
+            "flat_once_covered": reads.get("flat_once_covered"),
+            "p99_flatness_summarized": reads.get(
+                "summarized", {}).get("p99_flatness_max_over_min"),
+            "p99_flatness_no_summaries": reads.get(
+                "no_summaries", {}).get("p99_flatness_max_over_min"),
+            "trimmed_ticks": disk.get("trimmed_ticks"),
+            "fork_ms": res.get("fork_merge", {}).get("fork_ms"),
+            "merged_ops": res.get("fork_merge", {}).get("merged_ops"),
+        }))
+    elif "--qos-r17" in sys.argv:
         res = emit_round17()
         fair = res.get("abusive_10x_fair", {}).get("tenants", {})
         print(json.dumps({

@@ -125,17 +125,27 @@ class PlacementController:
 
     # -- planning --------------------------------------------------------------
 
+    #: Docs examined per donor pick when tenant-aware (bounded scan
+    #: keeps plan() O(moves × scan), not O(moves × owned)).
+    TENANT_SCAN = 8
+
     def plan(self, max_moves: int | None = None) -> list[tuple]:
         """One round's migration plan ``[(doc, src, dst), ...]``: move
         docs from the highest-scored host to the lowest until the
         owned-doc spread is within ``tolerance`` or the move budget is
-        spent. Pure — no state changes."""
+        spent. With a tenant-aware backend (``doc_tenant`` +
+        ``tenant_load`` signals) the donor sheds its HOTTEST tenant's
+        docs first and count-tied receivers prefer the host where that
+        tenant is lightest — a hot tenant SPREADS across hosts instead
+        of saturating its weighted share on one. Pure — no state
+        changes."""
         budget = max_moves if max_moves is not None \
             else self.max_moves_per_round
         sigs = self._signals()
         if len(sigs) < 2:
             return []
         docs = {h: list(self.backend.owned(h)) for h in sigs}
+        doc_tenant = getattr(self.backend, "doc_tenant", None)
         plan: list[tuple] = []
         for _ in range(budget):
             counts = {h: len(docs[h]) for h in sigs}
@@ -155,12 +165,41 @@ class PlacementController:
                 break
             hot = max(donors, key=lambda h: (sigs[h]["score"],
                                              counts[h], str(h)))
-            doc = docs[hot].pop(0)  # cheapest-to-move first
+            doc = docs[hot][0]  # cheapest-to-move first
+            tenant = None
+            if doc_tenant is not None:
+                # Shed the donor's hottest tenant first: among the
+                # cheapest few movable docs, the one whose tenant holds
+                # the biggest slice of this host's load (index order
+                # breaks ties, preserving cheapest-first).
+                hot_load = sigs[hot].get("tenant_load", {})
+                best = -1
+                for cand in docs[hot][:self.TENANT_SCAN]:
+                    t = doc_tenant(hot, cand)
+                    load = hot_load.get(t, 0) if t is not None else 0
+                    if load > best:
+                        best, doc, tenant = load, cand, t
+                if tenant is not None:
+                    # Count-tied receivers: the host where this tenant
+                    # is LIGHTEST takes the doc (spread, not pile-up).
+                    ties = [h for h in sigs if h != hot
+                            and counts[h] == counts[cold]]
+                    if ties:
+                        cold = min(ties, key=lambda h: (
+                            sigs[h].get("tenant_load", {}).get(tenant,
+                                                               0),
+                            sigs[h]["score"], str(h)))
+            docs[hot].remove(doc)
             docs[cold].append(doc)
             # The per-doc weight moves with the doc (score tracks docs).
             per_doc = sigs[hot]["score"] / max(1, counts[hot])
             sigs[hot]["score"] -= per_doc
             sigs[cold]["score"] += per_doc
+            if tenant is not None:
+                hl = sigs[hot].setdefault("tenant_load", {})
+                hl[tenant] = max(0, hl.get(tenant, 0) - 1)
+                cl = sigs[cold].setdefault("tenant_load", {})
+                cl[tenant] = cl.get(tenant, 0) + 1
             plan.append((doc, hot, cold))
         return plan
 
@@ -201,13 +240,37 @@ class PlacementController:
         }
 
     def drain(self, host) -> dict:
-        """Move EVERY doc off one host (maintenance / scale-in): each
-        doc goes to the currently least-loaded other host."""
+        """Move EVERY doc off one host (maintenance / scale-in). With a
+        batch-capable backend (``migrate_batch``) the whole range moves
+        in ONE durable directory intent write + ONE completion write —
+        not per-doc intents; otherwise each doc goes to the currently
+        least-loaded other host one migration at a time."""
         t0 = time.perf_counter()
         others = [h for h in self.backend.hosts_list()
                   if h != host]
         if not others:
             raise ValueError("cannot drain the only active host")
+        batch = getattr(self.backend, "migrate_batch", None)
+        if batch is not None:
+            sigs = self._signals()
+            counts = {h: sigs[h]["docs"] for h in others}
+            moves: list[tuple] = []
+            for doc in list(self.backend.owned(host)):
+                dst = min(others, key=lambda h: (counts[h],
+                                                 sigs[h]["score"],
+                                                 str(h)))
+                counts[dst] += 1
+                moves.append((doc, dst))
+            report = batch(moves)
+            self.moves.extend(
+                MigrationResult(doc, host, dst, report["blackout_s"])
+                for doc, dst in moves
+                if doc not in {d for d, _e in report["aborted"]})
+            return {"drained": host, "moves": report["moved"],
+                    "aborted": len(report["aborted"]),
+                    "directory_writes": report["directory_writes"],
+                    "elapsed_s": round(time.perf_counter() - t0, 4),
+                    "remaining": len(self.backend.owned(host))}
         moved = []
         for doc in list(self.backend.owned(host)):
             sigs = self._signals()
@@ -290,6 +353,29 @@ class StormClusterDirectory:
         """Roll a frozen migration BACK (the eviction refused): the doc
         keeps its previous owner and serving resumes at the source."""
         self.migrating.pop(doc, None)
+        self._save()
+
+    # Batch-drain forms (ONE durable directory write per call — a hot
+    # host's whole range freezes/completes in one head flip instead of
+    # one write per doc; recovery semantics are unchanged because the
+    # per-doc intents are the same records, published together).
+
+    def freeze_many(self, items: list[tuple]) -> None:
+        """``items`` = [(doc, src, dst), ...] frozen in one write."""
+        for doc, src, dst in items:
+            self.migrating[doc] = (src, dst)
+        self._save()
+
+    def complete_many(self, items: list[tuple]) -> None:
+        """``items`` = [(doc, dst), ...] completed in one write."""
+        for doc, dst in items:
+            self.owners[doc] = dst
+            self.migrating.pop(doc, None)
+        self._save()
+
+    def abort_many(self, docs: list[str]) -> None:
+        for doc in docs:
+            self.migrating.pop(doc, None)
         self._save()
 
 
@@ -397,16 +483,32 @@ class StormCluster:
 
     def load_signals(self, label) -> dict:
         """The load inputs placement decides on: owned docs, the
-        host's inbound queue depth, and its stage-ledger mean per-tick
-        attributed cost over the ring window."""
+        host's inbound queue depth, its stage-ledger mean per-tick
+        attributed cost over the ring window, and — multi-tenant — the
+        per-tenant slice of its owned docs (the QoS×placement seam: a
+        hot tenant's docs spread across hosts instead of saturating its
+        weighted share on one)."""
         storm = self.hosts[label]
         att = storm.ledger.attribution()
         win = att.get("_window") or {}
         ticks = win.get("ticks", 0)
         cost = (win.get("attributed_ms", 0.0) / ticks) if ticks else 0.0
+        tenant_load: dict[str, int] = {}
+        doc_tenant = storm.qos.doc_tenant
+        if doc_tenant:
+            for doc in self.owned(label):
+                t = doc_tenant.get(doc)
+                if t is not None:
+                    tenant_load[t] = tenant_load.get(t, 0) + 1
         return {"docs": len(self.owned(label)),
                 "queue_depth": storm._pending_docs,
-                "tick_cost_ms": cost}
+                "tick_cost_ms": cost,
+                "tenant_load": tenant_load}
+
+    def doc_tenant(self, label, doc: str) -> str | None:
+        """The tenant observed owning ``doc`` on host ``label`` (None
+        for single-tenant traffic — placement then ignores tenants)."""
+        return self.hosts[label].qos.doc_tenant.get(doc)
 
     # -- migration (the tentpole) ----------------------------------------------
 
@@ -480,6 +582,108 @@ class StormCluster:
         if on_phase is not None:
             on_phase("completed")
         return blackout
+
+    def migrate_batch(self, moves: list[tuple],
+                      on_phase: Callable[[str], None] | None = None
+                      ) -> dict:
+        """Batch drain: migrate ``moves`` = [(doc, dst), ...] with ONE
+        durable directory write for the whole batch's intents and ONE
+        for the completions (vs two per doc in :meth:`migrate`) — the
+        scale-in/maintenance shape where a hot host's whole range moves
+        at once. Per-doc semantics are unchanged: the same evict →
+        hydrate phases, the same kill points, and recovery rolls every
+        frozen intent forward individually. A doc whose eviction
+        refuses aborts alone; the rest of the batch completes."""
+        items: list[tuple] = []
+        seen: set[str] = set()
+        for doc, dst in moves:
+            if dst not in self.hosts:
+                raise KeyError(dst)
+            if doc in self.directory.migrating:
+                raise RuntimeError(f"{doc!r} is already migrating")
+            if doc in seen:
+                raise ValueError(f"{doc!r} repeats within one batch")
+            seen.add(doc)
+            src = self.owner_of(doc)
+            if src != dst:
+                items.append((doc, src, dst))
+        result = {"moved": 0, "aborted": [], "blackout_s": 0.0,
+                  "directory_writes": 0}
+        if not items:
+            return result
+        t0 = time.perf_counter()
+        self.directory.freeze_many(items)  # ONE durable intent write
+        result["directory_writes"] += 1
+        self._update_gauges()
+        if on_phase is not None:
+            on_phase("frozen")
+        faults.crashpoint("placement.pre_evict")
+        completed: list[tuple] = []
+        try:
+            for doc, src, dst in items:
+                try:
+                    res = self.hosts[src].residency
+                    if res.is_resident(doc):
+                        res.evict(doc, reason="migration")
+                    faults.crashpoint("placement.post_evict")
+                    retry = self.hosts[dst].residency.ensure_resident(
+                        doc, gate=False)
+                    if retry is not None:
+                        raise RuntimeError(
+                            f"target {dst!r} refused hydration of "
+                            f"{doc!r} (retry {retry}s)")
+                except (RuntimeError, KeyError) as err:
+                    # Refused eviction/hydration rolls THIS doc back;
+                    # the rest of the batch proceeds (drain must make
+                    # progress).
+                    result["aborted"].append((doc, repr(err)))
+                    continue
+                faults.crashpoint("placement.post_hydrate")
+                viewers = getattr(self.hosts[src].service, "viewers",
+                                  None)
+                if viewers is not None:
+                    self.stats["rehomed_viewers"] += \
+                        viewers.resync_room(doc, reason="moved",
+                                            moved_to=dst)
+                completed.append((doc, dst))
+        except BaseException:
+            # Unexpected failure mid-batch (disk full, interrupt — a
+            # planned chaos kill never reaches here, os._exit): flip
+            # what finished, abort EVERY other frozen intent, then
+            # surface the error — live hosts must never keep shedding
+            # "migrating" for intents nobody will complete (the
+            # single-doc migrate()'s abort contract, batch-wide).
+            done = {d for d, _dst in completed}
+            aborted = {d for d, _e in result["aborted"]}
+            stranded = [d for d, _s, _dst in items
+                        if d not in done and d not in aborted]
+            if completed:
+                self.directory.complete_many(completed)
+            if stranded or aborted:
+                self.directory.abort_many(stranded + sorted(aborted))
+            self._update_gauges()
+            raise
+        if completed:
+            self.directory.complete_many(completed)  # ONE flip write
+            result["directory_writes"] += 1
+        if result["aborted"]:
+            self.directory.abort_many([d for d, _ in result["aborted"]])
+            result["directory_writes"] += 1
+        blackout = time.perf_counter() - t0
+        result["moved"] = len(completed)
+        result["blackout_s"] = blackout
+        if completed:
+            self.blackouts_s.append(blackout)
+            self.stats["migrations"] += len(completed)
+            for storm in self.hosts.values():
+                m = storm.merge_host.metrics
+                m.counter("cluster.migrations").inc(len(completed))
+                m.gauge("cluster.last_blackout_ms").set(
+                    round(blackout * 1e3, 3))
+        self._update_gauges()
+        if on_phase is not None:
+            on_phase("completed")
+        return result
 
     def recover(self) -> list[str]:
         """Roll forward every durable MIGRATING intent after the hosts

@@ -250,6 +250,39 @@ class RequestSession:
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid, "messages": service.get_deltas(
                 doc, req["from_seq"], req.get("to_seq"))}
+        if op == "read_at":
+            # Historical read (the history plane): sheds like any other
+            # catch-up read — it is a read, and it must never outrank
+            # admitted writes under pressure.
+            if self.server.admission is not None:
+                retry = self.server.admission.admit_read(self.tenant_id)
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
+            doc = req.get("doc_id", self.doc_id)
+            return {"rid": rid, **service.read_at(doc, req["seq"])}
+        if op in ("fork", "merge_back"):
+            # Branch verbs are WRITE-class: fork settles the pipeline
+            # and uploads seeds, merge_back re-submits a branch's whole
+            # delta history through the sequencer — a throttled tenant
+            # must not route the write load admission is shedding
+            # through this door (the storm-side _admit still gates the
+            # individual merge frames).
+            if self.server.admission is not None:
+                retry = self.server.admission.admit_write(
+                    self.tenant_id,
+                    getattr(self.connection, "client_id", None))
+                if retry is not None:
+                    return {"rid": rid, "error": "throttled",
+                            "retry_after_s": retry}
+            if op == "fork":
+                doc = req.get("doc_id", self.doc_id)
+                return {"rid": rid,
+                        "branch": service.fork_doc(doc, req["seq"],
+                                                   req.get("name"))}
+            return {"rid": rid,
+                    **service.merge_back(req.get("branch",
+                                                 self.doc_id))}
         if op == "upload_snapshot":
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid,

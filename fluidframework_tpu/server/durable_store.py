@@ -141,6 +141,34 @@ class CircuitBreaker:
             return False
 
 
+def rewrite_oplog_records(log, path, transform):
+    """Rewrite an :class:`OpLog` record by record — ``transform(idx,
+    data)`` returns replacement bytes or None to keep — preserving
+    record count and indices, published atomically (tmp file + rename,
+    so a kill mid-rewrite keeps the original). Returns ``(fresh_log,
+    changed)``; the caller owns locking and adopts the fresh handle.
+    Shared by :meth:`GroupCommitLog.rewrite_records` and the storm
+    controller's plain-OpLog spill trim — one copy of the
+    crash-safety-critical publish sequence."""
+    path = Path(path)
+    tmp = path.with_suffix(".compact")
+    tmp.unlink(missing_ok=True)
+    fresh = OpLog(tmp)
+    changed = 0
+    for i in range(len(log)):
+        data = bytes(log.read(i))
+        new = transform(i, data)
+        if new is not None and new != data:
+            changed += 1
+            data = new
+        fresh.append(data)
+    fresh.sync()
+    fresh.close()
+    log.close()
+    tmp.replace(path)
+    return OpLog(path), changed
+
+
 class GroupCommitLog:
     """Async group-commit writer over a CRC-framed :class:`OpLog`.
 
@@ -174,6 +202,7 @@ class GroupCommitLog:
                  breaker: CircuitBreaker | None = None,
                  commit_latency_s: float = 0.0) -> None:
         self._log = OpLog(path)
+        self._path = Path(path)  # rewrite_records replace target
         self._fsync = fsync
         # Modeled additional commit latency per fsync BATCH (writer
         # thread only, after the real fsync): benches use it to put the
@@ -269,6 +298,26 @@ class GroupCommitLog:
                         "WAL fsync breaker is open; durability barrier "
                         "unavailable") from self._error
                 self._lock.wait(timeout=1.0)
+
+    def rewrite_records(self, transform: Callable[[int, bytes],
+                                                  bytes | None]) -> int:
+        """Rewrite the log in place, record by record: ``transform(idx,
+        data)`` returns replacement bytes or None to keep. Record COUNT
+        and indices are preserved — this exists for the history plane's
+        tail trim, which shrinks superseded tick blobs to fillers
+        without moving any WAL position. Barriers on full durability
+        first, requires an empty queue (call between serving rounds,
+        never on the hot path), and publishes atomically (tmp file +
+        rename), so a kill mid-rewrite keeps the original log intact.
+        Returns the number of records replaced."""
+        self.sync()
+        with self._lock:
+            assert not self._queued, \
+                "rewrite_records with queued (unfsynced) appends"
+        with self._io:
+            self._log, changed = rewrite_oplog_records(
+                self._log, self._path, transform)
+        return changed
 
     def close(self) -> None:
         with self._lock:

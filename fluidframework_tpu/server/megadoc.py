@@ -648,6 +648,14 @@ class MegaDocManager:
         self._window_ticks = 0
         self._idle_ticks: dict[str, int] = {}
         self._in_replay_control = False
+        # Promotion-window membership ops that arrived INSIDE a storm
+        # round (the pump the round runs drains the idle-eject path):
+        # the pipeline cannot settle mid-round, so the op parks here and
+        # the flush maintenance cadence orders it through the FULL
+        # mirror path once the round completes — no more falling back to
+        # legacy adopt-at-decide for promotion-window joins/leaves.
+        self._deferred_members: list[tuple[str, Any]] = []
+        self._draining_members = False
         # promote() settles via storm.flush(), whose tail calls
         # maybe_adapt() — the guard keeps the cycle from re-entering.
         self._adapting = False
@@ -660,6 +668,7 @@ class MegaDocManager:
         self._c_combined_ops = m.counter("megadoc.combined_ops")
         self._c_combined_batches = m.counter("megadoc.combined_batches")
         self._c_synth = m.counter("megadoc.synth_acks")
+        self._c_deferred = m.counter("megadoc.deferred_members")
         storm.megadoc = self
 
     # -- directory -------------------------------------------------------------
@@ -915,25 +924,47 @@ class MegaDocManager:
             doc, st.mirror.checkpoint(
                 self.storm.seq_host.DEFAULT_TIMEOUT_MS))
 
-    def intercept_membership(self, doc: str, raw) -> bool:
+    def intercept_membership(self, doc: str, raw):
         """Pre-order hook for one CLIENT_JOIN/LEAVE: False for
         unpromoted docs (the caller proceeds unintercepted). For a
         promoted doc: settle the pipeline (the mirror's head must be
         final, and the control journaled later must land after every
         already-composed tick's record), then fast-forward the doc row
-        so the deli path stamps the op the correct doc seq."""
+        so the deli path stamps the op the correct doc seq. Returns the
+        string ``"deferred"`` when the op arrived INSIDE a storm round:
+        the pipeline cannot settle mid-round, so the op parks on the
+        deferred-membership queue and the flush maintenance cadence
+        orders it through this same mirror path right after the round —
+        the caller must NOT order it now."""
         if not self.is_promoted(doc):
             return False
         if self.storm._in_round:
-            # Idle-eject cadence firing INSIDE a storm round (the pump
-            # the round runs drains the eject path): the pipeline cannot
-            # settle mid-round. Fall back to the legacy adopt-at-decide
-            # semantics for this one op rather than recurse into the
-            # round being assembled.
-            return False
+            # Idle-eject cadence firing inside a round (the round's pump
+            # drains the eject path): defer — never legacy-adopt, never
+            # recurse into the cohort being assembled.
+            self._deferred_members.append((doc, raw))
+            self._c_deferred.inc()
+            return "deferred"
         self.storm.flush()
         self._sync_doc_row(doc)
         return True
+
+    def _drain_deferred_membership(self) -> None:
+        """Order the membership ops a storm round deferred — now at top
+        level, so the full intercept path (settle + fast-forward +
+        mirror absorb + "member" control) runs for each. A doc demoted
+        meanwhile just orders through the normal deli path."""
+        if self._draining_members or not self._deferred_members:
+            return
+        if self.storm._in_round or self.storm._replay:
+            return
+        self._draining_members = True
+        try:
+            while self._deferred_members:
+                doc, raw = self._deferred_members.pop(0)
+                self.storm.service._order_membership(doc, raw)
+        finally:
+            self._draining_members = False
 
     def complete_membership(self, doc: str, raw) -> None:
         """Post-sequence hook (the service pumped the intercepted op):
@@ -1341,6 +1372,7 @@ class MegaDocManager:
     def maybe_adapt(self) -> None:
         """Flush-cadence auto promotion/demotion (thresholds armed in
         the constructor; explicit pins always win)."""
+        self._drain_deferred_membership()
         if self._adapting:
             return
         self._adapting = True

@@ -69,16 +69,32 @@ class TenantScheduler:
     def __init__(self, weights: dict[str, float] | None = None,
                  default_weight: float = 1.0,
                  quantum_docs: int | None = None,
+                 weight_source=None,
                  registry=None, prefix: str = "storm.tenant",
                  slice_capacity: int = 1024) -> None:
         self.weights: dict[str, float] = dict(weights or {})
         for t, w in self.weights.items():
             if w <= 0:
                 raise ValueError(f"tenant {t!r} weight must be > 0, got {w}")
+        # Tenant-record weight derivation (riddler paid tiers): a
+        # callable ``tenant_id -> weight | None`` consulted LIVE for
+        # tenants with no explicit/journaled weight — never cached, so
+        # a ``set_tier`` upgrade takes effect on the very next compose
+        # and an idle tenant's derived weight never bloats the
+        # journaled roster (pending_cap counts configured tenants as
+        # active). Recovery re-derives from the same durable tenant
+        # store; replay itself never re-composes, so weights need no
+        # per-tick journal of their own.
+        self.weight_source = weight_source
         self.default_weight = float(default_weight)
         self.quantum_docs = quantum_docs
         self._registry = registry
         self._prefix = prefix
+        # Runtime weight changes (set_weight / weight_source cache) must
+        # journal even before multi-tenant traffic makes the deficits
+        # non-trivial — constructor config alone stays unstamped (the
+        # pre-QoS byte-compat contract).
+        self._weights_dirty = False
         # DRR state (the replay-safe part): per-tenant deficit credit +
         # the rotation order/pointer. Rotation entry is first-seen order
         # — deterministic under deterministic workloads.
@@ -87,6 +103,12 @@ class TenantScheduler:
         self._rr_idx = 0
         # Live accounting (NOT replayed — rebuilt from buffered frames).
         self.pending_docs: dict[str, int] = {}
+        # doc -> owning tenant (observed at submit; bounded, insertion-
+        # ordered eviction). The cluster placement tier reads this to
+        # spread a hot tenant's docs ACROSS hosts instead of letting it
+        # saturate its weighted share on one (parallel/placement.py).
+        self.doc_tenant: dict[str, str] = {}
+        self.max_doc_tenants = 65536
         # Windowed per-tick slot slices: (tick, {tenant: [docs, ops]}).
         self._slices: deque = deque(maxlen=max(1, slice_capacity))
         # Lazily-created per-tenant metrics (a tenant that never sends
@@ -98,12 +120,24 @@ class TenantScheduler:
     # -- weights ---------------------------------------------------------------
 
     def weight(self, tenant: str) -> float:
-        return self.weights.get(tenant, self.default_weight)
+        w = self.weights.get(tenant)
+        if w is not None:
+            return w
+        if self.weight_source is not None:
+            derived = self.weight_source(tenant)
+            if derived is not None and derived > 0:
+                return float(derived)
+        return self.default_weight
 
     def set_weight(self, tenant: str, weight: float) -> None:
+        """Runtime weight change — journals like scheduler state: the
+        next composed tick's WAL header (and the next snapshot) carries
+        it, and recovery restores it (import_state OVERRIDES, so a
+        journaled change survives a restart over static config)."""
         if weight <= 0:
             raise ValueError(f"weight must be > 0, got {weight}")
         self.weights[tenant] = float(weight)
+        self._weights_dirty = True
 
     # -- metrics plumbing ------------------------------------------------------
 
@@ -143,6 +177,16 @@ class TenantScheduler:
         g = self._gauge(tenant)
         if g is not None:
             g.set(self.pending_docs[tenant])
+
+    def note_doc_tenants(self, tenant: str, docs) -> None:
+        """Record doc ownership for the placement tier (called per
+        multi-tenant frame; re-insertion refreshes the eviction order)."""
+        dt = self.doc_tenant
+        for doc in docs:
+            dt.pop(doc, None)
+            dt[doc] = tenant
+        while len(dt) > self.max_doc_tenants:
+            dt.pop(next(iter(dt)))
 
     def note_shed(self, tenant: str, n_ops: int) -> None:
         c = self._counter(tenant, "shed_ops")
@@ -444,8 +488,11 @@ class TenantScheduler:
 
     def is_trivial(self) -> bool:
         """True while no fairness state worth journaling exists: at most
-        the default tenant has ever composed. Keeps single-tenant WAL
-        headers byte-compatible with every pre-QoS reader and golden."""
+        the default tenant has ever composed AND no runtime weight
+        change happened. Keeps single-tenant WAL headers byte-compatible
+        with every pre-QoS reader and golden."""
+        if self._weights_dirty:
+            return False
         return not self.deficit or self._rr == [DEFAULT_TENANT]
 
     def export_state(self) -> dict:
@@ -465,7 +512,17 @@ class TenantScheduler:
         self._rr = list(snap.get("rr", ()))
         self._rr_idx = int(snap.get("rr_idx", 0))
         for t, w in snap.get("weights", {}).items():
-            self.weights.setdefault(t, float(w))
+            # Journaled weights OVERRIDE constructor config: a runtime
+            # set_weight is scheduler STATE, and recovery must compose
+            # against what the crashed host actually used — the tick
+            # headers roll these forward exactly like the deficits.
+            self.weights[t] = float(w)
+        if snap.get("weights"):
+            # Restored runtime weights must KEEP journaling: without
+            # this, a single-tenant host whose deficits look trivial
+            # again would stop stamping headers and a second restart
+            # would silently revert to constructor config.
+            self._weights_dirty = True
 
 
 __all__ = ["TenantScheduler", "DEFAULT_TENANT"]

@@ -199,6 +199,16 @@ class ResidencyManager:
                 "foreign_ticks", {}).get(self.host_label or "", ())]
         return [tuple(t) for t in snap.get("doc_ticks", ())]
 
+    def adopt_cold(self, doc_id: str, handle: str) -> None:
+        """Register an externally-written cold head (the history
+        plane's branch-fork seed writes the cold record itself): cache
+        the handle and count the doc cold — the first connect/frame
+        hydrates it through the normal admission-gated path."""
+        assert doc_id not in self.resident, doc_id
+        self._cold_handles.put(doc_id, handle)
+        self._known_cold += 1
+        self._update_gauges()
+
     def touch(self, doc_id: str, now: float | None = None) -> None:
         """Refresh a resident doc's idle clock (re-insert = LRU order)."""
         self.resident.pop(doc_id, None)

@@ -54,6 +54,16 @@ def sign_token(tenant_id: str, secret: str, document_id: str,
 class Tenant:
     tenant_id: str
     secret: str
+    #: Paid-tier column (the QoS weight source): serving fairness weights
+    #: derive from the tenant RECORD, not static scheduler config — see
+    #: :meth:`TenantManager.weight_for` and server/qos.py weight_source.
+    tier: str = "standard"
+
+
+#: Paid tier -> relative fair-share weight (the deficit scheduler's
+#: per-tenant multiplier). Unknown tiers are rejected at create time.
+TIER_WEIGHTS = {"free": 0.25, "standard": 1.0, "pro": 2.0,
+                "premium": 4.0}
 
 
 class TenantManager:
@@ -66,14 +76,23 @@ class TenantManager:
         self._store = store
         self._tenants: dict[str, Tenant] = {}
         if store is not None:
-            for tenant_id, secret in (store.get(self.STORE_KEY) or {}).items():
-                self._tenants[tenant_id] = Tenant(tenant_id, secret)
+            for tenant_id, rec in (store.get(self.STORE_KEY) or {}).items():
+                if isinstance(rec, str):  # legacy store: bare secret
+                    self._tenants[tenant_id] = Tenant(tenant_id, rec)
+                else:
+                    self._tenants[tenant_id] = Tenant(
+                        tenant_id, rec["secret"],
+                        rec.get("tier", "standard"))
 
     def create_tenant(self, tenant_id: str,
-                      secret: str | None = None) -> Tenant:
+                      secret: str | None = None,
+                      tier: str = "standard") -> Tenant:
         if tenant_id in self._tenants:
             raise ValueError(f"tenant {tenant_id!r} exists")
-        tenant = Tenant(tenant_id, secret or secrets.token_hex(16))
+        if tier not in TIER_WEIGHTS:
+            raise ValueError(f"unknown tier {tier!r} "
+                             f"(one of {sorted(TIER_WEIGHTS)})")
+        tenant = Tenant(tenant_id, secret or secrets.token_hex(16), tier)
         self._tenants[tenant_id] = tenant
         self._persist()
         return tenant
@@ -83,10 +102,38 @@ class TenantManager:
             raise AuthError(f"unknown tenant {tenant_id!r}")
         return self._tenants[tenant_id]
 
+    def set_tier(self, tenant_id: str, tier: str) -> None:
+        """Move a tenant between paid tiers (durable; the scheduler
+        resolves the new weight on its next compose through
+        weight_source and journals it with its state)."""
+        if tier not in TIER_WEIGHTS:
+            raise ValueError(f"unknown tier {tier!r} "
+                             f"(one of {sorted(TIER_WEIGHTS)})")
+        self.get_tenant(tenant_id).tier = tier
+        self._persist()
+
+    def weight_for(self, tenant_id: str) -> float | None:
+        """QoS weight derived from the tenant record's paid tier, or
+        None for unknown tenants (the scheduler falls back to its
+        default weight — an unauthenticated door must not crash the
+        composer)."""
+        tenant = self._tenants.get(tenant_id)
+        if tenant is None:
+            return None
+        return TIER_WEIGHTS.get(tenant.tier)
+
+    def tenant_weights(self) -> dict[str, float]:
+        """Every registered tenant's derived weight (the static-config
+        replacement for ``StormController(tenant_weights=...)``)."""
+        return {t.tenant_id: TIER_WEIGHTS[t.tier]
+                for t in self._tenants.values()
+                if t.tier in TIER_WEIGHTS}
+
     def _persist(self) -> None:
         if self._store is not None:
             self._store.put(self.STORE_KEY, {
-                t.tenant_id: t.secret for t in self._tenants.values()})
+                t.tenant_id: {"secret": t.secret, "tier": t.tier}
+                for t in self._tenants.values()})
 
     def validate_token(self, token: str, document_id: str | None = None,
                        now: float | None = None) -> dict:

@@ -762,11 +762,20 @@ class RouterliciousService:
         the mirror must see the sequenced outcome before any later lane
         frame combines against it."""
         mega = getattr(self.storm, "megadoc", None)
-        if mega is not None and mega.intercept_membership(doc_id, raw):
-            self.orderer.order_system(doc_id, raw)
-            self.pump()
-            mega.complete_membership(doc_id, raw)
-            return
+        if mega is not None:
+            verdict = mega.intercept_membership(doc_id, raw)
+            if verdict == "deferred":
+                # Arrived inside a storm round (idle-eject fired during
+                # the round's pump): parked on the deferred-membership
+                # queue; the flush maintenance cadence orders it through
+                # the FULL mirror path right after the round — never
+                # the legacy adopt-at-decide fallback.
+                return
+            if verdict:
+                self.orderer.order_system(doc_id, raw)
+                self.pump()
+                mega.complete_membership(doc_id, raw)
+                return
         self.orderer.order_system(doc_id, raw)
 
     def _maybe_pump(self) -> None:
@@ -1053,6 +1062,36 @@ class RouterliciousService:
         return [m for m in log
                 if m.sequence_number > from_seq
                 and (to_seq is None or m.sequence_number <= to_seq)]
+
+    # -- history plane (time travel / branches, server/history.py) -------------
+
+    def _history(self):
+        history = getattr(self.storm, "history", None)
+        if history is None:
+            raise RuntimeError(
+                "history plane not enabled (attach a HistoryPlane to "
+                "the storm controller)")
+        return history
+
+    def read_at(self, doc_id: str, seq: int) -> dict:
+        """Materialize ``doc_id``'s converged state at historical
+        ``seq`` — served entirely from summaries + durable records (a
+        cold doc stays cold; no device row hydrates)."""
+        self._maybe_pump()
+        return self._history().read_at(doc_id, seq)
+
+    def fork_doc(self, doc_id: str, seq: int,
+                 name: str | None = None) -> str:
+        """Fork ``doc_id`` at ``seq`` into a named branch doc (a full
+        citizen: residency/QoS/viewers serve it like any doc)."""
+        self._maybe_pump()
+        return self._history().fork(doc_id, seq, name)
+
+    def merge_back(self, branch: str) -> dict:
+        """Re-submit a branch's delta ops into its parent through the
+        ordinary sequencer."""
+        self._maybe_pump()
+        return self._history().merge_back(branch)
 
     def upload_snapshot(self, doc_id: str, snapshot: dict,
                         parent: str | None = None) -> str:

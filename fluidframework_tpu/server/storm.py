@@ -353,8 +353,12 @@ _storm_tick = compile_cache.uncached(_storm_tick)
 #: v2 (round 15) adds mega-doc lifecycle CONTROL records (docs-less tick
 #: headers carrying an "mg" event) and lane-id tick entries — a
 #: rolled-back binary must refuse rather than silently drop a promotion.
-STORM_WAL_VERSION = 2
-STORM_SNAPSHOT_VERSION = 2
+#: v3 (round 18) adds history-plane CONTROL records (docs-less tick
+#: headers carrying an "hp" event: branch forks, trimmed-tick fillers)
+#: and a snapshot "history" field — a rolled-back binary must refuse
+#: rather than silently drop a branch.
+STORM_WAL_VERSION = 3
+STORM_SNAPSHOT_VERSION = 3
 
 
 def choose_pipeline_depth(attribution: dict, current: int = 1) -> int:
@@ -424,6 +428,7 @@ class StormController:
                  doc_index_retention_ticks: int | None = None,
                  wal_commit_latency_s: float = 0.0,
                  tenant_weights: dict[str, float] | None = None,
+                 tenant_weight_source=None,
                  tick_slot_budget: int | None = None,
                  qos_borrow_fraction: float = 0.5,
                  logger=None) -> None:
@@ -487,6 +492,7 @@ class StormController:
         self.durability = durability
         self._blob_log = None
         self._group_wal = None
+        self._spill_path = None
         # (tick_id, [(frame, ack payload)], harvest_ns, ledger record)
         # awaiting the durability watermark — drained in tick order on
         # the serving thread.
@@ -499,6 +505,7 @@ class StormController:
             root = pathlib.Path(spill_dir)
             root.mkdir(parents=True, exist_ok=True)
             path = root / "storm_tick_words.log"
+            self._spill_path = path  # trim_tick_blobs rewrite target
             if durability == "group":
                 # commit_latency_s models a replicated durable log's
                 # quorum round trip (bench regime); 0 = local disk.
@@ -550,8 +557,12 @@ class StormController:
         # Scheduler state (deficits + rotation) rides every
         # multi-tenant tick's WAL header and the snapshot, so recovery
         # resumes composing exactly where the crash stopped.
+        # ``tenant_weight_source`` derives weights from tenant RECORDS
+        # (riddler paid tiers) for tenants with no explicit config; the
+        # resolved weight journals with the scheduler state.
         from .qos import TenantScheduler
         self.qos = TenantScheduler(weights=tenant_weights,
+                                   weight_source=tenant_weight_source,
                                    registry=merge_host.metrics)
         self.tick_slot_budget = tick_slot_budget
         # Weighted-shed borrow threshold: a tenant past its weighted
@@ -572,6 +583,11 @@ class StormController:
         # promoted docs serve up to L writer frames per tick through
         # per-lane sub-sequencer rows + the host combiner.
         self.megadoc = None
+        # History plane (server/history.py attaches itself): time-travel
+        # reads off the cold path, named branches journaled as "hp" WAL
+        # controls, and the background summarization compactor driven
+        # from the flush maintenance cadence below.
+        self.history = None
         # Cluster placement (parallel/placement.py attaches a per-host
         # router): when set, frames naming docs another host owns shed
         # with a "moved" nack carrying the owner as ``moved_to`` (the
@@ -783,6 +799,11 @@ class StormController:
         if not self._replay:
             self.qos.note_submitted(tenant_id, offset)
             self.qos.note_buffered(tenant_id, len(docs))
+            if tenant_id != "default":
+                # Placement input (multi-tenant only — the single-tenant
+                # hot path stays untouched): which tenant owns each doc.
+                self.qos.note_doc_tenants(tenant_id,
+                                          (d for d, *_ in docs))
         if self._pending_docs >= self.flush_threshold_docs:
             # Threshold-triggered: only run FULL rounds; a partial tail
             # (next tick's early frames) waits for its cohort instead of
@@ -962,6 +983,11 @@ class StormController:
         # here (never inside a round), then the RSS arena trim.
         if self.megadoc is not None and not self._replay:
             self.megadoc.maybe_adapt()
+        if self.history is not None and not self._replay \
+                and not self._in_checkpoint:
+            # Summarization compaction cadence (server/history.py): roll
+            # long WAL tails into fresh summaries + trim per retention.
+            self.history.maybe_compact()
         if self._auto_depth and not self._replay and (
                 self.stats["ticks"] - self._depth_adapted_at
                 >= self.depth_adapt_every):
@@ -1943,6 +1969,10 @@ class StormController:
                 # per-tick "qos" headers — deficit counters survive
                 # restarts exactly like the cohort machinery.
                 snap["qos"] = self.qos.export_state()
+            if self.history is not None and self.history.branches:
+                # Branch registry (summaries and cold seeds are already
+                # store-resident under their own heads).
+                snap["history"] = self.history.export_state()
             handle = self.snapshots.upload(self.SNAPSHOT_DOC, snap)
             faults.crashpoint("snapshot.pre_publish")
             self.snapshots.set_head(self.SNAPSHOT_DOC, handle)
@@ -1983,6 +2013,12 @@ class StormController:
                     self.megadoc.import_state(snap["megadoc"])
                 if snap.get("qos") is not None:
                     self.qos.import_state(snap["qos"])
+                if snap.get("history") is not None:
+                    if self.history is None:
+                        raise RuntimeError(
+                            "snapshot holds history-plane branch state "
+                            "but no HistoryPlane is attached")
+                    self.history.import_state(snap["history"])
                 start = snap["tick_watermark"]
                 restored_from = head
                 if self.residency is not None:
@@ -2062,6 +2098,22 @@ class StormController:
                     self._tick_counter = tick + 1
                     self.megadoc.apply_control(mg, header["ts"])
                     continue
+                hp = header.get("hp")
+                if hp is not None:
+                    # History-plane control record: branch forks re-seed
+                    # at the identical point in the total order (the
+                    # seed is a pure function of the records below this
+                    # tick); trimmed-tick fillers are stateless.
+                    self._tick_counter = tick + 1
+                    if hp.get("op") == "trimmed" or hp.get("trimmed"):
+                        continue
+                    if self.history is None:
+                        raise RuntimeError(
+                            "WAL holds history-plane control records "
+                            "but no HistoryPlane is attached — attach "
+                            "one before recover()")
+                    self.history.apply_control(hp, header["ts"])
+                    continue
                 self._tick_counter = tick
                 self._replay_ts = header["ts"]
                 entries = [e[:5] for e in header["docs"]]
@@ -2089,6 +2141,7 @@ class StormController:
                                           w_off[doc] + count * 4])
                             for doc, _c, _c0, _r, count in kept))
                     entries = kept
+                self._adopt_replay_clients(entries, header)
                 self.submit_frame(None, {"docs": entries, "rid": None},
                                   payload)
                 self.flush()
@@ -2097,6 +2150,39 @@ class StormController:
             self._replay_ts = None
         assert self._tick_counter == end, (self._tick_counter, end)
         return end - start
+
+    def _adopt_replay_clients(self, entries: list, header: dict) -> None:
+        """A client named by a durable tick header that the restored
+        row does not know joined AFTER the restore source (a branch
+        fork seed, or a fresh doc created past the last checkpoint —
+        membership rides the bus tier, never the storm WAL). Replaying
+        its frame against the ghost lane would silently drop ops the
+        live tick acked, so adopt the client at its RECORDED dedup
+        prefix: ``cseq`` just below the first sequenced op (the header's
+        ``count - ns`` dup prefix replays to the identical outcome) and
+        ``cref`` at the entry's ref (what the live tick left behind).
+        Mega lane ids are skipped — the combiner mirror syncs lane
+        membership itself (replay_decide)."""
+        rec_by_doc = {e[0]: e for e in header["docs"]}
+        for doc, client, cseq0, ref, count in entries:
+            if self.megadoc is not None \
+                    and self.megadoc.parent_of(doc) is not None:
+                continue
+            row = self.seq_host._rows.get(doc)
+            if row is not None and client in self.seq_host._slots[row]:
+                continue
+            ns = rec_by_doc[doc][5]
+            self.seq_host._row(doc)
+            cp = self.seq_host.checkpoint(doc)
+            cp.clients.append({
+                "client_id": client,
+                "client_seq": cseq0 + (count - ns) - 1,
+                "ref_seq": ref,
+                "last_update": header["ts"],
+                "can_evict": True, "can_summarize": True,
+                "nack": False,
+            })
+            self.seq_host.restore(doc, cp)
 
     # -- per-doc quarantine ----------------------------------------------------
     #
@@ -2158,6 +2244,12 @@ class StormController:
         is exact even when the served planes corrupt) into the converged
         map. The doc stays readable at scalar cost while frozen."""
         from ..dds.map_data import MapData
+        if self.history is not None and self.history.tail_floor(doc_id):
+            # A compacted+trimmed doc's record prefix is gone — the
+            # summary chain is the authoritative base; the history fold
+            # serves the same converged entries shape.
+            return self.history.read_at(
+                doc_id, self.history.head_seq(doc_id))["entries"]
         records = self.records_overlapping(doc_id, 0)
         data = MapData()
         for m in materialize_storm_records(records, self.datastore,
@@ -2299,6 +2391,45 @@ class StormController:
         blob = self._read_blob(tick_id)
         _header, off = self._parse_header(blob)
         return blob[off:]
+
+    def trim_tick_blobs(self, ticks: set[int]) -> int:
+        """Rewrite superseded tick blobs to tiny filler records (the
+        history-plane tail trim): tick ids stay 1:1 with WAL positions
+        — only the bytes shrink. Callers (HistoryPlane.trim_now) have
+        already proven the ticks are below the checkpoint watermark
+        (never replayed) and referenced by no live catch-up index. The
+        filler still parses as a valid docs-less tick header, so a
+        reused spill dir rescans cleanly."""
+        if not ticks:
+            return 0
+        import json as _json
+        import struct as _struct
+        header = _json.dumps(
+            {"v": STORM_WAL_VERSION, "ts": 0, "docs": [],
+             "hp": {"op": "trimmed"}}, separators=(",", ":")).encode()
+        filler = _struct.pack("<I", len(header)) + header
+
+        def transform(idx: int, data: bytes) -> bytes | None:
+            if idx in ticks and len(data) > len(filler):
+                return filler
+            return None
+
+        if self._group_wal is not None:
+            return self._group_wal.rewrite_records(transform)
+        if self._blob_log is not None:
+            # Plain OpLog spill ("sync"/"none"): the shared atomic
+            # rewrite, no writer thread to coordinate with.
+            from .durable_store import rewrite_oplog_records
+            self._blob_log, changed = rewrite_oplog_records(
+                self._blob_log, self._spill_path, transform)
+            return changed
+        changed = 0
+        for tick in ticks:
+            blob = self._tick_blobs.get(tick)
+            if blob is not None and len(blob) > len(filler):
+                self._tick_blobs[tick] = filler
+                changed += 1
+        return changed
 
     def records_overlapping(self, doc_id: str, from_seq: int,
                             to_seq: int | None = None) -> list[dict]:

@@ -149,6 +149,25 @@ QOS_KILL_POINTS = ("storm.qos_mid_compose", "storm.mid_tick",
 QOS_TENANTS = ("tn-abuser", "tn-b", "tn-c")
 QOS_ABUSE_FACTOR = 10
 
+#: History-plane kill classes (ISSUE 15): the child serves with a
+#: HistoryPlane compacting aggressively (summaries every ~2 rounds,
+#: tail retention 1 — trims fire) and forks ONE branch mid-run whose
+#: seeded writer keeps co-serving. Each point kills a distinct window:
+#: summary uploaded but head not flipped (the previous summary stays
+#: authoritative; the next cadence re-compacts) / fork control
+#: journaled but the branch not yet seeded (replay re-derives the
+#: identical seed) / records appended, not fsynced. The TWIN attaches
+#: the same plane but NEVER compacts or trims, so one digest equality
+#: proves kill-recovery AND compaction-never-changes-state — rolled-up
+#: summaries move read cost and disk, never bytes.
+HISTORY_KILL_POINTS = ("history.mid_compaction", "history.mid_fork",
+                       "wal.pre_fsync")
+
+#: Deterministic writer identity seeded INTO the fork control record
+#: (no bus-ordered join, so branch serving replays self-contained).
+HISTORY_BRANCH_WRITER = "branch-writer"
+HISTORY_BRANCH = "chaos-branch"
+
 
 # -- child process (the serving host under test) ------------------------------
 
@@ -440,6 +459,106 @@ def _qos_child(args) -> None:
     print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
 
 
+def _history_digest(service, storm, seq_host, merge_host, hist,
+                    docs: list[str]) -> dict:
+    """The history twin-diff surface: compaction-INVARIANT planes only
+    — converged map, sequencer checkpoint (minus arrival clocks), the
+    history plane's own read_at at head, and the branch registry. The
+    full per-op history is deliberately absent: the compacting arm
+    trimmed its tail prefix by design (a summary is a rollup), so the
+    digest compares exactly what compaction promises to preserve."""
+    out: dict = {"docs": {}, "branches": hist.export_state()}
+    for doc in docs:
+        cp = dataclasses.asdict(seq_host.checkpoint(doc))
+        cp.pop("log_offset", None)
+        for client in cp["clients"]:
+            client["last_update"] = 0  # arrival clock, not replica state
+        head = hist.head_seq(doc)
+        out["docs"][doc] = {
+            "map": merge_host.map_entries(doc, storm.datastore,
+                                          storm.channel),
+            "sequencer": cp,
+            "read_at_head": hist.read_at(doc, head),
+        }
+    return out
+
+
+def _history_child(args) -> None:
+    """One history-plane serving life (``--history compact|plain``):
+    per-doc frames per round, a mid-run branch fork (seeded writer
+    co-serves from the fork round on), and — in the ``compact`` arm —
+    the background summarizer rolling every ~2 rounds with tail
+    retention 1 (trims fire under the checkpoint watermark). ``plain``
+    is the never-compacted differential twin."""
+    from ..server.history import HistoryPlane
+    from ..utils import faults
+
+    compact = args.history == "compact"
+    docs = [f"chaos-doc-{i}" for i in range(args.docs)]
+    service, storm, seq_host, merge_host = _build_stack(args.dir,
+                                                        args.docs + 1)
+    hist = HistoryPlane(
+        storm,
+        summary_interval_ops=2 * args.k if compact else None,
+        tail_retention_summaries=1 if compact else None,
+        compact_check_every=1, trim_batch_ticks=1)
+    if args.resume_from is None:
+        clients = {d: service.connect(d, lambda m: None).client_id
+                   for d in docs}
+        service.pump()
+        storm.checkpoint()
+        start = 0
+        print("GENESIS", flush=True)
+    else:
+        info = storm.recover()
+        assert info["restored_from"] is not None, "no snapshot to recover"
+        clients = {d: f"client-{i + 1}" for i, d in enumerate(docs)}
+        start = args.resume_from
+    print("READY", flush=True)
+    faults.arm()
+    k = args.k
+    fork_at = max(1, args.ticks // 2)
+    # doc 0's seq at the START of round fork_at: join at 1, k ops/round.
+    fork_seq = 1 + fork_at * k
+    for r in range(start, args.ticks):
+        if r >= fork_at and HISTORY_BRANCH not in hist.branches:
+            # Fresh fork, or a re-fork after a kill that lost the
+            # unfsynced control — same seq, same derived seed.
+            hist.fork(docs[0], fork_seq, name=HISTORY_BRANCH,
+                      writer=HISTORY_BRANCH_WRITER)
+        acks: list = []
+        n_frames = 0
+        for i, d in enumerate(docs):
+            payload = _tick_words(args.seed, r, i, k).tobytes()
+            storm.submit_frame(
+                acks.append,
+                {"rid": (r, d),
+                 "docs": [[d, clients[d], 1 + r * k, 1, k]]},
+                memoryview(payload))
+            n_frames += 1
+        if r >= fork_at:
+            rb = r - fork_at
+            payload = _tick_words(args.seed, 1000 + r, 0, k).tobytes()
+            storm.submit_frame(
+                acks.append,
+                {"rid": (r, HISTORY_BRANCH),
+                 "docs": [[HISTORY_BRANCH, HISTORY_BRANCH_WRITER,
+                           1 + rb * k, fork_seq, k]]},
+                memoryview(payload))
+            n_frames += 1
+        storm.flush()
+        ok = [a for a in acks
+              if not (isinstance(a, dict) and a.get("error"))]
+        if len(ok) == n_frames:
+            print(f"ACKED {r}", flush=True)
+        if (r + 1) % args.cp_every == 0:
+            storm.checkpoint()
+    faults.disarm()
+    digest = _history_digest(service, storm, seq_host, merge_host, hist,
+                             docs + [HISTORY_BRANCH])
+    print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
+
+
 def child_main(args) -> None:
     """One serving-process life. Protocol on stdout (parent parses):
     ``READY`` once serving can start, ``ACKED <round>`` per
@@ -454,6 +573,9 @@ def child_main(args) -> None:
         return
     if getattr(args, "qos", None):
         _qos_child(args)
+        return
+    if getattr(args, "history", None):
+        _history_child(args)
         return
     mega_lanes = getattr(args, "megadoc", None)
     docs = [f"chaos-doc-{i}" for i in range(args.docs)]
@@ -645,7 +767,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 megadoc: int | None = None,
                 cluster: bool = False,
                 migrate_at: int = -1,
-                qos: str | None = None) -> dict:
+                qos: str | None = None,
+                history: str | None = None) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
@@ -660,6 +783,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
         cmd += ["--cluster", "--migrate-at", str(migrate_at)]
     if qos is not None:
         cmd += ["--qos", qos]
+    if history is not None:
+        cmd += ["--history", history]
     if resume_from is not None:
         cmd += ["--resume-from", str(resume_from)]
     env = dict(os.environ)
@@ -688,7 +813,8 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               megadoc: int | None = None,
               cluster: bool = False,
               migrate_at: int | None = None,
-              qos: bool = False) -> dict:
+              qos: bool = False,
+              history: bool = False) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
@@ -717,18 +843,25 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
         raise ValueError("cluster=True is its own scenario stack")
     if qos and (cluster or residency is not None or pipelined or megadoc):
         raise ValueError("qos=True is its own scenario stack")
+    if history and (qos or cluster or residency is not None
+                    or pipelined or megadoc):
+        raise ValueError("history=True is its own scenario stack")
     cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
                residency=residency, pipelined=pipelined, megadoc=megadoc,
                cluster=cluster,
                migrate_at=(migrate_at if migrate_at is not None
                            else ticks // 2) if cluster else -1,
-               qos="fair" if qos else None)
+               qos="fair" if qos else None,
+               history="compact" if history else None)
     if twin_digest is None:
-        # The qos twin is tenant-BLIND (same frames, no fairness):
-        # digest equality then ALSO proves fair composition never
-        # changes converged replica state — the cluster-twin pattern.
+        # The qos twin is tenant-BLIND (same frames, no fairness);
+        # the history twin is NEVER-compacted (same frames, same fork):
+        # digest equality then ALSO proves fair composition (resp.
+        # summarization compaction) never changes converged replica
+        # state — the cluster-twin pattern.
         twin_cfg = dict(cfg, migrate_at=-1) if cluster else (
-            dict(cfg, qos="blind") if qos else cfg)
+            dict(cfg, qos="blind") if qos else (
+                dict(cfg, history="plain") if history else cfg))
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
                            kill_env=None, timeout=timeout, **twin_cfg)
         assert twin["returncode"] == 0, twin["stderr"]
@@ -766,6 +899,30 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
     # No acked-durable op may be lost: every acked round's client seqs
     # must appear in the final history of every doc.
     from ..protocol.messages import MessageType
+    if history:
+        # The compacting arm's per-op prefix is trimmed BY DESIGN (the
+        # summary is the rollup), so retention is proven on the
+        # sequencer's per-client cseq watermarks instead: an acked
+        # round's ops were absorbed iff the writer's cseq covers them
+        # (their EFFECT is pinned by the twin-digest equality above).
+        fork_at = max(1, ticks // 2)
+        for doc, planes in digest["docs"].items():
+            cseqs = {c["client_id"]: c["client_seq"]
+                     for c in planes["sequencer"]["clients"]}
+            for r in acked:
+                if doc == HISTORY_BRANCH:
+                    if r < fork_at:
+                        continue
+                    want = (r - fork_at + 1) * k
+                    got = cseqs.get(HISTORY_BRANCH_WRITER, 0)
+                else:
+                    want = (r + 1) * k
+                    got = max(cseqs.values(), default=0)
+                assert got >= want, (
+                    f"acked round {r} lost ops for {doc}: writer cseq "
+                    f"{got} < {want}")
+        report["twin_digest"] = twin_digest
+        return report
     for doc, planes in digest["docs"].items():
         cseqs = {h[1] for h in planes["history"]
                  if h[4] == int(MessageType.OPERATION)}
@@ -1326,6 +1483,14 @@ def main(argv=None) -> None:
                              "first at 10x, through the deficit-fair "
                              "composer (fair) or tenant-blind (blind — "
                              "the differential twin; QOS_KILL_POINTS "
+                             "scenarios)")
+    parser.add_argument("--history", default=None,
+                        choices=("compact", "plain"),
+                        help="history-plane child: per-doc frames with "
+                             "a mid-run branch fork; 'compact' runs the "
+                             "background summarizer + tail trim, "
+                             "'plain' is the never-compacted "
+                             "differential twin (HISTORY_KILL_POINTS "
                              "scenarios)")
     parser.add_argument("--cluster", action="store_true",
                         help="serve a two-host in-process cluster over "

@@ -280,6 +280,36 @@ def render_megadoc(metrics: dict, prev: dict | None = None,
             f"boundary-exchanges {exchanges:,.1f}/s")
 
 
+def render_history(metrics: dict, prev: dict | None = None,
+                   interval: float = 1.0) -> str:
+    """History-plane line (the round-18 time-travel tier): live branch
+    count, summarization compactions (rate over the poll window;
+    cumulative with no window), trimmed WAL ticks, the deepest
+    un-summarized tail (ops behind the newest summary — the compaction
+    backlog signal), historical-read rate + p99, and merge-backs.
+    Empty when no history plane is attached (the gauges never
+    appear)."""
+    if "history.branches" not in metrics:
+        return ""
+    branches = metrics.get("history.branches", 0)
+    compactions = metrics.get("history.compactions", 0)
+    trimmed = metrics.get("history.trimmed_ticks", 0)
+    tail = metrics.get("history.tail_ops", 0)
+    reads = metrics.get("history.reads", 0)
+    merges = metrics.get("history.merges", 0)
+    per_s = max(interval, 1e-9)
+    if prev:
+        w_c = compactions - prev.get("history.compactions", 0)
+        w_r = reads - prev.get("history.reads", 0)
+        if w_c >= 0 and w_r >= 0:  # negative = service restarted
+            compactions, reads = w_c / per_s, w_r / per_s
+    p99 = metrics.get("history.read_s.p99", 0.0) * 1e3
+    return (f"history: branches {branches:g}  "
+            f"compactions {compactions:,.2f}/s  "
+            f"trimmed-ticks {trimmed:g}  tail {tail:g} ops  "
+            f"reads {reads:,.1f}/s p99 {p99:.3f}ms  merges {merges:g}")
+
+
 def render_tenants(metrics: dict, prev: dict | None = None,
                    interval: float = 1.0) -> str:
     """Multi-tenant QoS table (the round-17 fairness plane): one SLO row
@@ -370,6 +400,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     cluster_line = render_cluster(now, prev or None, interval)
     if cluster_line:
         lines.append(cluster_line)
+    history_line = render_history(now, prev or None, interval)
+    if history_line:
+        lines.append(history_line)
     tenant_line = render_tenants(now, prev or None, interval)
     if tenant_line:
         lines.append(tenant_line)

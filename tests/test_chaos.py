@@ -614,3 +614,71 @@ def test_qos_chaos_full_matrix(seed, tmp_path):
     killed = [r for r in reports if r["killed"]]
     assert len(killed) >= len(reports) // 2, \
         [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
+
+
+# -- history-plane kill classes (ISSUE 15): tier-1 smoke + slow matrix ---------
+
+#: Aggressive compaction cadence (summaries every ~2 rounds, retention
+#: 1, trims under the checkpoint watermark) + a mid-run branch fork —
+#: the mid-compaction/mid-fork windows genuinely fire.
+_HIST_CFG = dict(seed=0, docs=2, k=8, ticks=6, cp_every=2)
+
+_HIST_SMOKE = [("history.mid_compaction", 1), ("history.mid_fork", 1)]
+
+
+@pytest.fixture(scope="session")
+def history_twin_digest(tmp_path_factory):
+    """NEVER-compacted twin (same frames, same fork, summarizer off):
+    equality with the compacting arm proves summarization compaction +
+    tail trim never change converged state."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("hist_twin")), resume_from=None,
+        kill_env=None, timeout=300, history="plain", **_HIST_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _HIST_SMOKE,
+                         ids=[p for p, _ in _HIST_SMOKE])
+def test_history_chaos_smoke_recovers_byte_identical(
+        point, hits, tmp_path, history_twin_digest):
+    """Kill mid-compaction (summary uploaded, head not flipped) and
+    mid-fork (control journaled, branch not seeded): recovery must
+    reconverge byte-identical to the never-compacted twin — converged
+    maps, sequencer checkpoints, read_at-at-head, branch registry —
+    with zero acked-durable ops lost (the ISSUE 15 chaos bar)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=history_twin_digest,
+                             history=True, **_HIST_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_HIST_CFG["ticks"]))
+
+
+def test_history_compacting_clean_run_matches_plain_twin(
+        tmp_path, history_twin_digest):
+    """No kill at all: the compacting/trimming arm must digest
+    byte-identical to the never-compacted twin — summaries move read
+    cost and disk, never bytes."""
+    life = chaos._spawn_life(str(tmp_path), resume_from=None,
+                             kill_env=None, timeout=300,
+                             history="compact", **_HIST_CFG)
+    assert life["returncode"] == 0, life["stderr"]
+    assert json.dumps(life["digest"], sort_keys=True) == json.dumps(
+        history_twin_digest, sort_keys=True)
+    assert life["acked"] == list(range(_HIST_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_history_chaos_full_matrix(seed, tmp_path):
+    """Slow soak: every history kill point × hit position, per seed."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.HISTORY_KILL_POINTS, seeds=(seed,),
+        hit_positions=(1, 2), docs=2, k=8, ticks=6, cp_every=2,
+        history=True)
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]

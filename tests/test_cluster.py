@@ -382,3 +382,164 @@ def test_activation_survives_cluster_rebuild(tmp_path):
     rebuilt = StormCluster(hosts, git)
     assert sorted(rebuilt.active) == sorted(labels)
     assert sorted(rebuilt.hosts_list()) == sorted(labels)
+
+
+# -- QoS x placement (round-18 residue): tenant-aware spread -------------------
+
+
+class _TenantBackend:
+    """Deterministic duck-typed backend: three hosts, per-doc tenants,
+    static signals — plan() is pure in these."""
+
+    def __init__(self, owned, tenants, loads=None):
+        self._owned = {h: list(ds) for h, ds in owned.items()}
+        self._tenants = tenants
+        self._loads = loads or {}
+
+    def hosts_list(self):
+        return sorted(self._owned)
+
+    def owned(self, host):
+        return list(self._owned[host])
+
+    def load_signals(self, host):
+        tload = {}
+        for d in self._owned[host]:
+            t = self._tenants.get(d)
+            if t is not None:
+                tload[t] = tload.get(t, 0) + 1
+        return {"docs": len(self._owned[host]), "queue_depth": 0,
+                "tick_cost_ms": self._loads.get(host, 0.0),
+                "tenant_load": tload}
+
+    def doc_tenant(self, host, doc):
+        return self._tenants.get(doc)
+
+    def migrate(self, doc, dst):
+        for h, ds in self._owned.items():
+            if doc in ds:
+                ds.remove(doc)
+        self._owned[dst].append(doc)
+
+
+def test_plan_spreads_hot_tenant_across_hosts():
+    """A hot tenant saturating one host spreads to the host where it
+    is LIGHTEST: count-tied receivers break ties on that tenant's
+    load, and the donor sheds the hot tenant's docs first."""
+    tenants = {f"h{i}": "hot" for i in range(6)}
+    tenants.update({f"b{i}": "quiet" for i in range(3)})
+    backend = _TenantBackend(
+        owned={"A": [f"h{i}" for i in range(6)],
+               # B and C tie on count; B already carries the hot tenant.
+               "B": ["h5x", "b0", "b1"], "C": ["b2", "q0", "q1"]},
+        tenants=dict(tenants, h5x="hot", q0="quiet", q1="quiet"))
+    ctrl = PlacementController(backend, max_moves_per_round=2)
+    plan = ctrl.plan()
+    assert plan, "over-count host must shed"
+    for doc, src, _dst in plan:
+        assert src == "A"
+        assert backend.doc_tenant(src, doc) == "hot"
+    # First receiver is C (count-tied with B, but 'hot' is lightest
+    # there); the next min-count host takes the following move — the
+    # hot tenant SPREADS instead of piling onto one receiver.
+    assert [dst for _d, _s, dst in plan] == ["C", "B"], plan
+
+    # Tenant-blind backend (no doc_tenant): byte-for-byte the legacy
+    # cheapest-first / min-count plan.
+    class _Blind(_TenantBackend):
+        doc_tenant = None
+    blind = _Blind(owned={"A": [f"h{i}" for i in range(6)],
+                          "B": ["h5x", "b0", "b1"],
+                          "C": ["b2", "q0", "q1"]}, tenants={})
+    del _Blind.doc_tenant
+    blind_plan = PlacementController(blind, max_moves_per_round=2).plan()
+    assert [doc for doc, *_ in blind_plan] == ["h0", "h1"]
+
+
+def test_cluster_load_signals_carry_tenant_load(tmp_path):
+    """StormCluster threads per-tenant doc ownership (observed at the
+    storm front door) into the placement signals."""
+    git, hosts, cluster = _build(tmp_path)
+    docs = ["doc-0", "doc-1"]
+    clients = _connect(cluster, docs)
+    cseq = {d: 1 for d in docs}
+    for i, d in enumerate(docs):
+        storm = cluster.storm_for(d)
+        storm.submit_frame(
+            lambda p: None,
+            {"rid": d, "docs": [[d, clients[d], cseq[d], 1, 4]]},
+            memoryview(_words([9, i]).tobytes()),
+            tenant_id="tn-hot")
+        storm.flush()
+    total = {}
+    for label in cluster.labels:
+        sig = cluster.load_signals(label)
+        for t, n in sig["tenant_load"].items():
+            total[t] = total.get(t, 0) + n
+        for d in cluster.owned(label):
+            if d in docs:
+                assert cluster.doc_tenant(label, d) == "tn-hot"
+    assert total == {"tn-hot": 2}
+
+
+# -- batch drain (round-18 residue): one durable directory write ---------------
+
+
+def test_batch_drain_uses_two_directory_writes(tmp_path):
+    """Draining a host's whole range goes through ONE durable intent
+    write + ONE completion write (vs 2 per doc), with every doc served
+    on its target afterwards."""
+    docs = [f"doc-{i}" for i in range(4)]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {d: 1 for d in docs}
+    _serve_round(cluster, docs, clients, cseq, 0)
+    hot = max(cluster.labels, key=lambda h: len(cluster.owned(h)))
+    n_docs = len(cluster.owned(hot))
+    assert n_docs >= 2
+    saves = []
+    orig = type(cluster.directory)._save
+
+    def counting_save(self):
+        saves.append(1)
+        return orig(self)
+
+    type(cluster.directory)._save = counting_save
+    try:
+        report = PlacementController(cluster).drain(hot)
+    finally:
+        type(cluster.directory)._save = orig
+    assert report["remaining"] == 0
+    assert report["moves"] == n_docs
+    assert report["directory_writes"] == 2
+    assert len(saves) == 2, saves
+    assert not cluster.directory.migrating
+    # Drained docs keep serving at their targets.
+    _serve_round(cluster, docs, clients, cseq, 1)
+    digest = _cluster_digest(cluster, docs)
+    for d in docs:
+        assert digest["docs"][d]["map"]
+
+
+def test_batch_drain_recovery_rolls_each_intent_forward(tmp_path):
+    """A batch freeze with no completion (the crash window) is N
+    per-doc durable intents published together: recover() rolls every
+    one forward individually."""
+    docs = ["doc-0", "doc-1", "doc-2"]
+    git, hosts, cluster = _build(tmp_path)
+    clients = _connect(cluster, docs)
+    cseq = {d: 1 for d in docs}
+    _serve_round(cluster, docs, clients, cseq, 0)
+    hot = max(cluster.labels, key=lambda h: len(cluster.owned(h)))
+    dst = next(h for h in cluster.labels if h != hot)
+    mine = list(cluster.owned(hot))
+    cluster.directory.freeze_many([(d, hot, dst) for d in mine])
+    for d in mine:
+        assert cluster._route(d, hot)[0] == "migrating"
+        if cluster.hosts[hot].residency.is_resident(d):
+            cluster.hosts[hot].residency.evict(d, reason="migration")
+    completed = cluster.recover()
+    assert sorted(completed) == sorted(mine)
+    for d in mine:
+        assert cluster.owner_of(d) == dst
+    _serve_round(cluster, docs, clients, cseq, 1)

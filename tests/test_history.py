@@ -1,0 +1,483 @@
+"""History plane (round 18): time-travel reads, named branches, and
+summarization compaction (server/history.py).
+
+The acceptance bars under test:
+
+* **materialize-at-N ≡ replay-to-N** — for EVERY seq of a fuzzed op
+  stream, ``read_at(doc, s)`` equals a naive sequential replay of the
+  materialized deltas to ``s`` (and at the head, the DEVICE row);
+* **fork at N ≡ replay-to-N** — the branch's seeded device planes are
+  byte-identical to the parent's planes captured at N;
+* **compaction never changes state** — a compacting/trimming plane
+  serves every still-addressable read byte-identical to a
+  never-compacted twin, survives restart over the trimmed WAL, and
+  genuinely shrinks the spill file;
+* **merge-back determinism** — branch deltas re-submitted through the
+  ordinary sequencer converge identically across runs, concurrent
+  parent head writes included.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server.durable_store import GitSnapshotStore
+from fluidframework_tpu.server.history import (
+    HistoryError,
+    HistoryPlane,
+)
+from fluidframework_tpu.server.kernel_host import KernelSequencerHost
+from fluidframework_tpu.server.merge_host import KernelMergeHost
+from fluidframework_tpu.server.residency import ResidencyManager
+from fluidframework_tpu.server.routerlicious import RouterliciousService
+from fluidframework_tpu.server.storm import StormController
+
+K = 8
+
+
+def _stack(root, residency=False, spill=True, **hist_kw):
+    seq_host = KernelSequencerHost(num_slots=2, initial_capacity=8)
+    merge_host = KernelMergeHost(flush_threshold=10**9)
+    service = RouterliciousService(merge_host=merge_host,
+                                   batched_deli_host=seq_host,
+                                   auto_pump=False,
+                                   idle_check_interval=10**9)
+    kw: dict = {}
+    if spill:
+        kw.update(spill_dir=str(root / "spill"), durability="group")
+    storm = StormController(service, seq_host, merge_host,
+                            flush_threshold_docs=10**9, pipeline_depth=0,
+                            snapshots=GitSnapshotStore(str(root / "git")),
+                            **kw)
+    hist = HistoryPlane(storm, **hist_kw)
+    res = None
+    if residency:
+        res = ResidencyManager(storm, idle_evict_s=1e9,
+                               hydration_rate_per_s=1e9)
+    return service, storm, hist, res
+
+
+def _close(storm):
+    if storm._group_wal is not None:
+        storm._group_wal.close()
+
+
+def _words(seed, r, i, k=K, clears=True):
+    rng = np.random.default_rng([seed, r, i])
+    kinds = rng.choice([0, 0, 0, 1, 2] if clears else [0, 0, 0, 1],
+                       size=k).astype(np.uint32)
+    slots = rng.integers(0, 16, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def _serve(service, storm, docs, rounds, seed=7, clears=True,
+           checkpoint_first=True):
+    clients = {d: service.connect(d, lambda m: None).client_id
+               for d in docs}
+    service.pump()
+    if checkpoint_first and storm.snapshots is not None:
+        storm.checkpoint()
+    for r in range(rounds):
+        for i, d in enumerate(docs):
+            storm.submit_frame(
+                None, {"rid": (r, d),
+                       "docs": [[d, clients[d], 1 + r * K, 1, K]]},
+                memoryview(_words(seed, r, i, clears=clears).tobytes()))
+        storm.flush()
+    return clients
+
+
+def _naive_prefixes(service, doc):
+    """{seq: entries-after-applying-ops-through-seq} from the
+    materialized delta stream — the reference fold read_at must match
+    at EVERY seq."""
+    from fluidframework_tpu.protocol.messages import MessageType
+    by_seq = {}
+    for m in service.get_deltas(doc, 0):
+        if m.type == MessageType.OPERATION:
+            by_seq[m.sequence_number] = \
+                m.contents["contents"]["contents"]
+    head = max(by_seq, default=0)
+    state: dict = {}
+    out = {0: {}}
+    for s in range(1, head + 1):
+        c = by_seq.get(s)
+        if c is not None:
+            if c["type"] == "set":
+                state[c["key"]] = c["value"]
+            elif c["type"] == "delete":
+                state.pop(c["key"], None)
+            else:
+                state.clear()
+        out[s] = dict(state)
+    return out
+
+
+class TestTimeTravel:
+    def test_materialize_at_n_equals_replay_to_n_every_seq(self,
+                                                           tmp_path):
+        """The differential bar: for every seq of a fuzzed stream
+        (sets/deletes/clears), read_at's scalar fold equals the naive
+        sequential replay — and at the head, the device row."""
+        service, storm, hist, _ = _stack(tmp_path)
+        _serve(service, storm, ["d0"], rounds=6)
+        ref = _naive_prefixes(service, "d0")
+        head = hist.head_seq("d0")
+        assert head == max(ref)
+        for s in range(0, head + 1):
+            got = hist.read_at("d0", s)["entries"]
+            assert got == ref[s], (s, got, ref[s])
+        assert hist.read_at("d0", head)["entries"] == \
+            storm.merge_host.map_entries("d0", storm.datastore,
+                                         storm.channel)
+        _close(storm)
+
+    def test_read_at_serves_cold_docs_without_hydrating(self, tmp_path):
+        """Time travel is a READ: a cold doc's whole history serves
+        from its cold tick index + summaries — the pool never churns."""
+        service, storm, hist, res = _stack(tmp_path, residency=True)
+        _serve(service, storm, ["d0"], rounds=4)
+        ref = _naive_prefixes(service, "d0")
+        head = hist.head_seq("d0")
+        res.evict("d0")
+        assert not res.is_resident("d0")
+        hydrations_before = res.stats["hydrations"]
+        for s in (1, head // 2, head):
+            assert hist.read_at("d0", s)["entries"] == ref[s]
+        assert not res.is_resident("d0")  # reads never hydrate
+        assert res.stats["hydrations"] == hydrations_before
+        _close(storm)
+
+    def test_read_beyond_head_and_below_floor(self, tmp_path):
+        service, storm, hist, _ = _stack(
+            tmp_path, tail_retention_summaries=0)
+        _serve(service, storm, ["d0"], rounds=4)
+        head = hist.head_seq("d0")
+        with pytest.raises(HistoryError):
+            hist.read_at("d0", head + 1)  # beyond head fails fast
+        storm.checkpoint()
+        assert hist.compact("d0") is not None
+        assert hist.tail_floor("d0") == head
+        # Exact summary state stays addressable; interior seqs are gone.
+        assert hist.read_at("d0", head)["entries"]
+        with pytest.raises(HistoryError):
+            hist.read_at("d0", head - 1)
+        _close(storm)
+
+
+class TestCompaction:
+    def test_compacted_reads_match_never_compacted_twin(self, tmp_path):
+        """Summaries move read COST, never bytes: every seq still
+        addressable after compaction reads byte-identical to the
+        never-compacted twin."""
+        s1, st1, h1, _ = _stack(tmp_path / "a",
+                                tail_retention_summaries=1)
+        s2, st2, h2, _ = _stack(tmp_path / "b")
+        _serve(s1, st1, ["d0"], rounds=6)
+        _serve(s2, st2, ["d0"], rounds=6)
+        st1.checkpoint()
+        mid_handle = h1.compact("d0")
+        assert mid_handle is not None
+        # Serve more, compact again — the chain grows, floor advances.
+        for r in range(6, 9):
+            for st, svc in ((st1, s1), (st2, s2)):
+                client = "client-1"
+                st.submit_frame(
+                    None, {"rid": r,
+                           "docs": [["d0", client, 1 + r * K, 1, K]]},
+                    memoryview(_words(7, r, 0).tobytes()))
+                st.flush()
+        st1.checkpoint()
+        assert h1.compact("d0") is not None
+        h1.trim_now()
+        floor = h1.tail_floor("d0")
+        assert floor > 0
+        head = h1.head_seq("d0")
+        assert head == h2.head_seq("d0")
+        for s in range(floor, head + 1):
+            assert h1.read_at("d0", s) == h2.read_at("d0", s), s
+        # The chain's exact states below the floor stay addressable too.
+        chain_seq = h1.summary_seq("d0")
+        assert h1.read_at("d0", chain_seq) == h2.read_at("d0", chain_seq)
+        _close(st1)
+        _close(st2)
+
+    def test_trim_shrinks_spill_and_survives_restart(self, tmp_path):
+        """The disk story: tail trim rewrites superseded tick blobs to
+        fillers under the checkpoint watermark — the spill file
+        genuinely shrinks and a restarted controller recovers
+        byte-identically over the trimmed WAL."""
+        service, storm, hist, _ = _stack(
+            tmp_path, tail_retention_summaries=0, trim_batch_ticks=1)
+        _serve(service, storm, ["d0", "d1"], rounds=6)
+        storm.checkpoint()
+        spill = tmp_path / "spill" / "storm_tick_words.log"
+        before = os.path.getsize(spill)
+        assert hist.compact("d0") and hist.compact("d1")
+        assert hist.trim_now() == 0  # queued at compact time already
+        assert hist.stats["trimmed_ticks"] > 0
+        after = os.path.getsize(spill)
+        assert after < before, (before, after)
+        live = {d: storm.merge_host.map_entries(d, storm.datastore,
+                                                storm.channel)
+                for d in ("d0", "d1")}
+        live_reads = {d: hist.read_at(d, hist.head_seq(d))
+                      for d in ("d0", "d1")}
+        _close(storm)
+        service2, storm2, hist2, _ = _stack(tmp_path)
+        storm2.recover()
+        for d in ("d0", "d1"):
+            assert storm2.merge_host.map_entries(
+                d, storm2.datastore, storm2.channel) == live[d]
+            assert hist2.read_at(d, hist2.head_seq(d)) == live_reads[d]
+        _close(storm2)
+
+    def test_maybe_compact_cadence_rolls_long_tails(self, tmp_path):
+        """The background summarizer: tails past the op threshold roll
+        on the flush maintenance cadence without explicit calls."""
+        service, storm, hist, _ = _stack(
+            tmp_path, summary_interval_ops=2 * K, compact_check_every=1)
+        _serve(service, storm, ["d0"], rounds=6)
+        assert hist.stats["compactions"] >= 1
+        assert hist.summary_seq("d0") > 0
+        # Reads above the newest summary fold only the short tail.
+        head = hist.head_seq("d0")
+        assert hist.read_at("d0", head)["entries"] == \
+            storm.merge_host.map_entries("d0", storm.datastore,
+                                         storm.channel)
+        _close(storm)
+
+    def test_quarantined_read_path_survives_trim(self, tmp_path):
+        """quarantined_map_entries falls back to the summary fold once
+        the record prefix is trimmed (the scalar-shadow seam)."""
+        service, storm, hist, _ = _stack(
+            tmp_path, tail_retention_summaries=0, trim_batch_ticks=1)
+        _serve(service, storm, ["d0"], rounds=4)
+        storm.checkpoint()
+        assert hist.compact("d0")
+        expect = storm.merge_host.map_entries("d0", storm.datastore,
+                                              storm.channel)
+        assert storm.quarantined_map_entries("d0") == expect
+        _close(storm)
+
+
+class TestBranches:
+    def test_fork_seeds_byte_identical_planes(self, tmp_path):
+        """fork at N ≡ replay-to-N, byte-for-byte: the branch's device
+        planes equal the parent's planes captured right after seq N."""
+        service, storm, hist, _ = _stack(tmp_path, spill=False)
+        clients = _serve(service, storm, ["d0"], rounds=3,
+                         checkpoint_first=False)
+        xs = storm.merge_host._xstate
+        prow = storm._storm_mrow("d0").row
+        at_n = {f: np.asarray(getattr(xs, f)[prow])
+                for f in ("present", "value", "vseq", "cleared_seq")}
+        seq_n = storm.seq_host.checkpoint("d0").sequence_number
+        for r in range(3, 6):  # the head moves past N
+            storm.submit_frame(
+                None, {"rid": r,
+                       "docs": [["d0", clients["d0"], 1 + r * K, 1, K]]},
+                memoryview(_words(7, r, 0).tobytes()))
+            storm.flush()
+        branch = hist.fork("d0", seq_n, name="b0")
+        xs = storm.merge_host._xstate
+        brow = storm._storm_mrow(branch).row
+        for f in ("present", "value", "vseq", "cleared_seq"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(xs, f)[brow]), at_n[f], err_msg=f)
+        cp = storm.seq_host.checkpoint(branch)
+        assert cp.sequence_number == seq_n
+        assert hist.read_at(branch, seq_n)["entries"] == \
+            hist.read_at("d0", seq_n)["entries"]
+
+    def test_branch_reads_below_fork_delegate_to_parent(self, tmp_path):
+        service, storm, hist, _ = _stack(tmp_path)
+        _serve(service, storm, ["d0"], rounds=4)
+        ref = _naive_prefixes(service, "d0")
+        branch = hist.fork("d0", 17, name="b0")
+        for s in (1, 9, 17):
+            assert hist.read_at(branch, s)["entries"] == ref[s]
+        meta = hist.branch_info(branch)
+        assert meta == {"parent": "d0", "seq": 17, "name": "b0"}
+        _close(storm)
+
+    def test_branch_is_full_residency_citizen(self, tmp_path):
+        """Cold-seeded branch: not resident at fork, hydrates through
+        the normal admission path on first connect, serves, evicts."""
+        service, storm, hist, res = _stack(tmp_path, residency=True)
+        _serve(service, storm, ["d0"], rounds=3)
+        branch = hist.fork("d0", 13, name="b0")
+        assert not res.is_resident(branch)
+        seed = hist.read_at(branch, 13)["entries"]
+        assert not res.is_resident(branch)
+        client = service.connect(branch, lambda m: None).client_id
+        service.pump()
+        assert res.is_resident(branch)
+        assert storm.merge_host.map_entries(
+            branch, storm.datastore, storm.channel) == seed
+        storm.submit_frame(
+            None, {"rid": "bw", "docs": [[branch, client, 1, 14, K]]},
+            memoryview(_words(11, 0, 0).tobytes()))
+        storm.flush()
+        head = hist.head_seq(branch)
+        assert head > 14
+        assert hist.read_at(branch, head)["entries"] == \
+            storm.merge_host.map_entries(branch, storm.datastore,
+                                         storm.channel)
+        # Eviction re-exports the branch's own cold record; reads keep
+        # serving and rehydration converges.
+        res.evict(branch)
+        assert hist.read_at(branch, head)["entries"]
+        _close(storm)
+
+    def test_fork_control_replays_identically(self, tmp_path):
+        """Recovery over the fork's WAL control re-seeds the branch
+        (seeded writer included) byte-identically — including the
+        branch's own post-fork serving ticks."""
+        service, storm, hist, _ = _stack(tmp_path)
+        _serve(service, storm, ["d0"], rounds=4)
+        branch = hist.fork("d0", 17, name="b0", writer="w0")
+        storm.submit_frame(
+            None, {"rid": "bw", "docs": [[branch, "w0", 1, 17, K]]},
+            memoryview(_words(11, 0, 0).tobytes()))
+        storm.flush()
+        live_map = storm.merge_host.map_entries(branch, storm.datastore,
+                                                storm.channel)
+        live_cp = dataclasses.asdict(storm.seq_host.checkpoint(branch))
+        _close(storm)
+        service2, storm2, hist2, _ = _stack(tmp_path)
+        storm2.recover()
+        assert hist2.branch_info(branch) == {"parent": "d0", "seq": 17,
+                                             "name": "b0"}
+        assert storm2.merge_host.map_entries(
+            branch, storm2.datastore, storm2.channel) == live_map
+        rec_cp = dataclasses.asdict(storm2.seq_host.checkpoint(branch))
+        for c in live_cp["clients"] + rec_cp["clients"]:
+            c["last_update"] = 0  # arrival clock, not replica state
+        assert rec_cp == live_cp
+        _close(storm2)
+
+    def test_fork_rejects_colliding_and_out_of_range(self, tmp_path):
+        service, storm, hist, _ = _stack(tmp_path)
+        _serve(service, storm, ["d0"], rounds=2)
+        hist.fork("d0", 9, name="b0")
+        with pytest.raises(ValueError):
+            hist.fork("d0", 9, name="b0")  # branch id taken
+        with pytest.raises(ValueError):
+            hist.fork("d0", 5, name="d0")  # self-fork
+        with pytest.raises(HistoryError):
+            hist.fork("d0", 10**6, name="b1")  # beyond head
+        _close(storm)
+
+
+class TestMergeBack:
+    def _scenario(self, root):
+        """Fork, write to branch AND parent concurrently, merge back.
+        Returns (parent map, parent history cseq pairs, merge report)."""
+        service, storm, hist, _ = _stack(root)
+        clients = _serve(service, storm, ["d0"], rounds=3)
+        branch = hist.fork("d0", 1 + 3 * K, name="b0", writer="w0")
+        for r in range(3, 5):  # concurrent head writes + branch writes
+            storm.submit_frame(
+                None, {"rid": r,
+                       "docs": [["d0", clients["d0"], 1 + r * K, 1, K]]},
+                memoryview(_words(7, r, 0).tobytes()))
+            rb = r - 3
+            storm.submit_frame(
+                None, {"rid": ("b", r),
+                       "docs": [[branch, "w0", 1 + rb * K,
+                                 1 + 3 * K, K]]},
+                memoryview(_words(19, r, 0).tobytes()))
+            storm.flush()
+        report = hist.merge_back(branch)
+        final = storm.merge_host.map_entries("d0", storm.datastore,
+                                             storm.channel)
+        head = hist.head_seq("d0")
+        at_head = hist.read_at("d0", head)
+        _close(storm)
+        return final, at_head, report
+
+    def test_merge_back_resequences_through_ordinary_path(self,
+                                                          tmp_path):
+        final, at_head, report = self._scenario(tmp_path / "run")
+        assert report["merged_ops"] == 2 * K
+        assert at_head["entries"] == final
+
+    def test_merge_back_deterministic_under_concurrent_writes(
+            self, tmp_path):
+        """Two identical runs (fork + concurrent parent/branch writes +
+        merge-back) converge byte-identically — ordinary sequencing IS
+        the merge machinery."""
+        a = self._scenario(tmp_path / "a")
+        b = self._scenario(tmp_path / "b")
+        assert a == b
+
+    def test_merge_back_of_unwritten_branch_is_noop(self, tmp_path):
+        service, storm, hist, _ = _stack(tmp_path)
+        _serve(service, storm, ["d0"], rounds=2)
+        branch = hist.fork("d0", 9, name="b0")
+        before = storm.seq_host.checkpoint("d0").sequence_number
+        report = hist.merge_back(branch)
+        assert report["merged_ops"] == 0
+        assert storm.seq_host.checkpoint("d0").sequence_number == before
+        _close(storm)
+
+
+class TestServiceSurface:
+    def test_routerlicious_and_driver_surface(self, tmp_path):
+        """read_at/fork/merge_back through the service facade + the
+        client-side HistoricalDocumentService (in-process transport)."""
+        from fluidframework_tpu.drivers.history_driver import (
+            HistoricalDocumentService,
+        )
+        service, storm, hist, _ = _stack(tmp_path)
+        _serve(service, storm, ["d0"], rounds=3)
+        ref = _naive_prefixes(service, "d0")
+        svc = HistoricalDocumentService(service, "d0", seq=9)
+        assert svc.entries() == ref[9]
+        assert svc.read_at(5)["entries"] == ref[5]
+        deltas = svc.get_deltas(0)
+        assert max(m.sequence_number for m in deltas) <= 9
+        br = svc.fork(name="b0")
+        assert hist.is_branch(br.doc_id)
+        assert br.entries() == ref[9]
+        with pytest.raises(TypeError):
+            br.connect(lambda m: None)
+        assert br.merge_back()["merged_ops"] == 0
+        _close(storm)
+
+    def test_history_plane_requires_snapshots(self):
+        seq_host = KernelSequencerHost(num_slots=2, initial_capacity=4)
+        merge_host = KernelMergeHost(flush_threshold=10**9)
+        service = RouterliciousService(merge_host=merge_host,
+                                       batched_deli_host=seq_host,
+                                       auto_pump=False,
+                                       idle_check_interval=10**9)
+        storm = StormController(service, seq_host, merge_host,
+                                flush_threshold_docs=10**9)
+        with pytest.raises(ValueError):
+            HistoryPlane(storm, snapshots=None)
+
+
+def test_render_history_line():
+    from fluidframework_tpu.tools.monitor import render_history
+    assert render_history({}) == ""
+    line = render_history({
+        "history.branches": 2, "history.compactions": 5,
+        "history.trimmed_ticks": 12, "history.tail_ops": 96,
+        "history.reads": 30, "history.read_s.p99": 0.0012,
+        "history.merges": 1,
+    })
+    assert "branches 2" in line and "trimmed-ticks 12" in line
+    assert "tail 96 ops" in line and "merges 1" in line
+    windowed = render_history(
+        {"history.branches": 2, "history.compactions": 9,
+         "history.reads": 50},
+        prev={"history.compactions": 5, "history.reads": 30},
+        interval=2.0)
+    assert "compactions 2.00/s" in windowed

@@ -875,3 +875,65 @@ def test_join_mid_promotion_matches_single_lane_twin(tmp_path):
     svc2, storm2, seq2, mh2, mgr2 = build_stack(root, lanes=2)
     storm2.recover()
     assert digest(svc2, storm2, seq2, mh2) == sharded
+
+
+def test_idle_eject_inside_round_defers_membership(tmp_path):
+    """Round-18 satellite (the promotion-window seam's last gap): a
+    membership op firing INSIDE a storm round (the idle-eject cadence
+    runs off the round's pump) no longer falls back to legacy
+    adopt-at-decide — it parks on the deferred queue and the flush
+    maintenance cadence orders it through the FULL mirror path, so the
+    leave sequences at the doc's true head exactly like a top-level
+    membership op."""
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.server.sequencer import RawOperation
+
+    doc = "mega-defer"
+    svc, storm, seq, mh, mgr = build_stack(str(tmp_path), lanes=2)
+    writers = [svc.connect(doc, lambda m: None).client_id
+               for _ in range(2)]
+    svc.pump()
+    storm.checkpoint()
+    mgr.promote(doc, lanes=2)
+    for r in range(2):
+        for w, client in enumerate(writers):
+            storm.submit_frame(None, {
+                "rid": f"{r}.{w}",
+                "docs": [[doc, client, 1 + r * K, -1, K]]},
+                memoryview(storm_words(21, r, w).tobytes()))
+        storm.flush()
+    leave = RawOperation(client_id=None, type=MessageType.CLIENT_LEAVE,
+                         data=writers[1], timestamp=5)
+    # Simulate the idle-eject path firing mid-round: the intercept must
+    # DEFER (never order, never legacy-adopt).
+    storm._in_round = True
+    try:
+        svc._order_membership(doc, leave)
+    finally:
+        storm._in_round = False
+    assert len(mgr._deferred_members) == 1
+    assert mgr.docs[doc].mirror.writers[writers[1]].active  # not yet
+    # The next flush's maintenance cadence drains it through the full
+    # mirror path: settled, sequenced at the true head, journaled.
+    storm.flush()
+    assert not mgr._deferred_members
+    assert not mgr.docs[doc].mirror.writers[writers[1]].active
+    mirror_seq = mgr.docs[doc].mirror.seq
+    leaves = [m for m in svc.get_deltas(doc, 0)
+              if m.type == MessageType.CLIENT_LEAVE]
+    assert [m.sequence_number for m in leaves] == [mirror_seq]
+    # Post-leave serving + demotion stay exact, and recovery replays
+    # the deferred-then-ordered member control identically.
+    storm.submit_frame(None, {
+        "rid": "post", "docs": [[doc, writers[0], 1 + 2 * K, -1, K]]},
+        memoryview(storm_words(21, 2, 0).tobytes()))
+    storm.flush()
+    mgr.demote(doc)
+    storm.flush()
+    live = mh.map_entries(doc, storm.datastore, storm.channel)
+    storm._group_wal.close()
+    svc2, storm2, seq2, mh2, mgr2 = build_stack(str(tmp_path), lanes=2)
+    storm2.recover()
+    assert mh2.map_entries(doc, storm2.datastore,
+                           storm2.channel) == live
+    storm2._group_wal.close()

@@ -615,3 +615,123 @@ def test_render_tenants_line():
     assert "vic" in out2
     # Empty scrape -> empty line (the watch loop skips it).
     assert render_tenants({}, None, 1.0) == ""
+
+
+# -- tenant-record weights (round-18 residue): riddler tiers + journaling ------
+
+
+class TestTenantRecordWeights:
+    def test_weights_derive_from_riddler_paid_tier(self):
+        """Weights come from the tenant RECORD (paid-tier column), not
+        static config: a premium tenant out-shares a free one by the
+        tier ratio, resolved lazily through weight_source."""
+        from fluidframework_tpu.server.riddler import (
+            TIER_WEIGHTS,
+            TenantManager,
+        )
+        tenants = TenantManager()
+        tenants.create_tenant("prem", tier="premium")
+        tenants.create_tenant("free", tier="free")
+        s = TenantScheduler(weight_source=tenants.weight_for)
+        assert s.weight("prem") == TIER_WEIGHTS["premium"]
+        assert s.weight("free") == TIER_WEIGHTS["free"]
+        assert s.weight("unknown") == 1.0  # default, never a crash
+        # Derived weights are consulted LIVE, never cached: a tier
+        # upgrade takes effect on the very next compose, and idle
+        # tenants never bloat the journaled roster (pending_cap counts
+        # configured tenants as active).
+        assert s.export_state()["weights"] == {}
+        tenants.set_tier("free", "premium")
+        assert s.weight("free") == TIER_WEIGHTS["premium"]
+        tenants.set_tier("free", "free")
+        backlog = [F("prem", [f"p{i}"]) for i in range(40)] \
+            + [F("free", [f"f{i}"]) for i in range(40)]
+        served = {"prem": 0, "free": 0}
+        for _ in range(4):  # 40 slots for 80 docs: genuine contention
+            plan = s.compose(backlog, budget=10)
+            s.commit(plan)
+            for f in plan["selected"]:
+                served[f.tenant] += len(f.docs)
+            sel = set(id(f) for f in plan["selected"])
+            backlog = [f for f in backlog if id(f) not in sel]
+        ratio = served["prem"] / max(1, served["free"])
+        assert ratio >= 4.0, served  # 16x by weight; slack for caps
+
+    def test_set_weight_journals_and_import_overrides(self):
+        """A runtime set_weight is scheduler STATE: it rides
+        export_state and import_state OVERRIDES constructor config —
+        recovery composes with the weights the crashed host used."""
+        s = TenantScheduler(weights={"a": 1.0, "b": 1.0})
+        assert s.is_trivial()  # config alone stays unstamped
+        s.set_weight("a", 3.0)
+        assert not s.is_trivial()  # runtime change must journal
+        snap = s.export_state()
+        fresh = TenantScheduler(weights={"a": 1.0, "b": 1.0})
+        fresh.import_state(snap)
+        assert fresh.weight("a") == 3.0  # override, not setdefault
+        # The restored change must KEEP journaling — a second restart
+        # must not silently revert to constructor config.
+        assert not fresh.is_trivial()
+        fresh2 = TenantScheduler(weights={"a": 1.0, "b": 1.0})
+        fresh2.import_state(fresh.export_state())
+        assert fresh2.weight("a") == 3.0
+
+    def test_tier_changes_persist_and_legacy_store_loads(self):
+        """set_tier is durable; a legacy store (bare secrets) still
+        loads — old tenants default to the standard tier."""
+        from fluidframework_tpu.server.bus import StateStore
+        from fluidframework_tpu.server.riddler import TenantManager
+        store = StateStore()
+        tenants = TenantManager(store)
+        tenants.create_tenant("t0", secret="s0", tier="free")
+        tenants.set_tier("t0", "pro")
+        reopened = TenantManager(store)
+        assert reopened.get_tenant("t0").tier == "pro"
+        assert reopened.weight_for("t0") == 2.0
+        # Legacy format: {tenant: secret-string}.
+        legacy = StateStore()
+        legacy.put(TenantManager.STORE_KEY, {"old": "sekrit"})
+        mgr = TenantManager(legacy)
+        assert mgr.get_tenant("old").secret == "sekrit"
+        assert mgr.get_tenant("old").tier == "standard"
+        assert mgr.weight_for("old") == 1.0
+        with pytest.raises(ValueError):
+            mgr.create_tenant("bad", tier="galactic")
+
+    def test_storm_controller_threads_weight_source(self, tmp_path):
+        """End to end: StormController(tenant_weight_source=) resolves
+        tier weights LIVE at compose time (no caching — set_tier takes
+        effect immediately) while multi-tenant scheduler state still
+        journals in the tick's WAL header."""
+        from fluidframework_tpu.server.durable_store import (
+            GitSnapshotStore,
+        )
+        from fluidframework_tpu.server.riddler import TenantManager
+        tenants = TenantManager()
+        tenants.create_tenant("paid", tier="premium")
+        tenants.create_tenant("free", tier="free")
+        service, storm = _stack(
+            4, tenant_weight_source=tenants.weight_for,
+            tick_slot_budget=2,
+            spill_dir=str(tmp_path / "spill"), durability="group",
+            snapshots=GitSnapshotStore(str(tmp_path / "git")))
+        docs = {"paid": ["p0", "p1"], "free": ["f0", "f1"]}
+        clients = {d: service.connect(d, lambda m: None).client_id
+                   for t in docs for d in docs[t]}
+        service.pump()
+        for tenant, ds in docs.items():
+            for i, d in enumerate(ds):
+                storm.submit_frame(
+                    None, {"rid": d,
+                           "docs": [[d, clients[d], 1, 1, K]]},
+                    memoryview(_words(23, 0, i).tobytes()),
+                    tenant_id=tenant)
+        storm.flush()
+        assert storm.qos.weight("paid") == 4.0
+        assert storm.qos.weight("free") == 0.25
+        assert "paid" not in storm.qos.weights  # live, not cached
+        tenants.set_tier("free", "premium")
+        assert storm.qos.weight("free") == 4.0  # upgrade is immediate
+        header, _off = storm._parse_header(storm._read_blob(0))
+        assert "qos" in header  # multi-tenant state still journals
+        storm._group_wal.close()
