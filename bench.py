@@ -3718,6 +3718,152 @@ def main() -> None:
     }))
 
 
+def bench_replication_overhead(num_docs: int = 4, k: int = 64,
+                               rounds: int = 250, warmup: int = 25,
+                               pipeline_depth: int = 2) -> dict:
+    """Round-19 acceptance: REAL quorum replication vs none on the same
+    pipelined single-host serving path — per-frame ack latency (submit
+    → ack callback, which gates on min(durable, replicated)) and e2e
+    acked ops/s, with in-process followers doing real appends + fsyncs
+    into their own replica WALs. Arms: OFF / F=1 (2-of-2, chain) /
+    F=2 (majority, 1-of-2 follower acks). Supersedes the BENCH_r16
+    wal_commit_latency_ms sweep, which MODELED the commit wait."""
+    import os
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.parallel.placement import make_cluster_host
+    from fluidframework_tpu.server.durable_store import GitSnapshotStore
+    from fluidframework_tpu.server.replication import (
+        make_replicated_host,
+    )
+
+    def run_arm(followers: int) -> dict:
+        root = tempfile.mkdtemp(prefix=f"repl-bench-f{followers}-")
+        try:
+            git = GitSnapshotStore(os.path.join(root, "git"))
+            plane = None
+            if followers:
+                storm, plane = make_replicated_host(
+                    "hostA", os.path.join(root, "hostA"), git,
+                    [os.path.join(root, f"f{i}")
+                     for i in range(followers)],
+                    num_docs=num_docs, pipeline_depth=pipeline_depth)
+            else:
+                storm = make_cluster_host(
+                    "hostA", os.path.join(root, "hostA"), git,
+                    num_docs=num_docs, pipeline_depth=pipeline_depth)
+            docs = [f"doc-{i}" for i in range(num_docs)]
+            clients = {d: storm.service.connect(
+                d, lambda m: None).client_id for d in docs}
+            storm.service.pump()
+            cseq = {d: 1 for d in docs}
+            lat: list = []
+
+            def serve(n: int) -> None:
+                # Kept-fed pipeline: frames submit back-to-back; acks
+                # arrive on later harvests once the batch is durable
+                # AND quorum-replicated. flush() drains the tail.
+                for r in range(n):
+                    for i, d in enumerate(docs):
+                        words = _cluster_words([r, i], k)
+                        t0 = time.perf_counter()
+                        storm.submit_frame(
+                            lambda p, t0=t0: lat.append(
+                                time.perf_counter() - t0),
+                            {"rid": (r, d),
+                             "docs": [[d, clients[d], cseq[d], 1, k]]},
+                            memoryview(words.tobytes()))
+                        cseq[d] += k
+                storm.flush()
+
+            serve(warmup)
+            lat.clear()
+            start = time.perf_counter()
+            serve(rounds)
+            elapsed = time.perf_counter() - start
+            assert len(lat) == rounds * num_docs, (len(lat), rounds)
+            arr = np.asarray(lat) * 1e3
+            out = {
+                "followers": followers,
+                "acks_required": (plane.acks_required
+                                  if plane is not None else None),
+                "ack_ms_p50": float(np.percentile(arr, 50)),
+                "ack_ms_p99": float(np.percentile(arr, 99)),
+                "acked_ops_per_s": rounds * num_docs * k / elapsed,
+                "frames": int(arr.shape[0]),
+            }
+            if plane is not None:
+                assert plane.replicated_len \
+                    == storm._group_wal.durable_len
+                out["batches_shipped"] = plane.stats["batches_shipped"]
+                out["ship_failures"] = plane.stats["ship_failures"]
+            storm._group_wal.close()
+            return out
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    arms = {"off": run_arm(0), "f1": run_arm(1), "f2": run_arm(2)}
+    off, f1, f2 = arms["off"], arms["f1"], arms["f2"]
+    return {
+        "shape": {"num_docs": num_docs, "k": k, "rounds": rounds,
+                  "pipeline_depth": pipeline_depth},
+        "arms": arms,
+        "ack_p99_f1_over_off": f1["ack_ms_p99"]
+        / max(off["ack_ms_p99"], 1e-9),
+        "ack_p99_f2_over_off": f2["ack_ms_p99"]
+        / max(off["ack_ms_p99"], 1e-9),
+        "ops_f1_over_off": f1["acked_ops_per_s"]
+        / max(off["acked_ops_per_s"], 1e-9),
+        "ops_f2_over_off": f2["acked_ops_per_s"]
+        / max(off["acked_ops_per_s"], 1e-9),
+    }
+
+
+def emit_round19(path: str = "BENCH_r19.json") -> dict:
+    """ISSUE 17 acceptance bars: quorum-replicated WAL + leader
+    failover. Columns: replication-ON (F=1 chain, F=2 majority) vs OFF
+    ack p50/p99 and e2e acked ops/s under the pipelined tick (REAL
+    in-process followers, real fsyncs — superseding BENCH_r16's
+    modeled wal_commit_latency arms); failover blackout numbers ride
+    the chaos harness reports (tests/test_chaos.py REPLICATION)."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from fluidframework_tpu.utils import compile_cache
+
+    compile_cache.enable()
+    out: dict = {"round": 19,
+                 "environment": {"backend": jax.default_backend(),
+                                 "devices": len(jax.devices())}}
+    out["replication_overhead"] = bench_replication_overhead()
+    out["supersedes"] = ("BENCH_r16 wal_commit_latency_ms sweep "
+                         "(modeled commit wait; these arms replicate "
+                         "for real)")
+    out["environment"]["note"] = (
+        "Round-19 tentpole: shared-nothing HA. Every fsynced group-"
+        "commit batch ships synchronously to F follower replica WALs "
+        "over the storm codec framing; client acks gate on min("
+        "durable, quorum-replicated), so the pipelined tick hides the "
+        "replication round trip exactly as it hides the fsync. Head "
+        "flips (placement directory, checkpoints, cold residency, "
+        "history summaries) journal on the quorum BEFORE the backend "
+        "flips; failover promotes the most advanced follower over its "
+        "storm-shaped replica log through the ordinary recover() path "
+        "and fences the old incarnation (moved_to shedding). In-"
+        "process CPU arms: real fsyncs, zero network — the replication "
+        "tax shown is the serialization + follower-fsync floor.")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 if __name__ == "__main__":
     if "--history-r18" in sys.argv:
         res = emit_round18()
@@ -3756,6 +3902,23 @@ if __name__ == "__main__":
                 "ack_ticks_p99"),
             "victim_ack_ticks_p99": fair.get("vic1", {}).get(
                 "ack_ticks_p99"),
+        }))
+    elif "--replication-r19" in sys.argv:
+        res = emit_round19()
+        ov = res.get("replication_overhead", {})
+        arms = ov.get("arms", {})
+        print(json.dumps({
+            "metric": "quorum-replicated WAL: ack p99 + e2e acked "
+                      "ops/s, real F=1/F=2 followers vs replication "
+                      "OFF under the pipelined tick (BENCH_r19)",
+            "value": ov.get("ack_p99_f2_over_off"),
+            "unit": "ack_p99_F2 / ack_p99_off",
+            "ack_ms_p99_off": arms.get("off", {}).get("ack_ms_p99"),
+            "ack_ms_p99_f1": arms.get("f1", {}).get("ack_ms_p99"),
+            "ack_ms_p99_f2": arms.get("f2", {}).get("ack_ms_p99"),
+            "ops_f1_over_off": ov.get("ops_f1_over_off"),
+            "ops_f2_over_off": ov.get("ops_f2_over_off"),
+            "supersedes": res.get("supersedes"),
         }))
     elif "--cluster-r16" in sys.argv:
         res = emit_round16()

@@ -305,11 +305,17 @@ class StormClusterDirectory:
             # (a restart must not forget a completed scale-out); snaps
             # from before the field default to the genesis set.
             self.active: list = list(snap.get("active", self.genesis))
+            # Failover fencing stamps: label -> incarnation count.
+            # Bumped by fail_over when a replication plane promotes a
+            # follower under the same serving label; snaps from before
+            # the field default to incarnation 0 everywhere.
+            self.incarnations: dict = dict(snap.get("incarnations", {}))
         else:
             self.genesis = tuple(genesis)
             self.owners = {}
             self.migrating = {}
             self.active = list(self.genesis)
+            self.incarnations = {}
             self._save()
 
     def _save(self) -> None:
@@ -319,6 +325,7 @@ class StormClusterDirectory:
             "owners": self.owners,
             "migrating": {d: list(v) for d, v in self.migrating.items()},
             "active": list(self.active),
+            "incarnations": self.incarnations,
         })
         self.snapshots.set_head(self.KEY, handle)
 
@@ -326,6 +333,17 @@ class StormClusterDirectory:
         if label not in self.active:
             self.active.append(label)
             self._save()
+
+    def incarnation_of(self, label) -> int:
+        return self.incarnations.get(label, 0)
+
+    def bump_incarnation(self, label) -> int:
+        """Durable fencing flip: a NEW incarnation now serves ``label``
+        (leader failover). Old-incarnation zombies compare their stamp
+        against this and fence themselves."""
+        self.incarnations[label] = self.incarnations.get(label, 0) + 1
+        self._save()
+        return self.incarnations[label]
 
     def genesis_owner(self, doc: str):
         """The stable hash default (ignores the migration overlay)."""
@@ -444,6 +462,60 @@ class StormCluster:
             self.directory.activate(label)
             self.active.append(label)
         self._update_gauges()
+
+    def fail_over(self, label, promoted_storm,
+                  blackout_ms: float | None = None) -> int:
+        """Replace a dead host's controller with a PROMOTED follower
+        serving the SAME label (server/replication.py built it over the
+        replica log): the directory's incarnation stamp bumps durably —
+        the fencing flip an old-incarnation zombie checks itself
+        against — routing stays byte-identical (labels never change, so
+        no doc re-homes), and the old controller, if still in-process,
+        is fenced so its every frame sheds ``moved`` toward the new
+        incarnation. Returns the new incarnation number."""
+        if label not in self.hosts:
+            raise KeyError(label)
+        res = promoted_storm.residency
+        if res is None or res.host_label != label:
+            raise ValueError(
+                f"promoted host for {label!r} needs a ResidencyManager "
+                f"with host_label={label!r}")
+        old = self.hosts[label]
+        if old is not promoted_storm \
+                and getattr(old, "replication", None) is not None \
+                and not old.replication.fenced:
+            old.replication.fence(moved_to=label)
+        self.hosts[label] = promoted_storm
+        promoted_storm.placement = _HostRouter(self, label)
+        incarnation = self.directory.bump_incarnation(label)
+        # Promotion rolled journaled head flips straight onto the
+        # shared backend, so any historian cache layer still serving
+        # must drop its head entries now or answer from pre-failover
+        # refs for up to a TTL (server/historian.py invalidate_heads).
+        seen: set = set()
+        for store in [self.directory.snapshots] + [
+                h.snapshots for h in self.hosts.values()
+                if h.snapshots is not None]:
+            layer = store
+            while layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                # type-dict lookup: wrapper stores (ReplicatedHeadStore)
+                # delegate unknown attrs to their backend, which is
+                # walked below anyway.
+                invalidate = type(layer).__dict__.get("invalidate_heads")
+                if invalidate is not None:
+                    invalidate(layer)
+                layer = getattr(layer, "_backend", None)
+        self.stats["failovers"] = self.stats.get("failovers", 0) + 1
+        if blackout_ms is not None:
+            self.blackouts_s.append(blackout_ms / 1000.0)
+            m = promoted_storm.merge_host.metrics
+            m.gauge("cluster.last_blackout_ms").set(
+                round(blackout_ms, 3))
+            m.gauge("repl.last_failover_blackout_ms").set(
+                round(blackout_ms, 3))
+        self._update_gauges()
+        return incarnation
 
     def owner_of(self, doc: str):
         return self.directory.owner_of(doc)

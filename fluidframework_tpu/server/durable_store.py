@@ -237,6 +237,16 @@ class GroupCommitLog:
         #: open and will never heal) — callers distinguish this from a
         #: sick-disk outage and stop telling clients to retry.
         self.failed = False
+        #: Log-shipping seam (server/replication.py): called ON THE
+        #: WRITER THREAD after the local fsync with the batch's
+        #: ``[(index, record_bytes), ...]`` — the exact bytes that just
+        #: became locally durable, in index order. A replication plane
+        #: hooks this to ship the batch to followers before the durable
+        #: watermark advances; shipping failures must never kill the
+        #: writer (the plane resyncs lagging followers from the log), so
+        #: exceptions are swallowed here and surfaced by the plane's own
+        #: health gauges.
+        self.on_batch_durable: Callable[[list], None] | None = None
         self._stop = False
         self._thread = threading.Thread(target=self._writer_loop,
                                         name="group-commit-wal", daemon=True)
@@ -368,6 +378,21 @@ class GroupCommitLog:
                     # already appended to the local file.
                     time.sleep(self._commit_latency_s)
                 faults.crashpoint("wal.post_fsync")
+                ship = self.on_batch_durable
+                if ship is not None:
+                    # Ship the locally-durable batch BEFORE the watermark
+                    # advances: a synchronous plane returns only once its
+                    # quorum acked, so durable_len then implies
+                    # replicated too. An async/failed ship leaves the
+                    # follower behind; the plane's resync path re-ships
+                    # the tail from the log — never from here.
+                    try:
+                        ship([(idx,
+                               b"".join(bytes(p) for p in parts_of[idx]))
+                              for idx in batch
+                              if idx in parts_of])
+                    except Exception:
+                        pass  # plane reports its own health; writer lives
             except OSError as err:
                 # Transient I/O (the breaker's whole domain): keep the
                 # records queued and retry on the half-open cadence.

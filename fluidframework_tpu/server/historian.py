@@ -124,6 +124,17 @@ class Historian:
         self._backend.set_head(doc_id, handle)
         self._cache_head(doc_id, handle, self._clock())
 
+    def invalidate_heads(self) -> int:
+        """Drop every cached head — the failover hook: a leader
+        promotion (server/replication.py) rolls journaled head flips
+        straight onto the BACKEND, so any historian front still serving
+        must not answer from pre-failover entries for up to a TTL.
+        Object caches stay — content-addressed chunks are immutable.
+        Returns the number of entries dropped."""
+        dropped = len(self._heads)
+        self._heads.clear()
+        return dropped
+
     def release(self, doc_id: str, handle: str) -> list[str]:
         """GC pass-through (GitSnapshotStore refcounted release), with
         exactly the DELETED objects dropped from the cache — a deleted

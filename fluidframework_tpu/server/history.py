@@ -60,6 +60,16 @@ Safety invariants (chaos-proven, ``history.mid_compaction`` /
   twin digests byte-identical (state lives in summaries exactly when it
   leaves the tail, and only ticks below the storm checkpoint watermark
   — which recovery never replays — are ever rewritten).
+
+Round-19 additions (ROADMAP 5c/5d): the inline summary chain
+re-anchors past ``chain_reanchor_depth`` — the oldest entries roll into
+linked content-addressed anchor pages so ``__hist__`` head records stay
+O(depth) forever while every anchored exact state remains addressable
+through :meth:`_base_for`'s anchor walk — and paid-tier tenants
+(riddler weight > 1.0 via ``tenant_source``) can :meth:`pin_range` seq
+ranges against the tail-trim and chain-release retention trades; pins
+journal as ``"hp"`` controls and ride the storm snapshot like branch
+metadata, so they survive recovery and leader failover.
 """
 
 from __future__ import annotations
@@ -190,6 +200,8 @@ class HistoryPlane:
                  summary_interval_bytes: int | None = None,
                  tail_retention_summaries: int | None = None,
                  max_chain_summaries: int | None = None,
+                 chain_reanchor_depth: int | None = 64,
+                 tenant_source=None,
                  compact_docs_per_pass: int = 8,
                  compact_check_every: int = 16,
                  trim_batch_ticks: int = 64) -> None:
@@ -219,6 +231,22 @@ class HistoryPlane:
         #: refcount GC (reads at their seqs then fail like any
         #: compacted-away state).
         self.max_chain_summaries = max_chain_summaries
+        #: Inline-chain depth cap (ROADMAP 5c): when the head record's
+        #: chain grows past this, compact() rolls the OLDEST entries
+        #: into a content-addressed anchor page (a linked list under
+        #: the same hist key) and keeps only the newest half inline —
+        #: head records stay O(depth) while anchored exact states stay
+        #: addressable. None disables re-anchoring (unbounded chain).
+        self.chain_reanchor_depth = chain_reanchor_depth
+        #: Paid-tier authority for retention pins (ROADMAP 5d): any
+        #: object with riddler's ``weight_for(tenant_id)`` surface;
+        #: weight > 1.0 (pro/premium) may pin. None = pins ungated
+        #: (embedders with their own auth story).
+        self.tenant_source = tenant_source
+        #: (tenant, doc) -> (lo, hi): seq ranges pinned against the
+        #: tail trim and chain release — journaled as "hp" controls
+        #: and carried in the storm snapshot like branch metadata.
+        self.pins: dict[tuple[str, str], tuple[int, int]] = {}
         self.compact_docs_per_pass = max(1, compact_docs_per_pass)
         self.compact_check_every = max(1, compact_check_every)
         self.trim_batch_ticks = max(1, trim_batch_ticks)
@@ -245,7 +273,8 @@ class HistoryPlane:
         self._c_merges = m.counter("history.merges")
         self._h_read = m.histogram("history.read_s")
         self.stats = {"compactions": 0, "trimmed_ticks": 0, "forks": 0,
-                      "merges": 0, "reads": 0}
+                      "merges": 0, "reads": 0, "reanchors": 0,
+                      "pins": 0}
         storm.history = self
 
     # -- store keys ------------------------------------------------------------
@@ -299,9 +328,83 @@ class HistoryPlane:
             if s <= seq:
                 old = self.snapshots.get(self._hist_key(doc), handle)
                 if old is None:
-                    break  # GC'd mid-walk: fall through to the floor check
+                    return _FoldState(0)  # GC'd: fall to the floor check
                 return _FoldState.from_wire(old["state"])
+        # Below the inline chain: walk the anchor pages (newest page
+        # first, each linking to its predecessor) for the re-anchored
+        # older exact states.
+        anchor_handle = (rec.get("anchor") or {}).get("handle")
+        while anchor_handle is not None:
+            page = self.snapshots.get(self._hist_key(doc),
+                                      anchor_handle)
+            if page is None:
+                break  # anchor GC'd: fall through to the floor check
+            for s, handle in reversed(page.get("entries", ())):
+                if s <= seq:
+                    old = self.snapshots.get(self._hist_key(doc),
+                                             handle)
+                    if old is None:
+                        return _FoldState(0)
+                    return _FoldState.from_wire(old["state"])
+            anchor_handle = page.get("prev_anchor")
         return _FoldState(0)
+
+    # -- tenant retention pins -------------------------------------------------
+
+    def _pin_floor(self, doc: str) -> int | None:
+        """Lowest pinned start seq for ``doc`` (None = unpinned)."""
+        los = [lo for (_t, d), (lo, _hi) in self.pins.items()
+               if d == doc]
+        return min(los) if los else None
+
+    def _pinned_at(self, doc: str, seq: int) -> bool:
+        return any(d == doc and lo <= seq <= hi
+                   for (_t, d), (lo, hi) in self.pins.items())
+
+    def _pin_overlaps(self, doc: str, fs: int, ls: int) -> bool:
+        return any(d == doc and lo <= ls and fs <= hi
+                   for (_t, d), (lo, hi) in self.pins.items())
+
+    def pin_range(self, tenant_id: str, doc: str, from_seq: int,
+                  to_seq: int) -> dict:
+        """Pin ``doc``'s seq range ``[from_seq, to_seq]`` against WAL
+        tick-blob trimming and summary-chain release on behalf of
+        ``tenant_id`` — the paid-tier retention knob (ROADMAP 5d).
+        Gated on the riddler tier column when a ``tenant_source`` is
+        attached: weight must be > 1.0 (pro/premium); free/standard
+        tenants take the plane's default retention trade. One pin per
+        (tenant, doc) — re-pinning replaces the range. Journaled as an
+        ``"hp"`` control and carried in the storm snapshot, so pins
+        survive recovery and failover. Pins protect history from NOW
+        on: records a past compaction already trimmed stay trimmed."""
+        lo, hi = int(from_seq), int(to_seq)
+        if not 0 <= lo <= hi:
+            raise ValueError(f"bad pin range [{lo}, {hi}]")
+        if self.tenant_source is not None:
+            weight = self.tenant_source.weight_for(tenant_id)
+            if weight is None or weight <= 1.0:
+                raise HistoryError(
+                    f"tenant {tenant_id!r} (weight {weight}) cannot "
+                    "pin retention: pins are a paid-tier feature "
+                    "(riddler weight > 1.0 — pro/premium)")
+        now = int(self.storm.service._clock())
+        self._append_control({"op": "pin", "tenant": tenant_id,
+                              "doc": doc, "lo": lo, "hi": hi}, now)
+        self.pins[(tenant_id, doc)] = (lo, hi)
+        self.stats["pins"] = len(self.pins)
+        return {"tenant": tenant_id, "doc": doc, "lo": lo, "hi": hi}
+
+    def unpin_range(self, tenant_id: str, doc: str) -> bool:
+        """Drop the tenant's pin on ``doc`` (journaled); the next
+        compaction cadence reclaims what the pin was holding."""
+        if (tenant_id, doc) not in self.pins:
+            return False
+        now = int(self.storm.service._clock())
+        self._append_control({"op": "unpin", "tenant": tenant_id,
+                              "doc": doc}, now)
+        del self.pins[(tenant_id, doc)]
+        self.stats["pins"] = len(self.pins)
+        return True
 
     # -- time travel (the read path) -------------------------------------------
 
@@ -481,21 +584,60 @@ class HistoryPlane:
                 cut = max(0, len(bounds) - 1
                           - self.tail_retention_summaries)
                 floor = max(prev_floor, bounds[cut])
+            # Retention pins: the floor never passes the last chain
+            # boundary at-or-below the lowest pinned start, so every
+            # pinned seq keeps a reachable fold base above the floor.
+            # A pin created after a trim cannot resurrect records
+            # (prev_floor wins) — pins protect from now on.
+            pin_lo = self._pin_floor(doc)
+            if pin_lo is not None and floor > prev_floor:
+                bound = max([b for b in [0] + [s for s, _h in chain]
+                             if b <= pin_lo], default=0)
+                floor = max(prev_floor, min(floor, bound))
             # The chain keeps prior summaries ADDRESSABLE below the
             # floor (exact states; the per-op records between them are
             # what the trim drops). Only the optional chain cap ever
-            # releases one.
+            # releases one — and never a state inside a pinned range.
             released: list = []
             if self.max_chain_summaries is not None \
                     and len(chain) > self.max_chain_summaries:
                 cut_n = len(chain) - self.max_chain_summaries
                 released, chain = chain[:cut_n], chain[cut_n:]
+                if self.pins:
+                    keep = [e for e in released
+                            if self._pinned_at(doc, int(e[0]))]
+                    if keep:
+                        released = [e for e in released
+                                    if e not in keep]
+                        chain = keep + chain
+            # Re-anchoring (ROADMAP 5c): past the depth cap, roll the
+            # oldest inline entries into a content-addressed anchor
+            # page (linked to its predecessor) so the head record
+            # stays bounded; _base_for walks the pages for reads below
+            # the inline chain.
+            anchor = dict((rec or {}).get("anchor") or {}) or None
+            if self.chain_reanchor_depth is not None \
+                    and len(chain) > self.chain_reanchor_depth:
+                keep_n = max(1, self.chain_reanchor_depth // 2)
+                rolled, chain = chain[:-keep_n], chain[-keep_n:]
+                page = {"kind": "history-anchor",
+                        "format_version": HISTORY_SUMMARY_VERSION,
+                        "doc": doc,
+                        "entries": [list(e) for e in rolled],
+                        "prev_anchor": (anchor or {}).get("handle")}
+                page_handle = self.snapshots.upload(
+                    self._hist_key(doc), page)
+                anchor = {"handle": page_handle,
+                          "top_seq": int(rolled[-1][0])}
+                self.stats["reanchors"] += 1
             new_rec: dict[str, Any] = {
                 "kind": "history-summary",
                 "format_version": HISTORY_SUMMARY_VERSION,
                 "doc": doc, "seq": head_seq, "state": state.to_wire(),
                 "chain": chain, "tail_floor": floor,
             }
+            if anchor is not None:
+                new_rec["anchor"] = anchor
             if doc in self.branches:
                 new_rec["branch"] = dict(self.branches[doc])
             key = self._hist_key(doc)
@@ -568,6 +710,11 @@ class HistoryPlane:
             if header.get("mg") is not None \
                     or header.get("hp") is not None:
                 continue  # lifecycle controls are never trimmed
+            if self.pins and any(
+                    self._pin_overlaps(entry[0], int(entry[6]),
+                                       int(entry[7]))
+                    for entry in header.get("docs", ())):
+                continue  # a tenant retention pin covers this tick
             ticks.add(t)
         if not ticks:
             return 0
@@ -857,6 +1004,13 @@ class HistoryPlane:
                     self._apply_fork(event["branch"], event["parent"],
                                      event["seq"], event["name"],
                                      event.get("writer"))
+            elif op == "pin":
+                self.pins[(event["tenant"], event["doc"])] = (
+                    int(event["lo"]), int(event["hi"]))
+                self.stats["pins"] = len(self.pins)
+            elif op == "unpin":
+                self.pins.pop((event["tenant"], event["doc"]), None)
+                self.stats["pins"] = len(self.pins)
             elif op in (None, "trimmed"):
                 pass  # filler record of a trimmed tick — stateless
             else:
@@ -867,10 +1021,13 @@ class HistoryPlane:
     # -- snapshot state --------------------------------------------------------
 
     def export_state(self) -> dict:
-        """Branch metadata for the storm snapshot (summaries and seeds
-        are store-resident already — only the registry rides here)."""
+        """Branch metadata + retention pins for the storm snapshot
+        (summaries and seeds are store-resident already — only the
+        registries ride here)."""
         return {"branches": {b: dict(m)
-                             for b, m in sorted(self.branches.items())}}
+                             for b, m in sorted(self.branches.items())},
+                "pins": [[t, d, lo, hi] for (t, d), (lo, hi)
+                         in sorted(self.pins.items())]}
 
     def import_state(self, snap: dict) -> None:
         for branch, meta in snap.get("branches", {}).items():
@@ -878,6 +1035,9 @@ class HistoryPlane:
                 self.branches[branch] = dict(meta)
                 self.children.setdefault(meta["parent"],
                                          []).append(branch)
+        for t, d, lo, hi in snap.get("pins", ()):
+            self.pins.setdefault((t, d), (int(lo), int(hi)))
+        self.stats["pins"] = len(self.pins)
         self._g_branches.set(len(self.branches))
 
 

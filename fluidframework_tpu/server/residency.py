@@ -477,6 +477,15 @@ class ResidencyManager:
             raise EvictionRefused(
                 "WAL fsync breaker open: the cold snapshot's watermark "
                 "cannot barrier on durability")
+        if getattr(storm, "replication", None) is not None \
+                and storm.replication.fenced:
+            # A demoted ex-leader flipping a cold head would clobber the
+            # promoted incarnation's record — fenced hosts never write
+            # shared-store heads.
+            self.stats["evict_refusals"] += 1
+            raise EvictionRefused(
+                "eviction on a fenced (demoted) leader: cold-head flips "
+                "belong to the promoted incarnation")
         t0 = time.perf_counter()
         # Settle everything: bus-path ops (client joins/leaves, per-op
         # submits) sequence first — a doc whose JOIN is still buffered

@@ -595,6 +595,12 @@ class StormController:
         # mid-migration shed "migrating" with a retry hint — never
         # sequenced on the wrong host, never silently dropped.
         self.placement = None
+        # Replication plane (server/replication.py attaches itself):
+        # when set, client acks gate on min(durable, REPLICATED)
+        # watermarks — an acked op survived a follower quorum, not just
+        # this host's disk — and a fenced (demoted) plane sheds every
+        # frame with a "moved" nack naming the promoted incarnation.
+        self.replication = None
         self._in_round = False  # mid-_flush_round (evictions refuse)
         # Opt-in retention for the per-doc (first, last, tick) index:
         # entries whose tick falls below ``tick_counter - retention``
@@ -816,6 +822,18 @@ class StormController:
         quarantine, degraded (WAL breaker open), bounded queue, token
         buckets. A refusal pushes ONE busy-nack with ``retry_after_s``
         and returns the hint; None admits."""
+        if self.replication is not None and self.replication.fenced:
+            # Demoted ex-leader (a follower promoted over this
+            # incarnation): EVERY frame sheds with the new leader as
+            # ``moved_to`` — sequencing here would fork the history the
+            # promoted incarnation is already extending. Same nack
+            # shape as a placement move, so the PR 16 client redial
+            # machinery handles both.
+            target = self.replication.moved_to
+            return self._shed(
+                push, header, n_ops, "moved", self.busy_retry_s,
+                docs=[d for d, *_ in docs],
+                moved_to={d: target for d, *_ in docs})
         if self.placement is not None:
             # Ownership first — the cheapest check, and a frame for a
             # foreign doc must never consume this host's quarantine /
@@ -1030,11 +1048,29 @@ class StormController:
             return len(self._blob_log) if self.durability == "sync" else 0
         return None
 
+    @property
+    def acked_watermark(self) -> int | None:
+        """The watermark client acks actually gate on: local durability
+        alone without a replication plane, ``min(durable, replicated)``
+        with one — an ack then proves the op survives the HOST, not
+        just the process. The plane ships synchronously on the WAL
+        writer thread, so in the healthy case the two watermarks move
+        together and the pipelined tick hides the commit round trip; a
+        partitioned quorum freezes the replicated side and acks stay
+        withheld (clients resend — the degraded-WAL discipline)."""
+        dw = self.durable_watermark
+        if dw is not None and self.replication is not None:
+            dw = min(dw, self.replication.replicated_len)
+        return dw
+
     def _drain_durable_acks(self) -> None:
-        """Push withheld acks whose tick the WAL has fsynced — called on
-        the serving thread (harvest / forced flush), never the writer
-        thread, so session pushes stay single-threaded."""
+        """Push withheld acks whose tick the WAL has fsynced (and the
+        follower quorum journaled, when replication is attached) —
+        called on the serving thread (harvest / forced flush), never
+        the writer thread, so session pushes stay single-threaded."""
         dw = self._group_wal.durable_len
+        if self.replication is not None:
+            dw = min(dw, self.replication.replicated_len)
         if self._inflight and self._unacked and self._unacked[0][0] < dw:
             # Chaos kill class "fsync-complete-before-readback": tick N
             # is durable and about to ack while a later tick's device
@@ -1081,7 +1117,13 @@ class StormController:
                 self._group_wal.sync()
             except WalDegradedError:
                 return  # not durable: withhold (clients resend)
-        dw = self.durable_watermark
+            if self.replication is not None \
+                    and self.replication.replicated_len \
+                    < self._group_wal.durable_len:
+                # Durable locally but not on the follower quorum: the
+                # same withhold discipline, one tier out.
+                return
+        dw = self.acked_watermark
         for ack_i, (frame, _i0, _i1) in enumerate(acks):
             if frame.push is None:
                 continue
@@ -1914,6 +1956,14 @@ class StormController:
         one snapshot atomically: upload first, flip the head ref last —
         a crash mid-checkpoint leaves the previous head intact."""
         assert self.snapshots is not None, "no snapshot store attached"
+        if self.replication is not None and self.replication.fenced:
+            # A demoted leader's snapshot would clobber the promoted
+            # incarnation's head — the zombie-writes hazard fencing
+            # exists to stop.
+            raise RuntimeError(
+                "checkpoint() on a fenced (demoted) leader; the "
+                f"promoted incarnation {self.replication.moved_to!r} "
+                "owns the snapshot head")
         if self.wal_degraded:
             from .durable_store import WalDegradedError
             raise WalDegradedError(

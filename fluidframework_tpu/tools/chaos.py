@@ -168,6 +168,33 @@ HISTORY_KILL_POINTS = ("history.mid_compaction", "history.mid_fork",
 HISTORY_BRANCH_WRITER = "branch-writer"
 HISTORY_BRANCH = "chaos-branch"
 
+#: Replication-plane kill classes (ISSUE 17): the child serves a
+#: two-host cluster whose doc-0 genesis owner is a quorum-REPLICATED
+#: leader — every fsynced WAL batch ships to two follower directories
+#: before acks release, and every shared-store head flip (checkpoints,
+#: cold records, the ``__placement__`` directory) rides the same plane
+#: — while doc 0 live-migrates to the plain host mid-run. The kill
+#: lands either side of the ship (batch durable-not-shipped /
+#: shipped-and-quorum-acked) or inside the classic WAL/tick windows; a
+#: RESUMED life is the FAILOVER PATH ITSELF — it never reopens the
+#: dead leader's serving directory, it PROMOTES the most advanced
+#: follower (journaled-head roll-forward + recovery over the
+#: storm-shaped replica log), bumps the directory incarnation, prints
+#: ``FAILOVER <blackout_ms>``, and keeps serving under the same label.
+#: The twin is the same replicated stack never killed and never
+#: migrated, so one digest equality is simultaneously the failover
+#: zero-loss bar AND the migrated ≡ never-migrated bar.
+REPLICATION_CHAOS_POINTS = ("repl.pre_ship", "repl.post_ship",
+                            "wal.pre_fsync", "storm.mid_tick")
+
+#: Tier-1 smoke point: batch shipped and quorum-acked, leader killed
+#: before anything else — promotion must serve every acked op.
+REPLICATION_SMOKE_POINT = "repl.post_ship"
+
+#: Follower count behind the replicated chaos leader (F=2; the default
+#: quorum is (F+1)//2 = 1 follower ack).
+REPLICATION_FOLLOWERS = 2
+
 
 # -- child process (the serving host under test) ------------------------------
 
@@ -332,6 +359,129 @@ def _cluster_child(args) -> None:
                 storm.checkpoint()
     faults.disarm()
     digest = _cluster_digest(cluster, docs)
+    print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
+
+
+def _replication_digest(cluster, docs: list[str]) -> dict:
+    """The replication twin-diff surface: the cluster digest with
+    history filtered to OPERATION rows. Join rows live in each host's
+    bus tier, which is NOT on the replicated plane (only WAL batches
+    and head flips ship) — a promoted follower reproduces every
+    sequenced op, map plane and sequencer row from the replica log +
+    journaled heads, but not the dead leader's bus-tier join records.
+    Excluding the non-replicated plane is the same digest scoping the
+    qos/history children apply to their by-design differences."""
+    from ..protocol.messages import MessageType
+
+    digest = _cluster_digest(cluster, docs)
+    op = int(MessageType.OPERATION)
+    for planes in digest["docs"].values():
+        planes["history"] = [h for h in planes["history"] if h[4] == op]
+    return digest
+
+
+def _replication_child(args) -> None:
+    """One replicated-cluster serving life (the ISSUE 17 scenario):
+    the doc-0 genesis owner is a quorum-replicated leader over
+    ``REPLICATION_FOLLOWERS`` follower directories, the other host is
+    plain, and doc 0 live-migrates at round ``migrate_at`` (-1 =
+    never — the differential twin). A resumed life IS the failover: it
+    promotes the most advanced follower instead of reopening the dead
+    leader's directory, and prints ``FAILOVER <blackout_ms>``."""
+    import zlib
+
+    from ..parallel.placement import StormCluster, make_cluster_host
+    from ..server.durable_store import GitSnapshotStore
+    from ..server.replication import (
+        ReplicaNode,
+        ReplicatedHeadStore,
+        make_replicated_host,
+        promote,
+    )
+    from ..utils import faults
+
+    docs = [f"chaos-doc-{i}" for i in range(args.docs)]
+    labels = sorted(CLUSTER_HOSTS)
+    leader = labels[zlib.crc32(docs[0].encode()) % len(labels)]
+    other = next(h for h in CLUSTER_HOSTS if h != leader)
+    git = GitSnapshotStore(os.path.join(args.dir, "git"))
+    state_path = os.path.join(args.dir, "repl_state.json")
+    if args.resume_from is None:
+        f_dirs = [os.path.join(args.dir, f"f{i + 1}")
+                  for i in range(REPLICATION_FOLLOWERS)]
+        leader_storm, plane = make_replicated_host(
+            leader, os.path.join(args.dir, leader), git, f_dirs,
+            num_docs=args.docs)
+        other_storm = make_cluster_host(
+            other, os.path.join(args.dir, other), git, num_docs=args.docs)
+        cluster = StormCluster({leader: leader_storm, other: other_storm},
+                               ReplicatedHeadStore(git, plane))
+        clients = _cluster_clients(cluster, docs, connect=True)
+        for storm in cluster.hosts.values():
+            storm.service.pump()
+            storm.checkpoint()
+        with open(state_path, "w") as fh:
+            json.dump({"followers": f_dirs,
+                       "next_id": REPLICATION_FOLLOWERS + 1}, fh)
+        start = 0
+        print("GENESIS", flush=True)
+    else:
+        # Failover life: the dead leader's serving directory is NEVER
+        # reopened (its volatile state is the thing the kill lost) —
+        # the most advanced follower promotes under the same label, a
+        # fresh follower directory replaces it in the plane, and the
+        # survivor host recovers normally.
+        with open(state_path) as fh:
+            st = json.load(fh)
+        other_storm = make_cluster_host(
+            other, os.path.join(args.dir, other), git, num_docs=args.docs)
+        other_storm.recover()
+        nodes = [ReplicaNode(d) for d in st["followers"]]
+        fresh = os.path.join(args.dir, f"f{st['next_id']}")
+        leader_storm, plane, rep = promote(
+            leader, nodes, git, follower_dirs=[fresh],
+            num_docs=args.docs)
+        cluster = StormCluster({leader: leader_storm, other: other_storm},
+                               ReplicatedHeadStore(git, plane))
+        cluster.recover()  # roll forward any durable migration intent
+        cluster.fail_over(leader, leader_storm,
+                          blackout_ms=rep["blackout_ms"])
+        remaining = [d for d in st["followers"]
+                     if os.path.basename(d) != rep["promoted_node"]]
+        with open(state_path, "w") as fh:
+            json.dump({"followers": remaining + [fresh],
+                       "next_id": st["next_id"] + 1}, fh)
+        clients = _cluster_clients(cluster, docs, connect=False)
+        start = args.resume_from
+        print(f"FAILOVER {rep['blackout_ms']}", flush=True)
+    print("READY", flush=True)
+    faults.arm()
+    k = args.k
+    for r in range(start, args.ticks):
+        if r == args.migrate_at and cluster.owner_of(docs[0]) == leader:
+            # The scripted live migration off the replicated leader
+            # (skipped in resumed lives where recovery already rolled
+            # it forward): its directory head flip rides the quorum.
+            cluster.migrate(docs[0], other)
+        acks: list = []
+        for i, d in enumerate(docs):
+            payload = _tick_words(args.seed, r, i, k).tobytes()
+            storm = cluster.hosts[cluster.owner_of(d)]
+            storm.submit_frame(
+                acks.append,
+                {"rid": r * len(docs) + i,
+                 "docs": [[d, clients[d], 1 + r * k, 1, k]]},
+                memoryview(payload))
+            storm.flush()
+        ok = [a for a in acks
+              if not (isinstance(a, dict) and a.get("error"))]
+        if len(ok) == len(docs):
+            print(f"ACKED {r}", flush=True)
+        if (r + 1) % args.cp_every == 0:
+            for storm in cluster.hosts.values():
+                storm.checkpoint()
+    faults.disarm()
+    digest = _replication_digest(cluster, docs)
     print("DIGEST " + json.dumps(digest, sort_keys=True), flush=True)
 
 
@@ -568,6 +718,9 @@ def child_main(args) -> None:
     from ..utils import compile_cache, faults
 
     compile_cache.enable()
+    if getattr(args, "replication", False):
+        _replication_child(args)
+        return
     if getattr(args, "cluster", False):
         _cluster_child(args)
         return
@@ -768,7 +921,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
                 cluster: bool = False,
                 migrate_at: int = -1,
                 qos: str | None = None,
-                history: str | None = None) -> dict:
+                history: str | None = None,
+                replication: bool = False) -> dict:
     cmd = [sys.executable, "-m", "fluidframework_tpu.tools.chaos",
            "--child", "--dir", data_dir, "--seed", str(seed),
            "--docs", str(docs), "--k", str(k), "--ticks", str(ticks),
@@ -781,6 +935,8 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
         cmd += ["--megadoc", str(megadoc)]
     if cluster:
         cmd += ["--cluster", "--migrate-at", str(migrate_at)]
+    if replication:
+        cmd += ["--replication", "--migrate-at", str(migrate_at)]
     if qos is not None:
         cmd += ["--qos", qos]
     if history is not None:
@@ -794,14 +950,17 @@ def _spawn_life(data_dir: str, seed: int, docs: int, k: int, ticks: int,
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.run(cmd, capture_output=True, text=True,
                           timeout=timeout, env=env)
-    acked, digest = [], None
+    acked, digest, failovers = [], None, []
     for line in proc.stdout.splitlines():
         if line.startswith("ACKED "):
             acked.append(int(line.split()[1]))
+        elif line.startswith("FAILOVER "):
+            failovers.append(float(line.split()[1]))
         elif line.startswith("DIGEST "):
             digest = json.loads(line[len("DIGEST "):])
     return {"returncode": proc.returncode, "acked": acked,
-            "digest": digest, "stderr": proc.stderr}
+            "digest": digest, "failovers": failovers,
+            "stderr": proc.stderr}
 
 
 def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
@@ -814,7 +973,8 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
               cluster: bool = False,
               migrate_at: int | None = None,
               qos: bool = False,
-              history: bool = False) -> dict:
+              history: bool = False,
+              replication: bool = False) -> dict:
     """One scenario: a twin run, then a killed-and-recovered run, then
     the plane diff. Returns the report; raises AssertionError on any
     divergence or lost acked op. ``twin_digest`` lets callers share one
@@ -846,11 +1006,15 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
     if history and (qos or cluster or residency is not None
                     or pipelined or megadoc):
         raise ValueError("history=True is its own scenario stack")
+    if replication and (history or qos or cluster
+                        or residency is not None or pipelined or megadoc):
+        raise ValueError("replication=True is its own scenario stack")
     cfg = dict(seed=seed, docs=docs, k=k, ticks=ticks, cp_every=cp_every,
                residency=residency, pipelined=pipelined, megadoc=megadoc,
-               cluster=cluster,
+               cluster=cluster, replication=replication,
                migrate_at=(migrate_at if migrate_at is not None
-                           else ticks // 2) if cluster else -1,
+                           else ticks // 2) if (cluster or replication)
+               else -1,
                qos="fair" if qos else None,
                history="compact" if history else None)
     if twin_digest is None:
@@ -859,8 +1023,8 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
         # digest equality then ALSO proves fair composition (resp.
         # summarization compaction) never changes converged replica
         # state — the cluster-twin pattern.
-        twin_cfg = dict(cfg, migrate_at=-1) if cluster else (
-            dict(cfg, qos="blind") if qos else (
+        twin_cfg = dict(cfg, migrate_at=-1) if (cluster or replication) \
+            else (dict(cfg, qos="blind") if qos else (
                 dict(cfg, history="plain") if history else cfg))
         twin = _spawn_life(os.path.join(workdir, "twin"), resume_from=None,
                            kill_env=None, timeout=timeout, **twin_cfg)
@@ -870,10 +1034,12 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
     chaos_dir = os.path.join(workdir, f"chaos-{kill_point}-{kill_hits}")
     acked: set[int] = set()
     lives = 0
+    failovers: list[float] = []
     life = _spawn_life(chaos_dir, resume_from=None,
                        kill_env=f"{kill_point}:{kill_hits}",
                        timeout=timeout, **cfg)
     acked.update(life["acked"])
+    failovers.extend(life["failovers"])
     lives += 1
     killed = life["returncode"] == faults.KILL_EXIT_CODE
     # Restart lives (no further kills) until a clean finish. The resend
@@ -884,6 +1050,7 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
         life = _spawn_life(chaos_dir, resume_from=resume,
                            kill_env=None, timeout=timeout, **cfg)
         acked.update(life["acked"])
+        failovers.extend(life["failovers"])
         lives += 1
         assert lives <= 8, "chaos run did not converge to a clean life"
     digest = life["digest"]
@@ -891,6 +1058,13 @@ def run_chaos(workdir: str, kill_point: str, kill_hits: int = 1,
     report = {"kill_point": kill_point, "kill_hits": kill_hits,
               "killed": killed, "lives": lives,
               "acked_rounds": sorted(acked), **cfg}
+    if replication:
+        # The failover path only runs when the kill actually fired:
+        # every killed replication life must promote on restart, and
+        # each promotion's blackout rides the report (the matrix
+        # aggregates the p99 bound).
+        assert len(failovers) == lives - 1, (failovers, lives)
+        report["failover_blackouts_ms"] = failovers
     assert json.dumps(digest, sort_keys=True) == json.dumps(
         twin_digest, sort_keys=True), (
         f"recovered state diverged from the twin at {kill_point}:"
@@ -1497,6 +1671,13 @@ def main(argv=None) -> None:
                              "one shared snapshot store with a durable "
                              "placement directory (the "
                              "MIGRATION_KILL_POINTS scenarios)")
+    parser.add_argument("--replication", action="store_true",
+                        help="serve the two-host cluster with the doc-0 "
+                             "genesis owner quorum-replicated to "
+                             f"{REPLICATION_FOLLOWERS} follower dirs; a "
+                             "resumed life promotes a follower instead "
+                             "of reopening the leader (the "
+                             "REPLICATION_CHAOS_POINTS scenarios)")
     parser.add_argument("--migrate-at", type=int, default=-1,
                         help="cluster mode: round at which doc 0 live-"
                              "migrates to the other host (-1 = never)")
@@ -1521,6 +1702,7 @@ def main(argv=None) -> None:
                        seed=args.seed, docs=args.docs, k=args.k,
                        ticks=args.ticks, cp_every=args.cp_every,
                        pipelined=args.pipelined, cluster=args.cluster,
+                       replication=args.replication,
                        migrate_at=(args.migrate_at if args.migrate_at >= 0
                                    else None))
     report.pop("twin_digest", None)

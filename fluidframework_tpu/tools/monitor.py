@@ -250,6 +250,38 @@ def render_cluster(metrics: dict, prev: dict | None = None,
             f"last blackout {blackout:,.1f}ms")
 
 
+def render_replication(metrics: dict, prev: dict | None = None,
+                       interval: float = 1.0) -> str:
+    """Replication-plane line (the round-19 HA tier): this host's role
+    (leader / follower / demoted — a fenced old leader that must shed),
+    follower count, replication lag (durable ticks the slowest follower
+    is behind), the replicated-vs-durable watermark gap (ticks locally
+    fsynced but not yet quorum-acked — what acks are waiting on), ship
+    rate over the poll window (cumulative with no window), and the last
+    failover's blackout ms. Empty when no replication plane is attached
+    (the gauges never appear)."""
+    if "repl.role_code" not in metrics:
+        return ""
+    role = {1: "leader", 2: "follower",
+            3: "demoted"}.get(int(metrics.get("repl.role_code", 0)),
+                              "unknown")
+    followers = metrics.get("repl.followers", 0)
+    lag = metrics.get("repl.lag", 0)
+    gap = metrics.get("repl.watermark_gap", 0)
+    shipped = metrics.get("repl.shipped_batches", 0)
+    blackout = metrics.get("repl.last_failover_blackout_ms", 0.0)
+    per_s = max(interval, 1e-9)
+    rate = ""
+    if prev:
+        w_s = shipped - prev.get("repl.shipped_batches", 0)
+        if w_s >= 0:  # negative = service restarted
+            rate = f" ({w_s / per_s:,.1f}/s)"
+    return (f"replication: role {role}  followers {followers:g}  "
+            f"lag {lag:g}  watermark-gap {gap:g}  "
+            f"shipped {shipped:g}{rate}  "
+            f"last failover blackout {blackout:,.1f}ms")
+
+
 def render_megadoc(metrics: dict, prev: dict | None = None,
                    interval: float = 1.0) -> str:
     """Mega-doc write-tier line (the round-15 scale-out plane):
@@ -400,6 +432,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     cluster_line = render_cluster(now, prev or None, interval)
     if cluster_line:
         lines.append(cluster_line)
+    repl_line = render_replication(now, prev or None, interval)
+    if repl_line:
+        lines.append(repl_line)
     history_line = render_history(now, prev or None, interval)
     if history_line:
         lines.append(history_line)

@@ -682,3 +682,76 @@ def test_history_chaos_full_matrix(seed, tmp_path):
     killed = [r for r in reports if r["killed"]]
     assert len(killed) >= len(reports) // 2, \
         [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
+
+
+# -- replication kill classes (ISSUE 17): tier-1 smoke + slow matrix -----------
+
+#: Leader + 2 followers under concurrent writes, a scripted mid-run
+#: migration riding the same window; the resumed life ALWAYS promotes a
+#: follower (the leader's directory is never reopened) — so digest
+#: equality proves the replicated log + journaled heads alone carry the
+#: whole acked state through a leader loss.
+_REPL_CFG = dict(seed=0, docs=2, k=8, ticks=6, cp_every=2,
+                 replication=True, migrate_at=3)
+
+#: Tier-1 smoke: killed AFTER the batch shipped and quorum-acked but
+#: before the leader's watermark settles — the op is acked-replicated,
+#: so losing it would be the headline data-loss bug.
+_REPL_SMOKE = [(chaos.REPLICATION_SMOKE_POINT, 2)]
+
+
+@pytest.fixture(scope="session")
+def replication_twin_digest(tmp_path_factory):
+    """The never-killed, never-migrated replicated twin: equality
+    against it is simultaneously the failover-recovery bar and the
+    replication-is-transparent differential bar."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("repl_twin")), resume_from=None,
+        kill_env=None, timeout=300,
+        **dict(_REPL_CFG, migrate_at=-1))
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    assert life["failovers"] == []  # nothing died in the twin
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _REPL_SMOKE,
+                         ids=[p for p, _ in _REPL_SMOKE])
+def test_replication_chaos_smoke_promotes_follower(
+        point, hits, tmp_path, replication_twin_digest):
+    """kill -9 the replicated leader mid-storm (concurrent writes, an
+    in-flight migration): a follower promotes under the same label,
+    the converged digest is byte-identical to the never-killed twin,
+    zero acked-replicated ops are lost, and the failover blackout is
+    bounded and reported (the ISSUE 17 acceptance bar)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=replication_twin_digest,
+                             **_REPL_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_REPL_CFG["ticks"]))
+    blackouts = report["failover_blackouts_ms"]
+    assert len(blackouts) == report["lives"] - 1  # one per promotion
+    assert all(0 < b < 30_000 for b in blackouts), blackouts
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_replication_chaos_full_matrix(seed, tmp_path):
+    """Slow soak: every replication kill class (either side of the
+    ship, torn group commit, mid-tick) × hit position, per seed — with
+    the failover blackout p99 bounded across the whole matrix."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.REPLICATION_CHAOS_POINTS,
+        seeds=(seed,), hit_positions=(1, 2),
+        **{k: v for k, v in _REPL_CFG.items() if k != "seed"})
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]
+    blackouts = sorted(b for r in reports
+                       for b in r["failover_blackouts_ms"])
+    assert blackouts, "no promotion fired across the whole matrix"
+    p99 = blackouts[min(len(blackouts) - 1,
+                        int(0.99 * len(blackouts)))]
+    assert p99 < 30_000, blackouts

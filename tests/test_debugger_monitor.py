@@ -485,3 +485,39 @@ def test_cluster_line_renders_placement_plane():
                                      interval=1.0)
     human = monitor.render_human(m, {}, interval=1.0)
     assert "cluster: hosts 4" in human
+
+
+def test_replication_line_renders_plane_state():
+    """Round-19 replication line: silent without a replication plane,
+    then role, follower count, lag, watermark gap, shipped batches
+    (windowed rate) and the last failover's blackout ms — and the line
+    rides human watch mode."""
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_replication
+
+    assert render_replication({}) == ""  # no plane → no line
+    m = {"repl.role_code": 1.0,
+         "repl.followers": 2.0,
+         "repl.lag": 3.0,
+         "repl.watermark_gap": 1.0,
+         "repl.shipped_batches": 40.0,
+         "repl.last_failover_blackout_ms": 712.135}
+    text = render_replication(m)
+    assert "role leader" in text
+    assert "followers 2" in text
+    assert "lag 3" in text
+    assert "watermark-gap 1" in text
+    assert "shipped 40" in text
+    assert "last failover blackout 712.1ms" in text
+    # A fenced ex-leader shows as demoted.
+    assert "role demoted" in render_replication(
+        dict(m, **{"repl.role_code": 3.0}))
+    # Windowed ship rate over a 2s poll window.
+    windowed = render_replication(m, {"repl.shipped_batches": 30.0},
+                                  interval=2.0)
+    assert "(5.0/s)" in windowed
+    # Restart (negative window): cumulative count, no rate suffix.
+    assert "(" not in render_replication(
+        m, {"repl.shipped_batches": 99.0}, interval=1.0)
+    human = monitor.render_human(m, {}, interval=1.0)
+    assert "replication: role leader" in human

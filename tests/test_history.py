@@ -481,3 +481,125 @@ def test_render_history_line():
         prev={"history.compactions": 5, "history.reads": 30},
         interval=2.0)
     assert "compactions 2.00/s" in windowed
+
+
+class TestReanchorAndPins:
+    """Round 19 satellites: summary-chain re-anchoring (ROADMAP 5c —
+    head records stay O(depth) while anchored exact states remain
+    addressable) and paid-tier retention pins (ROADMAP 5d — riddler's
+    tier column gates who may hold history against the trim)."""
+
+    def test_chain_reanchors_past_depth_cap(self, tmp_path):
+        """Ten compactions against a depth-4 cap: the inline chain
+        stays bounded, the overflow rolls into linked anchor pages,
+        and EVERY prior summary's exact state still reads
+        byte-identical to a never-compacted twin through the page
+        walk."""
+        s, st, h, _ = _stack(tmp_path / "a", chain_reanchor_depth=4)
+        s2, st2, h2, _ = _stack(tmp_path / "b")  # never-compacted twin
+        _serve(s, st, ["d0"], rounds=1)
+        _serve(s2, st2, ["d0"], rounds=1)
+        assert h.compact("d0")
+        summary_seqs = [h.summary_seq("d0")]
+        for r in range(1, 10):
+            for stx in (st, st2):
+                stx.submit_frame(
+                    None, {"rid": r,
+                           "docs": [["d0", "client-1", 1 + r * K, 1, K]]},
+                    memoryview(_words(7, r, 0).tobytes()))
+                stx.flush()
+            assert h.compact("d0")
+            summary_seqs.append(h.summary_seq("d0"))
+        rec = h._summary_record("d0")
+        assert len(rec["chain"]) <= 4  # bounded inline
+        assert rec["anchor"]["handle"]
+        assert h.stats["reanchors"] >= 2  # pages form a linked list
+        for sq in summary_seqs:
+            assert h.read_at("d0", sq) == h2.read_at("d0", sq), sq
+        _close(st)
+        _close(st2)
+
+    def test_reanchor_disabled_keeps_unbounded_chain(self, tmp_path):
+        s, st, h, _ = _stack(tmp_path, chain_reanchor_depth=None)
+        _serve(s, st, ["d0"], rounds=1)
+        for r in range(6):
+            if r:
+                st.submit_frame(
+                    None, {"rid": r,
+                           "docs": [["d0", "client-1", 1 + r * K, 1, K]]},
+                    memoryview(_words(7, r, 0).tobytes()))
+                st.flush()
+            assert h.compact("d0")
+        rec = h._summary_record("d0")
+        assert len(rec["chain"]) == 5 and "anchor" not in rec
+        assert h.stats["reanchors"] == 0
+        _close(st)
+
+    def test_pin_blocks_trim_then_unpin_releases(self, tmp_path):
+        """A pinned range clamps the trim floor (reads inside it stay
+        exact while unpinned history trims away); dropping the pin
+        lets the next compaction cadence reclaim what it held."""
+        s, st, h, _ = _stack(tmp_path / "a",
+                             tail_retention_summaries=0,
+                             trim_batch_ticks=10**9)
+        s2, st2, h2, _ = _stack(tmp_path / "b")
+        _serve(s, st, ["d0"], rounds=4)
+        _serve(s2, st2, ["d0"], rounds=4)
+        st.checkpoint()
+        h.pin_range("tenant-a", "d0", 5, 20)
+        assert h.compact("d0")
+        h.trim_now()
+        assert h.tail_floor("d0") <= 5  # clamped by the pin
+        for sq in (5, 12, 20):
+            assert h.read_at("d0", sq) == h2.read_at("d0", sq), sq
+        trimmed_before = h.stats["trimmed_ticks"]
+        assert h.unpin_range("tenant-a", "d0")
+        assert not h.unpin_range("tenant-a", "d0")  # idempotent
+        for r in (4, 5):
+            for stx in (st, st2):
+                stx.submit_frame(
+                    None, {"rid": r,
+                           "docs": [["d0", "client-1", 1 + r * K, 1, K]]},
+                    memoryview(_words(7, r, 0).tobytes()))
+                stx.flush()
+        st.checkpoint()
+        assert h.compact("d0")
+        h.trim_now()
+        assert h.stats["trimmed_ticks"] > trimmed_before
+        assert h.tail_floor("d0") > 5  # the pin's hold is gone
+        _close(st)
+        _close(st2)
+
+    def test_pins_gated_on_riddler_paid_tier(self, tmp_path):
+        from fluidframework_tpu.server.riddler import TenantManager
+        tm = TenantManager()
+        tm.create_tenant("pro-t", tier="pro")
+        tm.create_tenant("free-t", tier="free")
+        tm.create_tenant("std-t", tier="standard")
+        s, st, h, _ = _stack(tmp_path, tenant_source=tm)
+        _serve(s, st, ["d0"], rounds=2)
+        for t in ("free-t", "std-t", "no-such-tenant"):
+            with pytest.raises(HistoryError):
+                h.pin_range(t, "d0", 1, 8)
+        assert h.stats["pins"] == 0
+        pin = h.pin_range("pro-t", "d0", 1, 8)
+        assert pin == {"tenant": "pro-t", "doc": "d0", "lo": 1, "hi": 8}
+        assert h.stats["pins"] == 1
+        with pytest.raises(ValueError):
+            h.pin_range("pro-t", "d0", 9, 2)  # inverted range
+        _close(st)
+
+    def test_pins_replay_through_recovery(self, tmp_path):
+        """Pins are journaled "hp" controls: a recovered plane holds
+        exactly the pins that were live — an unpinned pin stays
+        gone."""
+        s, st, h, _ = _stack(tmp_path)
+        _serve(s, st, ["d0"], rounds=2)
+        h.pin_range("tenant-a", "d0", 3, 9)
+        h.pin_range("tenant-b", "d0", 1, 4)
+        h.unpin_range("tenant-b", "d0")
+        _close(st)
+        s2, st2, h2, _ = _stack(tmp_path)
+        st2.recover()
+        assert h2.pins == {("tenant-a", "d0"): (3, 9)}
+        _close(st2)

@@ -1,0 +1,540 @@
+"""Replication plane (round 19 tentpole, server/replication.py):
+quorum-shipped WAL batches, replicated head flips, and leader failover.
+
+The acceptance bars under test here, in-process (the kill -9 recovery
+story rides tests/test_chaos.py's REPLICATION smoke + soak):
+
+* **stream hygiene** — torn, reordered and duplicated shipped batches
+  never corrupt a follower's replica log: torn payloads reject whole,
+  gaps nack with the follower's length so the leader re-ships the
+  missing tail, duplicates ack idempotently;
+* **quorum gating** — client acks advance on ``min(durable,
+  replicated)``: a partitioned quorum freezes the watermark (and with
+  it the acks) while local durability keeps going, and heals through
+  the gap-nack → resync path once the link returns;
+* **restart / lag resync** — a follower restarted mid-stream resumes
+  from its on-disk log; one whose lag crossed the history plane's
+  retention floor converges on journaled heads (snapshot) + log tail,
+  receiving the same filler bytes the leader holds;
+* **ship-then-flip heads** — a backend head only ever flips after a
+  follower quorum journaled it, so promotion can roll every journal
+  forward without ever rolling the backend back;
+* **promotion + fencing** — the most advanced follower becomes a
+  serving host byte-equal on every converged plane, and the demoted
+  ex-leader sheds all traffic with ``moved`` nacks, refuses
+  checkpoints, and never acks again.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.parallel.placement import (
+    StormCluster,
+    make_cluster_host,
+)
+from fluidframework_tpu.protocol.codec import (
+    decode_storm_body,
+    encode_storm_body,
+)
+from fluidframework_tpu.server.durable_store import GitSnapshotStore
+from fluidframework_tpu.server.history import HistoryPlane
+from fluidframework_tpu.server.historian import Historian
+from fluidframework_tpu.server.replication import (
+    REPLICATION_STREAM_VERSION,
+    ReplicaLink,
+    ReplicaNode,
+    ReplicatedHeadStore,
+    ReplicationPlane,
+    ReplicationQuorumError,
+    _frame,
+    choose_promotion_candidate,
+    make_replicated_host,
+    promote,
+    promote_heads,
+)
+
+K = 8
+
+
+def _words(seed, k=K):
+    rng = np.random.default_rng(seed)
+    kinds = rng.choice([0, 0, 0, 1], size=k).astype(np.uint32)  # set/del
+    slots = rng.integers(0, 16, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def _build(tmp_path, followers=1, acks_required=None, label="hostA",
+           num_docs=8):
+    git = GitSnapshotStore(str(tmp_path / "git"))
+    f_dirs = [str(tmp_path / f"f{i}") for i in range(followers)]
+    storm, plane = make_replicated_host(
+        label, str(tmp_path / label), git, f_dirs,
+        acks_required=acks_required, num_docs=num_docs)
+    return git, storm, plane
+
+
+def _serve(storm, docs, rounds, cseq=None, clients=None, seed=3, k=K,
+           sink=None):
+    if clients is None:
+        clients = {d: storm.service.connect(d, lambda m: None).client_id
+                   for d in docs}
+        storm.service.pump()
+    cseq = cseq if cseq is not None else {d: 1 for d in docs}
+    for r in range(rounds):
+        for i, d in enumerate(docs):
+            w = _words([seed, cseq[d], i], k)
+            storm.submit_frame(
+                sink or (lambda p: None),
+                {"rid": (cseq[d], d),
+                 "docs": [[d, clients[d], cseq[d], 1, k]]},
+                memoryview(w.tobytes()))
+            cseq[d] += k
+        storm.flush()
+    return clients, cseq
+
+
+def _entries(storm, docs):
+    return {d: storm.merge_host.map_entries(d, storm.datastore,
+                                            storm.channel)
+            for d in docs}
+
+
+def _close(storm):
+    if storm._group_wal is not None:
+        storm._group_wal.close()
+
+
+# -- shipped-batch stream hygiene (torn / reordered / duplicated) --------------
+
+
+class TestStreamEdgeCases:
+
+    def test_torn_payload_rejected_whole(self, tmp_path):
+        """A frame whose lens claim more record bytes than arrived is
+        refused before ANY append — a partial append would CRC-frame
+        garbage at a real index and poison every later read."""
+        node = ReplicaNode(tmp_path / "f")
+        torn = _frame("batch", {"seq": 0, "lens": [4, 4]}, b"only5")
+        hdr, _ = decode_storm_body(node.on_frame(torn))
+        assert hdr["k"] == "nack" and hdr["reason"] == "torn-payload"
+        assert node.log_len == 0 and node.stats["rejected"] == 1
+        # The same records delivered whole land fine afterwards.
+        good = _frame("batch", {"seq": 0, "lens": [4, 4]}, b"aaaabbbb")
+        hdr2 = ReplicaLink(node).call(good)
+        assert hdr2["k"] == "ack" and hdr2["len"] == 2
+        assert node.read(0) == b"aaaa" and node.read(1) == b"bbbb"
+
+    def test_truncated_frame_on_the_wire_rejected(self, tmp_path):
+        """Byte-level truncation in transit (ReplicaLink.transform):
+        the codec framing itself fails and the follower nacks without
+        touching its log."""
+        node = ReplicaNode(tmp_path / "f")
+        link = ReplicaLink(node)
+        link.transform = lambda b: b[:max(1, len(b) // 2)]
+        hdr = link.call(_frame("batch", {"seq": 0, "lens": [3]}, b"abc"))
+        assert hdr["k"] == "nack" and node.log_len == 0
+
+    def test_reordered_batch_gap_nacks_with_local_length(self, tmp_path):
+        """A batch arriving ahead of its predecessor (reordered or the
+        predecessor lost) is refused; the nack carries the follower's
+        length so the leader knows where the missing tail starts."""
+        node = ReplicaNode(tmp_path / "f")
+        link = ReplicaLink(node)
+        hdr = link.call(_frame("batch", {"seq": 5, "lens": [3]}, b"abc"))
+        assert hdr["k"] == "nack" and hdr["reason"] == "gap"
+        assert hdr["len"] == 0 and node.stats["gap_nacks"] == 1
+        assert node.log_len == 0
+
+    def test_duplicate_and_overlapping_batches_idempotent(self, tmp_path):
+        """Exact duplicates ack without re-appending; an overlapping
+        re-ship (retry straddling the follower's length) appends only
+        the genuinely new suffix."""
+        node = ReplicaNode(tmp_path / "f")
+        link = ReplicaLink(node)
+        link.call(_frame("batch", {"seq": 0, "lens": [2, 2]}, b"aabb"))
+        # Exact duplicate delivery.
+        hdr = link.call(_frame("batch", {"seq": 0, "lens": [2, 2]},
+                               b"aabb"))
+        assert hdr["k"] == "ack" and hdr["len"] == 2
+        assert node.stats["dup_records"] == 2
+        # Overlap: records 1-2 where record 1 is already journaled.
+        hdr = link.call(_frame("batch", {"seq": 1, "lens": [2, 2]},
+                               b"bbcc"))
+        assert hdr["k"] == "ack" and hdr["len"] == 3
+        assert [node.read(i) for i in range(3)] == [b"aa", b"bb", b"cc"]
+
+    def test_newer_stream_version_refused(self, tmp_path):
+        node = ReplicaNode(tmp_path / "f")
+        frame = encode_storm_body(
+            {"v": REPLICATION_STREAM_VERSION + 1, "k": "batch",
+             "seq": 0, "lens": [1]}, b"x")
+        hdr = ReplicaLink(node).call(frame)
+        assert hdr["k"] == "nack" and hdr["reason"] == "version"
+        assert node.log_len == 0
+
+    def test_head_flips_journal_monotonic_and_survive_reopen(
+            self, tmp_path):
+        """Duplicate/old head flips are idempotent; the journal reloads
+        from disk (the restart half of promotion's roll-forward)."""
+        node = ReplicaNode(tmp_path / "f")
+        link = ReplicaLink(node)
+        link.call(_frame("head", {"hseq": 1, "key": "a", "handle": "h1"}))
+        link.call(_frame("head", {"hseq": 2, "key": "a", "handle": "h2"}))
+        # Replayed old flip: refused silently (idempotent ack).
+        hdr = link.call(_frame("head",
+                               {"hseq": 1, "key": "a", "handle": "h1"}))
+        assert hdr["k"] == "ack" and hdr["hseq"] == 2
+        assert node.heads["a"] == (2, "h2")
+        node.close()
+        again = ReplicaNode(tmp_path / "f")
+        assert again.heads["a"] == (2, "h2") and again.max_hseq == 2
+
+
+# -- quorum watermark gating ---------------------------------------------------
+
+
+class TestQuorumGating:
+
+    def test_replicated_watermark_tracks_durable_f1(self, tmp_path):
+        """F=1 healthy: every fsynced batch ships synchronously, so the
+        replicated watermark equals the durable one after each flush
+        and the storm's ack gate never withholds."""
+        _git, storm, plane = _build(tmp_path, followers=1)
+        _serve(storm, ["doc-0", "doc-1"], rounds=3)
+        assert storm._group_wal.durable_len > 0
+        assert plane.replicated_len == storm._group_wal.durable_len
+        assert storm.acked_watermark == storm._group_wal.durable_len
+        assert plane.follower_lag == 0
+        assert plane.stats["batches_shipped"] >= 3
+        _close(storm)
+
+    def test_partitioned_quorum_freezes_acks_then_heals(self, tmp_path):
+        """The only follower partitioned (F=1): local durability keeps
+        advancing but the replicated watermark — and the ack gate —
+        freeze. When the link returns, the next ship gap-nacks and the
+        resync re-ships the missing tail; acks resume."""
+        _git, storm, plane = _build(tmp_path, followers=1)
+        clients, cseq = _serve(storm, ["doc-0"], rounds=2)
+        frozen = plane.replicated_len
+        assert frozen == storm._group_wal.durable_len
+        plane.links[0].down = True
+        _serve(storm, ["doc-0"], rounds=2, cseq=cseq, clients=clients)
+        assert storm._group_wal.durable_len > frozen
+        assert plane.replicated_len == frozen  # quorum unreachable
+        assert storm.acked_watermark == frozen  # acks withheld
+        assert plane.stats["ship_failures"] >= 2
+        plane.links[0].down = False
+        _serve(storm, ["doc-0"], rounds=1, cseq=cseq, clients=clients)
+        assert plane.replicated_len == storm._group_wal.durable_len
+        assert storm.acked_watermark == storm._group_wal.durable_len
+        assert plane.links[0].node.log_len == plane.replicated_len
+        _close(storm)
+
+    def test_f2_majority_tolerates_one_follower_down(self, tmp_path):
+        """F=2 with the default majority quorum ((F+1)//2 = 1 follower
+        ack): one partitioned follower slows nothing, but shows up as
+        follower lag — the resync debt a second failure would cost."""
+        _git, storm, plane = _build(tmp_path, followers=2)
+        assert plane.acks_required == 1
+        plane.links[1].down = True
+        _serve(storm, ["doc-0", "doc-1"], rounds=3)
+        assert plane.replicated_len == storm._group_wal.durable_len
+        assert plane.follower_lag == storm._group_wal.durable_len
+        _close(storm)
+
+    def test_chain_replication_waits_for_every_follower(self, tmp_path):
+        """acks_required=F (chain-style full replication): ONE follower
+        down freezes the watermark even though a majority is healthy."""
+        _git, storm, plane = _build(tmp_path, followers=2,
+                                    acks_required=2)
+        plane.links[1].down = True
+        _serve(storm, ["doc-0"], rounds=2)
+        assert plane.replicated_len == 0
+        assert storm.acked_watermark == 0
+        _close(storm)
+
+    def test_gauges_reflect_plane_state(self, tmp_path):
+        _git, storm, plane = _build(tmp_path, followers=2)
+        plane.links[1].down = True
+        _serve(storm, ["doc-0"], rounds=2)
+        snap = storm.merge_host.metrics.snapshot()
+        assert snap["repl.role_code"] == 1  # leader
+        assert snap["repl.followers"] == 2
+        # Gauges refresh on the ship hook, which runs BEFORE the batch
+        # it ships advances the durable watermark — so the sampled lag
+        # trails the live property by at most the current batch.
+        assert snap["repl.lag"] >= 1
+        assert plane.follower_lag == storm._group_wal.durable_len
+        assert snap["repl.watermark_gap"] == 0  # majority still acks
+        assert snap["repl.shipped_batches"] >= 2
+        _close(storm)
+
+
+# -- follower restart / retention-floor resync ---------------------------------
+
+
+class TestFollowerResync:
+
+    def test_follower_restart_mid_stream_resumes_from_disk(
+            self, tmp_path):
+        """Restarted follower (same directory): the replica log and
+        head journal reload from disk; batches missed while it was down
+        arrive through the gap-nack → tail re-ship path and the logs
+        reconverge byte-identical to the leader's."""
+        _git, storm, plane = _build(tmp_path, followers=1)
+        clients, cseq = _serve(storm, ["doc-0", "doc-1"], rounds=2)
+        link = plane.links[0]
+        link.down = True  # follower "crashes"
+        _serve(storm, ["doc-0", "doc-1"], rounds=2, cseq=cseq,
+               clients=clients)
+        behind = link.node.log_len
+        assert behind < storm._group_wal.durable_len
+        # Restart: a fresh ReplicaNode over the same directory.
+        link.node.close()
+        link.node = ReplicaNode(tmp_path / "f0")
+        assert link.node.log_len == behind  # resumed, not reset
+        link.down = False
+        _serve(storm, ["doc-0", "doc-1"], rounds=1, cseq=cseq,
+               clients=clients)
+        durable = storm._group_wal.durable_len
+        assert link.node.log_len == durable
+        assert plane.replicated_len == durable
+        assert [link.node.read(i) for i in range(durable)] == \
+            [storm._group_wal.read(i) for i in range(durable)]
+        assert plane.stats["resyncs"] >= 1
+        _close(storm)
+
+    def test_lag_beyond_retention_floor_converges_on_snapshot_plus_tail(
+            self, tmp_path):
+        """The nasty one: a follower partitioned long enough that the
+        history plane TRIMMED ticks it never received. The resync ships
+        the same filler bytes the leader now holds; the follower's
+        recovery story becomes snapshot (journaled checkpoint heads) +
+        log tail — promoting IT must still reproduce the leader's
+        converged map state exactly."""
+        docs = ["doc-0", "doc-1"]
+        git, storm, plane = _build(tmp_path, followers=2)
+        hist = HistoryPlane(storm, summary_interval_ops=1,
+                            tail_retention_summaries=0,
+                            trim_batch_ticks=1)
+        clients, cseq = _serve(storm, docs, rounds=2)
+        lagger = plane.links[1]
+        lagger.down = True  # misses everything from here
+        behind = lagger.node.log_len
+        _serve(storm, docs, rounds=3, cseq=cseq, clients=clients)
+        # Checkpoint (quorum via the healthy follower), then compact +
+        # trim everything below it — the records the lagger missed.
+        storm.checkpoint()
+        for d in docs:
+            hist.compact(d)
+        hist.trim_now()
+        assert hist.stats["trimmed_ticks"] > 0  # fillers on disk now
+        lagger.down = False
+        _serve(storm, docs, rounds=1, cseq=cseq, clients=clients)
+        want = _entries(storm, docs)
+        durable = storm._group_wal.durable_len
+        assert lagger.node.log_len == durable
+        # The MISSED region arrives exactly as the leader now holds it
+        # — fillers included. (Records received before the partition
+        # keep their original bytes on the follower; the leader later
+        # shrank its own copies, which recovery below the checkpoint
+        # skips either way.)
+        assert [lagger.node.read(i) for i in range(behind, durable)] \
+            == [storm._group_wal.read(i) for i in range(behind, durable)]
+        assert any(b"trimmed" in lagger.node.read(i)
+                   for i in range(behind, durable))
+        _close(storm)
+        # Promote the PREVIOUSLY-LAGGING follower alone (as if both the
+        # leader and the healthy follower died).
+        new_storm, _new_plane, report = promote(
+            "hostA", [lagger.node], git,
+            follower_dirs=[str(tmp_path / "fresh")], num_docs=8)
+        assert report["promoted_node"] == "f1"
+        assert _entries(new_storm, docs) == want
+        _close(new_storm)
+
+
+# -- replicated head flips (ship-then-flip) ------------------------------------
+
+
+class TestReplicatedHeads:
+
+    def test_set_head_ships_before_backend_flip(self, tmp_path):
+        git, storm, plane = _build(tmp_path, followers=1)
+        store = storm.snapshots
+        assert isinstance(store, ReplicatedHeadStore)
+        handle = git.upload("docX", {"kind": "x", "n": 1})
+        store.set_head("docX", handle)
+        assert git.head("docX") == handle
+        node = plane.links[0].node
+        assert node.heads["docX"][1] == handle  # journaled first
+        _close(storm)
+
+    def test_quorum_refusal_leaves_backend_untouched(self, tmp_path):
+        """An unreachable quorum REFUSES the flip — the backend head
+        can never run ahead of every journal (the invariant promotion's
+        roll-forward relies on). checkpoint() surfaces the refusal."""
+        git, storm, plane = _build(tmp_path, followers=1)
+        _serve(storm, ["doc-0"], rounds=1)
+        plane.links[0].down = True
+        handle = git.upload("docX", {"kind": "x", "n": 1})
+        with pytest.raises(ReplicationQuorumError):
+            storm.snapshots.set_head("docX", handle)
+        assert git.head("docX") is None
+        assert plane.stats["quorum_refusals"] == 1
+        with pytest.raises(ReplicationQuorumError):
+            storm.checkpoint()
+        plane.links[0].down = False
+        storm.checkpoint()  # heals: quorum back, flip lands
+        _close(storm)
+
+    def test_promote_heads_rolls_crash_window_forward(self, tmp_path):
+        """A flip the dead leader shipped but never applied (killed
+        between ship and backend flip) rolls FORWARD at promotion; a
+        journal can never be older than the backend, so nothing ever
+        rolls back."""
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        node = ReplicaNode(tmp_path / "f0")
+        plane = ReplicationPlane([node])
+        h1 = git.upload("docX", {"kind": "x", "n": 1})
+        plane.ship_head("docX", h1)
+        git.set_head("docX", h1)  # applied flip
+        h2 = git.upload("docX", {"kind": "x", "n": 2})
+        plane.ship_head("docX", h2)  # ...leader dies HERE: no flip
+        assert git.head("docX") == h1
+        assert promote_heads([node], git) == 1
+        assert git.head("docX") == h2
+        # Idempotent: a second promotion pass flips nothing.
+        assert promote_heads([node], git) == 0
+
+    def test_candidate_choice_prefers_longest_log(self, tmp_path):
+        a = ReplicaNode(tmp_path / "a")
+        b = ReplicaNode(tmp_path / "b")
+        ReplicaLink(b).call(_frame("batch", {"seq": 0, "lens": [2]},
+                                   b"xy"))
+        assert choose_promotion_candidate([a, b]) is b
+        # Equal logs: freshest head journal, then node id.
+        ReplicaLink(a).call(_frame("batch", {"seq": 0, "lens": [2]},
+                                   b"xy"))
+        ReplicaLink(a).call(_frame("head", {"hseq": 1, "key": "k",
+                                            "handle": "h"}))
+        assert choose_promotion_candidate([a, b]) is a
+
+
+# -- promotion + fencing -------------------------------------------------------
+
+
+class TestFailover:
+
+    def test_promotion_reproduces_acked_state_and_rearms(self, tmp_path):
+        """Full failover: serve + checkpoint, 'kill' the leader, promote
+        the most advanced follower — every converged map row must
+        reappear, and the promoted host must itself replicate (fresh
+        follower resynced from zero through the plane's own tail
+        re-ship)."""
+        docs = ["doc-0", "doc-1"]
+        git, storm, plane = _build(tmp_path, followers=2)
+        clients, cseq = _serve(storm, docs, rounds=2)
+        storm.checkpoint()
+        _serve(storm, docs, rounds=2, cseq=cseq, clients=clients)
+        want = _entries(storm, docs)
+        durable = storm._group_wal.durable_len
+        _close(storm)  # the "kill": leader gone, followers survive
+        nodes = [lk.node for lk in plane.links]
+        new_storm, new_plane, report = promote(
+            "hostA", nodes, git,
+            follower_dirs=[str(tmp_path / "fresh")], num_docs=8)
+        assert report["log_len"] == durable
+        assert report["blackout_ms"] > 0
+        assert report["replayed_ticks"] > 0  # post-checkpoint tail
+        assert _entries(new_storm, docs) == want
+        # Re-armed: new writes quorum-replicate (surviving follower +
+        # the fresh one, resynced from zero at attach).
+        assert new_plane.replicated_len == durable
+        fresh = [lk for lk in new_plane.links
+                 if lk.node.node_id == "fresh"][0]
+        assert fresh.node.log_len == durable
+        _serve(new_storm, docs, rounds=1, cseq=cseq, clients=None)
+        assert new_plane.replicated_len \
+            == new_storm._group_wal.durable_len > durable
+        _close(new_storm)
+
+    def test_fenced_leader_sheds_refuses_and_never_acks(self, tmp_path):
+        """The demoted ex-leader: every frame sheds with a ``moved``
+        nack naming the new incarnation, checkpoint() refuses loudly,
+        head flips refuse, and the ack watermark stays frozen."""
+        _git, storm, plane = _build(tmp_path, followers=1)
+        clients, cseq = _serve(storm, ["doc-0"], rounds=1)
+        frozen = storm.acked_watermark
+        plane.fence(moved_to="hostA")
+        shed = []
+        storm.submit_frame(
+            shed.append,
+            {"rid": (99, "doc-0"),
+             "docs": [["doc-0", clients["doc-0"], cseq["doc-0"], 1, K]]},
+            memoryview(_words([9, 9]).tobytes()))
+        storm.flush()
+        assert len(shed) == 1
+        assert shed[0]["moved_to"] == {"doc-0": "hostA"}
+        with pytest.raises(RuntimeError):
+            storm.checkpoint()
+        with pytest.raises(ReplicationQuorumError):
+            plane.ship_head("k", "h")
+        assert storm.acked_watermark == frozen
+        snap = storm.merge_host.metrics.snapshot()
+        assert snap["repl.role_code"] == 3  # demoted
+        _close(storm)
+
+    def test_cluster_fail_over_bumps_incarnation_and_flushes_caches(
+            self, tmp_path):
+        """StormCluster.fail_over: the incarnation stamp bumps DURABLY
+        (a rebuilt directory sees it), the old controller is fenced
+        toward the label, and historian head caches over the shared
+        store are invalidated (promotion flipped backend heads behind
+        them)."""
+        docs = ["doc-0", "doc-1"]
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        hist_front = Historian(git, head_ttl_s=1e9)
+        old, plane = make_replicated_host(
+            "hostA", str(tmp_path / "hostA"), git,
+            [str(tmp_path / "f0"), str(tmp_path / "f1")], num_docs=8)
+        other = make_cluster_host("hostB", str(tmp_path / "hostB"),
+                                  git, num_docs=8)
+        cluster = StormCluster({"hostA": old, "hostB": other},
+                               hist_front)
+        clients, cseq = _serve(old, docs, rounds=2)
+        old.checkpoint()
+        # A head the historian cached, then — exactly what promotion
+        # does — flipped DIRECTLY on the backend behind the cache.
+        h1 = git.upload("stale-doc", {"kind": "x", "n": 1})
+        git.set_head("stale-doc", h1)
+        assert hist_front.head("stale-doc") == h1  # cached, huge TTL
+        h2 = git.upload("stale-doc", {"kind": "x", "n": 2})
+        git.set_head("stale-doc", h2)
+        assert hist_front.head("stale-doc") == h1  # serving stale
+        _close(old)
+        new_storm, _p, rep = promote(
+            "hostA", [lk.node for lk in plane.links], git, num_docs=8)
+        inc0 = cluster.directory.incarnation_of("hostA")
+        inc = cluster.fail_over("hostA", new_storm,
+                                blackout_ms=rep["blackout_ms"])
+        assert inc == inc0 + 1
+        assert cluster.directory.incarnation_of("hostA") == inc
+        assert plane.fenced and plane.moved_to == "hostA"
+        assert cluster.hosts["hostA"] is new_storm
+        # Head cache flushed by fail_over: the stale entry is gone.
+        assert hist_front.head("stale-doc") == h2
+        snap = new_storm.merge_host.metrics.snapshot()
+        assert snap["repl.last_failover_blackout_ms"] \
+            == round(rep["blackout_ms"], 3)
+        # Durable: a directory rebuilt over the same store keeps it.
+        rebuilt = StormCluster({"hostA": new_storm, "hostB": other},
+                               git)
+        assert rebuilt.directory.incarnation_of("hostA") == inc
+        _close(new_storm)
+        _close(other)
