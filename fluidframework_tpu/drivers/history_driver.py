@@ -35,11 +35,33 @@ class HistoricalDocumentService:
 
     # -- transport dispatch ----------------------------------------------------
 
+    def _net_request(self, req: dict) -> dict:
+        """One front-door RPC with bounded read-tier redial: a
+        ``moved`` answer (the replica/placement directory naming the
+        serving host) redials the labeled address from the service's
+        address book and re-asks THERE — how a historical read lands on
+        its assigned read replica, and how a replica-shed stale read
+        falls back to the leader. Unknown labels surface to the caller
+        (who owns service discovery)."""
+        service = self._service
+        for _hop in range(4):
+            try:
+                return service._request(req)
+            except Exception as err:
+                moved = getattr(err, "moved_to", None)
+                addr = getattr(service, "hosts", {}).get(moved)
+                if moved is None or addr is None:
+                    raise
+                service._addr = tuple(addr)
+                service.reconnect()
+        raise ConnectionError(
+            "historical read redirect chain did not converge")
+
     def _read_at(self, doc_id: str, seq: int) -> dict:
         request = getattr(self._service, "_request", None)
         if request is not None:  # network front door
-            resp = request({"op": "read_at", "doc_id": doc_id,
-                            "seq": seq})
+            resp = self._net_request({"op": "read_at", "doc_id": doc_id,
+                                      "seq": seq})
             return {k: v for k, v in resp.items() if k != "rid"}
         return self._service.read_at(doc_id, seq)
 
@@ -72,9 +94,9 @@ class HistoricalDocumentService:
         to_seq = pin if to_seq is None else min(int(to_seq), pin)
         request = getattr(self._service, "_request", None)
         if request is not None:
-            return request({"op": "get_deltas", "doc_id": self.doc_id,
-                            "from_seq": from_seq,
-                            "to_seq": to_seq})["messages"]
+            return self._net_request(
+                {"op": "get_deltas", "doc_id": self.doc_id,
+                 "from_seq": from_seq, "to_seq": to_seq})["messages"]
         return self._service.get_deltas(self.doc_id, from_seq, to_seq)
 
     # -- branch verbs ----------------------------------------------------------
@@ -86,8 +108,12 @@ class HistoricalDocumentService:
         at = self._pinned_seq() if seq is None else int(seq)
         request = getattr(self._service, "_request", None)
         if request is not None:
-            branch = request({"op": "fork", "doc_id": self.doc_id,
-                              "seq": at, "name": name})["branch"]
+            # Branch verbs are writes: a replica front door answers
+            # "moved" naming the leader, and the same redial converges
+            # there.
+            branch = self._net_request(
+                {"op": "fork", "doc_id": self.doc_id,
+                 "seq": at, "name": name})["branch"]
         else:
             branch = self._service.fork_doc(self.doc_id, at, name)
         return HistoricalDocumentService(self._service, branch, at)
@@ -97,7 +123,8 @@ class HistoricalDocumentService:
         through the ordinary sequencer."""
         request = getattr(self._service, "_request", None)
         if request is not None:
-            resp = request({"op": "merge_back", "branch": self.doc_id})
+            resp = self._net_request({"op": "merge_back",
+                                      "branch": self.doc_id})
             return {k: v for k, v in resp.items() if k != "rid"}
         return self._service.merge_back(self.doc_id)
 

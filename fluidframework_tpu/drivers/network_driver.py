@@ -695,11 +695,27 @@ class ViewerStream:
                      "client_key": self._service._client_key}
         if self._service._token is not None:
             req["token"] = self._service._token
-        hello = self._service._request(req)
-        self.viewer_id = hello["client_id"]
-        self.last_seq = max(self.last_seq, hello.get("seq", 0))
-        self.audience_total = hello.get("viewers", 0)
-        return hello
+        from .utils import DocumentMovedError
+        for _hop in range(4):
+            try:
+                hello = self._service._request(req)
+            except DocumentMovedError as err:
+                # Read-tier redirect: the replica directory (or the
+                # placement directory) named the host serving this
+                # doc's viewer room — redial IT, same bounded-chain
+                # contract as the write connect path.
+                addr = self._service.hosts.get(err.moved_to)
+                if addr is None:
+                    raise
+                self._service._addr = tuple(addr)
+                self._service.reconnect()
+                continue
+            self.viewer_id = hello["client_id"]
+            self.last_seq = max(self.last_seq, hello.get("seq", 0))
+            self.audience_total = hello.get("viewers", 0)
+            return hello
+        raise ConnectionError(
+            "viewer connect redirect chain did not converge")
 
     def _handle_tick(self, payload: dict) -> None:
         self.stats["ticks"] += 1
@@ -735,8 +751,27 @@ class ViewerStream:
         ``last_seq``; a doc evicted to the cold tier meanwhile serves
         this from its cold-head index without hydrating), then
         ``viewer_resume`` — retrying at the server's ``retry_after_s``
-        hint when the resume storm is being laddered out. Returns the
+        hint when the resume storm is being laddered out. A re-home
+        directive (``moved_to`` — live migration, or a room spread onto
+        the read-replica tier) redials the named host and re-JOINS
+        there instead of resuming on the old one. Returns the
         caught-up messages."""
+        moved = self.moved_to
+        if moved is not None \
+                and moved in getattr(self._service, "hosts", {}):
+            # Catch up from the OLD host first (its WAL holds the seqs
+            # the dropped queue would have carried), then dial the new
+            # owner and join fresh — viewer_resume has no registration
+            # on the new host to resume.
+            caught_up = self._fetch_gap()
+            self._service._addr = tuple(self._service.hosts[moved])
+            self._service.reconnect()
+            self.moved_to = None
+            hello = self.connect()
+            if hello.get("seq", 0) > self.last_seq:
+                caught_up += self._fetch_gap()
+            self.lagged = False
+            return caught_up
         caught_up = self._fetch_gap()
         for _ in range(max_attempts):
             try:

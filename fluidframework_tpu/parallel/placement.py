@@ -848,6 +848,127 @@ def make_cluster_host(label: str, data_dir: str, shared_snapshots,
     return storm
 
 
+class ReplicaBalancer:
+    """Read-replica scoring + re-home (the read-tier half of placement,
+    server/read_replica.py): spreads hot docs' AUDIENCE across N
+    replicas while writer traffic stays wherever the placement
+    directory puts it. Scoring is (rooms assigned, replica lag) — the
+    fewest-loaded, freshest replica wins — and a re-home flips the
+    replica directory FIRST (ship-then-flip under a replicated store),
+    then drops the leader's room through the viewer plane's spread so
+    every member redials its hash-assigned label.
+
+    Also the leader-side staleness scrape: :meth:`update_gauges` folds
+    each assigned room's ``leader watermark − replica applied seq`` gap
+    into the shared registry (``replica.staleness_seqs`` histogram +
+    the gauges tools/monitor.py renders)."""
+
+    def __init__(self, directory, replicas: dict[str, Any],
+                 leader_storm=None, metrics=None,
+                 retry_after_s: float = 0.05) -> None:
+        self.directory = directory
+        self.replicas = dict(replicas)
+        self.leader = leader_storm
+        if metrics is None:
+            metrics = (leader_storm.merge_host.metrics
+                       if leader_storm is not None else None)
+        from ..utils import MetricsRegistry
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry()
+        self.retry_after_s = retry_after_s
+        self.stats = {"rehomed_rooms": 0, "rehomed_viewers": 0}
+        for label, replica in self.replicas.items():
+            self.directory.register(label,
+                                    node=getattr(replica.node,
+                                                 "node_id", label))
+        self._c_rooms = self.metrics.counter("replica.rehomed_rooms")
+        self._c_viewers = self.metrics.counter(
+            "replica.rehomed_viewers")
+        self._h_staleness = self.metrics.histogram(
+            "replica.staleness_seqs")
+        self.update_gauges()
+
+    # -- scoring ---------------------------------------------------------------
+
+    def score(self, label: str) -> tuple[int, int]:
+        """(rooms assigned here, shipped-but-unapplied WAL records) —
+        lower is better on both axes."""
+        return (len(self.directory.rooms_on(label)),
+                self.replicas[label].lag)
+
+    def pick(self, n: int = 1) -> list[str]:
+        """The ``n`` least-loaded replicas, freshest first on ties."""
+        return sorted(self.replicas, key=self.score)[:max(1, n)]
+
+    # -- re-home ---------------------------------------------------------------
+
+    def spread_room(self, doc: str, labels: list[str] | None = None,
+                    n: int = 1) -> dict:
+        """Assign ``doc``'s read audience to ``labels`` (default: the
+        ``n`` best-scoring replicas) and re-home the leader's live room
+        through the viewer plane — each member's resync directive names
+        its hash-assigned replica, and late joiners route through the
+        directory at connect time. Returns the assignment + per-label
+        re-home counts."""
+        if labels is None:
+            labels = self.pick(n)
+        self.directory.assign_room(doc, labels)
+        counts: dict[str, int] = {}
+        viewers = getattr(getattr(self.leader, "service", None),
+                          "viewers", None)
+        if viewers is not None:
+            counts = viewers.spread_room(doc, labels, reason="moved")
+        self.stats["rehomed_rooms"] += 1
+        self.stats["rehomed_viewers"] += sum(counts.values())
+        self._c_rooms.inc()
+        self._c_viewers.inc(sum(counts.values()))
+        self.update_gauges()
+        return {"doc": doc, "labels": list(labels), "rehomed": counts}
+
+    def unspread_room(self, doc: str) -> None:
+        """Return ``doc``'s reads to the leader (directory flip only;
+        replica-side viewers lag-drop back on their next resync)."""
+        self.directory.unassign_room(doc)
+        self.update_gauges()
+
+    # -- staleness (per room, against the leader's watermark) ------------------
+
+    def _leader_seq(self, doc: str) -> int:
+        if self.leader is None:
+            return 0
+        ticks = self.leader._doc_ticks.get(doc)
+        return max((ls for _fs, ls, _t in ticks), default=0) \
+            if ticks else 0
+
+    def room_staleness(self) -> dict[str, dict[str, int]]:
+        """room doc -> {replica label: leader watermark − applied seq}
+        (0 = fully caught up; the BOUND a replica-served read of that
+        room can be behind by right now)."""
+        out: dict[str, dict[str, int]] = {}
+        for doc, labels in self.directory.rooms().items():
+            lead = self._leader_seq(doc)
+            out[doc] = {
+                label: max(0, lead
+                           - self.replicas[label].doc_seq(doc))
+                for label in labels if label in self.replicas}
+        return out
+
+    def update_gauges(self) -> None:
+        m = self.metrics
+        m.gauge("replica.hosts").set(len(self.replicas))
+        rooms = self.directory.rooms()
+        m.gauge("replica.rooms").set(len(rooms))
+        worst = 0
+        for per_label in self.room_staleness().values():
+            for gap in per_label.values():
+                self._h_staleness.observe(gap)
+                worst = max(worst, gap)
+        m.gauge("replica.staleness_worst").set(worst)
+        m.gauge("replica.lag_records").set(
+            max((r.lag for r in self.replicas.values()), default=0))
+
+
 __all__ = ["PlacementController", "StormCluster",
            "StormClusterDirectory", "MigrationResult",
-           "MIGRATION_KILL_POINTS", "make_cluster_host"]
+           "MIGRATION_KILL_POINTS", "ReplicaBalancer",
+           "make_cluster_host"]

@@ -169,6 +169,10 @@ class RequestSession:
             elif req.get("scopes") is not None:
                 kwargs["scopes"] = tuple(req["scopes"])
             redirect = self._placement_redirect(rid)
+            if redirect is None:
+                # A write connect dialed at a read replica sheds to the
+                # leader (the replica's self-router names it).
+                redirect = self._read_redirect(rid, "write")
             if redirect is not None:
                 return redirect
             admission = self.server.admission
@@ -248,8 +252,13 @@ class RequestSession:
                     return {"rid": rid, "error": "throttled",
                             "retry_after_s": retry}
             doc = req.get("doc_id", self.doc_id)
-            return {"rid": rid, "messages": service.get_deltas(
-                doc, req["from_seq"], req.get("to_seq"))}
+            redirect = self._read_redirect(rid, "get_deltas", doc=doc,
+                                           key=req.get("client_key"))
+            if redirect is not None:
+                return redirect
+            return self._serve_read(rid, lambda: {
+                "rid": rid, "messages": service.get_deltas(
+                    doc, req["from_seq"], req.get("to_seq"))})
         if op == "read_at":
             # Historical read (the history plane): sheds like any other
             # catch-up read — it is a read, and it must never outrank
@@ -260,7 +269,12 @@ class RequestSession:
                     return {"rid": rid, "error": "throttled",
                             "retry_after_s": retry}
             doc = req.get("doc_id", self.doc_id)
-            return {"rid": rid, **service.read_at(doc, req["seq"])}
+            redirect = self._read_redirect(rid, "read_at", doc=doc,
+                                           key=req.get("client_key"))
+            if redirect is not None:
+                return redirect
+            return self._serve_read(rid, lambda: {
+                "rid": rid, **service.read_at(doc, req["seq"])})
         if op in ("fork", "merge_back"):
             # Branch verbs are WRITE-class: fork settles the pipeline
             # and uploads seeds, merge_back re-submits a branch's whole
@@ -277,12 +291,14 @@ class RequestSession:
                             "retry_after_s": retry}
             if op == "fork":
                 doc = req.get("doc_id", self.doc_id)
-                return {"rid": rid,
-                        "branch": service.fork_doc(doc, req["seq"],
-                                                   req.get("name"))}
-            return {"rid": rid,
-                    **service.merge_back(req.get("branch",
-                                                 self.doc_id))}
+                return self._serve_read(rid, lambda: {
+                    "rid": rid,
+                    "branch": service.fork_doc(doc, req["seq"],
+                                               req.get("name"))})
+            return self._serve_read(rid, lambda: {
+                "rid": rid,
+                **service.merge_back(req.get("branch",
+                                             self.doc_id))})
         if op == "upload_snapshot":
             doc = req.get("doc_id", self.doc_id)
             return {"rid": rid,
@@ -336,6 +352,14 @@ class RequestSession:
             viewers = getattr(service, "viewers", None)
             if viewers is None or self.viewer_id is None:
                 return {"rid": rid, "error": "no viewer session"}
+            # Directory-aware resume: a room spread across replicas
+            # hands each resuming viewer ITS hash-assigned host — the
+            # client redials the label and re-joins there, which is how
+            # one hot doc's audience lands on N replicas.
+            redirect = self._read_redirect(rid, "viewer",
+                                           key=req.get("client_key"))
+            if redirect is not None:
+                return redirect
             retry = viewers.admit_join(self.doc_id, req.get("client_key"),
                                        tenant_id=self.tenant_id)
             if retry is not None:
@@ -374,6 +398,42 @@ class RequestSession:
                     "retry_after_s": placement.retry_after_s}
         return None
 
+    def _read_redirect(self, rid, kind: str, doc: str | None = None,
+                       key: str | None = None) -> dict | None:
+        """Read-tier routing (server/read_replica.py): on a leader with
+        a replica directory, directory-assigned read classes answer
+        ``moved`` with the serving replica's label (clients hash-spread
+        across a doc's label list by ``client_key``); on a replica, the
+        self-router sheds writes — and reads it cannot serve — back to
+        the leader. No router attached = no redirect (every assembly
+        without a replica tier)."""
+        router = getattr(self.server.service, "read_router", None)
+        if router is None:
+            return None
+        target = router.route_read(doc if doc is not None
+                                   else self.doc_id, kind, key=key)
+        if target is None:
+            return None
+        return {"rid": rid, "error": "moved", "retryable": True,
+                "moved_to": target,
+                "retry_after_s": router.retry_after_s}
+
+    def _serve_read(self, rid, fn) -> dict:
+        """Run one service read/branch verb, mapping a replica-raised
+        redirect (anything carrying ``moved_to`` — duck-typed so no
+        replica import rides every assembly) to the retryable ``moved``
+        response the drivers' redial machinery already understands."""
+        try:
+            return fn()
+        except Exception as err:
+            moved = getattr(err, "moved_to", None)
+            if moved is None:
+                raise
+            return {"rid": rid, "error": "moved", "retryable": True,
+                    "moved_to": moved,
+                    "retry_after_s": getattr(err, "retry_after_s",
+                                             0.05)}
+
     def _connect_viewer(self, req: dict, rid) -> dict:
         """``mode="viewer"`` connect (the broadcast viewer plane,
         server/broadcaster.py): token-authenticated like any connect but
@@ -407,6 +467,12 @@ class RequestSession:
                 token, document_id=self.doc_id)
             self.tenant_id = claims.get("tenantId", "default")
         redirect = self._placement_redirect(rid)
+        if redirect is None:
+            # Replica-directory routing: a directory-assigned room's
+            # viewers land on their hash-assigned replica at CONNECT
+            # time (writer traffic never routes here).
+            redirect = self._read_redirect(rid, "viewer",
+                                           key=req.get("client_key"))
         if redirect is not None:
             return redirect
         retry = viewers.admit_join(self.doc_id, req.get("client_key"),

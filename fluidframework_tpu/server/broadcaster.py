@@ -572,6 +572,31 @@ class ViewerPlane:
         self.metrics.counter("viewer.rehomes").inc(len(members))
         return len(members)
 
+    def spread_room(self, doc_id: str, labels: list[str],
+                    reason: str = "moved") -> dict[str, int]:
+        """Re-home one doc's room ACROSS hosts (the read-replica tier's
+        audience spread): each member is lag-dropped with a
+        hash-assigned label in its directive, so the room's audience
+        lands spread over ``labels`` instead of stampeding one host.
+        A member that re-resolves through the replica directory instead
+        may hash to a different label — either way it lands on a
+        replica serving the doc. Returns viewers re-homed per label."""
+        import zlib
+        room = self._rooms.get(doc_id)
+        if not room or not labels:
+            return {}
+        members = list(room.values())
+        counts: dict[str, int] = {}
+        for viewer in members:
+            label = labels[zlib.crc32(viewer.vid.encode())
+                           % len(labels)]
+            self._lag_drop(viewer, reason, moved_to=label)
+            counts[label] = counts.get(label, 0) + 1
+        self.stats["rehomes"] = self.stats.get("rehomes", 0) \
+            + len(members)
+        self.metrics.counter("viewer.rehomes").inc(len(members))
+        return counts
+
     # -- presence --------------------------------------------------------------
 
     def _maybe_announce(self, doc_id: str) -> None:

@@ -188,6 +188,106 @@ class _FoldState:
         return st
 
 
+# -- off-leader fold reuse -----------------------------------------------------
+#
+# The read-replica tier (server/read_replica.py) serves read_at/branch
+# reads with NO HistoryPlane instance and NO storm controller — just the
+# shared snapshot store and a tailed copy of the WAL. These module-level
+# functions are the exact read path the plane itself uses, factored so
+# both callers fold the same records through the same code and stay
+# byte-identical by construction.
+
+
+def load_summary_record(snapshots, doc: str) -> dict | None:
+    """The doc's head summary record from the shared store (version-
+    checked), or None when the doc has never been compacted."""
+    handle = snapshots.head(HIST_KEY_PREFIX + doc)
+    if handle is None:
+        return None
+    rec = snapshots.get(HIST_KEY_PREFIX + doc, handle)
+    if rec is None:
+        return None
+    version = rec.get("format_version", 0)
+    if not 0 <= version <= HISTORY_SUMMARY_VERSION:
+        raise ValueError(
+            f"history summary format v{version} is newer than this "
+            f"reader (max v{HISTORY_SUMMARY_VERSION})")
+    return rec
+
+
+def summary_base_for(snapshots, doc: str, seq: int,
+                     rec: dict | None) -> _FoldState:
+    """Nearest exact summary state at-or-below ``seq`` given the doc's
+    head summary record ``rec`` (empty state at 0 when nothing covers):
+    head state, then the inline chain newest-first, then the linked
+    anchor pages of re-anchored older states."""
+    if rec is None:
+        return _FoldState(0)
+    if rec["seq"] <= seq:
+        return _FoldState.from_wire(rec["state"])
+    key = HIST_KEY_PREFIX + doc
+    for s, handle in reversed(rec.get("chain", ())):
+        if s <= seq:
+            old = snapshots.get(key, handle)
+            if old is None:
+                return _FoldState(0)  # GC'd: fall to the floor check
+            return _FoldState.from_wire(old["state"])
+    anchor_handle = (rec.get("anchor") or {}).get("handle")
+    while anchor_handle is not None:
+        page = snapshots.get(key, anchor_handle)
+        if page is None:
+            break  # anchor GC'd: fall through to the floor check
+        for s, handle in reversed(page.get("entries", ())):
+            if s <= seq:
+                old = snapshots.get(key, handle)
+                if old is None:
+                    return _FoldState(0)
+                return _FoldState.from_wire(old["state"])
+        anchor_handle = page.get("prev_anchor")
+    return _FoldState(0)
+
+
+def fold_storm_records(state: _FoldState, records, to_seq: int,
+                       read_tick_words) -> None:
+    """Fold storm-shaped doc records in ``(state.seq, to_seq]`` onto
+    ``state`` — the scalar twin of the device LWW kernel.
+    ``read_tick_words(tick)`` resolves a tick id to its raw op-word
+    bytes (leader: the storm's blob log; replica: its tailed WAL)."""
+    import base64
+    blob_cache: dict[int, bytes] = {}
+    for rec in sorted(records, key=lambda r: r["first_seq"]):
+        n_seq = rec["n_seq"]
+        if n_seq <= 0 or rec["last_seq"] <= state.seq:
+            continue
+        if "words" in rec:
+            words = np.frombuffer(base64.b64decode(rec["words"]),
+                                  np.uint32, rec["count"])
+        else:
+            tick = rec["tick"]
+            blob = blob_cache.get(tick)
+            if blob is None:
+                blob = read_tick_words(tick)
+                blob_cache[tick] = blob
+            words = np.frombuffer(blob, np.uint32, rec["count"],
+                                  rec["w_off"])
+        skip = rec["count"] - n_seq  # rejected prefix (dup resend)
+        first = rec["first_seq"]
+        batch: list[tuple[int, int]] = []
+        for j in range(n_seq):
+            seq = first + j
+            if seq <= state.seq:
+                continue
+            if seq > to_seq:
+                break
+            batch.append((int(words[skip + j]), seq))
+        if batch:
+            # One record = one tick's doc batch: the intra-tick
+            # winner rule applies per record.
+            state.apply_batch(batch)
+        if first + n_seq - 1 > to_seq:
+            return
+
+
 class HistoryPlane:
     """The history subsystem over one :class:`~.storm.StormController`.
     Attaches itself as ``storm.history``; the controller replays its
@@ -319,35 +419,8 @@ class HistoryPlane:
     def _base_for(self, doc: str, seq: int) -> _FoldState:
         """Nearest summary state at-or-below ``seq`` (empty state at 0
         when the doc has no covering summary)."""
-        rec = self._summary_record(doc)
-        if rec is None:
-            return _FoldState(0)
-        if rec["seq"] <= seq:
-            return _FoldState.from_wire(rec["state"])
-        for s, handle in reversed(rec.get("chain", ())):
-            if s <= seq:
-                old = self.snapshots.get(self._hist_key(doc), handle)
-                if old is None:
-                    return _FoldState(0)  # GC'd: fall to the floor check
-                return _FoldState.from_wire(old["state"])
-        # Below the inline chain: walk the anchor pages (newest page
-        # first, each linking to its predecessor) for the re-anchored
-        # older exact states.
-        anchor_handle = (rec.get("anchor") or {}).get("handle")
-        while anchor_handle is not None:
-            page = self.snapshots.get(self._hist_key(doc),
-                                      anchor_handle)
-            if page is None:
-                break  # anchor GC'd: fall through to the floor check
-            for s, handle in reversed(page.get("entries", ())):
-                if s <= seq:
-                    old = self.snapshots.get(self._hist_key(doc),
-                                             handle)
-                    if old is None:
-                        return _FoldState(0)
-                    return _FoldState.from_wire(old["state"])
-            anchor_handle = page.get("prev_anchor")
-        return _FoldState(0)
+        return summary_base_for(self.snapshots, doc, seq,
+                                self._summary_record(doc))
 
     # -- tenant retention pins -------------------------------------------------
 
@@ -420,6 +493,17 @@ class HistoryPlane:
             ticks = storm.residency.cold_doc_ticks(doc)
         if ticks:
             last = max(ls for _fs, ls, _t in ticks)
+        mega = storm.megadoc
+        if mega is not None and mega.has_history(doc):
+            # A promoted doc's doc-space frontier lives in the combiner
+            # mirror (its ticks index under LANE ids, so the scan above
+            # stops at the promotion seq). This is what lets fork() and
+            # read_at() address a mega-promoted doc directly — the fold
+            # below translates lane-era records through the combine
+            # logs via records_overlapping (ROADMAP 5b).
+            st = mega.docs.get(doc)
+            if st is not None and st.mirror is not None:
+                last = max(last, int(st.mirror.seq))
         rec = self._summary_record(doc)
         if rec is not None:
             last = max(last, int(rec["seq"]))
@@ -470,41 +554,10 @@ class HistoryPlane:
                       to_seq: int) -> None:
         """Fold the doc's durable records in ``(state.seq, to_seq]``
         onto ``state`` — the scalar twin of the device LWW kernel."""
-        import base64
         storm = self.storm
-        records = storm.records_overlapping(doc, state.seq, to_seq)
-        blob_cache: dict[int, bytes] = {}
-        for rec in sorted(records, key=lambda r: r["first_seq"]):
-            n_seq = rec["n_seq"]
-            if n_seq <= 0 or rec["last_seq"] <= state.seq:
-                continue
-            if "words" in rec:
-                words = np.frombuffer(base64.b64decode(rec["words"]),
-                                      np.uint32, rec["count"])
-            else:
-                tick = rec["tick"]
-                blob = blob_cache.get(tick)
-                if blob is None:
-                    blob = storm.read_tick_words(tick)
-                    blob_cache[tick] = blob
-                words = np.frombuffer(blob, np.uint32, rec["count"],
-                                      rec["w_off"])
-            skip = rec["count"] - n_seq  # rejected prefix (dup resend)
-            first = rec["first_seq"]
-            batch: list[tuple[int, int]] = []
-            for j in range(n_seq):
-                seq = first + j
-                if seq <= state.seq:
-                    continue
-                if seq > to_seq:
-                    break
-                batch.append((int(words[skip + j]), seq))
-            if batch:
-                # One record = one tick's doc batch: the intra-tick
-                # winner rule applies per record.
-                state.apply_batch(batch)
-            if first + n_seq - 1 > to_seq:
-                return
+        fold_storm_records(
+            state, storm.records_overlapping(doc, state.seq, to_seq),
+            to_seq, storm.read_tick_words)
 
     # -- summarization compaction ----------------------------------------------
 
@@ -1042,4 +1095,5 @@ class HistoryPlane:
 
 
 __all__ = ["HistoryPlane", "HistoryError", "HISTORY_SUMMARY_VERSION",
-           "HIST_KEY_PREFIX"]
+           "HIST_KEY_PREFIX", "load_summary_record", "summary_base_for",
+           "fold_storm_records"]

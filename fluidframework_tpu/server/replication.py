@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import threading
 import time
 from pathlib import Path
@@ -54,6 +55,7 @@ from pathlib import Path
 from ..native import OpLog
 from ..protocol.codec import decode_storm_body, encode_storm_body
 from ..utils import faults
+from .durable_store import rewrite_oplog_records
 
 #: Stream format stamp on every shipped frame ("v", exactly like the
 #: storm WAL headers): a follower refuses frames newer than its reader.
@@ -67,6 +69,11 @@ REPLICA_WAL_RELPATH = os.path.join("spill", "storm_tick_words.log")
 
 #: Journaled head flips (``[hseq, key, handle]`` records, CRC-framed).
 REPLICA_HEADS_RELPATH = "replica_heads.log"
+
+#: Journaled retention floors (one ASCII int per record): replica-side
+#: WAL trim progress, durable so a restarted follower knows its replay
+#: horizon without rescanning the log.
+REPLICA_RETENTION_RELPATH = "replica_retention.log"
 
 #: Kill classes for the chaos matrix: batch locally durable but not yet
 #: shipped / shipped and quorum-acked but the leader's watermark not
@@ -91,6 +98,20 @@ class ReplicationQuorumError(RuntimeError):
 def _frame(kind: str, header: dict, payload: bytes = b"") -> bytes:
     return encode_storm_body(
         {"v": REPLICATION_STREAM_VERSION, "k": kind, **header}, payload)
+
+
+def _trimmed_filler() -> bytes:
+    """The storm WAL's docs-less trimmed-tick blob — the SAME bytes
+    ``server/storm.py trim_tick_blobs`` writes — so a retention-trimmed
+    replica record still parses everywhere a real one would (promotion
+    replay treats it as a no-op control tick, resync re-ships it
+    verbatim). Imported lazily: the replica tier stays importable
+    without pulling the JAX-backed storm module in."""
+    from .storm import STORM_WAL_VERSION
+    header = json.dumps(
+        {"v": STORM_WAL_VERSION, "ts": 0, "docs": [],
+         "hp": {"op": "trimmed"}}, separators=(",", ":")).encode()
+    return struct.pack("<I", len(header)) + header
 
 
 class ReplicaNode:
@@ -121,8 +142,21 @@ class ReplicaNode:
         self.data_dir = str(root)
         self.node_id = node_id if node_id is not None else root.name
         self.fsync = fsync
+        #: "follower" (pure failover candidate) or "read-replica" (a
+        #: ReadReplica — server/read_replica.py — tails this node's WAL
+        #: and serves the read surface off it). Descriptive only: the
+        #: batch/head/trim protocol is identical either way.
+        self.role = "follower"
+        #: Tail seam subscribers: ``callback(start_index, records)``
+        #: after each batch append (post-fsync). See :meth:`subscribe`.
+        self._subscribers: list = []
         self._wal = OpLog(root / REPLICA_WAL_RELPATH)
         self._heads_log = OpLog(root / REPLICA_HEADS_RELPATH)
+        self._retention_log = OpLog(root / REPLICA_RETENTION_RELPATH)
+        self._retained_floor = 0
+        for i in range(len(self._retention_log)):
+            self._retained_floor = max(
+                self._retained_floor, int(self._retention_log.read(i)))
         self._lock = threading.Lock()
         #: key -> (hseq, handle): the latest journaled flip per key.
         self.heads: dict[str, tuple[int, str]] = {}
@@ -132,12 +166,30 @@ class ReplicaNode:
             self.heads[key] = (hseq, handle)
             self.max_hseq = max(self.max_hseq, hseq)
         self.stats = {"batches": 0, "records": 0, "dup_records": 0,
-                      "gap_nacks": 0, "head_flips": 0, "rejected": 0}
+                      "gap_nacks": 0, "head_flips": 0, "rejected": 0,
+                      "retained_records": 0}
 
     @property
     def log_len(self) -> int:
         with self._lock:
             return len(self._wal)
+
+    @property
+    def retained_floor(self) -> int:
+        """Indices below this are retention-trimmed (filler bytes) —
+        except the leader-named live set kept alongside each floor."""
+        return self._retained_floor
+
+    def subscribe(self, callback) -> None:
+        """Tail seam: ``callback(start_index, records)`` fires after a
+        batch appends (post-fsync) with the fresh record bytes in WAL
+        order — how a read replica learns of new ticks without polling.
+        Runs on the leader's WAL writer thread, so callbacks must be
+        CHEAP (note a watermark, poke a condition); heavy folding
+        belongs in the subscriber's own poll loop. Exceptions are
+        swallowed like the WAL's own ``on_batch_durable`` hook — a
+        broken reader must never nack the leader's ship."""
+        self._subscribers.append(callback)
 
     def on_frame(self, frame: bytes) -> bytes:
         """Handle one shipped frame; returns the encoded response frame.
@@ -169,6 +221,8 @@ class ReplicaNode:
         if kind == "probe":
             return _frame("ack", {"len": self.log_len,
                                   "hseq": self.max_hseq})
+        if kind == "trim":
+            return self._on_trim(hdr["floor"], hdr.get("keep"))
         self.stats["rejected"] += 1
         return _frame("nack", {"len": self.log_len, "reason": "kind"})
 
@@ -181,6 +235,8 @@ class ReplicaNode:
             self.stats["rejected"] += 1
             return _frame("nack", {"len": self.log_len,
                                    "reason": "torn-payload"})
+        fresh_start = 0
+        fresh: list[bytes] = []
         with self._lock:
             have = len(self._wal)
             if seq > have:
@@ -189,7 +245,6 @@ class ReplicaNode:
                 self.stats["gap_nacks"] += 1
                 return _frame("nack", {"len": have, "reason": "gap"})
             off = 0
-            appended = False
             for i, ln in enumerate(lens):
                 rec = bytes(payload[off:off + ln])
                 off += ln
@@ -199,12 +254,22 @@ class ReplicaNode:
                 got = self._wal.append(rec)
                 assert got == seq + i, (got, seq + i)
                 have = got + 1
-                appended = True
+                if not fresh:
+                    fresh_start = got
+                fresh.append(rec)
                 self.stats["records"] += 1
-            if appended and self.fsync:
+            if fresh and self.fsync:
                 self._wal.sync()
             self.stats["batches"] += 1
-            return _frame("ack", {"len": have})
+        if fresh:
+            # Outside the lock: a subscriber may read back through the
+            # node (read()/log_len take it).
+            for cb in list(self._subscribers):
+                try:
+                    cb(fresh_start, fresh)
+                except Exception:
+                    pass
+        return _frame("ack", {"len": have})
 
     def _on_head(self, hseq: int, key: str, handle: str) -> bytes:
         with self._lock:
@@ -224,6 +289,53 @@ class ReplicaNode:
         self.stats["head_flips"] += 1
         return True
 
+    def _on_trim(self, floor: int, keep=None) -> bytes:
+        try:
+            trimmed = self.retain(floor, keep)
+        except Exception as err:
+            self.stats["rejected"] += 1
+            return _frame("nack", {"len": self.log_len,
+                                   "reason": f"trim: {err}"})
+        return _frame("ack", {"len": self.log_len, "trimmed": trimmed})
+
+    def retain(self, floor: int, keep=None) -> int:
+        """Replica-side WAL retention (the PR 19 residue): shrink every
+        record below ``floor`` — except the leader-named ``keep`` set,
+        the ticks the leader itself still holds live (catch-up-indexed,
+        control, history-pinned) — to the storm trimmed-tick filler.
+        Record COUNT and indices are preserved, so the nack-driven
+        gap/dup stream recovery and a later promotion replay are
+        untouched, and the follower's bytes converge on exactly what
+        the leader's own history trim left behind. The floor journals
+        FIRST (fsynced) when it advances; the rewrite itself publishes
+        atomically (tmp + rename), so a kill mid-trim keeps the
+        original log and the next shipped floor reapplies. Returns the
+        number of records shrunk."""
+        keep = frozenset(keep or ())
+        with self._lock:
+            floor = min(int(floor), len(self._wal))
+            filler = _trimmed_filler()
+            victims = [i for i in range(floor)
+                       if i not in keep
+                       and len(self._wal.read(i)) > len(filler)]
+            if floor > self._retained_floor:
+                self._retention_log.append(str(floor).encode())
+                if self.fsync:
+                    self._retention_log.sync()
+                self._retained_floor = floor
+            if not victims:
+                return 0
+            hit = set(victims)
+
+            def transform(idx: int, data: bytes) -> bytes | None:
+                return filler if idx in hit else None
+
+            self._wal, changed = rewrite_oplog_records(
+                self._wal, Path(self.data_dir) / REPLICA_WAL_RELPATH,
+                transform)
+            self.stats["retained_records"] += changed
+            return changed
+
     def read(self, index: int) -> bytes:
         with self._lock:
             return self._wal.read(index)
@@ -232,6 +344,7 @@ class ReplicaNode:
         with self._lock:
             self._wal.close()
             self._heads_log.close()
+            self._retention_log.close()
 
 
 class ReplicaLink:
@@ -300,7 +413,7 @@ class ReplicationPlane:
         self._metrics = None
         self.stats = {"batches_shipped": 0, "ship_failures": 0,
                       "resyncs": 0, "head_flips_shipped": 0,
-                      "quorum_refusals": 0}
+                      "quorum_refusals": 0, "retention_floors_shipped": 0}
 
     # -- wiring ----------------------------------------------------------------
 
@@ -431,6 +544,50 @@ class ReplicationPlane:
             acked = sorted(self._acked.values(), reverse=True)
             quorum = acked[self.acks_required - 1]
             self._replicated = max(self._replicated, quorum)
+
+    # -- retention (checkpoint path) -------------------------------------------
+
+    def _live_below(self, floor: int) -> list[int]:
+        """WAL indices below ``floor`` the leader still holds LIVE —
+        anything that isn't already a trimmed/padding filler: real doc
+        batches the catch-up index may serve, history/mega control
+        ticks, pinned ranges. Followers must keep exactly these so
+        their read surface stays byte-identical to the leader's."""
+        keep = []
+        for i in range(floor):
+            data = bytes(self._wal.read(i))
+            try:
+                hlen = struct.unpack_from("<I", data)[0]
+                hdr = json.loads(data[4:4 + hlen])
+            except Exception:
+                keep.append(i)  # unparseable: never discard blindly
+                continue
+            hp = hdr.get("hp")
+            if (hdr.get("docs") or hdr.get("mg") is not None
+                    or (hp is not None and hp.get("op") != "trimmed")):
+                keep.append(i)
+        return keep
+
+    def ship_retention(self, floor: int) -> None:
+        """Replica-side WAL retention: after a checkpoint publishes,
+        ship the snapshot tick watermark as the followers' trim floor
+        plus the sub-floor indices the leader itself still holds live.
+        Followers shrink everything else to the trimmed filler (see
+        :meth:`ReplicaNode.retain`) — follower disks now track the
+        leader's own trim instead of growing unbounded. Best-effort,
+        no quorum: retention is hygiene, and a follower that misses a
+        trim just holds bytes until the next one (or until resync
+        re-ships the leader's fillers verbatim)."""
+        if self.fenced or self._wal is None or floor <= 0:
+            return
+        frame = _frame("trim", {"floor": int(floor),
+                                "keep": self._live_below(int(floor))})
+        for link in self.links:
+            try:
+                link.call(frame)
+            except Exception:
+                self.stats["ship_failures"] += 1
+        self.stats["retention_floors_shipped"] += 1
 
     # -- head flips (serving thread) -------------------------------------------
 
@@ -619,6 +776,7 @@ def make_replicated_host(label: str, data_dir: str, shared_snapshots,
 
 __all__ = [
     "REPLICATION_STREAM_VERSION", "REPLICATION_KILL_POINTS",
+    "REPLICA_WAL_RELPATH", "REPLICA_RETENTION_RELPATH",
     "ReplicaNode", "ReplicaLink", "ReplicationPlane",
     "ReplicatedHeadStore", "ReplicationLinkDown",
     "ReplicationQuorumError", "choose_promotion_candidate",

@@ -2027,6 +2027,12 @@ class StormController:
             faults.crashpoint("snapshot.pre_publish")
             self.snapshots.set_head(self.SNAPSHOT_DOC, handle)
             self._last_checkpoint_tick = self._tick_counter
+            if self.replication is not None:
+                # Replica-side WAL retention: the snapshot watermark is
+                # the followers' trim floor (recovery never replays
+                # below it); the plane names the sub-floor ticks still
+                # live here so follower reads stay byte-identical.
+                self.replication.ship_retention(self._last_checkpoint_tick)
             return handle
         finally:
             self._in_checkpoint = False

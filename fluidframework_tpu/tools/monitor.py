@@ -282,6 +282,45 @@ def render_replication(metrics: dict, prev: dict | None = None,
             f"last failover blackout {blackout:,.1f}ms")
 
 
+def render_replicas(metrics: dict, prev: dict | None = None,
+                    interval: float = 1.0) -> str:
+    """Read-replica tier line (the round-20 read scale-out): replica
+    host count, directory-assigned rooms (+ mean rooms per replica),
+    the per-room staleness distribution against the leader's sequenced
+    watermark (p50/p99 in seqs, plus the worst room right now — the
+    BOUND a replica-served read can be behind by), re-homed viewers
+    over the poll window, and read redirects shed through the front
+    door (directory routing + stale sheds). Empty when no
+    ReplicaBalancer scrapes (the gauges never appear)."""
+    if "replica.hosts" not in metrics:
+        return ""
+    hosts = metrics.get("replica.hosts", 0)
+    rooms = metrics.get("replica.rooms", 0)
+    per = f" ({rooms / hosts:.1f}/replica)" if hosts else ""
+    p50 = metrics.get("replica.staleness_seqs.p50", 0)
+    p99 = metrics.get("replica.staleness_seqs.p99", 0)
+    worst = metrics.get("replica.staleness_worst", 0)
+    rehomed = metrics.get("replica.rehomed_viewers", 0)
+    redirects = (metrics.get("replica.redirects", 0)
+                 + metrics.get("replica.stale_redirects", 0))
+    per_s = max(interval, 1e-9)
+
+    def rate(cur: float, key: str) -> str:
+        if not prev:
+            return ""
+        window = cur - prev.get(key, 0)
+        if key == "redirects":
+            window = cur - (prev.get("replica.redirects", 0)
+                            + prev.get("replica.stale_redirects", 0))
+        return f" ({window / per_s:,.1f}/s)" if window >= 0 else ""
+
+    return (f"replicas: hosts {hosts:g}  rooms {rooms:g}{per}  "
+            f"staleness p50 {p50:g} p99 {p99:g} worst {worst:g} seqs  "
+            f"re-homed {rehomed:g}"
+            f"{rate(rehomed, 'replica.rehomed_viewers')}  "
+            f"redirects {redirects:g}{rate(redirects, 'redirects')}")
+
+
 def render_megadoc(metrics: dict, prev: dict | None = None,
                    interval: float = 1.0) -> str:
     """Mega-doc write-tier line (the round-15 scale-out plane):
@@ -435,6 +474,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     repl_line = render_replication(now, prev or None, interval)
     if repl_line:
         lines.append(repl_line)
+    replicas_line = render_replicas(now, prev or None, interval)
+    if replicas_line:
+        lines.append(replicas_line)
     history_line = render_history(now, prev or None, interval)
     if history_line:
         lines.append(history_line)

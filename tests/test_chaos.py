@@ -755,3 +755,63 @@ def test_replication_chaos_full_matrix(seed, tmp_path):
     p99 = blackouts[min(len(blackouts) - 1,
                         int(0.99 * len(blackouts)))]
     assert p99 < 30_000, blackouts
+
+
+# -- read-replica chaos (ISSUE 18: replica reads never change bytes) ----------
+
+_REPLICAS_CFG = dict(seed=0, docs=2, k=8, ticks=6, cp_every=2,
+                     replicas=True, migrate_at=3)
+
+#: Tier-1 smoke: records applied/indexed on the replica but the tick's
+#: viewer broadcast NOT yet published — the restarted replica (a fresh
+#: from-zero re-poll of the durable follower WAL) must re-derive the
+#: identical read surface.
+_REPLICAS_SMOKE = [(chaos.REPLICAS_SMOKE_POINT, 2)]
+
+
+@pytest.fixture(scope="session")
+def replicas_twin_digest(tmp_path_factory):
+    """The replica-LESS twin (same frames, every digest read served by
+    the leader): equality against it is simultaneously the
+    kill-recovery bar and the replica-reads-never-change-bytes bar."""
+    life = chaos._spawn_life(
+        str(tmp_path_factory.mktemp("replicas_twin")), resume_from=None,
+        kill_env=None, timeout=300,
+        **dict(_REPLICAS_CFG, replicas="off", migrate_at=-1))
+    assert life["returncode"] == 0, life["stderr"]
+    assert life["digest"] is not None
+    return life["digest"]
+
+
+@pytest.mark.parametrize("point,hits", _REPLICAS_SMOKE,
+                         ids=[p for p, _ in _REPLICAS_SMOKE])
+def test_replicas_chaos_smoke_rebuilds_read_surface(
+        point, hits, tmp_path, replicas_twin_digest):
+    """kill -9 the read replica mid-broadcast (viewers in the room,
+    a directory-spread re-home mid-run): the restarted replica
+    re-polls its durable follower WAL from zero, viewers re-home via
+    the ordinary ``viewer_resync`` machinery, zero acked ops are lost,
+    and every replica-served read digests byte-identical to the
+    replica-less twin (the ISSUE 18 acceptance bar)."""
+    report = chaos.run_chaos(str(tmp_path), point, kill_hits=hits,
+                             twin_digest=replicas_twin_digest,
+                             **_REPLICAS_CFG)
+    assert report["killed"], report
+    assert report["lives"] >= 2
+    assert report["acked_rounds"] == list(range(_REPLICAS_CFG["ticks"]))
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_replicas_chaos_full_matrix(seed, tmp_path):
+    """Slow soak: both replica kill classes (mid-apply between index
+    and broadcast, mid-read inside a replica-served ``read_at``) × hit
+    position, per seed."""
+    reports = chaos.run_matrix(
+        str(tmp_path), points=chaos.REPLICAS_CHAOS_POINTS,
+        seeds=(seed,), hit_positions=(1, 2),
+        **{k: v for k, v in _REPLICAS_CFG.items() if k != "seed"})
+    killed = [r for r in reports if r["killed"]]
+    assert len(killed) >= len(reports) // 2, \
+        [(r["kill_point"], r["kill_hits"], r["killed"]) for r in reports]

@@ -521,3 +521,36 @@ def test_replication_line_renders_plane_state():
         m, {"repl.shipped_batches": 99.0}, interval=1.0)
     human = monitor.render_human(m, {}, interval=1.0)
     assert "replication: role leader" in human
+
+
+def test_replicas_line_renders_read_tier_state():
+    """Round-20 read-replica line: silent without a balancer scrape,
+    then host/room counts, the per-room staleness distribution (the
+    bound a replica-served read can be behind by), windowed re-home
+    and redirect rates — and the line rides human watch mode."""
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_replicas
+
+    assert render_replicas({}) == ""  # no balancer → no line
+    m = {"replica.hosts": 2.0,
+         "replica.rooms": 3.0,
+         "replica.staleness_seqs.p50": 0.0,
+         "replica.staleness_seqs.p99": 8.0,
+         "replica.staleness_worst": 8.0,
+         "replica.rehomed_viewers": 12.0,
+         "replica.redirects": 5.0,
+         "replica.stale_redirects": 1.0}
+    text = render_replicas(m)
+    assert "hosts 2" in text
+    assert "rooms 3 (1.5/replica)" in text
+    assert "staleness p50 0 p99 8 worst 8 seqs" in text
+    assert "re-homed 12" in text
+    assert "redirects 6" in text  # routing + stale sheds combined
+    # Windowed rates over a 2s poll window.
+    windowed = render_replicas(
+        m, {"replica.rehomed_viewers": 2.0, "replica.redirects": 2.0,
+            "replica.stale_redirects": 0.0}, interval=2.0)
+    assert "re-homed 12 (5.0/s)" in windowed
+    assert "redirects 6 (2.0/s)" in windowed
+    human = monitor.render_human(m, {}, interval=1.0)
+    assert "replicas: hosts 2" in human

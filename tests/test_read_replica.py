@@ -1,0 +1,399 @@
+"""Read-replica tier (round 20 tentpole, server/read_replica.py):
+follower-tailing read hosts serving the ENTIRE read surface.
+
+The acceptance bar is byte-exactness: every replica-served read —
+``read_at`` at EVERY tested seq, ``get_deltas`` catch-up, branch
+reads, viewer tick frames — must be byte-identical to the leader
+serving the same request, with staleness surfaced as an explicit
+bound (wait-then-shed ``moved`` redirects), never as silently wrong
+bytes. The kill -9 story rides tests/test_chaos.py's ``--replicas``
+smoke + soak.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.parallel.placement import ReplicaBalancer
+from fluidframework_tpu.protocol.codec import to_wire
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.server.durable_store import GitSnapshotStore
+from fluidframework_tpu.server.history import HistoryError, HistoryPlane
+from fluidframework_tpu.server.read_replica import (
+    READ_KINDS,
+    ReadReplica,
+    ReplicaDirectory,
+    ReplicaRedirect,
+    ReplicaRouter,
+)
+from fluidframework_tpu.server.replication import make_replicated_host
+
+K = 8
+
+
+def _words(seed, r, i, k=K):
+    rng = np.random.default_rng([seed, r, i])
+    kinds = rng.choice([0, 0, 0, 1, 2], size=k).astype(np.uint32)
+    slots = rng.integers(0, 16, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def _build(tmp_path, followers=1, label="hostA", num_docs=8,
+           **hist_kw):
+    git = GitSnapshotStore(str(tmp_path / "git"))
+    f_dirs = [str(tmp_path / f"f{i}") for i in range(followers)]
+    storm, plane = make_replicated_host(
+        label, str(tmp_path / label), git, f_dirs, num_docs=num_docs)
+    hist = HistoryPlane(storm, **hist_kw)
+    return git, storm, plane, hist
+
+
+def _serve(storm, docs, rounds, seed=7, clients=None, cseq=None):
+    if clients is None:
+        clients = {d: storm.service.connect(d, lambda m: None).client_id
+                   for d in docs}
+        storm.service.pump()
+    cseq = cseq if cseq is not None else {d: 1 for d in docs}
+    for _r in range(rounds):
+        for i, d in enumerate(docs):
+            w = _words(seed, cseq[d], i)
+            storm.submit_frame(
+                lambda p: None,
+                {"rid": (cseq[d], d),
+                 "docs": [[d, clients[d], cseq[d], 1, K]]},
+                memoryview(w.tobytes()))
+            cseq[d] += K
+        storm.flush()
+    return clients, cseq
+
+
+def _wire_ops(messages):
+    """Canonical wire form of the replicated (storm) message tier."""
+    return [to_wire(m) for m in messages
+            if m.type == MessageType.OPERATION]
+
+
+def _close(storm):
+    if storm._group_wal is not None:
+        storm._group_wal.close()
+
+
+# -- differential byte-exactness ----------------------------------------------
+
+
+class TestReplicaByteExactness:
+
+    def test_read_surface_byte_identical(self, tmp_path):
+        """THE tentpole bar: replica-served ``read_at`` at EVERY seq
+        up to the head, ``get_deltas``, and ``head_seq`` are
+        byte-identical to the leader serving the same request."""
+        git, storm, plane, hist = _build(tmp_path)
+        docs = ["doc-0", "doc-1"]
+        _serve(storm, docs, rounds=4)
+
+        rep = ReadReplica(plane.links[0].node, git, "replica0",
+                          leader_label="hostA")
+        assert rep.lag == 0
+        for d in docs:
+            head = storm.service.read_at(d, 0)["head_seq"]
+            assert rep.head_seq(d) == head
+            for s in range(head + 1):
+                leader = storm.service.read_at(d, s)
+                assert rep.read_at(d, s) == leader, (d, s)
+            assert _wire_ops(rep.get_deltas(d, 0, head)) \
+                == _wire_ops(storm.service.get_deltas(d, 0, head))
+            # Unbounded catch-up (the viewer resync shape) too.
+            assert _wire_ops(rep.get_deltas(d, head // 2)) \
+                == _wire_ops(storm.service.get_deltas(d, head // 2,
+                                                      head))
+        _close(storm)
+
+    def test_branch_reads_and_write_redirects(self, tmp_path):
+        """Branch forks tail through WAL controls: the replica serves
+        the branch (and below-fork parent delegation) byte-identically;
+        every write verb sheds a ``moved`` redirect at the leader."""
+        git, storm, plane, hist = _build(tmp_path)
+        _serve(storm, ["doc-0"], rounds=3)
+        branch = hist.fork("doc-0", 16, name="b1")
+        storm.flush()
+
+        rep = ReadReplica(plane.links[0].node, git, "replica0",
+                          leader_label="hostA")
+        assert rep.branches[branch]["parent"] == "doc-0"
+        for s in (0, 7, 16):  # below-fork delegation + the fork seq
+            assert rep.read_at(branch, s) \
+                == storm.service.read_at(branch, s)
+        for verb in (lambda: rep.connect("doc-0"),
+                     lambda: rep.fork_doc("doc-0", 8),
+                     lambda: rep.merge_back(branch)):
+            with pytest.raises(ReplicaRedirect) as err:
+                verb()
+            assert err.value.moved_to == "hostA"
+        _close(storm)
+
+    def test_stale_reads_wait_then_shed(self, tmp_path):
+        """A seq above the replica's watermark waits ``read_wait_s``
+        then sheds a retryable redirect naming the leader — staleness
+        is a BOUND, never silently wrong bytes."""
+        git, storm, plane, hist = _build(tmp_path)
+        _serve(storm, ["doc-0"], rounds=2)
+        rep = ReadReplica(plane.links[0].node, git, "replica0",
+                          leader_label="hostA", read_wait_s=0.02)
+        head = rep.head_seq("doc-0")
+        with pytest.raises(ReplicaRedirect) as err:
+            rep.read_at("doc-0", head + 100)
+        assert err.value.moved_to == "hostA"
+        with pytest.raises(ReplicaRedirect):
+            rep.get_deltas("doc-0", 0, head + 100)
+        assert rep.stats["stale_redirects"] == 2
+        assert rep.metrics.counter(
+            "replica.stale_redirects").value == 2
+        _close(storm)
+
+    def test_mega_promoted_doc_redirects(self, tmp_path):
+        """Mega-promoted docs are the documented scope limit: their
+        lane-era records translate only through the leader's combine
+        logs, so the replica sheds them to the leader — even after a
+        demote (the lane era stays leader-only)."""
+        from fluidframework_tpu.server.megadoc import MegaDocManager
+
+        git, storm, plane, hist = _build(tmp_path)
+        mgr = MegaDocManager(storm, default_lanes=2)
+        _serve(storm, ["hot"], rounds=1)
+        mgr.promote("hot")
+        _serve(storm, ["plain"], rounds=1)
+
+        rep = ReadReplica(plane.links[0].node, git, "replica0",
+                          leader_label="hostA")
+        assert not rep.can_serve("hot")
+        assert rep.can_serve("plain")
+        with pytest.raises(ReplicaRedirect) as err:
+            rep.read_at("hot", 1)
+        assert err.value.moved_to == "hostA"
+        # The self-router sheds them at the front door, pre-read.
+        assert rep.read_router.route_read("hot", "read_at") == "hostA"
+        assert rep.read_router.route_read("plain", "read_at") is None
+        _close(storm)
+
+
+# -- viewer plane on the replica ----------------------------------------------
+
+
+class TestReplicaViewerPlane:
+
+    def test_rebroadcast_matches_leader_frames(self, tmp_path):
+        """A viewer re-homed onto the replica sees byte-identical
+        ``storm_tick`` frames: same doc/seq window/op words as the
+        leader's own broadcast of the same ticks."""
+        from fluidframework_tpu.protocol.codec import (
+            decode_body,
+            decode_storm_push,
+            is_storm_body,
+        )
+
+        def collector(events):
+            def push(payload):
+                if isinstance(payload, (bytes, bytearray)):
+                    events.append(decode_storm_push(payload)
+                                  if is_storm_body(payload)
+                                  else decode_body(payload))
+                else:
+                    events.append(payload)
+            return push
+
+        git, storm, plane, hist = _build(tmp_path)
+        leader_events: list = []
+        storm.service.connect("doc-0", collector(leader_events),
+                              mode="viewer")
+        clients, cseq = _serve(storm, ["doc-0"], rounds=1)
+
+        rep = ReadReplica(plane.links[0].node, git, "replica0",
+                          leader_label="hostA")
+        replica_events: list = []
+        hello = rep.viewers.join("doc-0", collector(replica_events))
+        assert hello["seq"] == 0  # joined before any replica broadcast
+        _serve(storm, ["doc-0"], rounds=2, clients=clients, cseq=cseq)
+        rep.poll()
+
+        def ticks(events):
+            return [(e["doc"], e["n"], e["first"], e["last"],
+                     list(e["words"]))
+                    for e in events if isinstance(e, dict)
+                    and e.get("event") == "storm_tick"]
+
+        leader_ticks = ticks(leader_events)
+        assert ticks(replica_events) == leader_ticks[1:]  # post-join
+        assert rep.stats["broadcast_ticks"] == 2
+        _close(storm)
+
+
+# -- retention + restart ------------------------------------------------------
+
+
+class TestReplicaRetentionRestart:
+
+    def test_trim_then_restart_serves_identical_bytes(self, tmp_path):
+        """Checkpoint ships the retention floor (PR 19 residue): the
+        follower WAL trims below it, and a RESTARTED replica (fresh
+        ReadReplica re-polling the durable follower WAL from zero)
+        still serves every addressable read byte-identically — the
+        trimmed range answers with the leader's own compaction error."""
+        git, storm, plane, hist = _build(
+            tmp_path, num_docs=4, tail_retention_summaries=0,
+            trim_batch_ticks=1)
+        clients, cseq = _serve(storm, ["doc-0"], rounds=4)
+        assert hist.compact("doc-0")  # summary + tail trim below it
+        _serve(storm, ["doc-0"], rounds=2, clients=clients, cseq=cseq)
+        storm.checkpoint()  # ships the follower retention floor
+        node = plane.links[0].node
+        assert node.retained_floor > 0  # the trim actually shipped
+
+        rep = ReadReplica(node, git, "replica0", leader_label="hostA")
+        restarted = ReadReplica(node, git, "replica0b",
+                                leader_label="hostA")
+        assert restarted.applied == rep.applied
+        head = storm.service.read_at("doc-0", 0)["head_seq"]
+        floor = hist.tail_floor("doc-0")
+        for s in range(head + 1):
+            try:
+                leader = storm.service.read_at("doc-0", s)
+            except HistoryError:
+                if s > floor:
+                    raise
+                for r in (rep, restarted):
+                    with pytest.raises(HistoryError):
+                        r.read_at("doc-0", s)
+                continue
+            assert rep.read_at("doc-0", s) == leader, s
+            assert restarted.read_at("doc-0", s) == leader, s
+        assert _wire_ops(restarted.get_deltas("doc-0", floor, head)) \
+            == _wire_ops(storm.service.get_deltas("doc-0", floor,
+                                                  head))
+        _close(storm)
+
+
+# -- directory + routing ------------------------------------------------------
+
+
+class TestDirectoryAndRouting:
+
+    def test_directory_assignment_and_hash_spread(self, tmp_path):
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        d = ReplicaDirectory(git)
+        d.register("r0")
+        d.register("r1")
+        d.assign_room("hot", ["r0", "r1"])
+        # Same client key always lands on the same label; the audience
+        # spreads across BOTH labels.
+        seen = {d.replica_for("hot", "viewer", key=f"c{i}")
+                for i in range(16)}
+        assert seen == {"r0", "r1"}
+        assert d.replica_for("hot", "viewer", key="c1") \
+            == d.replica_for("hot", "viewer", key="c1")
+        # Room assignment wins over read-class default; no assignment
+        # at all means the leader serves.
+        d.assign_reads("read_at", "r1")
+        assert d.replica_for("cold", "read_at") == "r1"
+        assert d.replica_for("cold", "viewer") is None
+        with pytest.raises(ValueError):
+            d.assign_reads("write", "r0")
+        # A second directory over the SAME store sees flips (the
+        # shared-store cross-host contract), and a deregistered label
+        # never routes.
+        d2 = ReplicaDirectory(git)
+        assert d2.rooms() == {"hot": ["r0", "r1"]}
+        d.deregister("r1")
+        d2.reload()
+        assert d2.replica_for("cold", "read_at") is None
+        assert set(d2.rooms()["hot"]) == {"r0"}
+
+    def test_router_local_short_circuit(self, tmp_path):
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        d = ReplicaDirectory(git)
+        d.register("r0")
+        d.assign_room("hot", "r0")
+        router = ReplicaRouter(d, local_label="hostA")
+        assert router.route_read("hot", "viewer") == "r0"
+        assert router.route_read("hot", "write") is None
+        assert router.route_read("cold", "viewer") is None
+        # The replica's own router never redirects to itself.
+        local = ReplicaRouter(d, local_label="r0")
+        assert local.route_read("hot", "viewer") is None
+        assert router.metrics.counter("replica.redirects").value == 1
+
+    def test_balancer_spread_rehomes_room(self, tmp_path):
+        """ReplicaBalancer flips the directory then re-homes the
+        leader's live room: every member gets a ``moved`` directive
+        naming a replica label, staleness scrapes to the shared
+        registry, and ``unspread`` returns reads to the leader."""
+        git, storm, plane, hist = _build(tmp_path, followers=2)
+        moved: list = []
+
+        def _viewer(payload):
+            if isinstance(payload, dict) \
+                    and payload.get("event") == "viewer_resync":
+                moved.append(payload.get("moved_to"))
+
+        for _ in range(3):
+            storm.service.connect("doc-0", _viewer, mode="viewer")
+        _serve(storm, ["doc-0"], rounds=2)
+        reps = {f"replica{i}": ReadReplica(plane.links[i].node, git,
+                                           f"replica{i}",
+                                           leader_label="hostA")
+                for i in range(2)}
+        directory = ReplicaDirectory(git)
+        bal = ReplicaBalancer(directory, reps, leader_storm=storm)
+        out = bal.spread_room("doc-0", n=2)
+        assert sorted(out["labels"]) == ["replica0", "replica1"]
+        assert sum(out["rehomed"].values()) == 3
+        assert sorted(moved) == sorted(
+            l for l, n in out["rehomed"].items() for _ in range(n))
+        # Caught-up replicas: every room staleness gap is 0.
+        assert bal.room_staleness() == {
+            "doc-0": {"replica0": 0, "replica1": 0}}
+        m = bal.metrics
+        assert m.gauge("replica.hosts").value == 2
+        assert m.gauge("replica.rooms").value == 1
+        assert m.gauge("replica.staleness_worst").value == 0
+        bal.unspread_room("doc-0")
+        assert directory.rooms() == {}
+        _close(storm)
+
+
+# -- promoted fork ≡ demote-then-fork (ROADMAP 5b satellite) ------------------
+
+
+class TestPromotedFork:
+
+    def test_promoted_fork_equals_demote_then_fork(self, tmp_path):
+        """ROADMAP 5b pin: fork() of a mega-PROMOTED doc direct (the
+        lane-era records translating through the combine logs) yields a
+        branch byte-identical to the old demote-first route — entries,
+        every branch read_at, and the parent's materialized history."""
+        from fluidframework_tpu.server.megadoc import MegaDocManager
+
+        def play(demote_first: bool, root):
+            git, storm, plane, hist = _build(root, num_docs=4)
+            mgr = MegaDocManager(storm, default_lanes=2)
+            clients, cseq = _serve(storm, ["hot"], rounds=1, seed=11)
+            mgr.promote("hot")
+            _serve(storm, ["hot"], rounds=3, seed=11,
+                   clients=clients, cseq=cseq)
+            if demote_first:
+                mgr.demote("hot")
+                storm.flush()
+            branch = hist.fork("hot", 20, name="fb")
+            storm.flush()
+            reads = {s: hist.read_at(branch, s)["entries"]
+                     for s in (0, 10, 20)}
+            history = _wire_ops(storm.service.get_deltas("hot", 0, 20))
+            _close(storm)
+            return reads, history
+
+        direct = play(False, tmp_path / "direct")
+        demoted = play(True, tmp_path / "demoted")
+        assert direct == demoted
