@@ -4234,8 +4234,362 @@ def emit_round19(path: str = "BENCH_r19.json") -> dict:
     return out
 
 
+def bench_net_ack_overhead(num_docs: int = 4, k: int = 64,
+                           rounds: int = 150, warmup: int = 20,
+                           pipeline_depth: int = 2) -> dict:
+    """Round-21 headline: the acked-write path with followers in
+    OTHER OS PROCESSES over localhost TCP vs the in-process arms of
+    BENCH_r19. Same serving loop, same quorum gating — the delta is
+    the wire: storm-codec frames over the length-prefixed transport,
+    one socket round trip per shipped batch per follower. Bar:
+    net F=1 ack p99 within 2x in-process F=1."""
+    import os
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.parallel.placement import make_cluster_host
+    from fluidframework_tpu.server.durable_store import GitSnapshotStore
+    from fluidframework_tpu.server.replication import (
+        make_replicated_host,
+    )
+    from fluidframework_tpu.tools.launch_cluster import (
+        launch_follower,
+        reap_all,
+    )
+
+    def run_arm(followers: int, net: bool) -> dict:
+        root = tempfile.mkdtemp(
+            prefix=f"net-bench-{'net' if net else 'inproc'}"
+                   f"-f{followers}-")
+        children, links, plane = [], [], None
+        try:
+            git = GitSnapshotStore(os.path.join(root, "git"))
+            if net:
+                for i in range(followers):
+                    children.append(launch_follower(
+                        os.path.join(root, f"f{i}"), label=f"f{i}"))
+                links = [c.link() for c in children]
+            else:
+                links = [os.path.join(root, f"f{i}")
+                         for i in range(followers)]
+            if followers:
+                storm, plane = make_replicated_host(
+                    "hostA", os.path.join(root, "hostA"), git, links,
+                    num_docs=num_docs, pipeline_depth=pipeline_depth)
+            else:
+                storm = make_cluster_host(
+                    "hostA", os.path.join(root, "hostA"), git,
+                    num_docs=num_docs, pipeline_depth=pipeline_depth)
+            docs = [f"doc-{i}" for i in range(num_docs)]
+            clients = {d: storm.service.connect(
+                d, lambda m: None).client_id for d in docs}
+            storm.service.pump()
+            cseq = {d: 1 for d in docs}
+            lat: list = []
+
+            def serve(n: int) -> None:
+                for r in range(n):
+                    for i, d in enumerate(docs):
+                        words = _cluster_words([r, i], k)
+                        t0 = time.perf_counter()
+                        storm.submit_frame(
+                            lambda p, t0=t0: lat.append(
+                                time.perf_counter() - t0),
+                            {"rid": (r, d),
+                             "docs": [[d, clients[d], cseq[d], 1, k]]},
+                            memoryview(words.tobytes()))
+                        cseq[d] += k
+                storm.flush()
+
+            serve(warmup)
+            lat.clear()
+            start = time.perf_counter()
+            serve(rounds)
+            elapsed = time.perf_counter() - start
+            assert len(lat) == rounds * num_docs, (len(lat), rounds)
+            arr = np.asarray(lat) * 1e3
+            out = {
+                "followers": followers,
+                "net": net,
+                "ack_ms_p50": float(np.percentile(arr, 50)),
+                "ack_ms_p99": float(np.percentile(arr, 99)),
+                "acked_ops_per_s": rounds * num_docs * k / elapsed,
+            }
+            if plane is not None:
+                assert plane.replicated_len \
+                    == storm._group_wal.durable_len
+                out["acks_required"] = plane.acks_required
+                out["ship_failures"] = plane.stats["ship_failures"]
+                rtts: list = []
+                for lk in plane.links:
+                    ts = getattr(lk, "transport_stats", None)
+                    if ts is not None:
+                        rtts.extend(ts()["rtt_s"])
+                if rtts:
+                    rarr = np.asarray(rtts) * 1e3
+                    out["ship_rtt_ms_p50"] = float(
+                        np.percentile(rarr, 50))
+                    out["ship_rtt_ms_p99"] = float(
+                        np.percentile(rarr, 99))
+            storm._group_wal.close()
+            return out
+        finally:
+            for lk in links:
+                close = getattr(lk, "close", None)
+                if close is not None:
+                    close()
+            for child in children:
+                child.shutdown()
+            reap_all()
+            shutil.rmtree(root, ignore_errors=True)
+
+    arms = {"inproc_f1": run_arm(1, net=False),
+            "net_f1": run_arm(1, net=True),
+            "net_f2": run_arm(2, net=True)}
+    ratio = arms["net_f1"]["ack_ms_p99"] \
+        / max(arms["inproc_f1"]["ack_ms_p99"], 1e-9)
+    return {
+        "shape": {"num_docs": num_docs, "k": k, "rounds": rounds,
+                  "pipeline_depth": pipeline_depth,
+                  "transport": "localhost TCP, follower subprocesses"},
+        "arms": arms,
+        "ack_p99_net_f1_over_inproc_f1": ratio,
+        "bar_within_2x": bool(ratio <= 2.0),
+    }
+
+
+def bench_net_failover_blackout(num_docs: int = 4, k: int = 64,
+                                rounds: int = 30) -> dict:
+    """Round-21 failover: leader lives end, promotion runs OVER THE
+    WIRE — hello every surviving follower child, shut the most
+    advanced one down (releasing its WAL), recover a serving host from
+    its directory. Per-life blackout = shutdown + recover + rearm,
+    measured inside promote_over_wire."""
+    import os
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.server.durable_store import GitSnapshotStore
+    from fluidframework_tpu.tools.launch_cluster import (
+        launch_cluster,
+        promote_over_wire,
+        reap_all,
+    )
+
+    root = tempfile.mkdtemp(prefix="net-failover-")
+    try:
+        cluster = launch_cluster(root, followers=2, detector=False,
+                                 num_docs=num_docs)
+        git = GitSnapshotStore(os.path.join(root, "git"))
+        storm, children = cluster.storm, list(cluster.children)
+        docs = [f"doc-{i}" for i in range(num_docs)]
+        clients = {d: storm.service.connect(
+            d, lambda m: None).client_id for d in docs}
+        storm.service.pump()
+        cseq = {d: 1 for d in docs}
+
+        def serve(n: int) -> None:
+            for r in range(n):
+                for i, d in enumerate(docs):
+                    words = _cluster_words([r, i], k)
+                    storm.submit_frame(
+                        lambda p: None,
+                        {"rid": (r, d),
+                         "docs": [[d, clients[d], cseq[d], 1, k]]},
+                        memoryview(words.tobytes()))
+                    cseq[d] += k
+            storm.flush()
+
+        serve(rounds)
+        storm.checkpoint()
+        blackouts: list = []
+        lives = []
+        life = 0
+        while children:
+            # The leader "dies": abandon it (close its WAL) and
+            # promote whatever the survivors hold, over real sockets.
+            # Each promotion consumes the most advanced child (its
+            # directory becomes the new leader); a fresh in-process
+            # follower dir keeps the plane legal as children thin out.
+            for lk in cluster.plane.links:
+                close = getattr(lk, "close", None)
+                if close is not None:
+                    close()
+            storm._group_wal.close()
+            life += 1
+            storm, plane, rep = promote_over_wire(
+                children, git, num_docs=num_docs,
+                follower_dirs=[os.path.join(root, f"fresh{life}")])
+            cluster.storm, cluster.plane = storm, plane
+            children = [c for c in children if c.alive]
+            blackouts.append(rep["blackout_ms"])
+            lives.append({"promoted": rep["promoted_node"],
+                          "blackout_ms": rep["blackout_ms"],
+                          "surviving_followers": len(children)})
+            clients = {d: storm.service.connect(
+                d, lambda m: None).client_id for d in docs}
+            storm.service.pump()
+            serve(4)
+        storm._group_wal.close()
+        return {
+            "shape": {"num_docs": num_docs, "k": k,
+                      "warm_rounds": rounds, "followers": 2},
+            "lives": lives,
+            "blackout_ms_per_life": blackouts,
+            "blackout_ms_worst": max(blackouts),
+        }
+    finally:
+        reap_all()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_parked_write_recovery(num_docs: int = 2, k: int = 64,
+                                writes: int = 6) -> dict:
+    """Round-21 degraded mode: partition the only follower (quorum
+    lost), submit writes — they PARK (durable locally, no acks, no
+    shed) — then heal and measure heal -> last-parked-ack. Bar: the
+    parked backlog drains within 1 s of heal (the detector's next
+    heartbeat renews the lease and resyncs; the next flush ships)."""
+    import os
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.tools.launch_cluster import (
+        launch_cluster,
+        reap_all,
+    )
+
+    root = tempfile.mkdtemp(prefix="net-parked-")
+    try:
+        cluster = launch_cluster(
+            root, followers=1, detector=True, hb_interval_s=0.05,
+            lease_s=0.25, park_max_s=3600.0,
+            fault_plan={"f0": {}}, num_docs=num_docs)
+        storm, plane = cluster.storm, cluster.plane
+        ft = plane.links[0]
+        docs = [f"doc-{i}" for i in range(num_docs)]
+        clients = {d: storm.service.connect(
+            d, lambda m: None).client_id for d in docs}
+        storm.service.pump()
+        cseq = {d: 1 for d in docs}
+        acked: list = []
+
+        def submit(r: int) -> None:
+            for i, d in enumerate(docs):
+                words = _cluster_words([r, i], k)
+                storm.submit_frame(
+                    lambda p: acked.append(time.perf_counter()),
+                    {"rid": (r, d),
+                     "docs": [[d, clients[d], cseq[d], 1, k]]},
+                    memoryview(words.tobytes()))
+                cseq[d] += k
+            storm.flush()
+
+        submit(0)  # healthy warmup
+        assert len(acked) == num_docs
+        acked.clear()
+        ft.install("partition")
+        deadline = time.monotonic() + 10.0
+        while plane.quorum_ok:  # lease expiry -> degraded
+            assert time.monotonic() < deadline, "never degraded"
+            time.sleep(0.02)
+        for r in range(1, writes + 1):
+            submit(r)
+        parked = writes * num_docs - len(acked)
+        assert len(acked) == 0, "acked without a quorum"
+        assert storm.stats.get("quorum_rejects", 0) == 0  # parked, not shed
+        t_heal = time.perf_counter()
+        ft.heal()
+        deadline = time.monotonic() + 10.0
+        while len(acked) < writes * num_docs:
+            assert time.monotonic() < deadline, \
+                f"parked writes never drained ({len(acked)})"
+            storm.flush()
+            time.sleep(0.01)
+        recovery_s = max(acked) - t_heal
+        cluster.close()
+        return {
+            "shape": {"num_docs": num_docs, "k": k, "writes": writes,
+                      "lease_s": 0.25, "hb_interval_s": 0.05},
+            "parked_writes": parked,
+            "recovery_s_after_heal": recovery_s,
+            "bar_under_1s": bool(recovery_s < 1.0),
+        }
+    finally:
+        reap_all()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def emit_round21(path: str = "BENCH_r21.json") -> dict:
+    """ISSUE 20 acceptance bars: the networked replication transport.
+    Columns: acked-write p50/p99 with followers as real OS processes
+    over localhost TCP (F=1/F=2) vs the in-process F=1 arm (bar: net
+    F=1 p99 within 2x), per-life failover blackout with promotion over
+    the wire, and parked-write recovery after a healed partition (bar:
+    drained within 1 s of heal)."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from fluidframework_tpu.utils import compile_cache
+
+    compile_cache.enable()
+    out: dict = {"round": 21,
+                 "environment": {"backend": jax.default_backend(),
+                                 "devices": len(jax.devices())}}
+    out["net_ack_overhead"] = bench_net_ack_overhead()
+    out["failover_blackout"] = bench_net_failover_blackout()
+    out["parked_write_recovery"] = bench_parked_write_recovery()
+    out["environment"]["note"] = (
+        "Round-21 tentpole: cutting the in-process cord. Followers run "
+        "as real OS subprocesses serving ReplicaNode over asyncio TCP "
+        "(length-prefixed frames, the alfred framing); the leader "
+        "ships the SAME storm-codec replication frames through "
+        "NetworkReplicaLink — per-call deadlines, bounded retransmits "
+        "with jittered exponential backoff, transparent reconnection — "
+        "so every byte on the wire is the byte the in-process tier "
+        "ships. Lease-based failure detection (heartbeat probes, "
+        "follower leases) feeds the plane's degraded mode: quorum loss "
+        "PARKS writes (locally durable, acks withheld, shed only past "
+        "park_max_s with retry_after_s) and heal drains through the "
+        "detector's resync. Failover promotes over the wire: hello "
+        "every survivor, shut down the most advanced child (releasing "
+        "its WAL lock), recover a serving host from its directory, "
+        "fence the old incarnation on the wire (lower-stamped frames "
+        "nack `fenced` from a durable floor). The fault matrix "
+        "(partitions, one-way partitions, drop/dup/reorder/slow) rides "
+        "tests/test_chaos.py --netsplit with twin-digest equality.")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 if __name__ == "__main__":
-    if "--history-r18" in sys.argv:
+    if "--net-r21" in sys.argv:
+        res = emit_round21()
+        net = res.get("net_ack_overhead", {})
+        fo = res.get("failover_blackout", {})
+        park = res.get("parked_write_recovery", {})
+        print(json.dumps({
+            "metric": "networked replication: acked-write p99 over "
+                      "localhost TCP follower processes vs in-process "
+                      "+ wire failover blackout (BENCH_r21)",
+            "value": net.get("ack_p99_net_f1_over_inproc_f1"),
+            "unit": "net F=1 ack p99 / in-process F=1 ack p99 "
+                    "(bar <= 2x)",
+            "bar_within_2x": net.get("bar_within_2x"),
+            "net_f1_ack_ms_p99": net.get("arms", {}).get(
+                "net_f1", {}).get("ack_ms_p99"),
+            "blackout_ms_per_life": fo.get("blackout_ms_per_life"),
+            "parked_recovery_s": park.get("recovery_s_after_heal"),
+            "parked_bar_under_1s": park.get("bar_under_1s"),
+        }))
+    elif "--history-r18" in sys.argv:
         res = emit_round18()
         reads = res.get("historical_reads", {})
         disk = res.get("compaction_disk", {})

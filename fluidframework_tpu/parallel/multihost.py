@@ -34,6 +34,35 @@ import numpy as np
 from .mesh import doc_sharding, make_mesh
 
 
+def child_process_env(process_id: int = 0, num_processes: int = 1,
+                      coordinator_address: str | None = None) -> dict:
+    """Environment for one LAUNCHED cluster child (tools/
+    launch_cluster.py): pin JAX to CPU — follower and read-replica
+    children have no device work, and on a shared host they must never
+    race the leader for accelerators — and, for a genuinely multi-
+    process mesh, carry the jax.distributed coordinates the child's
+    :func:`initialize` call consumes."""
+    env = {"JAX_PLATFORMS": "cpu"}
+    if num_processes > 1:
+        env.update({
+            "FFTPU_COORDINATOR": coordinator_address or "127.0.0.1:0",
+            "FFTPU_NUM_PROCESSES": str(num_processes),
+            "FFTPU_PROCESS_ID": str(process_id),
+        })
+    return env
+
+
+def initialize_from_env() -> bool:
+    """Child-side twin of :func:`child_process_env`: join the
+    process-spanning mesh iff the launcher provided coordinates."""
+    import os
+    n = int(os.environ.get("FFTPU_NUM_PROCESSES", "1"))
+    return initialize(
+        coordinator_address=os.environ.get("FFTPU_COORDINATOR"),
+        num_processes=n,
+        process_id=int(os.environ.get("FFTPU_PROCESS_ID", "0")))
+
+
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None) -> bool:

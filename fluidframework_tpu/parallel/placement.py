@@ -890,15 +890,26 @@ class ReplicaBalancer:
 
     # -- scoring ---------------------------------------------------------------
 
-    def score(self, label: str) -> tuple[int, int]:
-        """(rooms assigned here, shipped-but-unapplied WAL records) —
-        lower is better on both axes."""
-        return (len(self.directory.rooms_on(label)),
+    def score(self, label: str,
+              _room_stale: dict | None = None) -> tuple[int, int, int]:
+        """(rooms assigned here, worst PER-ROOM staleness gap, shipped-
+        but-unapplied WAL records) — lower is better on every axis. The
+        middle term is the room watermark gap (leader sequenced
+        watermark − replica applied seq, per room assigned to this
+        label), so a replica that is idle-fresh globally but behind on
+        its one hot room stops winning new rooms until it catches up."""
+        stale = (_room_stale if _room_stale is not None
+                 else self.room_staleness())
+        worst = max((per.get(label, 0) for per in stale.values()),
+                    default=0)
+        return (len(self.directory.rooms_on(label)), worst,
                 self.replicas[label].lag)
 
     def pick(self, n: int = 1) -> list[str]:
         """The ``n`` least-loaded replicas, freshest first on ties."""
-        return sorted(self.replicas, key=self.score)[:max(1, n)]
+        stale = self.room_staleness()
+        return sorted(self.replicas,
+                      key=lambda lb: self.score(lb, stale))[:max(1, n)]
 
     # -- re-home ---------------------------------------------------------------
 
@@ -959,11 +970,17 @@ class ReplicaBalancer:
         rooms = self.directory.rooms()
         m.gauge("replica.rooms").set(len(rooms))
         worst = 0
+        stale_rooms = 0
         for per_label in self.room_staleness().values():
+            room_worst = 0
             for gap in per_label.values():
                 self._h_staleness.observe(gap)
-                worst = max(worst, gap)
+                room_worst = max(room_worst, gap)
+            worst = max(worst, room_worst)
+            if room_worst > 0:
+                stale_rooms += 1
         m.gauge("replica.staleness_worst").set(worst)
+        m.gauge("replica.stale_rooms").set(stale_rooms)
         m.gauge("replica.lag_records").set(
             max((r.lag for r in self.replicas.values()), default=0))
 

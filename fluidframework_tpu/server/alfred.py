@@ -58,6 +58,28 @@ async def read_frame(reader: asyncio.StreamReader) -> Any:
     return decode_body(await read_frame_raw(reader))
 
 
+def read_frame_raw_sync(sock) -> bytes:
+    """Blocking-socket twin of :func:`read_frame_raw` — one definition
+    of the length-prefixed wire format for synchronous callers (the
+    replication transport's client half, ``server/transport.py``).
+    Raises ``ConnectionError`` on a closed or over-limit peer; socket
+    timeouts propagate for the caller's deadline/retry policy."""
+
+    def recv_exact(n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed mid-frame")
+            buf += chunk
+        return bytes(buf)
+
+    length = int.from_bytes(recv_exact(4), "big")
+    if length > MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {length}")
+    return recv_exact(length)
+
+
 class RequestSession:
     """One connection = one (doc, client) session, mirroring the
     reference's per-socket connection state (alfred index.ts:278).

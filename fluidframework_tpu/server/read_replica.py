@@ -303,12 +303,14 @@ class ReadReplica:
         self._arrival_cap = 8192
         self.stats = {"polls": 0, "records_applied": 0,
                       "bad_records": 0, "stale_redirects": 0,
+                      "room_stale_sheds": 0,
                       "reads": 0, "deltas": 0, "broadcast_ticks": 0}
         m = self.metrics
         self._g_applied = m.gauge("replica.applied")
         self._g_lag = m.gauge("replica.lag")
         self._h_staleness = m.histogram("replica.staleness_s")
         self._c_stale = m.counter("replica.stale_redirects")
+        self._c_room_stale = m.counter("replica.room_stale_sheds")
         self.viewers = None
         if viewer_plane:
             from .broadcaster import ViewerPlane
@@ -433,6 +435,19 @@ class ReadReplica:
         per-room staleness is measured against the leader's watermark."""
         return self._doc_seq.get(doc, 0)
 
+    def room_staleness(self, doc: str,
+                       leader_seq: int | None = None) -> int:
+        """PER-ROOM staleness for ``doc`` in sequence numbers: a known
+        leader sequenced watermark (the balancer scrapes it off the
+        leader's doc ticks; a read carries it as the requested seq)
+        minus this replica's addressable frontier, floored at 0.
+        Without one, the shipped-but-unapplied record lag is the only
+        local bound — shipping is FIFO, so zero lag means every room
+        is exactly as fresh as the stream itself."""
+        if leader_seq is None:
+            return self.lag
+        return max(0, int(leader_seq) - self.head_seq(doc))
+
     def can_serve(self, doc: str) -> bool:
         return doc not in self._mega
 
@@ -483,6 +498,8 @@ class ReadReplica:
         seq = int(seq)
         self._require_servable(doc)
         deadline = time.monotonic() + self.read_wait_s
+        shipped = self.node.log_len
+        polls = 0
         while True:
             head = self.head_seq(doc)
             if seq <= head:
@@ -494,8 +511,25 @@ class ReadReplica:
                 self._shed_stale(
                     f"seq {seq} is above this replica's watermark "
                     f"({head}) for {doc!r}")
+            if polls and self.lag == 0 \
+                    and self.node.log_len == shipped:
+                # Early shed: everything shipped is applied and nothing
+                # new arrived across a full grace poll — the missing seq
+                # cannot materialize from records already here, so
+                # burning the rest of ``read_wait_s`` only delays the
+                # client's redial to the leader (who alone may rule the
+                # seq beyond-head). The wait-then-shed decision is thus
+                # per-ROOM: a busy stream keeps the wait alive, an idle
+                # one sheds at once.
+                self.stats["room_stale_sheds"] += 1
+                self._c_room_stale.inc()
+                self._shed_stale(
+                    f"seq {seq} is above this replica's watermark "
+                    f"({head}) for {doc!r} and the stream is idle")
+            shipped = self.node.log_len
             time.sleep(0.002)
             self.poll()
+            polls += 1
 
     def _state_at(self, doc: str, seq: int):
         meta = self.branches.get(doc)
@@ -541,14 +575,29 @@ class ReadReplica:
         self._require_servable(doc)
         if to_seq is not None:
             deadline = time.monotonic() + self.read_wait_s
+            shipped = self.node.log_len
+            polls = 0
             while self.head_seq(doc) < to_seq:
                 if time.monotonic() >= deadline:
                     self._shed_stale(
                         f"get_deltas to_seq {to_seq} is above this "
                         f"replica's watermark "
                         f"({self.head_seq(doc)}) for {doc!r}")
+                if polls and self.lag == 0 \
+                        and self.node.log_len == shipped:
+                    # Same early shed as read_at: an idle, fully
+                    # applied stream cannot produce to_seq.
+                    self.stats["room_stale_sheds"] += 1
+                    self._c_room_stale.inc()
+                    self._shed_stale(
+                        f"get_deltas to_seq {to_seq} is above this "
+                        f"replica's watermark "
+                        f"({self.head_seq(doc)}) for {doc!r} and the "
+                        f"stream is idle")
+                shipped = self.node.log_len
                 time.sleep(0.002)
                 self.poll()
+                polls += 1
         records = self._records_for(doc, from_seq, to_seq)
         messages = materialize_storm_records(
             records, self.datastore, self.channel,

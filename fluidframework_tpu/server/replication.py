@@ -75,6 +75,13 @@ REPLICA_HEADS_RELPATH = "replica_heads.log"
 #: horizon without rescanning the log.
 REPLICA_RETENTION_RELPATH = "replica_retention.log"
 
+#: The follower's durable incarnation floor (one ASCII int, atomically
+#: replaced): frames stamped with a LOWER incarnation are refused with
+#: a ``fenced`` nack — a zombie ex-leader's ships are rejected ON THE
+#: WIRE, not merely ignored, and the refusal survives a follower
+#: restart.
+REPLICA_INCARNATION_RELPATH = "replica_incarnation"
+
 #: Kill classes for the chaos matrix: batch locally durable but not yet
 #: shipped / shipped and quorum-acked but the leader's watermark not
 #: yet advanced — recovery must prove no acked-replicated op is lost
@@ -153,6 +160,19 @@ class ReplicaNode:
         self._wal = OpLog(root / REPLICA_WAL_RELPATH)
         self._heads_log = OpLog(root / REPLICA_HEADS_RELPATH)
         self._retention_log = OpLog(root / REPLICA_RETENTION_RELPATH)
+        #: Durable incarnation floor (wire fencing): the highest "inc"
+        #: stamp ever accepted; lower-stamped frames nack ``fenced``.
+        self._inc_path = root / REPLICA_INCARNATION_RELPATH
+        self.incarnation = 0
+        try:
+            self.incarnation = int(self._inc_path.read_text())
+        except (FileNotFoundError, ValueError):
+            pass
+        #: Monotonic stamp of the last frame heard from ANY leader —
+        #: the follower-side lease (``hello`` surfaces it as
+        #: ``leader_silence_s``; silence past the lease makes this
+        #: node promotion-eligible).
+        self.last_frame_monotonic: float | None = None
         self._retained_floor = 0
         for i in range(len(self._retention_log)):
             self._retained_floor = max(
@@ -167,7 +187,7 @@ class ReplicaNode:
             self.max_hseq = max(self.max_hseq, hseq)
         self.stats = {"batches": 0, "records": 0, "dup_records": 0,
                       "gap_nacks": 0, "head_flips": 0, "rejected": 0,
-                      "retained_records": 0}
+                      "retained_records": 0, "fenced_frames": 0}
 
     @property
     def log_len(self) -> int:
@@ -205,6 +225,19 @@ class ReplicaNode:
             self.stats["rejected"] += 1
             return _frame("nack", {"len": self.log_len,
                                    "reason": "version"})
+        inc = int(hdr.get("inc", 0))
+        if inc < self.incarnation:
+            # Zombie leader: a NEWER incarnation already shipped here.
+            # The frame is REFUSED on the wire (never appended, never
+            # journaled) and the nack names the floor — the stale
+            # plane's triage demotes itself on sight of it.
+            self.stats["fenced_frames"] += 1
+            return _frame("nack", {"len": self.log_len,
+                                   "reason": "fenced",
+                                   "inc": self.incarnation})
+        if inc > self.incarnation:
+            self._adopt_incarnation(inc)
+        self.last_frame_monotonic = time.monotonic()
         kind = hdr.get("k")
         if kind == "batch":
             return self._on_batch(hdr, payload)
@@ -288,6 +321,22 @@ class ReplicaNode:
         self.max_hseq = hseq
         self.stats["head_flips"] += 1
         return True
+
+    def _adopt_incarnation(self, inc: int) -> None:
+        """Raise the durable fencing floor (atomic replace + fsync):
+        once adopted, every lower-stamped frame is refused forever —
+        including across this follower's own restarts."""
+        with self._lock:
+            if inc <= self.incarnation:
+                return
+            tmp = self._inc_path.with_name(self._inc_path.name + ".tmp")
+            with open(tmp, "w") as fh:
+                fh.write(str(int(inc)))
+                fh.flush()
+                if self.fsync:
+                    os.fsync(fh.fileno())
+            os.replace(tmp, self._inc_path)
+            self.incarnation = int(inc)
 
     def _on_trim(self, floor: int, keep=None) -> bytes:
         try:
@@ -385,7 +434,10 @@ class ReplicationPlane:
 
     def __init__(self, nodes, acks_required: int | None = None,
                  label: str = "leader") -> None:
-        links = [n if isinstance(n, ReplicaLink) else ReplicaLink(n)
+        # Anything ``call``-shaped is already a link (in-process
+        # ReplicaLink, a NetworkReplicaLink, a FaultyTransport wrapper);
+        # bare nodes get the in-process link.
+        links = [n if hasattr(n, "call") else ReplicaLink(n)
                  for n in nodes]
         if not links:
             raise ValueError("a replication plane needs >= 1 follower")
@@ -396,6 +448,25 @@ class ReplicationPlane:
         self.label = label
         self.role = "leader"
         self.moved_to: str | None = None
+        #: Wire-fencing stamp: every shipped frame carries it, and a
+        #: follower whose durable floor is higher refuses the frame
+        #: (``fenced`` nack) — promotion bumps it past every journal.
+        self.incarnation = max(
+            (getattr(lk.node, "incarnation", 0) for lk in links),
+            default=0)
+        # Failure detection (lease-based, armed by
+        # start_failure_detector; without it quorum_ok only tracks
+        # follower-set size — the in-process legacy behavior).
+        self.lease_s: float | None = None
+        self.hb_interval_s: float = 0.0
+        #: How long writes PARK (admitted, buffered, unacked) under a
+        #: lost quorum before _admit sheds them with a retry hint.
+        self.park_max_s: float = 5.0
+        self._hb_thread = None
+        self._hb_stop: threading.Event | None = None
+        self._degraded_since: float | None = None
+        now = time.monotonic()
+        self._last_ok = {lk.node.node_id: now for lk in links}
         self._lock = threading.Lock()
         self._acked = {lk.node.node_id: lk.node.log_len for lk in links}
         self._replicated = 0
@@ -413,7 +484,16 @@ class ReplicationPlane:
         self._metrics = None
         self.stats = {"batches_shipped": 0, "ship_failures": 0,
                       "resyncs": 0, "head_flips_shipped": 0,
-                      "quorum_refusals": 0, "retention_floors_shipped": 0}
+                      "quorum_refusals": 0, "retention_floors_shipped": 0,
+                      "ship_retries": 0, "heartbeat_misses": 0,
+                      "fenced_nacks": 0, "followers_dropped": 0}
+
+    def _stamp(self, kind: str, header: dict, payload: bytes = b"") \
+            -> bytes:
+        """A plane frame with this incarnation's fencing stamp."""
+        if self.incarnation:
+            header = {"inc": self.incarnation, **header}
+        return _frame(kind, header, payload)
 
     # -- wiring ----------------------------------------------------------------
 
@@ -473,9 +553,9 @@ class ReplicationPlane:
             return
         faults.crashpoint("repl.pre_ship")
         seq = records[0][0]
-        frame = _frame("batch",
-                       {"seq": seq, "lens": [len(b) for _i, b in records]},
-                       b"".join(b for _i, b in records))
+        frame = self._stamp(
+            "batch", {"seq": seq, "lens": [len(b) for _i, b in records]},
+            b"".join(b for _i, b in records))
         end = records[-1][0] + 1
         for link in self.links:
             self._ship_to(link, frame, end)
@@ -485,21 +565,71 @@ class ReplicationPlane:
         faults.crashpoint("repl.post_ship")
 
     def _ship_to(self, link: ReplicaLink, frame: bytes, end: int) -> None:
-        try:
-            hdr = link.call(frame)
-        except Exception:
-            self.stats["ship_failures"] += 1
+        """Ship one frame to one follower, triaging the failure modes:
+
+        * TRANSIENT (timeout/reset/partition — ``ReplicationLinkDown``
+          or any other ``OSError``): count it, retry ONCE immediately
+          (the frame is idempotent — a dup delivery acks), and leave
+          the follower's acked watermark alone; the next contact
+          (heartbeat or batch) resyncs the missing tail.
+        * PERMANENT — ``fenced`` nack: a newer incarnation owns this
+          quorum, so THIS plane is the zombie — demote self, stop
+          shipping. ``version`` nack: the follower cannot read this
+          stream format, ever — drop it from the plane (quorum math
+          shrinks with it; an unreachable quorum parks writes).
+        * Gap nack: the ordinary behind-follower path — re-ship its
+          missing tail from the leader log (resync's upper bound
+          retries the batch implicitly).
+        """
+        hdr = None
+        for attempt in (0, 1):
+            try:
+                hdr = link.call(frame)
+                break
+            except ReplicationLinkDown:
+                self.stats["ship_failures"] += 1
+                if attempt:
+                    return
+                self.stats["ship_retries"] += 1
+            except Exception:
+                self.stats["ship_failures"] += 1
+                return
+        if hdr is None:
             return
         if hdr.get("k") == "nack":
-            # Follower behind (restarted mid-stream, or missed batches
-            # across a partition): re-ship its missing tail from the
-            # leader log, then retry the batch implicitly via resync's
-            # upper bound.
+            reason = hdr.get("reason")
+            if reason == "fenced":
+                self.stats["fenced_nacks"] += 1
+                self.fence(moved_to=self.moved_to)
+                return
+            if reason == "version":
+                self._drop_follower(link, reason="version")
+                return
             self._resync(link, upto=end)
             return
+        nid = link.node.node_id
+        self._last_ok[nid] = time.monotonic()
         with self._lock:
-            self._acked[link.node.node_id] = max(
-                self._acked[link.node.node_id], hdr["len"])
+            self._acked[nid] = max(self._acked[nid], hdr["len"])
+
+    def _drop_follower(self, link, reason: str) -> None:
+        """Remove a PERMANENTLY incompatible follower from the plane.
+        ``acks_required`` is unchanged — losing a follower must never
+        silently weaken the quorum; if the remainder cannot reach it,
+        writes park and head flips refuse, loudly."""
+        with self._lock:
+            if link in self.links:
+                self.links.remove(link)
+            self._acked.pop(link.node.node_id, None)
+        self._last_ok.pop(link.node.node_id, None)
+        self.stats["followers_dropped"] += 1
+        close = getattr(link, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+        self._update_gauges()
 
     def _resync(self, link: ReplicaLink, upto: int | None = None) -> None:
         """Bring one follower to ``upto`` (default: leader durable):
@@ -515,16 +645,19 @@ class ReplicationPlane:
             upto = self._wal.durable_len
         self.stats["resyncs"] += 1
         try:
-            have = link.call(_frame("probe", {}))["len"]
+            have = link.call(self._stamp("probe", {}))["len"]
             while have < upto:
                 batch = range(have, min(upto, have + RESYNC_BATCH_RECORDS))
                 recs = [self._wal.read(i) for i in batch]
-                hdr = link.call(_frame(
+                hdr = link.call(self._stamp(
                     "batch",
                     {"seq": batch.start, "lens": [len(r) for r in recs]},
                     b"".join(recs)))
                 if hdr.get("k") != "ack":
                     self.stats["ship_failures"] += 1
+                    if hdr.get("reason") == "fenced":
+                        self.stats["fenced_nacks"] += 1
+                        self.fence(moved_to=self.moved_to)
                     return
                 have = hdr["len"]
             with self._lock:
@@ -532,7 +665,8 @@ class ReplicationPlane:
                     [hseq, key, handle]
                     for key, (hseq, handle) in self._heads.items())
             if entries:
-                link.call(_frame("heads", {"entries": entries}))
+                link.call(self._stamp("heads", {"entries": entries}))
+            self._last_ok[link.node.node_id] = time.monotonic()
             with self._lock:
                 self._acked[link.node.node_id] = max(
                     self._acked[link.node.node_id], have)
@@ -542,8 +676,113 @@ class ReplicationPlane:
     def _advance(self) -> None:
         with self._lock:
             acked = sorted(self._acked.values(), reverse=True)
+            if len(acked) < self.acks_required:
+                return  # dropped below quorum size: watermark freezes
             quorum = acked[self.acks_required - 1]
             self._replicated = max(self._replicated, quorum)
+
+    # -- failure detection (lease-based heartbeats) ----------------------------
+
+    @property
+    def quorum_ok(self) -> bool:
+        """``acks_required`` followers hold a FRESH lease. Without an
+        armed detector (``lease_s`` unset) only the follower-set size
+        counts — the in-process legacy semantics, where a slow link
+        merely withholds acks."""
+        if len(self.links) < self.acks_required:
+            return False
+        if self.lease_s is None:
+            return True
+        now = time.monotonic()
+        live = sum(1 for lk in self.links
+                   if now - self._last_ok.get(lk.node.node_id, 0.0)
+                   <= self.lease_s)
+        return live >= self.acks_required
+
+    def quorum_degraded_s(self) -> float | None:
+        """Seconds the quorum has been lost (None while healthy) —
+        the storm's park-then-shed clock."""
+        if self.quorum_ok:
+            self._degraded_since = None
+            return None
+        now = time.monotonic()
+        if self._degraded_since is None:
+            self._degraded_since = now
+        return now - self._degraded_since
+
+    def heartbeat(self) -> bool:
+        """One failure-detector round: probe links idle past the
+        heartbeat interval, renew leases on success, and — the heal
+        path — resync any follower whose acked length fell behind the
+        durable frontier, so parked writes drain as soon as the first
+        probe lands instead of waiting for the next batch. Returns
+        ``quorum_ok``."""
+        if self.fenced:
+            return False
+        now = time.monotonic()
+        durable = self._wal.durable_len if self._wal is not None else None
+        for link in list(self.links):
+            nid = link.node.node_id
+            if self.hb_interval_s \
+                    and now - self._last_ok.get(nid, 0.0) \
+                    < self.hb_interval_s:
+                continue  # recent traffic IS the heartbeat
+            try:
+                hdr = link.call(self._stamp("probe", {}))
+            except Exception:
+                self.stats["heartbeat_misses"] += 1
+                continue
+            if hdr.get("k") != "ack":
+                if hdr.get("reason") == "fenced":
+                    self.stats["fenced_nacks"] += 1
+                    self.fence(moved_to=self.moved_to)
+                    return False
+                self.stats["heartbeat_misses"] += 1
+                continue
+            self._last_ok[nid] = time.monotonic()
+            with self._lock:
+                self._acked[nid] = max(self._acked[nid], hdr["len"])
+            if durable is not None and hdr["len"] < durable:
+                self._resync(link)
+        self._advance()
+        ok = self.quorum_ok
+        self._update_gauges()
+        return ok
+
+    def start_failure_detector(self, interval_s: float = 0.5,
+                               lease_s: float = 2.0,
+                               park_max_s: float | None = None) -> None:
+        """Arm lease-based failure detection: a daemon thread probes
+        every ``interval_s``; a follower silent past ``lease_s`` stops
+        counting toward the quorum, and a lost quorum parks writes
+        (``park_max_s`` caps the park before _admit sheds)."""
+        self.hb_interval_s = float(interval_s)
+        self.lease_s = float(lease_s)
+        if park_max_s is not None:
+            self.park_max_s = float(park_max_s)
+        if self._hb_thread is not None:
+            return
+        self._hb_stop = threading.Event()
+
+        def loop() -> None:
+            while not self._hb_stop.wait(self.hb_interval_s):
+                try:
+                    self.heartbeat()
+                except Exception:
+                    pass  # the detector must outlive any one bad round
+
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True,
+            name=f"repl-heartbeat-{self.label}")
+        self._hb_thread.start()
+
+    def stop_failure_detector(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(5)
+            self._hb_thread = None
+            self._hb_stop = None
 
     # -- retention (checkpoint path) -------------------------------------------
 
@@ -580,8 +819,8 @@ class ReplicationPlane:
         re-ships the leader's fillers verbatim)."""
         if self.fenced or self._wal is None or floor <= 0:
             return
-        frame = _frame("trim", {"floor": int(floor),
-                                "keep": self._live_below(int(floor))})
+        frame = self._stamp("trim", {"floor": int(floor),
+                                     "keep": self._live_below(int(floor))})
         for link in self.links:
             try:
                 link.call(frame)
@@ -605,15 +844,26 @@ class ReplicationPlane:
             self._hseq += 1
             hseq = self._hseq
             self._heads[key] = (hseq, handle)
-        frame = _frame("head", {"hseq": hseq, "key": key,
-                                "handle": handle})
+        frame = self._stamp("head", {"hseq": hseq, "key": key,
+                                     "handle": handle})
         acks = 0
-        for link in self.links:
+        for link in list(self.links):
             try:
-                if link.call(frame).get("k") == "ack":
-                    acks += 1
+                hdr = link.call(frame)
             except Exception:
                 self.stats["ship_failures"] += 1
+                continue
+            if hdr.get("k") == "ack":
+                acks += 1
+                self._last_ok[link.node.node_id] = time.monotonic()
+            elif hdr.get("reason") == "fenced":
+                self.stats["fenced_nacks"] += 1
+                self.fence(moved_to=self.moved_to)
+                raise ReplicationQuorumError(
+                    f"head flip for {key!r} fenced by a newer "
+                    f"incarnation; this leader is demoted")
+            elif hdr.get("reason") == "version":
+                self._drop_follower(link, reason="version")
         if acks < self.acks_required:
             self.stats["quorum_refusals"] += 1
             raise ReplicationQuorumError(
@@ -636,6 +886,57 @@ class ReplicationPlane:
             max(0, durable - self.replicated_len))
         m.gauge("repl.shipped_batches").set(
             self.stats["batches_shipped"])
+        m.gauge("repl.quorum_ok").set(1 if self.quorum_ok else 0)
+        deg = self.quorum_degraded_s()
+        m.gauge("repl.degraded_s").set(
+            0.0 if deg is None else round(deg, 3))
+        parked = 0
+        if deg is not None and self.storm is not None:
+            parked = self.storm._pending_docs
+        m.gauge("repl.parked_docs").set(parked)
+        # Wire-level stats exist only on networked links; aggregate
+        # across edges so the monitor gets one transport line.
+        rtts: list = []
+        agg = {"calls": 0, "retransmits": 0, "reconnects": 0,
+               "timeouts": 0}
+        netlinks = 0
+        for lk in self.links:
+            ts = getattr(lk, "transport_stats", None)
+            if ts is None:
+                continue
+            netlinks += 1
+            s = ts()
+            rtts.extend(s.get("rtt_s", ()))
+            for k in agg:
+                agg[k] += s.get(k, 0)
+        if netlinks or self.lease_s is not None:
+            rtts.sort()
+
+            def pct(q: float) -> float:
+                if not rtts:
+                    return 0.0
+                return rtts[min(len(rtts) - 1,
+                                int(q * (len(rtts) - 1)))]
+
+            m.gauge("transport.links").set(netlinks)
+            m.gauge("transport.rtt_p50_ms").set(
+                round(1000 * pct(0.50), 3))
+            m.gauge("transport.rtt_p99_ms").set(
+                round(1000 * pct(0.99), 3))
+            m.gauge("transport.calls").set(agg["calls"])
+            m.gauge("transport.retransmits").set(agg["retransmits"])
+            m.gauge("transport.reconnects").set(agg["reconnects"])
+            m.gauge("transport.timeouts").set(agg["timeouts"])
+            m.gauge("transport.heartbeat_misses").set(
+                self.stats["heartbeat_misses"])
+            open_partitions = 0
+            if self.lease_s is not None:
+                now = time.monotonic()
+                open_partitions = sum(
+                    1 for lk in self.links
+                    if now - self._last_ok.get(lk.node.node_id, 0.0)
+                    > self.lease_s)
+            m.gauge("transport.open_partitions").set(open_partitions)
 
 
 class ReplicatedHeadStore:
@@ -735,6 +1036,11 @@ def promote(label: str, nodes: list[ReplicaNode], shared_snapshots,
         followers.append(ReplicaNode(d))
     plane = ReplicationPlane(followers, acks_required=acks_required,
                              label=label)
+    # Fence the dead incarnation ON THE WIRE: bump past every journal's
+    # durable floor before the first stamped frame ships (attach
+    # resyncs), so the quorum refuses the zombie's frames outright.
+    plane.incarnation = 1 + max(
+        (getattr(n, "incarnation", 0) for n in nodes), default=0)
     store = ReplicatedHeadStore(shared_snapshots, plane)
     candidate.close()  # the promoted storm owns the WAL file now
     storm = make_cluster_host(label, candidate.data_dir, store,
@@ -764,7 +1070,11 @@ def make_replicated_host(label: str, data_dir: str, shared_snapshots,
     ``follower_dirs``. Returns ``(storm, plane)``."""
     from ..parallel.placement import make_cluster_host
 
-    nodes = [ReplicaNode(d) for d in follower_dirs]
+    # A follower may be a bare directory (in-process node) or anything
+    # ``call``-shaped — a NetworkReplicaLink to another OS process, or
+    # a FaultyTransport wrapping either.
+    nodes = [d if hasattr(d, "call") else ReplicaNode(d)
+             for d in follower_dirs]
     plane = ReplicationPlane(nodes, acks_required=acks_required,
                              label=label)
     store = ReplicatedHeadStore(shared_snapshots, plane)
@@ -777,6 +1087,7 @@ def make_replicated_host(label: str, data_dir: str, shared_snapshots,
 __all__ = [
     "REPLICATION_STREAM_VERSION", "REPLICATION_KILL_POINTS",
     "REPLICA_WAL_RELPATH", "REPLICA_RETENTION_RELPATH",
+    "REPLICA_INCARNATION_RELPATH",
     "ReplicaNode", "ReplicaLink", "ReplicationPlane",
     "ReplicatedHeadStore", "ReplicationLinkDown",
     "ReplicationQuorumError", "choose_promotion_candidate",

@@ -618,7 +618,7 @@ class StormController:
                       "nacked_or_ignored_ops": 0,
                       "shed_frames": 0, "shed_ops": 0,
                       "quarantined_docs": 0, "readmitted_docs": 0,
-                      "degraded_rejects": 0}
+                      "degraded_rejects": 0, "quorum_rejects": 0}
         self.tick_seconds: list[float] = []  # submit→harvest per round
         self.harvest_intervals: list[float] = []  # completion cadence
         # Observability plane (the round-10 tentpole): one fixed-shape
@@ -883,6 +883,21 @@ class StormController:
             cooldown = self._group_wal.breaker.cooldown_s
             return self._shed(push, header, n_ops, "degraded",
                               max(cooldown, self.busy_retry_s))
+        if (self.replication is not None
+                and not self.replication.quorum_ok):
+            # Follower quorum lost (lease-based failure detector,
+            # server/transport.py): writes PARK — admitted and buffered
+            # FIFO, never acked, because _flush_round declines rounds —
+            # while the outage is young. Past ``park_max_s`` new frames
+            # shed with a retry hint instead of growing the parked
+            # queue without bound. Either way: never ack-without-quorum.
+            deg = self.replication.quorum_degraded_s()
+            if deg is not None and deg >= self.replication.park_max_s:
+                self.stats["quorum_rejects"] += 1
+                return self._shed(
+                    push, header, n_ops, "quorum-lost",
+                    max(self.busy_retry_s,
+                        self.replication.park_max_s / 2))
         if self.max_pending_docs is not None:
             n = len(docs)
             cap = self.qos.pending_cap(tenant_id, self.max_pending_docs)
@@ -1168,6 +1183,18 @@ class StormController:
             # pipeline's few ticks still need WAL appends, so the
             # bounded group-commit queue can never overflow into the
             # harvest path mid-outage.
+            return False
+        if (self.replication is not None and not self._replay
+                and not self.replication.quorum_ok):
+            # Quorum lost: a tick here would advance device state and
+            # journal records no quorum can replicate — the acks would
+            # park anyway, and history past the replicated watermark is
+            # exactly what a promoted incarnation forks away. Frames
+            # stay buffered in arrival order (per-doc FIFO preserved),
+            # so the healed quorum sequences the identical history a
+            # never-partitioned leader would have.
+            self.merge_host.metrics.gauge("repl.parked_docs").set(
+                self._pending_docs)
             return False
         round_start = _time.perf_counter()
         queue_depth = self._pending_docs

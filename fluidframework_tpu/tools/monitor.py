@@ -282,6 +282,40 @@ def render_replication(metrics: dict, prev: dict | None = None,
             f"last failover blackout {blackout:,.1f}ms")
 
 
+def render_transport(metrics: dict, prev: dict | None = None,
+                     interval: float = 1.0) -> str:
+    """Networked-transport line (the round-21 cut-the-cord tier):
+    live replication links, per-link round-trip p50/p99, retransmit
+    rate over the poll window (cumulative with no window), heartbeat
+    misses, links past their lease right now (open partitions), the
+    parked-write depth (docs whose frames are held FIFO during a
+    quorum blackout — never shed, never falsely acked), and how long
+    the plane has currently been degraded. Empty when replication is
+    purely in-process with no failure detector armed (the gauges never
+    appear)."""
+    if "transport.links" not in metrics:
+        return ""
+    links = metrics.get("transport.links", 0)
+    p50 = metrics.get("transport.rtt_p50_ms", 0.0)
+    p99 = metrics.get("transport.rtt_p99_ms", 0.0)
+    retrans = metrics.get("transport.retransmits", 0)
+    misses = metrics.get("transport.heartbeat_misses", 0)
+    partitions = metrics.get("transport.open_partitions", 0)
+    parked = metrics.get("repl.parked_docs", 0)
+    degraded = metrics.get("repl.degraded_s", 0.0)
+    per_s = max(interval, 1e-9)
+    rate = ""
+    if prev:
+        window = retrans - prev.get("transport.retransmits", 0)
+        if window >= 0:  # negative = service restarted
+            rate = f" ({window / per_s:,.1f}/s)"
+    state = f"DEGRADED {degraded:,.1f}s" if degraded else "quorum ok"
+    return (f"transport: links {links:g}  rtt p50 {p50:,.1f}ms "
+            f"p99 {p99:,.1f}ms  retransmits {retrans:g}{rate}  "
+            f"hb-misses {misses:g}  open-partitions {partitions:g}  "
+            f"parked {parked:g}  {state}")
+
+
 def render_replicas(metrics: dict, prev: dict | None = None,
                     interval: float = 1.0) -> str:
     """Read-replica tier line (the round-20 read scale-out): replica
@@ -474,6 +508,9 @@ def render_human(now: dict, prev: dict, interval: float) -> str:
     repl_line = render_replication(now, prev or None, interval)
     if repl_line:
         lines.append(repl_line)
+    transport_line = render_transport(now, prev or None, interval)
+    if transport_line:
+        lines.append(transport_line)
     replicas_line = render_replicas(now, prev or None, interval)
     if replicas_line:
         lines.append(replicas_line)

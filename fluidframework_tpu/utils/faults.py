@@ -67,6 +67,27 @@ fsync is survivable-by-design, a kill is not). Registered failpoints:
 Environment protocol: ``FFTPU_FAILPOINT="wal.fsync:3"`` fails the next
 3 hits, then heals. Failure plans share the :func:`arm` gate with kill
 plans.
+
+The third plan family is *link faults* — named network pathologies the
+:class:`~..server.transport.FaultyTransport` wrapper injects per
+replication edge (see ``server/transport.py``; the chaos ``--netsplit``
+scenarios install them mid-run):
+
+==================  ==========================================================
+``drop`` (p)        per-call frame loss, nothing delivered
+``delay`` (s, p)    added latency before delivery
+``slow`` (s)        every call slowed (a saturated link)
+``dup`` (p)         delivered twice — the idempotent-redelivery path
+``reorder`` (p)     held past the next frame — a genuine out-of-order arrival
+``partition``       full partition: every call fails, nothing delivered
+``partition_send``  one-way: requests lost before the follower sees them
+``partition_recv``  one-way: delivered, but the response is lost (the
+                    leader retries — duplicate delivery for real)
+==================  ==========================================================
+
+Environment protocol (parsed by :func:`link_fault_plan_from_env`)::
+
+    FFTPU_LINKFAULTS="f0:drop@p=0.2;f0:delay@s=0.01,p=0.5;f1:partition"
 """
 
 from __future__ import annotations
@@ -140,6 +161,24 @@ def clear() -> None:
     _plan, _armed, _hits = None, False, 0
     fired.clear()
     _fail_plans.clear()
+
+
+def link_fault_plan_from_env(var: str = "FFTPU_LINKFAULTS") -> dict:
+    """Parse a link-fault plan — ``{edge: {fault: params}}``, the shape
+    ``server/transport.FaultyTransport`` installs from — out of the
+    environment. Entries are ``;``-separated ``edge:fault[@k=v,...]``;
+    parameter values parse as floats. Empty/missing env = empty plan."""
+    plan: dict[str, dict] = {}
+    for entry in filter(None, (e.strip() for e
+                               in os.environ.get(var, "").split(";"))):
+        edge, _, rest = entry.partition(":")
+        fault, _, params = rest.partition("@")
+        kw: dict[str, float] = {}
+        for pair in filter(None, (s.strip() for s in params.split(","))):
+            key, _, val = pair.partition("=")
+            kw[key.strip()] = float(val)
+        plan.setdefault(edge.strip(), {})[fault.strip()] = kw
+    return plan
 
 
 def crashpoint(name: str) -> None:

@@ -38,6 +38,21 @@ compile_cache.enable()
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reap_cluster_children():
+    """Subprocess hygiene: any cluster child spawned through
+    tools/launch_cluster during a test is reaped at teardown even when
+    the test failed or timed out mid-launch — CI must never accumulate
+    orphaned follower/replica processes. Free for the rest of the
+    suite (one sys.modules lookup)."""
+    import sys
+
+    yield
+    mod = sys.modules.get("fluidframework_tpu.tools.launch_cluster")
+    if mod is not None:
+        mod.reap_all()
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh_devices():
     import jax

@@ -523,6 +523,48 @@ def test_replication_line_renders_plane_state():
     assert "replication: role leader" in human
 
 
+def test_transport_line_renders_wire_state():
+    """Round-21 networked-transport line: silent when replication is
+    purely in-process (no transport gauges), then link count, RTT
+    p50/p99, windowed retransmit rate, heartbeat misses, open
+    partitions, the parked-write depth and time-in-degraded-mode — and
+    the line rides human watch mode."""
+    from fluidframework_tpu.tools import monitor
+    from fluidframework_tpu.tools.monitor import render_transport
+
+    assert render_transport({}) == ""  # in-process plane → no line
+    m = {"transport.links": 2.0,
+         "transport.rtt_p50_ms": 0.8,
+         "transport.rtt_p99_ms": 4.25,
+         "transport.retransmits": 12.0,
+         "transport.heartbeat_misses": 3.0,
+         "transport.open_partitions": 1.0,
+         "repl.parked_docs": 5.0,
+         "repl.degraded_s": 1.75}
+    text = render_transport(m)
+    assert "links 2" in text
+    assert "rtt p50 0.8ms p99 4.2ms" in text
+    assert "retransmits 12" in text
+    assert "hb-misses 3" in text
+    assert "open-partitions 1" in text
+    assert "parked 5" in text
+    assert "DEGRADED 1.8s" in text
+    # A healthy quorum renders the ok state, not a degraded clock.
+    healthy = render_transport(dict(m, **{"repl.degraded_s": 0.0,
+                                          "transport.open_partitions": 0.0,
+                                          "repl.parked_docs": 0.0}))
+    assert "quorum ok" in healthy and "DEGRADED" not in healthy
+    # Windowed retransmit rate over a 2s poll window; a restart
+    # (negative window) falls back to the cumulative count.
+    windowed = render_transport(m, {"transport.retransmits": 2.0},
+                                interval=2.0)
+    assert "retransmits 12 (5.0/s)" in windowed
+    assert "(" not in render_transport(
+        m, {"transport.retransmits": 99.0}, interval=1.0).split("rtt")[1]
+    human = monitor.render_human(m, {}, interval=1.0)
+    assert "transport: links 2" in human
+
+
 def test_replicas_line_renders_read_tier_state():
     """Round-20 read-replica line: silent without a balancer scrape,
     then host/room counts, the per-room staleness distribution (the

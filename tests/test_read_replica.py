@@ -397,3 +397,80 @@ class TestPromotedFork:
         direct = play(False, tmp_path / "direct")
         demoted = play(True, tmp_path / "demoted")
         assert direct == demoted
+
+
+# -- per-room staleness: shed early, score per room (round 21 satellite) -------
+
+
+class TestRoomStaleness:
+
+    def test_room_staleness_bound_and_early_shed(self, tmp_path):
+        """``room_staleness`` is the per-room gap against a known
+        leader watermark; an IDLE stream sheds a stale read at once
+        instead of burning the whole ``read_wait_s`` grace."""
+        import time as _time
+
+        git, storm, plane, hist = _build(tmp_path)
+        _serve(storm, ["doc-0"], rounds=2)
+        rep = ReadReplica(plane.links[0].node, git, "replica0",
+                          leader_label="hostA", read_wait_s=5.0)
+        head = rep.head_seq("doc-0")
+        # Caught up: zero gap whichever way it is measured.
+        assert rep.room_staleness("doc-0") == 0  # FIFO stream bound
+        assert rep.room_staleness("doc-0", leader_seq=head) == 0
+        assert rep.room_staleness("doc-0", leader_seq=head + 7) == 7
+        assert rep.room_staleness("doc-0", leader_seq=head - 3) == 0
+        # Early shed: everything shipped is applied and the stream is
+        # idle, so the missing seq cannot materialize here — the shed
+        # fires in milliseconds, NOT after read_wait_s (5 s).
+        t0 = _time.monotonic()
+        with pytest.raises(ReplicaRedirect) as err:
+            rep.read_at("doc-0", head + 50)
+        assert _time.monotonic() - t0 < 2.0
+        assert err.value.moved_to == "hostA"
+        with pytest.raises(ReplicaRedirect):
+            rep.get_deltas("doc-0", 0, head + 50)
+        assert rep.stats["room_stale_sheds"] == 2
+        assert rep.metrics.counter(
+            "replica.room_stale_sheds").value == 2
+        _close(storm)
+
+    def test_balancer_scores_per_room_gap_and_gauges_stale_rooms(
+            self, tmp_path):
+        """The balancer's score is (rooms, worst PER-ROOM gap, lag):
+        a replica behind on its assigned room stops winning new rooms
+        even against an equally-loaded peer, and the gap surfaces as
+        ``replica.stale_rooms`` / ``replica.staleness_worst``."""
+        git, storm, plane, hist = _build(tmp_path, followers=2)
+        _serve(storm, ["doc-0"], rounds=1)
+        reps = {f"replica{i}": ReadReplica(plane.links[i].node, git,
+                                           f"replica{i}",
+                                           leader_label="hostA")
+                for i in range(2)}
+        directory = ReplicaDirectory(git)
+        bal = ReplicaBalancer(directory, reps, leader_storm=storm)
+        directory.assign_room("doc-0", ["replica0", "replica1"])
+        # replica0 tails the stream; replica1 stops polling and the
+        # leader keeps writing — replica1 is now behind on ITS room.
+        _serve(storm, ["doc-0"], rounds=2)
+        reps["replica0"].poll()
+        stale = bal.room_staleness()
+        gap = stale["doc-0"]["replica1"]
+        assert stale["doc-0"]["replica0"] == 0 and gap > 0
+        s0, s1 = bal.score("replica0"), bal.score("replica1")
+        assert s0[0] == s1[0] == 1  # equally loaded (rooms)...
+        assert s0[1] == 0 and s1[1] == gap  # ...split by room gap
+        assert s0 < s1
+        assert bal.pick(1) == ["replica0"]
+        out = bal.spread_room("doc-1", n=1)
+        assert out["labels"] == ["replica0"]  # fresh replica wins
+        bal.update_gauges()
+        m = bal.metrics
+        assert m.gauge("replica.stale_rooms").value == 1
+        assert m.gauge("replica.staleness_worst").value == gap
+        # The laggard catches up: gap closes, gauges clear.
+        reps["replica1"].poll()
+        bal.update_gauges()
+        assert bal.room_staleness()["doc-0"]["replica1"] == 0
+        assert m.gauge("replica.stale_rooms").value == 0
+        _close(storm)

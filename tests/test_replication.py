@@ -538,3 +538,114 @@ class TestFailover:
         assert rebuilt.directory.incarnation_of("hostA") == inc
         _close(new_storm)
         _close(other)
+
+
+# -- ship-failure triage (transient vs permanent) ------------------------------
+
+
+class _FlakyLink:
+    """Raise ``exc`` for the next ``times`` calls, then delegate — a
+    transient wire blip (timeout, connection reset) in link clothing."""
+
+    def __init__(self, inner, exc, times=1):
+        self.inner, self.exc, self.times = inner, exc, times
+
+    @property
+    def node(self):
+        return self.inner.node
+
+    def call(self, frame):
+        if self.times:
+            self.times -= 1
+            raise self.exc
+        return self.inner.call(frame)
+
+
+class _VersionRefusingLink:
+    """A follower that can NEVER read this stream format — every frame
+    nacks ``version``. The permanent incompatibility class."""
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.log_len = 0
+        self.max_hseq = 0
+        self.closed = False
+
+    @property
+    def node(self):
+        return self
+
+    def call(self, frame):
+        return {"v": REPLICATION_STREAM_VERSION, "k": "nack", "len": 0,
+                "reason": "version"}
+
+    def close(self):
+        self.closed = True
+
+
+class TestShipTriage:
+    """_ship_to's failure taxonomy: transient failures retry/resync and
+    KEEP the follower; permanent ones (version) drop it without ever
+    weakening the quorum arithmetic."""
+
+    def test_transient_linkdown_retries_once_and_acks_same_round(
+            self, tmp_path):
+        """ReplicationLinkDown (timeout / refused): one immediate
+        retransmit — the frame is idempotent — and the round still
+        acks. The follower stays in the plane."""
+        from fluidframework_tpu.server.replication import (
+            ReplicationLinkDown,
+        )
+        _git, storm, plane = _build(tmp_path, followers=1)
+        real = plane.links[0]
+        plane.links[0] = _FlakyLink(
+            real, ReplicationLinkDown("timed out"), times=1)
+        _serve(storm, ["doc-0"], rounds=1)
+        assert plane.stats["ship_retries"] == 1
+        assert plane.stats["ship_failures"] == 1
+        assert plane.stats["followers_dropped"] == 0
+        assert len(plane.links) == 1  # follower retained
+        # The retransmit delivered: acks advanced with the round.
+        assert storm.acked_watermark == storm._group_wal.durable_len > 0
+        assert real.node.log_len == storm._group_wal.durable_len
+        _close(storm)
+
+    def test_transient_reset_freezes_then_resyncs_on_next_contact(
+            self, tmp_path):
+        """A non-link-shaped transient (connection reset mid-frame): no
+        in-round retry, the watermark freezes, and the NEXT contact
+        heals through gap-nack -> resync — the follower is never
+        dropped."""
+        _git, storm, plane = _build(tmp_path, followers=1)
+        real = plane.links[0]
+        plane.links[0] = _FlakyLink(
+            real, ConnectionResetError("reset by peer"), times=1)
+        _serve(storm, ["doc-0"], rounds=1)
+        assert plane.stats["ship_failures"] == 1
+        assert plane.stats["followers_dropped"] == 0
+        assert storm.acked_watermark == 0  # frozen, not lost
+        clients, cseq = _serve(storm, ["doc-0"], rounds=1)
+        assert plane.stats["resyncs"] >= 1  # gap-nack healed the tail
+        assert storm.acked_watermark == storm._group_wal.durable_len
+        assert real.node.log_len == storm._group_wal.durable_len
+        _close(storm)
+
+    def test_permanent_version_nack_drops_follower_loudly(self, tmp_path):
+        """A ``version`` nack is forever: the follower is dropped (and
+        closed), ``acks_required`` does NOT shrink with it, so an
+        unreachable quorum parks acks and refuses head flips instead of
+        silently weakening durability."""
+        _git, storm, plane = _build(tmp_path, followers=2,
+                                    acks_required=2)
+        stub = _VersionRefusingLink(plane.links[1].node.node_id)
+        plane.links[1] = stub
+        _serve(storm, ["doc-0"], rounds=1)
+        assert plane.stats["followers_dropped"] == 1
+        assert stub not in plane.links and len(plane.links) == 1
+        assert stub.closed
+        assert plane.acks_required == 2  # quorum math untouched
+        assert storm.acked_watermark == 0  # below quorum: acks park
+        assert not plane.quorum_ok
+        with pytest.raises(ReplicationQuorumError):
+            plane.ship_head("doc-0", "h1")
+        _close(storm)

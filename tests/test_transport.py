@@ -1,0 +1,604 @@
+"""Networked replication transport (round 21 tentpole,
+server/transport.py): length-prefixed frames over real TCP sockets,
+deadline/retry/reconnect policy, seeded link-fault injection, and
+lease-based failure detection feeding the plane's degraded mode.
+
+The bars under test here (the multi-process story rides
+tests/test_chaos.py's --netsplit scenarios):
+
+* **wire fidelity** — a replication frame shipped through a
+  ``NetworkReplicaLink`` lands on the follower byte-for-byte identical
+  to the same frame delivered in-process; the replica WAL files are
+  bitwise equal afterwards;
+* **deadline / retry** — a dead or silent peer costs bounded time:
+  jittered exponential backoff, ``retransmits``/``timeouts`` counted,
+  ``ReplicationLinkDown`` once the budget is spent; a bounced server
+  is redialed transparently;
+* **fault semantics** — every ``FaultyTransport`` pathology surfaces
+  exactly as a real network would (partitions fail, ``partition_recv``
+  delivers-then-fails so the retransmit is a REAL duplicate, reorder
+  holds the frame and nacks with the follower's true length) and the
+  node's idempotent-redelivery machinery absorbs all of them;
+* **fencing on the wire** — after a follower adopts a higher
+  incarnation, lower-stamped frames are refused with a ``fenced``
+  nack over the socket, and the floor survives in ``hello``;
+* **degraded mode** — quorum loss parks writes (no acks, no loss);
+  heal + heartbeat drains the parked backlog; parking past
+  ``park_max_s`` sheds loudly with a ``retry_after_s`` hint.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server.durable_store import GitSnapshotStore
+from fluidframework_tpu.server.replication import (
+    REPLICA_WAL_RELPATH,
+    ReplicaLink,
+    ReplicaNode,
+    ReplicationLinkDown,
+    _frame,
+    make_replicated_host,
+)
+from fluidframework_tpu.server.transport import (
+    LINK_FAULTS,
+    FaultyTransport,
+    NetworkReplicaLink,
+    ReplicaServerThread,
+)
+from fluidframework_tpu.utils import faults
+
+K = 8
+
+
+def _words(seed, k=K):
+    rng = np.random.default_rng(seed)
+    kinds = rng.choice([0, 0, 0, 1], size=k).astype(np.uint32)
+    slots = rng.integers(0, 16, k).astype(np.uint32)
+    vals = rng.integers(0, 1 << 20, k).astype(np.uint32)
+    return (kinds | (slots << 2) | (vals << 12)).astype(np.uint32)
+
+
+def _batch(seq, records, **extra):
+    return _frame("batch", {"seq": seq, "lens": [len(r) for r in records],
+                            **extra}, b"".join(records))
+
+
+def _records(n, seed=0, size=24):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, size).astype(np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def _wal_bytes(data_dir):
+    from pathlib import Path
+    return (Path(data_dir) / REPLICA_WAL_RELPATH).read_bytes()
+
+
+@pytest.fixture()
+def served(tmp_path):
+    """A ReplicaNode behind a real TCP socket; yields (node, server)."""
+    node = ReplicaNode(tmp_path / "fnet", node_id="fnet")
+    server = ReplicaServerThread(node)
+    yield node, server
+    server.close()
+    node.close()
+
+
+# -- wire round trip -----------------------------------------------------------
+
+
+class TestWireRoundTrip:
+
+    def test_batch_lands_byte_identical_to_in_process(self, served,
+                                                      tmp_path):
+        """The same frames, shipped over TCP and in-process, leave the
+        two follower WALs bitwise equal — the transport carries
+        ``on_frame`` byte-for-byte, adding nothing, reordering
+        nothing."""
+        node, server = served
+        twin = ReplicaNode(tmp_path / "floc", node_id="floc")
+        link = NetworkReplicaLink(server.port)
+        local = ReplicaLink(twin)
+        try:
+            recs = _records(6, seed=1)
+            for lk in (link, local):
+                hdr = lk.call(_batch(0, recs[:4]))
+                assert hdr["k"] == "ack" and hdr["len"] == 4
+                hdr = lk.call(_batch(4, recs[4:]))
+                assert hdr["k"] == "ack" and hdr["len"] == 6
+                lk.call(_frame("heads", {"entries": [[3, "doc/a", "h3"]]}))
+            assert node.log_len == twin.log_len == 6
+            assert node.heads == twin.heads == {"doc/a": (3, "h3")}
+            assert _wal_bytes(node.data_dir) == _wal_bytes(twin.data_dir)
+        finally:
+            link.close()
+            twin.close()
+
+    def test_hello_handshake_populates_node_surface(self, served):
+        node, server = served
+        link = NetworkReplicaLink(server.port)
+        try:
+            assert link.node is link  # plane reads link.node.<attr>
+            assert link.node_id == "fnet"
+            assert link.role == "follower"
+            assert link.log_len == 0 and link.max_hseq == 0
+            d = link.hello()
+            assert d["leader_silence_s"] is None  # never heard a leader
+            link.call(_batch(0, _records(2)))
+            link.call(_frame("heads", {"entries": [[7, "doc/b", "h7"]]}))
+            d = link.hello()
+            assert d["len"] == 2 and d["hseq"] == 7
+            assert link.heads == {"doc/b": (7, "h7")}
+            assert d["leader_silence_s"] is not None
+        finally:
+            link.close()
+
+    def test_control_ping_unknown_op_and_custom_handler(self, tmp_path):
+        node = ReplicaNode(tmp_path / "f0")
+        server = ReplicaServerThread(
+            node, handlers={"echo": lambda req: {"back": req["x"]}})
+        link = NetworkReplicaLink(server.port)
+        try:
+            assert link.control("ping") == {"ok": True}
+            assert "error" in link.control("no_such_verb")
+            assert link.control("echo", x=41)["back"] == 41
+            # A handler that raises must not kill the connection.
+            server.server.handlers["boom"] = lambda req: 1 / 0
+            assert "ZeroDivisionError" in link.control("boom")["error"]
+            assert link.control("ping") == {"ok": True}  # link survives
+        finally:
+            link.close()
+            server.close()
+            node.close()
+
+    def test_shutdown_closes_node_and_releases_wal(self, tmp_path):
+        """The promotion prerequisite: ``shutdown`` closes the node
+        BEFORE responding, so the caller can immediately reopen the
+        directory locally (the over-the-wire failover path)."""
+        node = ReplicaNode(tmp_path / "f0")
+        server = ReplicaServerThread(node)
+        link = NetworkReplicaLink(server.port)
+        try:
+            link.call(_batch(0, _records(3, seed=2)))
+            out = link.control("shutdown")
+            assert out == {"ok": True, "closed": True}
+            reopened = ReplicaNode(tmp_path / "f0")  # WAL lock released
+            assert reopened.log_len == 3
+            reopened.close()
+        finally:
+            link.close()
+            server.close()
+
+
+# -- deadline / retry / reconnect ----------------------------------------------
+
+
+class TestRetryReconnect:
+
+    def test_dead_port_raises_linkdown_within_budget(self):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        # Nothing listens on `port` now: connect refused, every retry.
+        t0 = time.monotonic()
+        with pytest.raises(ReplicationLinkDown):
+            NetworkReplicaLink(port, retries=2, backoff_base_s=0.01,
+                               call_timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_silent_peer_times_out_not_hangs(self):
+        """A peer that accepts but never answers costs bounded time —
+        the per-call deadline, not a hung link."""
+        gate = socket.socket()
+        gate.bind(("127.0.0.1", 0))
+        gate.listen(1)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(ReplicationLinkDown):
+                NetworkReplicaLink(gate.getsockname()[1], retries=1,
+                                   call_timeout_s=0.2,
+                                   backoff_base_s=0.01)
+            elapsed = time.monotonic() - t0
+            assert 0.2 <= elapsed < 3.0
+        finally:
+            gate.close()
+
+    def test_reconnects_transparently_after_server_bounce(self, tmp_path):
+        node = ReplicaNode(tmp_path / "f0")
+        server = ReplicaServerThread(node)
+        port = server.port
+        link = NetworkReplicaLink(port, retries=3, backoff_base_s=0.02)
+        try:
+            assert link.call(_batch(0, _records(2)))["k"] == "ack"
+            dials = link.stats["reconnects"]
+            server.close()
+            server = ReplicaServerThread(node, port=port)
+            # Same address, new server: the stale socket errors, the
+            # retry loop redials, the call succeeds — no caller-visible
+            # failure.
+            hdr = link.call(_batch(2, _records(2, seed=5)))
+            assert hdr["k"] == "ack" and hdr["len"] == 4
+            assert link.stats["reconnects"] > dials
+            assert link.stats["retransmits"] >= 1
+        finally:
+            link.close()
+            server.close()
+            node.close()
+
+    def test_transport_stats_shape(self, served):
+        node, server = served
+        link = NetworkReplicaLink(server.port)
+        try:
+            link.call(_frame("probe", {}))
+            ts = link.transport_stats()
+            assert ts["calls"] >= 2  # hello + probe
+            assert len(ts["rtt_s"]) >= 2
+            assert all(r >= 0 for r in ts["rtt_s"])
+            for key in ("retransmits", "reconnects", "timeouts"):
+                assert key in ts
+        finally:
+            link.close()
+
+
+# -- fault semantics -----------------------------------------------------------
+
+
+class TestFaultSemantics:
+    """In-process inner link — the fault wrapper's contract is
+    transport-agnostic, and these must stay fast."""
+
+    @pytest.fixture()
+    def edge(self, tmp_path):
+        node = ReplicaNode(tmp_path / "f0")
+        ft = FaultyTransport(ReplicaLink(node), edge="f0", seed=7)
+        yield node, ft
+        node.close()
+
+    def test_partition_blocks_everything_until_heal(self, edge):
+        node, ft = edge
+        ft.install("partition")
+        with pytest.raises(ReplicationLinkDown):
+            ft.call(_batch(0, _records(2)))
+        assert node.log_len == 0  # nothing delivered
+        assert ft.stats["partition"] == 1
+        ft.heal("partition")
+        assert ft.call(_batch(0, _records(2)))["len"] == 2
+
+    def test_partition_send_loses_request(self, edge):
+        node, ft = edge
+        ft.install("partition_send")
+        with pytest.raises(ReplicationLinkDown):
+            ft.call(_batch(0, _records(2)))
+        assert node.log_len == 0
+
+    def test_partition_recv_delivers_then_fails_making_real_dups(self,
+                                                                 edge):
+        """The response-lost pathology: the frame LANDS, the caller
+        sees failure, and the retransmit becomes a genuine duplicate
+        the node must absorb idempotently."""
+        node, ft = edge
+        frame = _batch(0, _records(3, seed=3))
+        ft.install("partition_recv")
+        with pytest.raises(ReplicationLinkDown):
+            ft.call(frame)
+        assert node.log_len == 3  # delivered despite the failure
+        ft.heal()
+        hdr = ft.call(frame)  # the leader's retransmit: a REAL dup
+        assert hdr["k"] == "ack" and hdr["len"] == 3
+        assert node.stats["dup_records"] == 3
+
+    def test_drop_p1_drops_every_call(self, edge):
+        node, ft = edge
+        ft.install("drop", p=1.0)
+        for _ in range(3):
+            with pytest.raises(ReplicationLinkDown):
+                ft.call(_batch(0, _records(1)))
+        assert node.log_len == 0 and ft.stats["drop"] == 3
+
+    def test_dup_delivers_twice_idempotently(self, edge):
+        node, ft = edge
+        ft.install("dup", p=1.0)
+        hdr = ft.call(_batch(0, _records(4, seed=4)))
+        assert hdr["k"] == "ack" and hdr["len"] == 4
+        assert node.log_len == 4  # not 8
+        assert node.stats["dup_records"] == 4
+        assert ft.stats["dup"] == 1
+
+    def test_slow_link_adds_latency(self, edge):
+        node, ft = edge
+        ft.install("slow", s=0.05)
+        t0 = time.perf_counter()
+        ft.call(_batch(0, _records(1)))
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_reorder_holds_frame_nacks_true_length_then_delivers(self,
+                                                                 edge):
+        """Out-of-order arrival: the frame is withheld, the sender sees
+        a nack carrying the follower's REAL length (what resync keys
+        off), and the held frame lands before the next call."""
+        node, ft = edge
+        ft.install("reorder", p=1.0)
+        hdr = ft.call(_batch(0, _records(2, seed=6)))
+        assert hdr["k"] == "nack" and hdr["reason"] == "reorder"
+        assert hdr["len"] == 0  # the follower's true length, probed
+        assert node.log_len == 0  # held, not delivered
+        ft.heal("reorder")
+        hdr = ft.call(_frame("probe", {}))
+        # The held batch was delivered FIRST, then the probe ran:
+        assert node.log_len == 2
+        assert hdr["k"] == "ack" and hdr["len"] == 2
+
+    def test_seeded_faults_replay_identically(self, edge):
+        node, _ = edge
+
+        class _Sink:
+            node = None
+
+            def call(self, frame):
+                return {"k": "ack", "len": 0}
+
+        def outcomes(seed):
+            ft = FaultyTransport(_Sink(), edge="f1", seed=seed)
+            ft.install("drop", p=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    ft.call(b"x")
+                    out.append(1)
+                except ReplicationLinkDown:
+                    out.append(0)
+            return out
+
+        assert outcomes(11) == outcomes(11)
+        assert outcomes(11) != outcomes(12)
+
+    def test_plan_dict_and_env_parser_install_per_edge(self, edge,
+                                                       monkeypatch):
+        node, _ = edge
+        monkeypatch.setenv(
+            "FFTPU_LINKFAULTS",
+            "f0:drop@p=0.2;f0:delay@s=0.01,p=0.5;f1:partition")
+        plan = faults.link_fault_plan_from_env()
+        assert plan == {"f0": {"drop": {"p": 0.2},
+                               "delay": {"s": 0.01, "p": 0.5}},
+                        "f1": {"partition": {}}}
+        ft0 = FaultyTransport(ReplicaLink(node), edge="f0", plan=plan)
+        assert set(ft0.faults) == {"drop", "delay"}
+        ft1 = FaultyTransport(ReplicaLink(node), edge="f1", plan=plan)
+        with pytest.raises(ReplicationLinkDown):
+            ft1.call(_frame("probe", {}))
+        # An edge the plan doesn't name gets a clean link.
+        ft2 = FaultyTransport(ReplicaLink(node), edge="f9", plan=plan)
+        assert ft2.faults == {}
+
+    def test_unknown_fault_rejected(self, edge):
+        _, ft = edge
+        with pytest.raises(ValueError, match="unknown link fault"):
+            ft.install("blackhole")
+        assert set(LINK_FAULTS) >= {"drop", "partition", "reorder"}
+
+    def test_wrapper_is_transparent_to_plane_attribute_reads(self, edge):
+        node, ft = edge
+        assert ft.node is node  # link.node passthrough
+        ft.call(_frame("heads", {"entries": [[2, "doc/c", "h2"]]}))
+        assert ft.node.max_hseq == 2
+
+
+# -- fencing on the wire -------------------------------------------------------
+
+
+class TestWireFencing:
+
+    def test_lower_incarnation_refused_over_socket(self, served,
+                                                   tmp_path):
+        """A zombie ex-leader's frames are refused ON THE WIRE: after
+        the follower adopts incarnation N, anything stamped < N nacks
+        ``fenced`` — and the floor is durable, surviving restart."""
+        node, server = served
+        link = NetworkReplicaLink(server.port)
+        try:
+            # New-regime frame adopts the higher incarnation...
+            hdr = link.call(_batch(0, _records(1), inc=3))
+            assert hdr["k"] == "ack"
+            assert link.hello()["incarnation"] == 3
+            # ...and the zombie (stamped lower / unstamped) is refused.
+            hdr = link.call(_batch(1, _records(1), inc=2))
+            assert hdr["k"] == "nack" and hdr["reason"] == "fenced"
+            assert hdr["inc"] == 3  # the floor, for the zombie's logs
+            hdr = link.call(_frame("probe", {}))
+            assert hdr["k"] == "nack" and hdr["reason"] == "fenced"
+            assert node.stats["fenced_frames"] == 2
+            assert node.log_len == 1  # nothing fenced ever appended
+        finally:
+            link.close()
+        node.close()
+        reopened = ReplicaNode(node.data_dir)
+        assert reopened.incarnation == 3  # durable floor
+        reopened.close()
+
+
+# -- degraded mode: park, drain, shed ------------------------------------------
+
+
+class TestDegradedMode:
+    """Manual-drive failure detection (no detector thread): backdate
+    the lease book, call ``heartbeat()`` by hand — deterministic."""
+
+    def _build(self, tmp_path, park_max_s=5.0):
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        node = ReplicaNode(tmp_path / "f0")
+        ft = FaultyTransport(ReplicaLink(node), edge="f0", seed=0)
+        storm, plane = make_replicated_host(
+            "hostA", str(tmp_path / "hostA"), git, [ft], num_docs=8)
+        plane.lease_s = 0.2
+        plane.park_max_s = park_max_s
+        return storm, plane, ft
+
+    def _expire_leases(self, plane):
+        for nid in list(plane._last_ok):
+            plane._last_ok[nid] -= 10.0
+
+    def _one_write(self, storm, doc, cseq, sink):
+        client = storm.service.connect(doc, lambda m: None).client_id
+        storm.service.pump()
+        w = _words([1, cseq])
+        storm.submit_frame(sink, {"rid": cseq,
+                                  "docs": [[doc, client, cseq, 1, K]]},
+                           memoryview(w.tobytes()))
+        storm.flush()
+
+    def test_quorum_loss_parks_writes_then_heal_drains(self, tmp_path):
+        storm, plane, ft = self._build(tmp_path)
+        acks = []
+        try:
+            ft.install("partition")
+            self._expire_leases(plane)
+            assert plane.heartbeat() is False
+            assert plane.quorum_ok is False
+            assert plane.quorum_degraded_s() >= 0.0
+            self._one_write(storm, "doc/p", 1, acks.append)
+            # Parked: locally durable, NOT acked, NOT lost.
+            assert acks == []
+            assert storm.stats.get("quorum_rejects", 0) == 0
+            ft.heal()
+            assert plane.heartbeat() is True  # lease renewed by probe
+            assert plane.quorum_ok is True
+            storm.flush()  # drain the parked round
+            assert [a["rid"] for a in acks] == [1]
+            assert all("error" not in a for a in acks)
+            assert plane.quorum_degraded_s() is None
+        finally:
+            if storm._group_wal is not None:
+                storm._group_wal.close()
+
+    def test_park_past_max_sheds_with_retry_hint(self, tmp_path):
+        storm, plane, ft = self._build(tmp_path, park_max_s=0.0)
+        acks = []
+        try:
+            ft.install("partition")
+            self._expire_leases(plane)
+            assert plane.heartbeat() is False
+            assert plane.quorum_degraded_s() >= 0.0  # degraded clock on
+            self._one_write(storm, "doc/s", 1, acks.append)
+            assert storm.stats["quorum_rejects"] >= 1
+            assert len(acks) == 1
+            assert acks[0]["error"] == "quorum-lost"
+            assert acks[0]["retryable"] is True
+            assert acks[0]["retry_after_s"] > 0
+        finally:
+            if storm._group_wal is not None:
+                storm._group_wal.close()
+
+    def test_heartbeat_resyncs_lagging_follower(self, tmp_path):
+        """The detector is also the repair loop: a follower that missed
+        frames (transient outage) is caught up by the next heartbeat,
+        not only by the next write."""
+        storm, plane, ft = self._build(tmp_path)
+        acks = []
+        try:
+            self._one_write(storm, "doc/r", 1, acks.append)
+            assert len(acks) == 1
+            shipped = ft.node.log_len
+            assert shipped > 0
+            # Simulate a missed tail: follower forgets its lease AND
+            # the plane's acked watermark says it is behind.
+            ft.install("partition")
+            self._one_write(storm, "doc/r", 1 + K, acks.append)
+            assert ft.node.log_len == shipped  # outage: frame lost
+            ft.heal()
+            self._expire_leases(plane)
+            assert plane.heartbeat() is True
+            assert ft.node.log_len == storm._group_wal.durable_len
+            assert plane.stats["resyncs"] >= 1
+        finally:
+            if storm._group_wal is not None:
+                storm._group_wal.close()
+
+
+# -- end to end: a storm serving over real sockets -----------------------------
+
+
+class TestNetworkedHost:
+
+    def test_replicated_host_over_tcp_matches_in_process_follower(
+            self, tmp_path):
+        """``make_replicated_host`` with a ``NetworkReplicaLink``
+        follower: client acks flow over the socket quorum, and the
+        remote replica WAL is bitwise identical to the in-process
+        follower fed by the same plane."""
+        node = ReplicaNode(tmp_path / "fnet", node_id="fnet")
+        server = ReplicaServerThread(node)
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        link = NetworkReplicaLink(server.port)
+        storm, plane = make_replicated_host(
+            "hostA", str(tmp_path / "hostA"), git,
+            [link, str(tmp_path / "floc")], num_docs=8)
+        acks = []
+        try:
+            docs = ["doc/x", "doc/y"]
+            clients = {d: storm.service.connect(d, lambda m: None).client_id
+                       for d in docs}
+            storm.service.pump()
+            cseq = {d: 1 for d in docs}
+            for _ in range(3):
+                for i, d in enumerate(docs):
+                    w = _words([9, cseq[d], i])
+                    storm.submit_frame(
+                        acks.append,
+                        {"rid": (cseq[d], d),
+                         "docs": [[d, clients[d], cseq[d], 1, K]]},
+                        memoryview(w.tobytes()))
+                    cseq[d] += K
+                storm.flush()
+            assert len(acks) == 6
+            assert all("error" not in a for a in acks)
+            local = next(lk for lk in plane.links
+                         if not isinstance(lk, NetworkReplicaLink))
+            assert node.log_len == local.node.log_len > 0
+            assert (_wal_bytes(node.data_dir)
+                    == _wal_bytes(local.node.data_dir))
+            # Wire stats flowed: RTTs recorded, no retransmits needed.
+            ts = link.transport_stats()
+            assert ts["calls"] >= 4 and len(ts["rtt_s"]) >= 3
+            # Checkpoint flips heads through the same socket quorum.
+            storm.checkpoint()
+            link.hello()
+            assert link.max_hseq == local.node.max_hseq > 0
+            assert link.heads == local.node.heads
+        finally:
+            link.close()
+            server.close()
+            node.close()
+            if storm._group_wal is not None:
+                storm._group_wal.close()
+
+    def test_transport_gauges_populated(self, tmp_path):
+        node = ReplicaNode(tmp_path / "f0")
+        server = ReplicaServerThread(node)
+        git = GitSnapshotStore(str(tmp_path / "git"))
+        link = NetworkReplicaLink(server.port)
+        storm, plane = make_replicated_host(
+            "hostA", str(tmp_path / "hostA"), git, [link], num_docs=8)
+        try:
+            link.call(_frame("probe", {}))
+            plane._update_gauges()
+            snap = storm.merge_host.metrics.snapshot()
+            assert snap["transport.links"] == 1
+            assert snap["transport.rtt_p50_ms"] >= 0
+            assert snap["transport.rtt_p99_ms"] >= snap[
+                "transport.rtt_p50_ms"]
+            assert snap["transport.calls"] >= 2
+            assert snap["transport.open_partitions"] == 0
+        finally:
+            link.close()
+            server.close()
+            node.close()
+            if storm._group_wal is not None:
+                storm._group_wal.close()
